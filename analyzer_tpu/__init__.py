@@ -1,0 +1,25 @@
+"""analyzer_tpu — a TPU-native match-rating framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+``vainglorygame/analyzer`` (reference: ``rater.py``, ``worker.py``,
+``worker_test.py``): per-match TrueSkill skill updates, match-quality scoring,
+and win-probability models as jit-compiled pure functions over HBM-resident
+match/player tensors, scaled over a TPU mesh with XLA collectives instead of
+RabbitMQ competing consumers.
+
+Layers (bottom up):
+  ops       closed-form rating kernels (TrueSkill two-team, Elo, quality)
+  core      tensor schemas: match batches (SoA) + player rating state
+  sched     chronology-respecting conflict-free superstep scheduler
+  parallel  device-mesh data parallelism (shard_map + psum over ICI)
+  models    win-probability heads (logistic, MLP) trained with optax
+  io        synthetic/CSV match streams, host feed, checkpointing
+  service   broker/store/worker shell mirroring the reference service
+  rater     reference-compatible object API (get_trueskill_seed, rate_match)
+"""
+
+from analyzer_tpu.config import RatingConfig, ServiceConfig
+
+__version__ = "0.1.0"
+
+__all__ = ["RatingConfig", "ServiceConfig", "__version__"]

@@ -1,0 +1,112 @@
+"""Typed configuration with reference-compatible environment variables.
+
+The reference configures everything through env vars read at import time:
+rating hyperparameters at ``rater.py:10-11`` (``UNKNOWN_PLAYER_SIGMA`` default
+500, ``TAU`` default 1000/100) and twelve service vars at ``worker.py:16-27``.
+We keep the exact same variable names and defaults so a deployment can switch
+frameworks without touching its environment, but read them into frozen
+dataclasses instead of module globals, and validate once at construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Mapping
+
+
+def _env(env: Mapping[str, str] | None) -> Mapping[str, str]:
+    return os.environ if env is None else env
+
+
+@dataclasses.dataclass(frozen=True)
+class RatingConfig:
+    """TrueSkill environment hyperparameters.
+
+    Defaults mirror the reference environment at ``rater.py:30-37``:
+    mu0=1500, sigma0=1000, beta=10/30*3000=1000, tau=TAU, draw_probability=0.
+    ``draw_probability`` must stay 0: the closed-form two-team kernel in
+    :mod:`analyzer_tpu.ops.trueskill` exploits it (no draw margin).
+    """
+
+    mu0: float = 1500.0
+    sigma0: float = 1000.0
+    beta: float = 10.0 / 30.0 * 3000.0
+    tau: float = 1000.0 / 100.0
+    unknown_player_sigma: float = 500.0
+    draw_probability: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.draw_probability != 0.0:
+            raise ValueError(
+                "analyzer_tpu implements the draw_probability=0 closed form "
+                "(the reference fixes draw_probability=0 at rater.py:36)"
+            )
+        if self.beta <= 0 or self.sigma0 <= 0:
+            raise ValueError("beta and sigma0 must be positive")
+
+    @classmethod
+    def from_env(cls, env: Mapping[str, str] | None = None) -> "RatingConfig":
+        """Reads ``UNKNOWN_PLAYER_SIGMA`` and ``TAU`` like ``rater.py:10-11``
+        (empty string falls back to the default, matching ``or``-defaults)."""
+        e = _env(env)
+        return cls(
+            unknown_player_sigma=float(e.get("UNKNOWN_PLAYER_SIGMA") or 500),
+            tau=float(e.get("TAU") or 1000 / 100.0),
+        )
+
+    @property
+    def beta2(self) -> float:
+        return self.beta * self.beta
+
+    @property
+    def tau2(self) -> float:
+        return self.tau * self.tau
+
+
+@dataclasses.dataclass(frozen=True)
+class ServiceConfig:
+    """Service-shell knobs, mirroring ``worker.py:16-27`` name-for-name.
+
+    ``database_uri`` is required there (plain ``os.environ[...]`` KeyError at
+    ``worker.py:17``); here it is optional because the in-memory store and the
+    tensor pipeline do not need a database.
+    """
+
+    rabbitmq_uri: str = "amqp://localhost"
+    database_uri: str | None = None
+    batch_size: int = 500
+    chunk_size: int = 100
+    idle_timeout: float = 1.0
+    queue: str = "analyze"
+    do_crunch_match: bool = False
+    crunch_queue: str = "crunch_global"
+    do_telesuck_match: bool = False
+    telesuck_queue: str = "telesuck"
+    do_sew_match: bool = False
+    sew_queue: str = "sew"
+
+    @classmethod
+    def from_env(cls, env: Mapping[str, str] | None = None) -> "ServiceConfig":
+        e = _env(env)
+        return cls(
+            rabbitmq_uri=e.get("RABBITMQ_URI") or "amqp://localhost",
+            database_uri=e.get("DATABASE_URI"),
+            batch_size=int(e.get("BATCHSIZE") or 500),
+            chunk_size=int(e.get("CHUNKSIZE") or 100),
+            idle_timeout=float(e.get("IDLE_TIMEOUT") or 1),
+            queue=e.get("QUEUE") or "analyze",
+            do_crunch_match=e.get("DOCRUNCHMATCH") == "true",
+            crunch_queue=e.get("CRUNCH_QUEUE") or "crunch_global",
+            do_telesuck_match=e.get("DOTELESUCKMATCH") == "true",
+            telesuck_queue=e.get("TELESUCK_QUEUE") or "telesuck",
+            do_sew_match=e.get("DOSEWMATCH") == "true",
+            sew_queue=e.get("SEW_QUEUE") or "sew",
+        )
+
+    @property
+    def failed_queue(self) -> str:
+        return self.queue + "_failed"
+
+
+DEFAULT_RATING_CONFIG = RatingConfig()

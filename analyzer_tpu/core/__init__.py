@@ -1,0 +1,43 @@
+from analyzer_tpu.core.constants import (
+    MODES,
+    MODE_TO_ID,
+    N_RATING_COLS,
+    RATING_COLUMNS,
+    VST_POINTS,
+    VST_TABLE,
+)
+from analyzer_tpu.core.seeding import trueskill_seed
+from analyzer_tpu.core.state import MAX_TEAM_SIZE, MatchBatch, PlayerState
+from analyzer_tpu.core.update import (
+    RateOutputs,
+    apply_outputs,
+    check_conflict_free,
+    check_skill_tiers,
+    rate_and_apply,
+    rate_and_apply_checked,
+    rate_and_apply_jit,
+    rate_batch,
+    resolve_priors,
+)
+
+__all__ = [
+    "MODES",
+    "MODE_TO_ID",
+    "N_RATING_COLS",
+    "RATING_COLUMNS",
+    "VST_POINTS",
+    "VST_TABLE",
+    "trueskill_seed",
+    "MAX_TEAM_SIZE",
+    "MatchBatch",
+    "PlayerState",
+    "RateOutputs",
+    "apply_outputs",
+    "check_conflict_free",
+    "check_skill_tiers",
+    "rate_and_apply",
+    "rate_and_apply_checked",
+    "rate_and_apply_jit",
+    "rate_batch",
+    "resolve_priors",
+]

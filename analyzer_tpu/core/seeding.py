@@ -1,0 +1,62 @@
+"""Vectorized TrueSkill seeding for players with no rating yet.
+
+Semantics mirror ``get_trueskill_seed`` (``rater.py:42-62``) exactly:
+  * fallback 1 — seed from rank points: take ``max(rank_points_ranked,
+    rank_points_blitz)`` where ``None`` **and** ``0`` both mean "missing"
+    (``rater.py:45-52``); sigma = UNKNOWN_PLAYER_SIGMA * 2/3 ("more accurate
+    than skill tier = more trust"), mu = points + sigma — so the conservative
+    estimate mu - sigma equals the seed points exactly (asserted at
+    ``worker_test.py:86,95,104,113``).
+  * fallback 2 — seed from the skill-tier table: sigma =
+    UNKNOWN_PLAYER_SIGMA, mu = vst_points[tier] + sigma (``rater.py:57-60``).
+
+Tensor-path representation: missing rank points are NaN (0 is additionally
+treated as missing, as above); missing skill tier is encoded as 0 by the
+encoders, which the reference would KeyError on only for tiers outside
+-1..29 — the tensor path clamps to the table range instead (the object API in
+:mod:`analyzer_tpu.rater` preserves the KeyError contract).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.core import constants
+
+
+def trueskill_seed(
+    rank_points_ranked: jnp.ndarray,
+    rank_points_blitz: jnp.ndarray,
+    skill_tier: jnp.ndarray,
+    cfg: RatingConfig,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Elementwise seed over any-shaped feature arrays. Returns (mu, sigma)."""
+    dtype = rank_points_ranked.dtype
+    neg_inf = jnp.asarray(-jnp.inf, dtype)
+
+    rr = jnp.where(
+        jnp.isnan(rank_points_ranked) | (rank_points_ranked == 0),
+        neg_inf,
+        rank_points_ranked,
+    )
+    rb = jnp.where(
+        jnp.isnan(rank_points_blitz) | (rank_points_blitz == 0),
+        neg_inf,
+        rank_points_blitz,
+    )
+    rank_points = jnp.maximum(rr, rb)
+    has_points = rank_points > neg_inf
+
+    sigma_points = jnp.asarray(cfg.unknown_player_sigma * (2.0 / 3.0), dtype)
+    sigma_tier = jnp.asarray(cfg.unknown_player_sigma, dtype)
+
+    table = jnp.asarray(constants.VST_TABLE, dtype)
+    tier_idx = jnp.clip(
+        skill_tier, constants.MIN_SKILL_TIER, constants.MAX_SKILL_TIER
+    ) - constants.MIN_SKILL_TIER
+    tier_points = table[tier_idx]
+
+    sigma = jnp.where(has_points, sigma_points, sigma_tier)
+    mu = jnp.where(has_points, rank_points + sigma_points, tier_points + sigma_tier)
+    return mu, sigma

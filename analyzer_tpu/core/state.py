@@ -1,0 +1,162 @@
+"""HBM-resident rating state and the structure-of-arrays match batch.
+
+The reference's "state" is seven (mu, sigma) column pairs per player row in
+MySQL — the shared ``trueskill`` pair plus one pair per game mode
+(``worker.py:184-190`` and the 5v5 pair supported at ``rater.py:79-82``) —
+plus the seeding features ``rank_points_ranked/blitz`` and ``skill_tier``.
+Here the whole player table lives in device memory as dense arrays (a few
+million players x 7 f32 column pairs is tens of MB — far below one chip's
+HBM), so rating updates are pure gather -> compute -> scatter steps with no
+database round-trip.
+
+Conventions (load-bearing):
+  * NaN encodes SQL NULL ("never rated") in mu/sigma and rank-point columns.
+    The reference branches on ``player.trueskill_mu is not None``
+    (``rater.py:115,124,150``); the tensor path branches on ``~isnan(mu)``.
+  * Every array has one extra trailing **padding row** (index ``n_players``).
+    Empty team slots and masked-out writes target that row, so scatters keep
+    static shapes with no dynamic filtering — the TPU-friendly alternative to
+    ragged batches.
+  * A ``MatchBatch`` packs two teams x ``team_size`` padded slots; 3v3 and
+    5v5 share one compiled kernel via the slot mask (SURVEY.md section 7
+    "static shapes").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analyzer_tpu.core import constants
+
+MAX_TEAM_SIZE = 5
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["mu", "sigma", "rank_points_ranked", "rank_points_blitz", "skill_tier"],
+    meta_fields=[],
+)
+@dataclasses.dataclass
+class PlayerState:
+    """Dense per-player rating state. Row ``n_players`` is the padding row.
+
+    mu, sigma: ``[P+1, 7]`` — column 0 is the shared rating, columns 1..6 the
+    per-mode ratings in :data:`analyzer_tpu.core.constants.MODES` order.
+    """
+
+    mu: jnp.ndarray
+    sigma: jnp.ndarray
+    rank_points_ranked: jnp.ndarray
+    rank_points_blitz: jnp.ndarray
+    skill_tier: jnp.ndarray
+
+    @property
+    def n_players(self) -> int:
+        return self.mu.shape[0] - 1
+
+    @property
+    def pad_row(self) -> int:
+        return self.mu.shape[0] - 1
+
+    @classmethod
+    def create(
+        cls,
+        n_players: int,
+        rank_points_ranked: np.ndarray | None = None,
+        rank_points_blitz: np.ndarray | None = None,
+        skill_tier: np.ndarray | None = None,
+        dtype=jnp.float32,
+    ) -> "PlayerState":
+        """Fresh state: all ratings unset (NaN), features optionally provided.
+
+        Missing rank points are NaN; missing skill tier is 0 (tier 0 seeds to
+        1 point, the reference's floor — ``rater.py:15-16``).
+        """
+        p1 = n_players + 1
+
+        def _feat(x, fill):
+            out = np.full((p1,), fill, dtype=np.float64)
+            if x is not None:
+                out[:n_players] = np.asarray(x, dtype=np.float64)
+            return out
+
+        tiers = np.zeros((p1,), dtype=np.int32)
+        if skill_tier is not None:
+            tiers[:n_players] = np.asarray(skill_tier, dtype=np.int32)
+        return cls(
+            mu=jnp.full((p1, constants.N_RATING_COLS), jnp.nan, dtype=dtype),
+            sigma=jnp.full((p1, constants.N_RATING_COLS), jnp.nan, dtype=dtype),
+            rank_points_ranked=jnp.asarray(_feat(rank_points_ranked, np.nan), dtype=dtype),
+            rank_points_blitz=jnp.asarray(_feat(rank_points_blitz, np.nan), dtype=dtype),
+            skill_tier=jnp.asarray(tiers),
+        )
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["player_idx", "slot_mask", "winner", "mode_id", "afk"],
+    meta_fields=[],
+)
+@dataclasses.dataclass
+class MatchBatch:
+    """A batch of B two-team matches in structure-of-arrays layout.
+
+    player_idx: ``[B, 2, T]`` int32 indices into PlayerState rows (padding
+      slots point at the padding row).
+    slot_mask:  ``[B, 2, T]`` bool, True for real players.
+    winner:     ``[B]`` int32, 0 or 1 — index of the winning team, encoding
+      the reference's ``ranks=[int(not r.winner)]`` (``rater.py:144``).
+    mode_id:    ``[B]`` int32, index into MODES, or -1 for an unsupported
+      mode (the reference logs and skips those, ``rater.py:83-85``).
+    afk:        ``[B]`` bool, the reference's ``anyAfk`` gate — True when any
+      participant went AFK **or** the match does not have exactly two rosters
+      (``rater.py:90-100``).
+    """
+
+    player_idx: jnp.ndarray
+    slot_mask: jnp.ndarray
+    winner: jnp.ndarray
+    mode_id: jnp.ndarray
+    afk: jnp.ndarray
+
+    @property
+    def batch_size(self) -> int:
+        return self.player_idx.shape[0]
+
+    @property
+    def supported(self) -> jnp.ndarray:
+        return self.mode_id >= 0
+
+    @property
+    def ratable(self) -> jnp.ndarray:
+        """Matches that actually get a rating update (``rater.py:102-106``:
+        AFK matches only get quality=0 / any_afk=True side effects)."""
+        return self.supported & ~self.afk
+
+    @classmethod
+    def pad_to(cls, batch: "MatchBatch", size: int, pad_row: int) -> "MatchBatch":
+        """Pads the batch dim to ``size`` with inert matches (all slots
+        masked, unsupported mode) so one kernel shape serves ragged tails."""
+        b = batch.batch_size
+        if b == size:
+            return batch
+        extra = size - b
+        t = batch.player_idx.shape[2]
+        return cls(
+            player_idx=jnp.concatenate(
+                [batch.player_idx, jnp.full((extra, 2, t), pad_row, jnp.int32)]
+            ),
+            slot_mask=jnp.concatenate(
+                [batch.slot_mask, jnp.zeros((extra, 2, t), bool)]
+            ),
+            winner=jnp.concatenate([batch.winner, jnp.zeros((extra,), jnp.int32)]),
+            mode_id=jnp.concatenate(
+                [batch.mode_id, jnp.full((extra,), constants.UNSUPPORTED_MODE_ID, jnp.int32)]
+            ),
+            afk=jnp.concatenate([batch.afk, jnp.zeros((extra,), bool)]),
+        )

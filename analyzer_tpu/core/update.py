@@ -1,0 +1,232 @@
+"""The batched per-match rating step: gather -> rate -> scatter.
+
+This module composes the full semantics of the reference's ``rate_match``
+(``rater.py:69-169``) as one jit-compiled pure function over a
+:class:`~analyzer_tpu.core.state.PlayerState` and a
+:class:`~analyzer_tpu.core.state.MatchBatch`:
+
+  1. prior resolution — shared prior from player state, else the seed
+     (``rater.py:114-121``); queue-specific prior from the mode column, else
+     the shared prior (``rater.py:123-132``);
+  2. match quality from the **queue-specific** matchup — the reference's
+     comment says "shared" but its code passes ``matchup`` (``rater.py:140-141``);
+     we preserve the code's behavior;
+  3. the shared update, written to column 0, with the per-participant
+     ``trueskill_delta`` = change of the conservative estimate mu - sigma,
+     or 0 for a first-ever rating (``rater.py:143-157``);
+  4. the queue-specific update, written to the mode column (``rater.py:159-169``);
+  5. gating — unsupported modes mutate nothing (``rater.py:83-85``); AFK /
+     invalid-roster matches get quality=0 and any_afk=True but **no** rating
+     update (``rater.py:90-106``).
+
+Correctness precondition: no player index may appear twice among the ratable
+matches of one batch (the scatters would collide). The scheduler in
+:mod:`analyzer_tpu.sched` constructs batches with that property; a debug
+helper here asserts it (SURVEY.md section 5.2: race detection is
+correctness-critical on TPU where the reference just raced through MySQL).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.core import constants
+from analyzer_tpu.core.seeding import trueskill_seed
+from analyzer_tpu.core.state import MatchBatch, PlayerState
+from analyzer_tpu.ops import trueskill as ts
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=[
+        "quality",
+        "shared_mu",
+        "shared_sigma",
+        "delta",
+        "mode_mu",
+        "mode_sigma",
+        "any_afk",
+        "write_quality",
+        "updated",
+    ],
+    meta_fields=[],
+)
+@dataclasses.dataclass
+class RateOutputs:
+    """Per-match / per-slot outputs mirroring the reference's writes.
+
+    quality       [B]       -> match.trueskill_quality (0 for AFK matches)
+    shared_mu/.._sigma [B,2,T] -> participant.trueskill_mu/sigma snapshot
+    delta         [B,2,T]   -> participant.trueskill_delta
+    mode_mu/.._sigma   [B,2,T] -> participant_items.trueskill_<mode>_mu/sigma
+    any_afk       [B]       -> participant_items.any_afk (per participant)
+    write_quality [B]       whether quality/any_afk are written at all
+                            (False for unsupported modes and batch padding)
+    updated       [B]       whether ratings were written (ratable matches)
+    """
+
+    quality: jnp.ndarray
+    shared_mu: jnp.ndarray
+    shared_sigma: jnp.ndarray
+    delta: jnp.ndarray
+    mode_mu: jnp.ndarray
+    mode_sigma: jnp.ndarray
+    any_afk: jnp.ndarray
+    write_quality: jnp.ndarray
+    updated: jnp.ndarray
+
+
+def _mode_col(mode_id: jnp.ndarray) -> jnp.ndarray:
+    """Rating-state column for a mode id: column 0 is the shared rating, so
+    mode i lives at column i+1. Unsupported (-1) clamps to column 1; callers
+    must mask those matches out (they never read or write state)."""
+    return jnp.clip(mode_id, 0, None) + 1
+
+
+def resolve_priors(state: PlayerState, batch: MatchBatch, cfg: RatingConfig):
+    """Gathers priors for every slot and applies the seed/shared fallbacks.
+
+    Returns (mu_sh, sigma_sh, mu_q, sigma_q, had_shared) with shape [B,2,T];
+    ``had_shared`` is the reference's ``player.trueskill_mu is not None``
+    test (``rater.py:115,150``) needed for the delta rule.
+    """
+    idx = batch.player_idx  # padding slots already point at the padding row
+    mu_cols = state.mu[idx]  # [B,2,T,C]
+    sigma_cols = state.sigma[idx]
+
+    shared_mu_p = mu_cols[..., constants.SHARED_COL]
+    shared_sigma_p = sigma_cols[..., constants.SHARED_COL]
+
+    mode_col = _mode_col(batch.mode_id)[:, None, None, None]
+    q_mu_p = jnp.take_along_axis(mu_cols, mode_col, axis=-1)[..., 0]
+    q_sigma_p = jnp.take_along_axis(sigma_cols, mode_col, axis=-1)[..., 0]
+
+    seed_mu, seed_sigma = trueskill_seed(
+        state.rank_points_ranked[idx],
+        state.rank_points_blitz[idx],
+        state.skill_tier[idx],
+        cfg,
+    )
+
+    had_shared = ~jnp.isnan(shared_mu_p)
+    mu_sh = jnp.where(had_shared, shared_mu_p, seed_mu)
+    sigma_sh = jnp.where(had_shared, shared_sigma_p, seed_sigma)
+
+    had_mode = ~jnp.isnan(q_mu_p)
+    mu_q = jnp.where(had_mode, q_mu_p, mu_sh)
+    sigma_q = jnp.where(had_mode, q_sigma_p, sigma_sh)
+    return mu_sh, sigma_sh, mu_q, sigma_q, had_shared
+
+
+def rate_batch(state: PlayerState, batch: MatchBatch, cfg: RatingConfig) -> RateOutputs:
+    """Computes all rating outputs for a batch without touching the state."""
+    mu_sh, sigma_sh, mu_q, sigma_q, had_shared = resolve_priors(state, batch, cfg)
+    mask = batch.slot_mask
+
+    quality = ts.quality(mu_q, sigma_q, mask, cfg)  # queue matchup quirk
+    new_sh_mu, new_sh_sigma = ts.two_team_update(mu_sh, sigma_sh, mask, batch.winner, cfg)
+    new_q_mu, new_q_sigma = ts.two_team_update(mu_q, sigma_q, mask, batch.winner, cfg)
+
+    delta = jnp.where(
+        had_shared & mask,
+        (new_sh_mu - new_sh_sigma) - (mu_sh - sigma_sh),
+        0.0,
+    )
+
+    ratable = batch.ratable
+    return RateOutputs(
+        quality=jnp.where(ratable, quality, 0.0),
+        shared_mu=new_sh_mu,
+        shared_sigma=new_sh_sigma,
+        delta=delta,
+        mode_mu=new_q_mu,
+        mode_sigma=new_q_sigma,
+        any_afk=batch.supported & batch.afk,
+        write_quality=batch.supported,
+        updated=ratable,
+    )
+
+
+def apply_outputs(
+    state: PlayerState, batch: MatchBatch, out: RateOutputs
+) -> PlayerState:
+    """Scatters posteriors into the player table. Masked / non-ratable slots
+    are routed to the padding row, so shapes stay static and no collision can
+    occur as long as the batch is conflict-free."""
+    do = out.updated[:, None, None] & batch.slot_mask
+    idx = jnp.where(do, batch.player_idx, state.pad_row)
+
+    mu = state.mu.at[idx, constants.SHARED_COL].set(out.shared_mu)
+    sigma = state.sigma.at[idx, constants.SHARED_COL].set(out.shared_sigma)
+
+    mode_col = jnp.broadcast_to(_mode_col(batch.mode_id)[:, None, None], idx.shape)
+    mu = mu.at[idx, mode_col].set(out.mode_mu)
+    sigma = sigma.at[idx, mode_col].set(out.mode_sigma)
+
+    return dataclasses.replace(state, mu=mu, sigma=sigma)
+
+
+def rate_and_apply(
+    state: PlayerState, batch: MatchBatch, cfg: RatingConfig
+) -> tuple[PlayerState, RateOutputs]:
+    """One superstep: rate a conflict-free batch and commit the posteriors."""
+    out = rate_batch(state, batch, cfg)
+    return apply_outputs(state, batch, out), out
+
+
+rate_and_apply_jit = jax.jit(rate_and_apply, static_argnames=("cfg",))
+
+
+def rate_and_apply_checked(
+    state: PlayerState, batch: MatchBatch, cfg: RatingConfig
+) -> tuple[PlayerState, RateOutputs]:
+    """Entry point for *untrusted* batches (anything not produced by the
+    scheduler in :mod:`analyzer_tpu.sched`, which constructs conflict-free
+    supersteps by construction): host-side race check first, then the jitted
+    step. SURVEY.md section 5.2 — scatter collisions must be impossible or
+    detected."""
+    check_conflict_free(batch)
+    return rate_and_apply_jit(state, batch, cfg)
+
+
+def check_conflict_free(batch: MatchBatch) -> None:
+    """Debug-mode race detector (SURVEY.md section 5.2): asserts no player
+    appears in two ratable matches of one batch. Host-side, not jittable —
+    call it on untrusted batches before the jitted step (or use
+    :func:`rate_and_apply_checked`)."""
+    import numpy as np
+
+    idx = np.asarray(batch.player_idx)
+    mask = np.asarray(batch.slot_mask) & np.asarray(batch.ratable)[:, None, None]
+    flat = idx[mask]
+    uniq, counts = np.unique(flat, return_counts=True)
+    dup = uniq[counts > 1]
+    if dup.size:
+        raise ValueError(
+            f"batch is not conflict-free: player rows {dup[:16].tolist()} appear "
+            "in multiple ratable matches; scatters would collide"
+        )
+
+
+def check_skill_tiers(state: PlayerState) -> None:
+    """Debug check matching the reference's KeyError contract for tiers
+    outside -1..29 (``rater.py:60``): the jitted seed path clamps silently
+    for shape-stability, so run this on ingested state to surface bad rows."""
+    import numpy as np
+
+    tiers = np.asarray(state.skill_tier[: state.n_players])
+    bad = np.where(
+        (tiers < constants.MIN_SKILL_TIER) | (tiers > constants.MAX_SKILL_TIER)
+    )[0]
+    if bad.size:
+        raise KeyError(
+            f"player rows {bad[:16].tolist()} have skill_tier outside "
+            f"[{constants.MIN_SKILL_TIER}, {constants.MAX_SKILL_TIER}] "
+            f"(values {tiers[bad[:16]].tolist()}); the reference raises KeyError "
+            "for these (rater.py:60)"
+        )

@@ -1,0 +1,37 @@
+"""Shared logging setup: INFO/DEBUG to stdout, WARNING+ to stderr.
+
+The reference duplicates this block in both files and marks it
+``# TODO share this between the two classes`` (``rater.py:172-188``,
+``worker.py:202-217``); this module is that TODO done. It also fixes the
+reference's quirk of naming the logger with the literal string ``"__name__"``
+(``rater.py:178``) — loggers here are namespaced per module.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+
+class InfoFilter(logging.Filter):
+    def filter(self, record: logging.LogRecord) -> bool:
+        return record.levelno in (logging.DEBUG, logging.INFO)
+
+
+_configured: set[str] = set()
+
+
+def get_logger(name: str) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if name not in _configured:
+        logger.setLevel(logging.INFO)
+        h1 = logging.StreamHandler(sys.stdout)
+        h1.setLevel(logging.INFO)
+        h1.addFilter(InfoFilter())
+        logger.addHandler(h1)
+        h2 = logging.StreamHandler(sys.stderr)
+        h2.setLevel(logging.WARNING)
+        logger.addHandler(h2)
+        logger.propagate = False
+        _configured.add(name)
+    return logger

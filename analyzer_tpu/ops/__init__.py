@@ -1,0 +1,16 @@
+from analyzer_tpu.ops.normal import cdf, log_pdf, v_win, w_win
+from analyzer_tpu.ops.trueskill import (
+    quality,
+    two_team_update,
+    win_probability,
+)
+
+__all__ = [
+    "cdf",
+    "log_pdf",
+    "v_win",
+    "w_win",
+    "quality",
+    "two_team_update",
+    "win_probability",
+]

@@ -1,0 +1,51 @@
+"""Numerically stable standard-normal helpers for rating updates.
+
+The TrueSkill win update needs the inverse Mills ratio v(t) = phi(t) / Phi(t)
+and w(t) = v(t) * (v(t) + t). Naively dividing pdf by cdf underflows for
+t << 0 (Phi(t) hits 0 in float32 around t = -12, long before real matchups
+stop occurring at sigma0=1000 scale). The reference sidesteps this with
+50-digit mpmath (``rater.py:8``) — three orders of magnitude too slow and not
+TPU-expressible. We instead compute v in log space via ``log_ndtr``:
+
+    v(t) = exp(log phi(t) - log Phi(t))
+
+which is finite and accurate over the whole float range, and clamp w into its
+mathematical range [0, 1]. Everything here is elementwise, fuses into the
+surrounding update kernel, and runs on the VPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.special import log_ndtr, ndtr
+
+_LOG_SQRT_2PI = 0.9189385332046727  # log(sqrt(2*pi))
+
+
+def log_pdf(t: jnp.ndarray) -> jnp.ndarray:
+    return -0.5 * t * t - _LOG_SQRT_2PI
+
+
+def cdf(t: jnp.ndarray) -> jnp.ndarray:
+    return ndtr(t)
+
+
+def v_win(t: jnp.ndarray) -> jnp.ndarray:
+    """phi(t)/Phi(t), stable for arbitrarily negative t.
+
+    For t -> -inf, v(t) -> -t (the update saturates at "move the full
+    surprise"); for t -> +inf, v(t) -> 0.
+    """
+    return jnp.exp(log_pdf(t) - log_ndtr(t))
+
+
+def w_win(t: jnp.ndarray, v: jnp.ndarray | None = None) -> jnp.ndarray:
+    """w(t) = v(t) * (v(t) + t), the variance-shrink factor, in (0, 1).
+
+    Clamped to [0, 1): w -> 1 as t -> -inf and float cancellation in
+    v*(v+t) can otherwise push it epsilon outside the valid range, which
+    would make the posterior variance negative.
+    """
+    if v is None:
+        v = v_win(t)
+    return jnp.clip(v * (v + t), 0.0, 1.0)

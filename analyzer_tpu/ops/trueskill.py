@@ -1,0 +1,133 @@
+"""Closed-form two-team TrueSkill kernels.
+
+The reference rates matches through the generic trueskill 0.4.4 factor graph
+run at 50-digit mpmath precision (``rater.py:30-37,141,144,161``) — iterative
+Gaussian message passing per match on one CPU core. That design cannot run on
+a TPU and does not need to: the reference only ever rates **two** teams
+(``len(match.rosters) != 2`` is rejected, ``rater.py:91``) with
+``draw_probability=0`` (``rater.py:36``), and for that case the factor graph
+converges in a single pass to the closed-form update of Herbrich et al.'s
+original TrueSkill paper:
+
+    c^2   = sum_i (sigma_i^2 + tau^2) + n * beta^2      (all players, n total)
+    t     = (mu_winners - mu_losers) / c
+    v     = phi(t) / Phi(t)        w = v * (v + t)
+    mu_i    <- mu_i +/- (sigma_i^2 + tau^2) / c * v     (+ winners, - losers)
+    sigma_i <- sqrt((sigma_i^2 + tau^2) * (1 - (sigma_i^2 + tau^2) / c^2 * w))
+
+This is a handful of elementwise VPU ops with two small reductions — exactly
+vmappable over a match batch, fusable by XLA, and numerically safe in float32
+via the log-space v/w in :mod:`analyzer_tpu.ops.normal` (replacing the
+reference's 50-digit arbitrary precision).
+
+Shape convention: per-slot arrays are ``[..., 2, T]`` — two teams of up to
+``T`` padded player slots with a boolean ``mask`` selecting real players.
+All functions broadcast over arbitrary leading batch dims.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.ops.normal import cdf, v_win, w_win
+
+_TINY = 1e-20
+
+
+def _masked_sum_stats(mu, sigma2, mask):
+    """Returns (n, sigma2_sum, mu_diff) reduced over the (2, T) team axes."""
+    maskf = mask.astype(mu.dtype)
+    n = maskf.sum(axis=(-2, -1))
+    sigma2_sum = (sigma2 * maskf).sum(axis=(-2, -1))
+    team_mu = (mu * maskf).sum(axis=-1)  # [..., 2]
+    mu_diff = team_mu[..., 0] - team_mu[..., 1]
+    return n, sigma2_sum, mu_diff
+
+
+def two_team_update(
+    mu: jnp.ndarray,
+    sigma: jnp.ndarray,
+    mask: jnp.ndarray,
+    winner: jnp.ndarray,
+    cfg: RatingConfig,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One TrueSkill win/loss update for a (batch of) two-team matches.
+
+    Args:
+      mu, sigma: prior ratings, ``[..., 2, T]``.
+      mask: real-player mask, ``[..., 2, T]`` bool.
+      winner: index (0 or 1) of the winning team, ``[...]`` int. Mirrors the
+        reference's ``ranks=[int(not r.winner) ...]`` (``rater.py:144``):
+        the roster with ``winner=True`` gets the better (lower) rank.
+      cfg: TrueSkill environment (mu0/sigma0/beta/tau).
+
+    Returns posterior (mu, sigma) with masked slots passed through unchanged.
+    """
+    dtype = mu.dtype
+    tau2 = jnp.asarray(cfg.tau2, dtype)
+    beta2 = jnp.asarray(cfg.beta2, dtype)
+
+    s2 = sigma * sigma + tau2  # dynamics-inflated prior variance
+    n, s2_sum, mu_diff = _masked_sum_stats(mu, s2, mask)
+    c2 = jnp.maximum(s2_sum + n * beta2, _TINY)
+    c = jnp.sqrt(c2)
+
+    sign = (1 - 2 * winner).astype(dtype)  # +1 if team 0 won
+    t = sign * mu_diff / c
+    v = v_win(t)
+    w = w_win(t, v)
+
+    # +1 for every slot on the winning team, -1 on the losing team.
+    team_sign = sign[..., None] * jnp.asarray([1.0, -1.0], dtype)  # [..., 2]
+    mu_new = mu + team_sign[..., None] * (s2 / c[..., None, None]) * v[..., None, None]
+    sigma_new = jnp.sqrt(s2 * (1.0 - (s2 / c2[..., None, None]) * w[..., None, None]))
+
+    mu_new = jnp.where(mask, mu_new, mu)
+    sigma_new = jnp.where(mask, sigma_new, sigma)
+    return mu_new, sigma_new
+
+
+def quality(
+    mu: jnp.ndarray,
+    sigma: jnp.ndarray,
+    mask: jnp.ndarray,
+    cfg: RatingConfig,
+) -> jnp.ndarray:
+    """Match-quality (draw-probability) score, ``env.quality`` equivalent.
+
+    For one comparison row A = (1..1, -1..-1) the general matrix expression
+    sqrt(det(beta^2 A A^T) / det(beta^2 A A^T + A Sigma A^T)) *
+    exp(-1/2 mu^T A^T (...)^-1 A mu) collapses to
+
+        q = sqrt(n beta^2 / D) * exp(-(mu_0 - mu_1)^2 / (2 D)),
+        D = n beta^2 + sum_i sigma_i^2
+
+    (no tau inflation — quality evaluates priors as-is, matching trueskill's
+    ``env.quality`` called at ``rater.py:141``). Verified against the dense
+    matrix formula in tests/test_trueskill_ops.py.
+    """
+    dtype = mu.dtype
+    beta2 = jnp.asarray(cfg.beta2, dtype)
+    n, s2_sum, mu_diff = _masked_sum_stats(mu, sigma * sigma, mask)
+    denom = jnp.maximum(n * beta2 + s2_sum, _TINY)
+    return jnp.sqrt(n * beta2 / denom) * jnp.exp(-(mu_diff * mu_diff) / (2.0 * denom))
+
+
+def win_probability(
+    mu: jnp.ndarray,
+    sigma: jnp.ndarray,
+    mask: jnp.ndarray,
+    cfg: RatingConfig,
+) -> jnp.ndarray:
+    """P(team 0 beats team 1) = Phi((mu_0 - mu_1) / c), c^2 = sum sigma^2 + n beta^2.
+
+    The reference has no explicit win-probability output; this is the
+    closed-form head that BASELINE.json config 3 builds on (and the
+    probability whose complement-symmetry is tested).
+    """
+    dtype = mu.dtype
+    beta2 = jnp.asarray(cfg.beta2, dtype)
+    n, s2_sum, mu_diff = _masked_sum_stats(mu, sigma * sigma, mask)
+    c = jnp.sqrt(jnp.maximum(n * beta2 + s2_sum, _TINY))
+    return cdf(mu_diff / c)

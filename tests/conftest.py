@@ -1,0 +1,20 @@
+"""Test harness config: run JAX on a virtual 8-device CPU mesh.
+
+Must run before the first ``import jax`` anywhere in the test session —
+pytest imports conftest first, so setting the env here is sufficient.
+Sharding/mesh tests then exercise real multi-device semantics without TPU
+hardware (SURVEY.md section 4), exactly how the driver's multichip dry-run
+validates the pjit path.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,213 @@
+"""Batched core path: prior resolution, gating, scatter routing, checks.
+
+The load-bearing consistency test: a match rated through the tensor path
+(PlayerState/MatchBatch/rate_and_apply) must produce the same numbers as the
+same match rated through the reference-compatible object API, since both
+express ``rater.py:69-169``.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from analyzer_tpu import rater
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.core import (
+    MatchBatch,
+    PlayerState,
+    check_conflict_free,
+    check_skill_tiers,
+    rate_and_apply_checked,
+    rate_and_apply_jit,
+    rate_batch,
+)
+from analyzer_tpu.core import constants
+from tests.fakes import fake_match, fake_participant, fake_player, fake_roster
+
+CFG = RatingConfig()
+PAD = 12  # 12 players -> padding row index 12
+
+
+def make_state(n=12, tier=15):
+    return PlayerState.create(n, skill_tier=np.full(n, tier))
+
+
+def make_batch(matches, mode=1, team=3):
+    """matches: list of (team0_idx, team1_idx, winner)."""
+    b = len(matches)
+    idx = np.full((b, 2, 5), PAD, np.int32)
+    mask = np.zeros((b, 2, 5), bool)
+    winner = np.zeros((b,), np.int32)
+    for i, (t0, t1, w) in enumerate(matches):
+        idx[i, 0, : len(t0)] = t0
+        idx[i, 1, : len(t1)] = t1
+        mask[i, 0, : len(t0)] = True
+        mask[i, 1, : len(t1)] = True
+        winner[i] = w
+    return MatchBatch(
+        player_idx=jnp.asarray(idx),
+        slot_mask=jnp.asarray(mask),
+        winner=jnp.asarray(winner),
+        mode_id=jnp.full((b,), mode, jnp.int32),
+        afk=jnp.zeros((b,), bool),
+    )
+
+
+class TestTensorObjectConsistency:
+    def test_matches_object_api(self):
+        state = make_state()
+        batch = make_batch([([0, 1, 2], [3, 4, 5], 0)])
+        state2, out = rate_and_apply_jit(state, batch, CFG)
+
+        # the same match through the object API with 6 distinct players
+        def part():
+            return fake_participant(player=fake_player(skill_tier=15))
+
+        match = fake_match(
+            "ranked",
+            [fake_roster(True, [part() for _ in range(3)]),
+             fake_roster(False, [part() for _ in range(3)])],
+        )
+        rater.rate_match(match)
+        obj_winner = match.rosters[0].participants[0].player[0]
+        obj_loser = match.rosters[1].participants[0].player[0]
+
+        assert float(state2.mu[0, 0]) == pytest.approx(obj_winner.trueskill_mu, rel=1e-6)
+        assert float(state2.sigma[0, 0]) == pytest.approx(obj_winner.trueskill_sigma, rel=1e-6)
+        assert float(state2.mu[3, 0]) == pytest.approx(obj_loser.trueskill_mu, rel=1e-6)
+        assert float(state2.mu[0, 2]) == pytest.approx(obj_winner.trueskill_ranked_mu, rel=1e-6)
+        assert float(out.quality[0]) == pytest.approx(match.trueskill_quality, rel=1e-6)
+
+    def test_sequential_supersteps_match_sequential_objects(self):
+        """Two chained matches sharing players: scan order == object order."""
+        state = make_state(6)
+
+        def step(state, t0, t1, w):
+            idx = np.full((1, 2, 5), 6, np.int32)
+            mask = np.zeros((1, 2, 5), bool)
+            idx[0, 0, :3], idx[0, 1, :3] = t0, t1
+            mask[0, :, :3] = True
+            batch = MatchBatch(
+                player_idx=jnp.asarray(idx), slot_mask=jnp.asarray(mask),
+                winner=jnp.asarray([w], jnp.int32),
+                mode_id=jnp.asarray([1], jnp.int32), afk=jnp.asarray([False]))
+            return rate_and_apply_jit(state, batch, CFG)[0]
+
+        state = step(state, [0, 1, 2], [3, 4, 5], 0)
+        state = step(state, [0, 3, 4], [1, 2, 5], 1)  # rematch, mixed teams
+
+        players = [fake_player(skill_tier=15) for _ in range(6)]
+
+        def play(t0, t1, w0):
+            m = fake_match(
+                "ranked",
+                [fake_roster(w0, [fake_participant(player=players[i]) for i in t0]),
+                 fake_roster(not w0, [fake_participant(player=players[i]) for i in t1])],
+            )
+            rater.rate_match(m)
+
+        play([0, 1, 2], [3, 4, 5], True)
+        play([0, 3, 4], [1, 2, 5], False)
+
+        for i, p in enumerate(players):
+            assert float(state.mu[i, 0]) == pytest.approx(p.trueskill_mu, rel=1e-5), i
+            assert float(state.sigma[i, 0]) == pytest.approx(p.trueskill_sigma, rel=1e-5), i
+
+
+class TestGating:
+    def test_afk_match_updates_nothing(self):
+        state = make_state()
+        batch = make_batch([([0, 1, 2], [3, 4, 5], 0)])
+        batch = MatchBatch(
+            player_idx=batch.player_idx, slot_mask=batch.slot_mask,
+            winner=batch.winner, mode_id=batch.mode_id,
+            afk=jnp.asarray([True]))
+        state2, out = rate_and_apply_jit(state, batch, CFG)
+        # real rows untouched (the padding row is scratch by design)
+        assert bool(jnp.isnan(state2.mu[:PAD]).all())
+        assert float(out.quality[0]) == 0.0
+        assert bool(out.any_afk[0])
+        assert not bool(out.updated[0])
+
+    def test_unsupported_mode_writes_nothing(self):
+        state = make_state()
+        batch = make_batch([([0, 1, 2], [3, 4, 5], 0)], mode=-1)
+        state2, out = rate_and_apply_jit(state, batch, CFG)
+        assert bool(jnp.isnan(state2.mu[:PAD]).all())
+        assert not bool(out.write_quality[0])
+        assert not bool(out.any_afk[0])
+
+    def test_mode_column_routing(self):
+        state = make_state()
+        for mode_id, mode in enumerate(constants.MODES):
+            batch = make_batch([([0, 1, 2], [3, 4, 5], 0)], mode=mode_id)
+            state2, _ = rate_and_apply_jit(state, batch, CFG)
+            cols = set(range(constants.N_RATING_COLS))
+            written = {constants.SHARED_COL, mode_id + 1}
+            for c in written:
+                assert not bool(jnp.isnan(state2.mu[0, c])), (mode, c)
+            for c in cols - written:
+                assert bool(jnp.isnan(state2.mu[0, c])), (mode, c)
+
+
+class TestPriorResolution:
+    def test_mode_prior_falls_back_to_shared(self):
+        state = make_state()
+        # give player 0 a shared rating but no ranked rating
+        state.mu.block_until_ready()
+        mu = state.mu.at[0, 0].set(2000.0)
+        sigma = state.sigma.at[0, 0].set(100.0)
+        import dataclasses
+        state = dataclasses.replace(state, mu=mu, sigma=sigma)
+        batch = make_batch([([0, 1, 2], [3, 4, 5], 0)])
+        out = rate_batch(state, batch, CFG)
+        # delta defined only for players with an existing shared rating
+        assert float(out.delta[0, 0, 0]) != 0.0
+        assert float(out.delta[0, 0, 1]) == 0.0
+        # ranked posterior of player 0 must start near the 2000 shared prior
+        assert 1800 < float(out.mode_mu[0, 0, 0]) < 2200
+
+    def test_seed_features_used(self):
+        state = PlayerState.create(
+            12,
+            rank_points_ranked=np.asarray([2500.0] + [np.nan] * 11),
+            skill_tier=np.full(12, 15),
+        )
+        batch = make_batch([([0, 1, 2], [3, 4, 5], 0)])
+        out = rate_batch(state, batch, CFG)
+        # player 0 seeded at mu-sigma = 2500, way above tier-15 teammates
+        assert float(out.shared_mu[0, 0, 0]) > float(out.shared_mu[0, 0, 1])
+
+
+class TestChecks:
+    def test_conflict_detection(self):
+        batch = make_batch([([0, 1, 2], [3, 4, 5], 0), ([0, 6, 7], [8, 9, 10], 0)])
+        with pytest.raises(ValueError, match="conflict-free"):
+            check_conflict_free(batch)
+        with pytest.raises(ValueError, match="conflict-free"):
+            rate_and_apply_checked(make_state(), batch, CFG)
+
+    def test_conflict_ignores_non_ratable(self):
+        batch = make_batch([([0, 1, 2], [3, 4, 5], 0), ([0, 6, 7], [8, 9, 10], 0)])
+        batch = MatchBatch(
+            player_idx=batch.player_idx, slot_mask=batch.slot_mask,
+            winner=batch.winner, mode_id=batch.mode_id,
+            afk=jnp.asarray([False, True]))  # second match AFK -> no scatter
+        check_conflict_free(batch)  # must not raise
+
+    def test_skill_tier_check(self):
+        state = PlayerState.create(3, skill_tier=np.asarray([15, 30, 0]))
+        with pytest.raises(KeyError, match="skill_tier"):
+            check_skill_tiers(state)
+        check_skill_tiers(make_state())  # in-range: no raise
+
+    def test_pad_to_is_inert(self):
+        state = make_state()
+        batch = make_batch([([0, 1, 2], [3, 4, 5], 0)])
+        padded = MatchBatch.pad_to(batch, 4, pad_row=PAD)
+        assert padded.batch_size == 4
+        s1, _ = rate_and_apply_jit(state, batch, CFG)
+        s2, _ = rate_and_apply_jit(state, padded, CFG)
+        np.testing.assert_array_equal(
+            np.asarray(s1.mu[:12]), np.asarray(s2.mu[:12])
+        )

@@ -1,0 +1,163 @@
+"""Kernel-level verification of the closed-form TrueSkill ops.
+
+Three independent oracles, none of them the trueskill library (which is not
+installable here, SURVEY.md section 6):
+
+  1. **Monte-Carlo posterior oracle** — for one linear-threshold observation
+     ("sum of winner performances > sum of loser performances") the TrueSkill
+     EP update is the *exact* Gaussian moment match of the true posterior, so
+     rejection-sampled conditional means/stds must agree with the kernel.
+  2. **Dense matrix oracle for quality** — the general TrueSkill quality
+     expression sqrt(det(b2 A A^T)/det(b2 A A^T + A S A^T)) * exp(-1/2 mu^T
+     A^T (b2 A A^T + A S A^T)^-1 A mu) evaluated with numpy linalg for the
+     two-team comparison matrix.
+  3. **Analytic limits** — v/w asymptotics and invariants (winner up, loser
+     down, sigma shrinks, masked slots inert).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.ops import normal, trueskill as ts
+
+CFG = RatingConfig()
+
+
+def _priors():
+    mu = jnp.asarray([[[1500.0, 1650.0, 1400.0], [1550.0, 1450.0, 1520.0]]])
+    sigma = jnp.asarray([[[1000.0, 400.0, 300.0], [800.0, 500.0, 950.0]]])
+    mask = jnp.ones((1, 2, 3), bool)
+    return mu, sigma, mask
+
+
+class TestNormalHelpers:
+    def test_v_win_extreme_negative_is_finite(self):
+        t = jnp.asarray([-40.0, -12.0, 0.0, 12.0], jnp.float32)
+        v = normal.v_win(t)
+        assert bool(jnp.all(jnp.isfinite(v)))
+        # v(t) -> -t as t -> -inf
+        assert abs(float(v[0]) - 40.0) < 0.1
+        # v(0) = sqrt(2/pi)
+        assert abs(float(v[2]) - np.sqrt(2 / np.pi)) < 1e-5
+        # v decays to 0 for sure wins
+        assert float(v[3]) < 1e-6
+
+    def test_w_win_in_unit_interval(self):
+        t = jnp.linspace(-40.0, 10.0, 101)
+        w = normal.w_win(t)
+        assert bool(jnp.all((w >= 0) & (w <= 1)))
+        assert abs(float(normal.w_win(jnp.asarray(-40.0))) - 1.0) < 1e-3
+
+
+class TestTwoTeamUpdate:
+    def test_directions_and_shrinkage(self):
+        mu, sigma, mask = _priors()
+        new_mu, new_sigma = ts.two_team_update(mu, sigma, mask, jnp.asarray([0]), CFG)
+        assert bool(jnp.all(new_mu[0, 0] > mu[0, 0]))  # winners gain
+        assert bool(jnp.all(new_mu[0, 1] < mu[0, 1]))  # losers lose
+        assert bool(jnp.all(new_sigma < sigma + CFG.tau))  # no blow-up
+        assert bool(jnp.all(new_sigma > 0))
+
+    def test_winner_index_symmetry(self):
+        mu, sigma, mask = _priors()
+        up0 = ts.two_team_update(mu, sigma, mask, jnp.asarray([1]), CFG)
+        # swapping teams and winner index must give the mirrored result
+        mu_sw = mu[:, ::-1]
+        sigma_sw = sigma[:, ::-1]
+        up1 = ts.two_team_update(mu_sw, sigma_sw, mask, jnp.asarray([0]), CFG)
+        np.testing.assert_allclose(np.asarray(up0[0])[:, ::-1], np.asarray(up1[0]), rtol=1e-6)
+
+    def test_masked_slots_inert(self):
+        mu, sigma, _ = _priors()
+        mask = jnp.asarray([[[True, True, False], [True, True, False]]])
+        new_mu, new_sigma = ts.two_team_update(mu, sigma, mask, jnp.asarray([0]), CFG)
+        assert float(new_mu[0, 0, 2]) == float(mu[0, 0, 2])
+        assert float(new_sigma[0, 1, 2]) == float(sigma[0, 1, 2])
+        # and the masked result equals a genuinely smaller match
+        mu2 = mu[:, :, :2]
+        new_mu2, _ = ts.two_team_update(mu2, sigma[:, :, :2], jnp.ones((1, 2, 2), bool),
+                                        jnp.asarray([0]), CFG)
+        np.testing.assert_allclose(np.asarray(new_mu[:, :, :2]), np.asarray(new_mu2),
+                                   rtol=1e-6)
+
+    def test_monte_carlo_posterior(self):
+        """Exact-moment oracle: conditional mean/std of skills given the win."""
+        mu, sigma, mask = _priors()
+        new_mu, new_sigma = ts.two_team_update(mu, sigma, mask, jnp.asarray([0]), CFG)
+
+        rng = np.random.default_rng(7)
+        n = 4_000_000
+        mu_np = np.asarray(mu[0], np.float64)  # [2,3]
+        s2 = np.asarray(sigma[0], np.float64) ** 2 + CFG.tau2
+        skills = rng.normal(mu_np, np.sqrt(s2), size=(n, 2, 3))
+        perfs = skills + rng.normal(0.0, CFG.beta, size=(n, 2, 3))
+        won = perfs[:, 0].sum(-1) > perfs[:, 1].sum(-1)
+        cond = skills[won]
+        mc_mu = cond.mean(0)
+        mc_sigma = cond.std(0)
+
+        np.testing.assert_allclose(np.asarray(new_mu[0]), mc_mu, atol=10.0)
+        np.testing.assert_allclose(np.asarray(new_sigma[0]), mc_sigma, atol=10.0)
+
+    def test_float32_stable_for_huge_upset(self):
+        # an enormous surprise: strong team loses; t << 0 territory where the
+        # reference needed 50-digit mpmath (rater.py:8)
+        mu = jnp.asarray([[[9000.0] * 3, [100.0] * 3]], jnp.float32)
+        sigma = jnp.asarray([[[50.0] * 3, [50.0] * 3]], jnp.float32)
+        mask = jnp.ones((1, 2, 3), bool)
+        new_mu, new_sigma = ts.two_team_update(mu, sigma, mask, jnp.asarray([1]), CFG)
+        assert bool(jnp.all(jnp.isfinite(new_mu)))
+        assert bool(jnp.all(jnp.isfinite(new_sigma)))
+        assert bool(jnp.all(new_sigma > 0))
+        assert float(new_mu[0, 1, 0]) > 100.0  # underdogs gain
+
+
+class TestQuality:
+    def _matrix_quality(self, team_mus, team_sigmas, beta):
+        """General TrueSkill quality via dense linear algebra (the formula the
+        trueskill library implements with its own matrix type)."""
+        flat_mu = np.concatenate([np.asarray(t, np.float64) for t in team_mus])
+        n0, n1 = len(team_mus[0]), len(team_mus[1])
+        # comparison row: +1 for team 0 players, -1 for team 1 players
+        a = np.concatenate([np.ones(n0), -np.ones(n1)])[None, :]
+        s = np.diag(
+            np.concatenate([np.asarray(t, np.float64) ** 2 for t in team_sigmas])
+        )
+        b2ata = beta**2 * (a @ a.T)
+        mid = b2ata + a @ s @ a.T
+        e = np.exp(-0.5 * flat_mu @ a.T @ np.linalg.inv(mid) @ a @ flat_mu)
+        return float(e * np.sqrt(np.linalg.det(b2ata) / np.linalg.det(mid)))
+
+    def test_matches_matrix_formula(self):
+        mu, sigma, mask = _priors()
+        q = float(ts.quality(mu, sigma, mask, CFG)[0])
+        mu_np = np.asarray(mu[0], np.float64)
+        sigma_np = np.asarray(sigma[0], np.float64)
+        q_ref = self._matrix_quality(list(mu_np), list(sigma_np), CFG.beta)
+        assert q == pytest.approx(q_ref, rel=1e-5)
+
+    def test_balanced_match_high_quality(self):
+        mu = jnp.full((1, 2, 3), 1500.0)
+        sigma = jnp.full((1, 2, 3), 100.0)
+        mask = jnp.ones((1, 2, 3), bool)
+        q_bal = float(ts.quality(mu, sigma, mask, CFG)[0])
+        mu_unbal = mu.at[0, 0].add(3000.0)
+        q_unbal = float(ts.quality(mu_unbal, sigma, mask, CFG)[0])
+        assert 0 < q_unbal < q_bal <= 1
+
+
+class TestWinProbability:
+    def test_complement_symmetry(self):
+        mu, sigma, mask = _priors()
+        p = float(ts.win_probability(mu, sigma, mask, CFG)[0])
+        p_sw = float(ts.win_probability(mu[:, ::-1], sigma[:, ::-1], mask, CFG)[0])
+        assert p + p_sw == pytest.approx(1.0, abs=1e-6)
+        assert 0 < p < 1
+
+    def test_stronger_team_favored(self):
+        mu = jnp.asarray([[[2000.0] * 3, [1000.0] * 3]])
+        sigma = jnp.full((1, 2, 3), 200.0)
+        mask = jnp.ones((1, 2, 3), bool)
+        assert float(ts.win_probability(mu, sigma, mask, CFG)[0]) > 0.8
