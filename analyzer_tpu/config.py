@@ -107,6 +107,3 @@ class ServiceConfig:
     @property
     def failed_queue(self) -> str:
         return self.queue + "_failed"
-
-
-DEFAULT_RATING_CONFIG = RatingConfig()

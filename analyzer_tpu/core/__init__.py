@@ -16,6 +16,7 @@ from analyzer_tpu.core.update import (
     rate_and_apply,
     rate_and_apply_checked,
     rate_and_apply_jit,
+    rate_and_apply_step,
     rate_batch,
     resolve_priors,
 )
@@ -38,6 +39,7 @@ __all__ = [
     "rate_and_apply",
     "rate_and_apply_checked",
     "rate_and_apply_jit",
+    "rate_and_apply_step",
     "rate_batch",
     "resolve_priors",
 ]

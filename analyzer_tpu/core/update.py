@@ -181,6 +181,15 @@ def rate_and_apply(
 
 rate_and_apply_jit = jax.jit(rate_and_apply, static_argnames=("cfg",))
 
+# Hot-loop variant: donates the state so XLA scatters into the existing HBM
+# buffers instead of allocating a fresh [P+1, 7] table per superstep. Use in
+# ``state = rate_and_apply_step(state, batch, cfg)[0]`` loops ONLY — the
+# passed-in state is invalidated. (The scan runner in sched.runner donates
+# its whole chunk the same way.)
+rate_and_apply_step = jax.jit(
+    rate_and_apply, static_argnames=("cfg",), donate_argnums=(0,)
+)
+
 
 def rate_and_apply_checked(
     state: PlayerState, batch: MatchBatch, cfg: RatingConfig

@@ -1,0 +1,21 @@
+"""Host-side IO: match streams in and rating state out.
+
+The reference's IO edge is RabbitMQ + MySQL (``worker.py:85-199``); its
+"checkpoint" is the database itself (every batch commit persists all player
+state — SURVEY.md section 5.4). Here the HBM-resident state is volatile, so
+this package provides the replacements: synthetic and CSV match streams for
+feeding the scheduler, and explicit state snapshots with a resume cursor.
+"""
+
+from analyzer_tpu.io.synthetic import synthetic_stream, synthetic_players
+from analyzer_tpu.io.csv_codec import load_stream_csv, save_stream_csv
+from analyzer_tpu.io.checkpoint import load_checkpoint, save_checkpoint
+
+__all__ = [
+    "synthetic_stream",
+    "synthetic_players",
+    "load_stream_csv",
+    "save_stream_csv",
+    "load_checkpoint",
+    "save_checkpoint",
+]

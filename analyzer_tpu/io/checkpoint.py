@@ -1,0 +1,47 @@
+"""Rating-state snapshots with a resume cursor.
+
+The reference needs no checkpoint subsystem because MySQL *is* the
+checkpoint: every batch commit persists all player state, and a restarted
+worker resumes from the broker queue (SURVEY.md section 5.3-5.4). With the
+player table living in HBM, restarts lose state — so snapshots are explicit:
+the full PlayerState plus the stream cursor (index of the next unrated
+match), making re-rate idempotent from any snapshot.
+
+Format: a single ``.npz`` (atomic rename on save). Orbax is a heavier
+dependency than this state shape needs — the whole table is a handful of
+dense arrays — but the layout is orbax-compatible (a flat dict of arrays)
+if sharded async checkpointing becomes necessary at multi-host scale.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from analyzer_tpu.core.state import PlayerState
+
+_FIELDS = ("mu", "sigma", "rank_points_ranked", "rank_points_blitz", "skill_tier")
+_FORMAT_VERSION = 1
+
+
+def save_checkpoint(path: str, state: PlayerState, cursor: int = 0) -> None:
+    """Writes state + cursor atomically (tmp file + rename)."""
+    arrays = {f: np.asarray(getattr(state, f)) for f in _FIELDS}
+    arrays["cursor"] = np.int64(cursor)
+    arrays["format_version"] = np.int64(_FORMAT_VERSION)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, path)
+
+
+def load_checkpoint(path: str) -> tuple[PlayerState, int]:
+    """Returns (state, cursor). Raises on version mismatch."""
+    with np.load(path) as z:
+        version = int(z["format_version"])
+        if version != _FORMAT_VERSION:
+            raise ValueError(f"checkpoint format {version} != {_FORMAT_VERSION}")
+        state = PlayerState(**{f: jnp.asarray(z[f]) for f in _FIELDS})
+        return state, int(z["cursor"])

@@ -1,0 +1,67 @@
+"""CSV match-stream codec (BASELINE config 1: "Elo pairwise rater on
+1k-match CSV").
+
+One row per match: ``match_id,mode,winner,afk,team0,team1`` where the team
+columns are ``;``-separated player ids. Mode is the reference's game-mode
+string (``rater.py:70-82``) — unknown strings map to UNSUPPORTED_MODE_ID and
+are carried through (the reference logs-and-skips them, ``rater.py:83-85``).
+Rows must already be in chronological order, mirroring the reference's
+``ORDER BY created_at ASC`` contract (``worker.py:176``).
+"""
+
+from __future__ import annotations
+
+import csv
+import io as _io
+
+import numpy as np
+
+from analyzer_tpu.core import constants
+from analyzer_tpu.sched.superstep import MatchStream
+
+HEADER = ("match_id", "mode", "winner", "afk", "team0", "team1")
+
+
+def save_stream_csv(path: str, stream: MatchStream) -> None:
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(HEADER)
+        for i in range(stream.n_matches):
+            mode = (
+                constants.MODES[stream.mode_id[i]]
+                if stream.mode_id[i] >= 0
+                else "unsupported"
+            )
+            teams = []
+            for team in range(2):
+                ids = stream.player_idx[i, team]
+                teams.append(";".join(str(x) for x in ids[ids >= 0]))
+            w.writerow([i, mode, int(stream.winner[i]), int(stream.afk[i])] + teams)
+
+
+def load_stream_csv(path_or_file) -> MatchStream:
+    if isinstance(path_or_file, str):
+        with open(path_or_file, newline="") as f:
+            return _parse(f)
+    return _parse(path_or_file)
+
+
+def _parse(f) -> MatchStream:
+    rows = list(csv.reader(f))
+    if rows and tuple(rows[0]) == HEADER:
+        rows = rows[1:]
+    n = len(rows)
+    teams = [[r[4].split(";") if r[4] else [], r[5].split(";") if r[5] else []] for r in rows]
+    t_max = max((max(len(t[0]), len(t[1])) for t in teams), default=1)
+    player_idx = np.full((n, 2, t_max), -1, dtype=np.int32)
+    winner = np.zeros(n, dtype=np.int32)
+    mode_id = np.zeros(n, dtype=np.int32)
+    afk = np.zeros(n, dtype=bool)
+    for i, r in enumerate(rows):
+        mode_id[i] = constants.MODE_TO_ID.get(r[1], constants.UNSUPPORTED_MODE_ID)
+        winner[i] = int(r[2])
+        afk[i] = bool(int(r[3]))
+        for team in range(2):
+            ids = teams[i][team]
+            player_idx[i, team, : len(ids)] = [int(x) for x in ids]
+    return MatchStream(player_idx=player_idx, winner=winner, mode_id=mode_id, afk=afk)

@@ -1,0 +1,113 @@
+"""Synthetic match-history generation for tests and benchmarks.
+
+Produces chronologically ordered streams with the reference's real-world
+shape: a heavy-tailed player-activity distribution (a few very active
+players — the worst case for superstep depth), a mix of 3v3 and 5v5 modes,
+occasional AFK/invalid matches, and seed features (rank points / skill
+tiers) distributed like the reference's fallback paths expect
+(``rater.py:42-62``). Outcomes are sampled from latent skills so the
+win-probability models (BASELINE configs 3-4) have signal to learn.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from analyzer_tpu.core import constants
+from analyzer_tpu.sched.superstep import MatchStream
+
+# 3v3 modes per MODES order: casual, ranked, blitz, br are 3v3; 5v5_* are 5.
+_MODE_TEAM_SIZE = np.array([3, 3, 3, 3, 5, 5], dtype=np.int32)
+
+
+@dataclasses.dataclass
+class SyntheticPlayers:
+    """Latent skills + observable seed features for a synthetic population."""
+
+    latent_skill: np.ndarray  # [P] float64, the "true" skill driving outcomes
+    rank_points_ranked: np.ndarray  # [P] float64, NaN = missing
+    rank_points_blitz: np.ndarray  # [P] float64, NaN = missing
+    skill_tier: np.ndarray  # [P] int32 in [-1, 29]
+
+    @property
+    def n_players(self) -> int:
+        return self.latent_skill.shape[0]
+
+
+def synthetic_players(n_players: int, seed: int = 0) -> SyntheticPlayers:
+    rng = np.random.default_rng(seed)
+    latent = rng.normal(1500.0, 400.0, n_players)
+    # ~40% of players have rank points (fallback 1); the rest seed from tier.
+    has_ranked = rng.random(n_players) < 0.35
+    has_blitz = rng.random(n_players) < 0.15
+    rp_ranked = np.where(has_ranked, np.clip(latent + rng.normal(0, 150, n_players), 1, None), np.nan)
+    rp_blitz = np.where(has_blitz, np.clip(latent + rng.normal(0, 200, n_players), 1, None), np.nan)
+    # Skill tier loosely tracks latent skill, clipped to the table range.
+    tier = np.clip(((latent - 600.0) / 85.0).astype(np.int32), -1, 29)
+    return SyntheticPlayers(
+        latent_skill=latent,
+        rank_points_ranked=rp_ranked,
+        rank_points_blitz=rp_blitz,
+        skill_tier=tier.astype(np.int32),
+    )
+
+
+def synthetic_stream(
+    n_matches: int,
+    players: SyntheticPlayers,
+    seed: int = 0,
+    afk_rate: float = 0.02,
+    unsupported_rate: float = 0.005,
+    activity_concentration: float = 1.2,
+) -> MatchStream:
+    """Samples a chronologically ordered stream of two-team matches.
+
+    Player selection is Zipf-flavored (``activity_concentration`` > 1 skews
+    toward a hot head of active players, deepening the superstep dependency
+    chain like real ladder traffic would). Winners are sampled from the
+    latent-skill gap through a logistic link.
+    """
+    rng = np.random.default_rng(seed)
+    p = players.n_players
+    n = n_matches
+
+    # Heavy-tailed activity weights.
+    ranks = np.arange(1, p + 1, dtype=np.float64)
+    weights = 1.0 / ranks**activity_concentration
+    rng.shuffle(weights)
+    weights /= weights.sum()
+
+    mode_id = rng.integers(0, constants.N_MODES, n).astype(np.int32)
+    unsupported = rng.random(n) < unsupported_rate
+    mode_id[unsupported] = constants.UNSUPPORTED_MODE_ID
+    team_size = np.where(mode_id >= 0, _MODE_TEAM_SIZE[np.clip(mode_id, 0, None)], 3)
+
+    t_max = int(team_size.max()) if n else 3
+    player_idx = np.full((n, 2, t_max), -1, dtype=np.int32)
+    afk = rng.random(n) < afk_rate
+
+    # Sample 2*team_size distinct players per match (vectorized draw with
+    # rejection fix-up for the rare duplicate).
+    flat = rng.choice(p, size=(n, 2 * t_max), p=weights)
+    for i in range(n):
+        k = 2 * team_size[i]
+        row = flat[i, :k]
+        uniq = np.unique(row)
+        while uniq.size < k:
+            extra = rng.choice(p, size=k - uniq.size, p=weights)
+            uniq = np.unique(np.concatenate([uniq, extra]))
+        row = rng.permutation(uniq[:k])
+        player_idx[i, 0, : team_size[i]] = row[: team_size[i]]
+        player_idx[i, 1, : team_size[i]] = row[team_size[i] : k]
+
+    # Outcome from latent skills: P(team0 wins) = logistic(gap / scale).
+    skill = players.latent_skill
+    masked = player_idx >= 0
+    team_skill = np.where(masked, skill[np.clip(player_idx, 0, None)], 0.0).sum(axis=2)
+    gap = team_skill[:, 0] - team_skill[:, 1]
+    p_win = 1.0 / (1.0 + np.exp(-gap / (400.0 * np.maximum(team_size, 1))))
+    winner = (rng.random(n) >= p_win).astype(np.int32)  # 0 if team0 wins
+
+    return MatchStream(player_idx=player_idx, winner=winner, mode_id=mode_id, afk=afk)

@@ -1,0 +1,28 @@
+"""Chronology-respecting scheduling of the match stream onto the TPU.
+
+The reference processes matches strictly in ``created_at`` order inside a
+single-threaded loop (``worker.py:176,191-192``) because ratings are a
+temporal recurrence: the posterior of match *t* is the prior of match *t+1*
+for every shared player. Naively vmapping a batch of matches breaks that
+(SURVEY.md section 7, hard part #1). This package turns the time-ordered
+stream into **conflict-free supersteps** — maximal groups of matches with no
+shared player, each safely rated as one batched kernel call — and drives a
+``lax.scan`` over the packed steps.
+"""
+
+from analyzer_tpu.sched.superstep import (
+    MatchStream,
+    PackedSchedule,
+    assign_supersteps,
+    pack_schedule,
+)
+from analyzer_tpu.sched.runner import HistoryOutputs, rate_history
+
+__all__ = [
+    "MatchStream",
+    "PackedSchedule",
+    "assign_supersteps",
+    "pack_schedule",
+    "HistoryOutputs",
+    "rate_history",
+]

@@ -1,0 +1,111 @@
+"""The device-side history runner: ``lax.scan`` over packed supersteps.
+
+Replaces the reference's per-match Python loop (``worker.py:191-192``) with
+one compiled scan: each scan iteration gathers priors for a whole
+conflict-free superstep, applies the closed-form TrueSkill updates, and
+scatters posteriors back into the HBM-resident player table. The scan
+carries only the PlayerState; per-match outputs are optionally collected and
+scattered back into stream (chronological) order by ``match_idx``.
+
+Large histories stream through in chunks of steps so the packed schedule
+never has to fit in HBM at once (the reference's CHUNKSIZE/yield_per idea,
+``worker.py:191``, at superstep granularity); the state buffer is donated
+between chunks so XLA updates it in place.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.core.state import MatchBatch, PlayerState
+from analyzer_tpu.core.update import rate_and_apply
+from analyzer_tpu.sched.superstep import PackedSchedule
+
+
+@dataclasses.dataclass
+class HistoryOutputs:
+    """Per-match outputs in stream order (numpy, host-side).
+
+    Mirrors what the reference persists per match/participant
+    (``rater.py:140-169``): match quality, shared posterior snapshot +
+    conservative-estimate delta, mode posterior, and the any_afk flag.
+    Rows for matches that were not rated (AFK/unsupported) hold the gate
+    outputs only; ``updated`` marks rows whose ratings were written.
+    """
+
+    quality: np.ndarray  # [N]
+    shared_mu: np.ndarray  # [N, 2, T]
+    shared_sigma: np.ndarray  # [N, 2, T]
+    delta: np.ndarray  # [N, 2, T]
+    mode_mu: np.ndarray  # [N, 2, T]
+    mode_sigma: np.ndarray  # [N, 2, T]
+    any_afk: np.ndarray  # [N]
+    updated: np.ndarray  # [N]
+
+
+@partial(jax.jit, static_argnames=("cfg", "collect"), donate_argnums=(0,))
+def _scan_chunk(state: PlayerState, arrays, cfg: RatingConfig, collect: bool):
+    """Scans rate_and_apply over a [S', B, ...] slab of supersteps."""
+
+    def step(st, xs):
+        pidx, mask, winner, mode, afk = xs
+        batch = MatchBatch(
+            player_idx=pidx, slot_mask=mask, winner=winner, mode_id=mode, afk=afk
+        )
+        st, out = rate_and_apply(st, batch, cfg)
+        return st, out if collect else None
+
+    return jax.lax.scan(step, state, arrays)
+
+
+def rate_history(
+    state: PlayerState,
+    sched: PackedSchedule,
+    cfg: RatingConfig,
+    collect: bool = False,
+    steps_per_chunk: int = 1024,
+) -> tuple[PlayerState, HistoryOutputs | None]:
+    """Rates a full packed history. Returns the final state and, when
+    ``collect``, per-match outputs reordered back to stream order."""
+    n_steps = sched.n_steps
+    # The chunked scan donates its carry; copy once at entry so the caller's
+    # state stays valid (the table is small — tens of MB at 10M players).
+    state = jax.tree.map(jnp.copy, state)
+    outs = [] if collect else None
+    for start in range(0, n_steps, steps_per_chunk):
+        stop = min(start + steps_per_chunk, n_steps)
+        arrays = sched.device_arrays(start, stop)
+        state, ys = _scan_chunk(state, arrays, cfg, collect)
+        if collect:
+            outs.append(jax.tree.map(np.asarray, ys))
+    if not collect:
+        return state, None
+
+    n = sched.n_matches
+    flat_idx = sched.match_idx.reshape(-1)
+    sel = flat_idx >= 0
+    dest = flat_idx[sel]
+
+    def gather(field):
+        full = np.concatenate([getattr(y, field) for y in outs], axis=0)
+        full = full.reshape((-1,) + full.shape[2:])  # [S*B, ...]
+        out = np.zeros((n,) + full.shape[1:], dtype=full.dtype)
+        out[dest] = full[sel]
+        return out
+
+    return state, HistoryOutputs(
+        quality=gather("quality"),
+        shared_mu=gather("shared_mu"),
+        shared_sigma=gather("shared_sigma"),
+        delta=gather("delta"),
+        mode_mu=gather("mode_mu"),
+        mode_sigma=gather("mode_sigma"),
+        any_afk=gather("any_afk"),
+        updated=gather("updated"),
+    )

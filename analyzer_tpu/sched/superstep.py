@@ -1,0 +1,259 @@
+"""Conflict-free superstep construction (host-side).
+
+A *superstep* is a set of matches in which no player appears twice, so the
+whole set can be rated by one gather -> update -> scatter kernel call without
+scatter collisions, while still respecting per-player chronology across
+steps. The assignment is the ASAP (as-soon-as-possible) schedule of the
+match dependency chain:
+
+    step(match) = 1 + max(step(previous match of each of its players))
+
+which is provably minimal in step count for a schedule that preserves every
+player's match order, and conflict-free by construction (a player's next
+match always lands in a strictly later step than their previous one).
+
+Matches that never touch rating state — unsupported modes and AFK/invalid
+matches (``rater.py:83-85,90-106``) — impose no dependencies: their outputs
+(quality=0, any_afk) do not read priors. They are assigned to whatever step
+has room, keeping occupancy high.
+
+The assignment loop is a sequential recurrence over the stream and is the
+host-side hot path for a full-history re-rate; a C++ implementation is used
+when built (:mod:`analyzer_tpu.sched._native`), with this numpy/python
+version as the always-available fallback.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from analyzer_tpu.core import constants
+from analyzer_tpu.core.state import MAX_TEAM_SIZE, MatchBatch
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class MatchStream:
+    """N matches in chronological (``created_at`` ascending) order, SoA.
+
+    player_idx: ``[N, 2, T]`` int32 rows into the player table; -1 marks an
+      empty (padded) team slot.
+    winner:     ``[N]`` 0/1 winning-team index.
+    mode_id:    ``[N]`` index into :data:`analyzer_tpu.core.constants.MODES`,
+      or -1 for an unsupported mode.
+    afk:        ``[N]`` bool — any participant AFK or roster count != 2.
+    """
+
+    player_idx: np.ndarray
+    winner: np.ndarray
+    mode_id: np.ndarray
+    afk: np.ndarray
+
+    def __post_init__(self) -> None:
+        self.player_idx = np.ascontiguousarray(self.player_idx, dtype=np.int32)
+        self.winner = np.ascontiguousarray(self.winner, dtype=np.int32)
+        self.mode_id = np.ascontiguousarray(self.mode_id, dtype=np.int32)
+        self.afk = np.ascontiguousarray(self.afk, dtype=bool)
+        if self.player_idx.ndim != 3 or self.player_idx.shape[1] != 2:
+            raise ValueError(f"player_idx must be [N, 2, T], got {self.player_idx.shape}")
+
+    @property
+    def n_matches(self) -> int:
+        return self.player_idx.shape[0]
+
+    @property
+    def team_size(self) -> int:
+        return self.player_idx.shape[2]
+
+    @property
+    def ratable(self) -> np.ndarray:
+        return (self.mode_id >= 0) & ~self.afk
+
+    def slice(self, start: int, stop: int) -> "MatchStream":
+        return MatchStream(
+            self.player_idx[start:stop],
+            self.winner[start:stop],
+            self.mode_id[start:stop],
+            self.afk[start:stop],
+        )
+
+
+@dataclasses.dataclass
+class PackedSchedule:
+    """The stream packed into ``[S, B, ...]`` static-shape superstep batches.
+
+    match_idx ``[S, B]`` maps each packed slot back to its stream position
+    (-1 for padding) so per-match outputs can be scattered back into
+    chronological order. ``player_idx`` padding slots already point at
+    ``pad_row`` (the player-table padding row), ready for the device gather.
+    """
+
+    player_idx: np.ndarray  # [S, B, 2, T] int32
+    slot_mask: np.ndarray  # [S, B, 2, T] bool
+    winner: np.ndarray  # [S, B] int32
+    mode_id: np.ndarray  # [S, B] int32
+    afk: np.ndarray  # [S, B] bool
+    match_idx: np.ndarray  # [S, B] int32
+    pad_row: int
+
+    @property
+    def n_steps(self) -> int:
+        return self.player_idx.shape[0]
+
+    @property
+    def batch_size(self) -> int:
+        return self.player_idx.shape[1]
+
+    @property
+    def n_matches(self) -> int:
+        return int((self.match_idx >= 0).sum())
+
+    @property
+    def occupancy(self) -> float:
+        """Fraction of packed slots holding real matches — the efficiency of
+        the schedule (padding slots burn identical FLOPs)."""
+        return self.n_matches / max(self.match_idx.size, 1)
+
+    def step_batch(self, s: int) -> MatchBatch:
+        """Materializes superstep ``s`` as a device MatchBatch."""
+        return MatchBatch(
+            player_idx=jnp.asarray(self.player_idx[s]),
+            slot_mask=jnp.asarray(self.slot_mask[s]),
+            winner=jnp.asarray(self.winner[s]),
+            mode_id=jnp.asarray(self.mode_id[s]),
+            afk=jnp.asarray(self.afk[s]),
+        )
+
+    def device_arrays(self, start: int = 0, stop: int | None = None):
+        """The ``[S', B, ...]`` slab for a lax.scan over steps start..stop."""
+        sl = slice(start, stop)
+        return (
+            jnp.asarray(self.player_idx[sl]),
+            jnp.asarray(self.slot_mask[sl]),
+            jnp.asarray(self.winner[sl]),
+            jnp.asarray(self.mode_id[sl]),
+            jnp.asarray(self.afk[sl]),
+        )
+
+
+def assign_supersteps(stream: MatchStream) -> np.ndarray:
+    """ASAP superstep index per match, ``[N]`` int64. Non-ratable matches get
+    step -1 (meaning "no dependency — place anywhere")."""
+    try:
+        from analyzer_tpu.sched import _native
+
+        return _native.assign_supersteps(stream)
+    except ImportError:
+        return _assign_supersteps_py(stream)
+
+
+def _assign_supersteps_py(stream: MatchStream) -> np.ndarray:
+    n = stream.n_matches
+    steps = np.full(n, -1, dtype=np.int64)
+    if n == 0:
+        return steps
+    n_players = int(stream.player_idx.max()) + 1 if n else 0
+    # last_step[p] = superstep of p's most recent ratable match, -1 if none.
+    last_step = np.full(max(n_players, 1), -1, dtype=np.int64)
+    ratable = stream.ratable
+    idx = stream.player_idx
+    for i in range(n):
+        if not ratable[i]:
+            continue
+        players = idx[i].ravel()
+        players = players[players >= 0]
+        s = last_step[players].max() + 1 if players.size else 0
+        steps[i] = s
+        last_step[players] = s
+    return steps
+
+
+def pack_schedule(
+    stream: MatchStream,
+    pad_row: int,
+    batch_size: int = 512,
+    team_size: int = MAX_TEAM_SIZE,
+) -> PackedSchedule:
+    """Packs a stream into ``[S, B, ...]`` superstep batches.
+
+    Steps whose match count exceeds ``batch_size`` are split into several
+    consecutive batches (still conflict-free — subsets of a conflict-free set).
+    Non-ratable matches are backfilled into padding slots of existing batches
+    wherever there is room (their relative order does not matter: they read
+    and write no rating state), falling back to extra batches if needed.
+    """
+    n = stream.n_matches
+    t_in = stream.team_size
+    if t_in > team_size:
+        raise ValueError(f"stream team size {t_in} exceeds pack team size {team_size}")
+    steps = assign_supersteps(stream)
+
+    ratable_order = np.flatnonzero(steps >= 0)
+    # Stable sort by step: within a step, stream order is preserved.
+    ratable_order = ratable_order[np.argsort(steps[ratable_order], kind="stable")]
+    filler = np.flatnonzero(steps < 0)
+
+    # Number of batches per step after splitting oversize steps.
+    if ratable_order.size:
+        step_ids, counts = np.unique(steps[ratable_order], return_counts=True)
+        batches_per_step = -(-counts // batch_size)  # ceil
+        n_rate_batches = int(batches_per_step.sum())
+    else:
+        n_rate_batches = 0
+
+    # Free slots left in those batches, to backfill with non-ratable matches.
+    free = n_rate_batches * batch_size - ratable_order.size
+    extra_batches = max(0, -(-(filler.size - free) // batch_size)) if filler.size else 0
+    s_total = max(n_rate_batches + extra_batches, 1)
+
+    shape_bt = (s_total, batch_size)
+    out = PackedSchedule(
+        player_idx=np.full(shape_bt + (2, team_size), pad_row, dtype=np.int32),
+        slot_mask=np.zeros(shape_bt + (2, team_size), dtype=bool),
+        winner=np.zeros(shape_bt, dtype=np.int32),
+        mode_id=np.full(shape_bt, constants.UNSUPPORTED_MODE_ID, dtype=np.int32),
+        afk=np.zeros(shape_bt, dtype=bool),
+        match_idx=np.full(shape_bt, -1, dtype=np.int32),
+        pad_row=pad_row,
+    )
+
+    # Flat slot assignment: ratable matches fill batches front-to-back in
+    # step order; fillers take every remaining slot.
+    slot_of = np.empty(ratable_order.size + filler.size, dtype=np.int64)
+    pos = 0
+    if ratable_order.size:
+        b = 0  # current batch
+        used = 0  # slots used in current batch
+        prev_step = steps[ratable_order[0]]
+        for mi in ratable_order:
+            s = steps[mi]
+            if s != prev_step or used == batch_size:
+                b += 1
+                used = 0
+                prev_step = s
+            slot_of[pos] = b * batch_size + used
+            used += 1
+            pos += 1
+    if filler.size:
+        all_slots = np.arange(s_total * batch_size)
+        taken = np.zeros(s_total * batch_size, dtype=bool)
+        taken[slot_of[:pos]] = True
+        free_slots = all_slots[~taken]
+        slot_of[pos : pos + filler.size] = free_slots[: filler.size]
+
+    order = np.concatenate([ratable_order, filler]).astype(np.int64)
+    flat = slot_of[: order.size]
+    bi, si = np.divmod(flat, batch_size)
+
+    mask_in = stream.player_idx >= 0
+    pidx = np.where(mask_in, stream.player_idx, pad_row)
+    out.player_idx[bi, si, :, :t_in] = pidx[order]
+    out.slot_mask[bi, si, :, :t_in] = mask_in[order]
+    out.winner[bi, si] = stream.winner[order]
+    out.mode_id[bi, si] = stream.mode_id[order]
+    out.afk[bi, si] = stream.afk[order]
+    out.match_idx[bi, si] = order.astype(np.int32)
+    return out

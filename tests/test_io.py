@@ -1,0 +1,75 @@
+"""IO: synthetic stream properties, CSV roundtrip, checkpoint roundtrip."""
+
+import numpy as np
+
+from analyzer_tpu.core import constants
+from analyzer_tpu.core.state import PlayerState
+from analyzer_tpu.io.checkpoint import load_checkpoint, save_checkpoint
+from analyzer_tpu.io.csv_codec import load_stream_csv, save_stream_csv
+from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
+
+
+class TestSynthetic:
+    def test_stream_shape_and_ranges(self):
+        players = synthetic_players(50, seed=1)
+        s = synthetic_stream(200, players, seed=1)
+        assert s.n_matches == 200
+        assert s.player_idx.shape[1] == 2
+        assert ((s.winner == 0) | (s.winner == 1)).all()
+        assert s.mode_id.max() < constants.N_MODES
+        assert (s.player_idx < 50).all()
+
+    def test_team_sizes_match_mode(self):
+        players = synthetic_players(100, seed=2)
+        s = synthetic_stream(300, players, seed=2)
+        sizes = (s.player_idx >= 0).sum(axis=2)
+        three = (s.mode_id >= 0) & (s.mode_id < 4)
+        five = s.mode_id >= 4
+        assert (sizes[three] == 3).all()
+        assert (sizes[five] == 5).all()
+
+    def test_no_duplicate_players_within_match(self):
+        players = synthetic_players(30, seed=3)
+        s = synthetic_stream(100, players, seed=3)
+        for i in range(s.n_matches):
+            ids = s.player_idx[i][s.player_idx[i] >= 0]
+            assert len(np.unique(ids)) == len(ids)
+
+    def test_seed_features_present(self):
+        players = synthetic_players(500, seed=4)
+        assert np.isfinite(players.rank_points_ranked).any()
+        assert np.isnan(players.rank_points_ranked).any()
+        assert players.skill_tier.min() >= constants.MIN_SKILL_TIER
+        assert players.skill_tier.max() <= constants.MAX_SKILL_TIER
+
+
+class TestCsv:
+    def test_roundtrip(self, tmp_path):
+        players = synthetic_players(40, seed=5)
+        s = synthetic_stream(120, players, seed=5)
+        path = str(tmp_path / "stream.csv")
+        save_stream_csv(path, s)
+        r = load_stream_csv(path)
+        assert r.n_matches == s.n_matches
+        np.testing.assert_array_equal(r.winner, s.winner)
+        np.testing.assert_array_equal(r.mode_id, s.mode_id)
+        np.testing.assert_array_equal(r.afk, s.afk)
+        # player sets per team identical (padding layout may differ)
+        for i in range(s.n_matches):
+            for t in range(2):
+                a = sorted(s.player_idx[i, t][s.player_idx[i, t] >= 0].tolist())
+                b = sorted(r.player_idx[i, t][r.player_idx[i, t] >= 0].tolist())
+                assert a == b
+
+
+class TestCheckpoint:
+    def test_roundtrip(self, tmp_path):
+        state = PlayerState.create(10, skill_tier=np.full(10, 5))
+        path = str(tmp_path / "ck.npz")
+        save_checkpoint(path, state, cursor=42)
+        restored, cursor = load_checkpoint(path)
+        assert cursor == 42
+        np.testing.assert_array_equal(
+            np.asarray(state.skill_tier), np.asarray(restored.skill_tier)
+        )
+        assert np.isnan(np.asarray(restored.mu)).all()

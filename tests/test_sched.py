@@ -1,0 +1,196 @@
+"""Scheduler: superstep construction, packing, and the scan runner.
+
+The load-bearing test is the *sequential oracle*: the superstep-scheduled
+run over a synthetic history must produce exactly the state a one-match-at-
+a-time run produces (the reference's semantics — a strict chronological loop,
+``worker.py:191-192``). That proves both conflict-freedom and ordering.
+"""
+
+import numpy as np
+import pytest
+
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.core.state import PlayerState
+from analyzer_tpu.core.update import check_conflict_free, rate_and_apply_jit
+from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
+from analyzer_tpu.sched import (
+    MatchStream,
+    assign_supersteps,
+    pack_schedule,
+    rate_history,
+)
+
+CFG = RatingConfig()
+
+
+def small_stream(n_matches=120, n_players=30, seed=3):
+    players = synthetic_players(n_players, seed=seed)
+    stream = synthetic_stream(n_matches, players, seed=seed)
+    state = PlayerState.create(
+        n_players,
+        rank_points_ranked=players.rank_points_ranked,
+        rank_points_blitz=players.rank_points_blitz,
+        skill_tier=players.skill_tier,
+    )
+    return stream, state
+
+
+def sequential_oracle(state, stream, cfg=CFG):
+    """Rates the stream one match at a time, in order — the reference loop."""
+    sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=1)
+    # batch_size=1 packing may reorder non-ratable matches, which is
+    # irrelevant to state; but ratable ones stay in stream order per player.
+    for s in range(sched.n_steps):
+        state, _ = rate_and_apply_jit(state, sched.step_batch(s), cfg)
+    return state
+
+
+class TestAssignment:
+    def test_no_player_twice_per_step(self):
+        stream, _ = small_stream()
+        steps = assign_supersteps(stream)
+        ratable = stream.ratable
+        for s in np.unique(steps[steps >= 0]):
+            sel = np.flatnonzero((steps == s) & ratable)
+            ids = stream.player_idx[sel]
+            ids = ids[ids >= 0]
+            assert len(np.unique(ids)) == len(ids), f"collision in step {s}"
+
+    def test_per_player_chronology(self):
+        stream, _ = small_stream()
+        steps = assign_supersteps(stream)
+        # for every player, step indices of their ratable matches are strictly
+        # increasing in stream order
+        last = {}
+        for i in range(stream.n_matches):
+            if steps[i] < 0:
+                continue
+            for p in stream.player_idx[i].ravel():
+                if p < 0:
+                    continue
+                assert steps[i] > last.get(p, -1)
+                last[p] = steps[i]
+
+    def test_nonratable_unconstrained(self):
+        stream, _ = small_stream()
+        steps = assign_supersteps(stream)
+        assert (steps[~stream.ratable] == -1).all()
+        assert (steps[stream.ratable] >= 0).all()
+
+    def test_disjoint_matches_one_step(self):
+        # 4 matches over 24 distinct players -> all fit in step 0
+        idx = np.arange(24, dtype=np.int32).reshape(4, 2, 3)
+        stream = MatchStream(
+            player_idx=idx,
+            winner=np.zeros(4, np.int32),
+            mode_id=np.ones(4, np.int32),
+            afk=np.zeros(4, bool),
+        )
+        assert (assign_supersteps(stream) == 0).all()
+
+    def test_chain_depth(self):
+        # same two teams 5 times -> 5 sequential steps
+        idx = np.tile(np.arange(6, dtype=np.int32).reshape(1, 2, 3), (5, 1, 1))
+        stream = MatchStream(
+            player_idx=idx,
+            winner=np.zeros(5, np.int32),
+            mode_id=np.ones(5, np.int32),
+            afk=np.zeros(5, bool),
+        )
+        assert assign_supersteps(stream).tolist() == [0, 1, 2, 3, 4]
+
+
+class TestPacking:
+    def test_batches_conflict_free_and_complete(self):
+        stream, state = small_stream()
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=16)
+        assert sched.n_matches == stream.n_matches
+        seen = sched.match_idx[sched.match_idx >= 0]
+        assert sorted(seen.tolist()) == list(range(stream.n_matches))
+        for s in range(sched.n_steps):
+            check_conflict_free(sched.step_batch(s))
+
+    def test_padding_slots_inert(self):
+        stream, state = small_stream(n_matches=10)
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=64)
+        pad = sched.match_idx < 0
+        assert (sched.mode_id[pad] == -1).all()
+        assert (~sched.slot_mask[pad]).all()
+        assert (sched.player_idx[pad] == state.pad_row).all()
+
+    def test_oversize_step_split(self):
+        # 8 disjoint matches, batch_size 3 -> split into ceil(8/3)=3 batches
+        idx = np.arange(48, dtype=np.int32).reshape(8, 2, 3)
+        stream = MatchStream(
+            player_idx=idx,
+            winner=np.zeros(8, np.int32),
+            mode_id=np.ones(8, np.int32),
+            afk=np.zeros(8, bool),
+        )
+        sched = pack_schedule(stream, pad_row=100, batch_size=3)
+        assert sched.n_steps == 3
+        assert sched.n_matches == 8
+
+    def test_occupancy(self):
+        stream, state = small_stream(n_matches=300, n_players=200)
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=32)
+        assert 0 < sched.occupancy <= 1
+
+
+class TestRunnerOracle:
+    def test_matches_sequential_execution(self):
+        stream, state = small_stream(n_matches=150, n_players=40)
+        oracle = sequential_oracle(state, stream)
+
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=32)
+        final, _ = rate_history(state, sched, CFG, steps_per_chunk=7)
+
+        # Compare real player rows only: the padding row absorbs masked-out
+        # scatter writes and legitimately differs between schedules.
+        p = state.n_players
+        np.testing.assert_allclose(
+            np.asarray(final.mu)[:p], np.asarray(oracle.mu)[:p], rtol=1e-6, equal_nan=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(final.sigma)[:p],
+            np.asarray(oracle.sigma)[:p],
+            rtol=1e-6,
+            equal_nan=True,
+        )
+
+    def test_collected_outputs(self):
+        stream, state = small_stream(n_matches=60, n_players=25)
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=16)
+        _, outs = rate_history(state, sched, CFG, collect=True)
+        assert outs.quality.shape == (stream.n_matches,)
+        ratable = stream.ratable
+        assert (outs.updated == ratable).all()
+        assert (outs.quality[ratable] > 0).all()
+        assert (outs.quality[~ratable] == 0).all()
+        afk_supported = stream.afk & (stream.mode_id >= 0)
+        assert (outs.any_afk == afk_supported).all()
+        # delta is nonzero only on updated matches where player had a rating
+        assert (outs.delta[~ratable] == 0).all()
+
+    def test_rerun_from_checkpoint_idempotent(self, tmp_path):
+        from analyzer_tpu.io.checkpoint import load_checkpoint, save_checkpoint
+
+        stream, state = small_stream(n_matches=80, n_players=30)
+        half = stream.n_matches // 2
+        s1 = pack_schedule(stream.slice(0, half), pad_row=state.pad_row, batch_size=16)
+        mid, _ = rate_history(state, s1, CFG)
+        path = str(tmp_path / "ck.npz")
+        save_checkpoint(path, mid, cursor=half)
+        restored, cursor = load_checkpoint(path)
+        assert cursor == half
+        s2 = pack_schedule(
+            stream.slice(half, stream.n_matches), pad_row=state.pad_row, batch_size=16
+        )
+        final_a, _ = rate_history(restored, s2, CFG)
+
+        full = pack_schedule(stream, pad_row=state.pad_row, batch_size=16)
+        final_b, _ = rate_history(state, full, CFG)
+        p = state.n_players
+        np.testing.assert_allclose(
+            np.asarray(final_a.mu)[:p], np.asarray(final_b.mu)[:p], rtol=1e-6, equal_nan=True
+        )
