@@ -18,7 +18,6 @@ from analyzer_tpu.core.update import (
     rate_and_apply_jit,
     rate_and_apply_step,
     rate_batch,
-    resolve_priors,
 )
 
 __all__ = [
@@ -41,5 +40,4 @@ __all__ = [
     "rate_and_apply_jit",
     "rate_and_apply_step",
     "rate_batch",
-    "resolve_priors",
 ]

@@ -4,19 +4,32 @@ The reference's "state" is seven (mu, sigma) column pairs per player row in
 MySQL — the shared ``trueskill`` pair plus one pair per game mode
 (``worker.py:184-190`` and the 5v5 pair supported at ``rater.py:79-82``) —
 plus the seeding features ``rank_points_ranked/blitz`` and ``skill_tier``.
-Here the whole player table lives in device memory as dense arrays (a few
-million players x 7 f32 column pairs is tens of MB — far below one chip's
-HBM), so rating updates are pure gather -> compute -> scatter steps with no
-database round-trip.
+Here the whole player table lives in device memory, so rating updates are
+pure gather -> compute -> scatter steps with no database round-trip.
+
+Layout (load-bearing for TPU performance): ALL per-player state the kernel
+touches is packed into ONE ``[P+1, 16]`` float32 table —
+
+    cols 0..6   mu      (0 = shared ``trueskill``, 1..6 per-mode)
+    cols 7..13  sigma   (same order)
+    col  14     seed_mu     (precomputed ``get_trueskill_seed`` result)
+    col  15     seed_sigma
+
+so one superstep performs a single whole-row gather ``[B, 2, T, 16]`` and a
+single whole-row scatter. Per-element (1-D) gathers and take_along_axis
+column selects are ~300x slower on TPU than row gathers (the gather unit
+moves lane-aligned rows); measured on v5e, the packed layout takes the
+superstep from ~1.0 ms to ~microseconds at B=512. Seeding is a pure
+function of static features (``rater.py:42-62``), so it is evaluated once
+at ingest into cols 14-15 instead of per match in the kernel.
 
 Conventions (load-bearing):
-  * NaN encodes SQL NULL ("never rated") in mu/sigma and rank-point columns.
-    The reference branches on ``player.trueskill_mu is not None``
-    (``rater.py:115,124,150``); the tensor path branches on ``~isnan(mu)``.
+  * NaN encodes SQL NULL ("never rated") in mu/sigma columns. The reference
+    branches on ``player.trueskill_mu is not None`` (``rater.py:115,124``);
+    the tensor path branches on ``~isnan``.
   * Every array has one extra trailing **padding row** (index ``n_players``).
     Empty team slots and masked-out writes target that row, so scatters keep
-    static shapes with no dynamic filtering — the TPU-friendly alternative to
-    ragged batches.
+    static shapes with no dynamic filtering.
   * A ``MatchBatch`` packs two teams x ``team_size`` padded slots; 3v3 and
     5v5 share one compiled kernel via the slot mask (SURVEY.md section 7
     "static shapes").
@@ -35,33 +48,64 @@ from analyzer_tpu.core import constants
 
 MAX_TEAM_SIZE = 5
 
+# Packed-table column layout.
+N_COLS = constants.N_RATING_COLS  # 7: shared + 6 modes
+MU_LO, MU_HI = 0, N_COLS
+SIGMA_LO, SIGMA_HI = N_COLS, 2 * N_COLS
+COL_SEED_MU = 2 * N_COLS
+COL_SEED_SIGMA = 2 * N_COLS + 1
+TABLE_WIDTH = 2 * N_COLS + 2  # 16
+
 
 @partial(
     jax.tree_util.register_dataclass,
-    data_fields=["mu", "sigma", "rank_points_ranked", "rank_points_blitz", "skill_tier"],
-    meta_fields=[],
+    data_fields=["table", "rank_points_ranked", "rank_points_blitz", "skill_tier"],
+    meta_fields=["seed_cfg"],
 )
 @dataclasses.dataclass
 class PlayerState:
     """Dense per-player rating state. Row ``n_players`` is the padding row.
 
-    mu, sigma: ``[P+1, 7]`` — column 0 is the shared rating, columns 1..6 the
-    per-mode ratings in :data:`analyzer_tpu.core.constants.MODES` order.
+    ``table``: ``[P+1, 16]`` packed as documented in the module docstring.
+    The raw seed features are kept for ingest/debug (they are NOT read by
+    the rating kernel — seeds are precomputed into the table).
+
+    ``seed_cfg`` records the RatingConfig whose UNKNOWN_PLAYER_SIGMA baked
+    the seed columns; the rating kernel refuses to run with a different
+    config (the mismatch would silently ignore env overrides on the tensor
+    path while the object API honors them). None = unchecked (raw loads).
     """
 
-    mu: jnp.ndarray
-    sigma: jnp.ndarray
+    table: jnp.ndarray
     rank_points_ranked: jnp.ndarray
     rank_points_blitz: jnp.ndarray
     skill_tier: jnp.ndarray
+    seed_cfg: object = None
+
+    # Views used by the object API, tests, and checkpointing.
+    @property
+    def mu(self) -> jnp.ndarray:
+        return self.table[:, MU_LO:MU_HI]
+
+    @property
+    def sigma(self) -> jnp.ndarray:
+        return self.table[:, SIGMA_LO:SIGMA_HI]
+
+    @property
+    def seed_mu(self) -> jnp.ndarray:
+        return self.table[:, COL_SEED_MU]
+
+    @property
+    def seed_sigma(self) -> jnp.ndarray:
+        return self.table[:, COL_SEED_SIGMA]
 
     @property
     def n_players(self) -> int:
-        return self.mu.shape[0] - 1
+        return self.table.shape[0] - 1
 
     @property
     def pad_row(self) -> int:
-        return self.mu.shape[0] - 1
+        return self.table.shape[0] - 1
 
     @classmethod
     def create(
@@ -70,13 +114,19 @@ class PlayerState:
         rank_points_ranked: np.ndarray | None = None,
         rank_points_blitz: np.ndarray | None = None,
         skill_tier: np.ndarray | None = None,
+        cfg=None,
         dtype=jnp.float32,
     ) -> "PlayerState":
-        """Fresh state: all ratings unset (NaN), features optionally provided.
+        """Fresh state: all ratings unset (NaN), seeds precomputed from the
+        features per ``get_trueskill_seed`` semantics (``rater.py:42-62``).
 
-        Missing rank points are NaN; missing skill tier is 0 (tier 0 seeds to
-        1 point, the reference's floor — ``rater.py:15-16``).
+        Missing rank points are NaN; missing skill tier is 0 (tier 0 seeds
+        to 1 point, the reference's floor — ``rater.py:15-16``).
         """
+        from analyzer_tpu.config import RatingConfig
+        from analyzer_tpu.core.seeding import trueskill_seed
+
+        cfg = cfg or RatingConfig()
         p1 = n_players + 1
 
         def _feat(x, fill):
@@ -88,13 +138,29 @@ class PlayerState:
         tiers = np.zeros((p1,), dtype=np.int32)
         if skill_tier is not None:
             tiers[:n_players] = np.asarray(skill_tier, dtype=np.int32)
+
+        rr = jnp.asarray(_feat(rank_points_ranked, np.nan), dtype)
+        rb = jnp.asarray(_feat(rank_points_blitz, np.nan), dtype)
+        ti = jnp.asarray(tiers)
+        seed_mu, seed_sigma = trueskill_seed(rr, rb, ti, cfg)
+
+        table = jnp.full((p1, TABLE_WIDTH), jnp.nan, dtype=dtype)
+        table = table.at[:, COL_SEED_MU].set(seed_mu)
+        table = table.at[:, COL_SEED_SIGMA].set(seed_sigma)
         return cls(
-            mu=jnp.full((p1, constants.N_RATING_COLS), jnp.nan, dtype=dtype),
-            sigma=jnp.full((p1, constants.N_RATING_COLS), jnp.nan, dtype=dtype),
-            rank_points_ranked=jnp.asarray(_feat(rank_points_ranked, np.nan), dtype=dtype),
-            rank_points_blitz=jnp.asarray(_feat(rank_points_blitz, np.nan), dtype=dtype),
-            skill_tier=jnp.asarray(tiers),
+            table=table,
+            rank_points_ranked=rr,
+            rank_points_blitz=rb,
+            skill_tier=ti,
+            seed_cfg=cfg,
         )
+
+    def set_rating(self, row: int, col: int, mu: float, sigma: float) -> "PlayerState":
+        """Returns a copy with one (mu, sigma) pair written — ingest/tests."""
+        table = (
+            self.table.at[row, MU_LO + col].set(mu).at[row, SIGMA_LO + col].set(sigma)
+        )
+        return dataclasses.replace(self, table=table)
 
 
 @partial(

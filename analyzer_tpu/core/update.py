@@ -5,9 +5,9 @@ This module composes the full semantics of the reference's ``rate_match``
 :class:`~analyzer_tpu.core.state.PlayerState` and a
 :class:`~analyzer_tpu.core.state.MatchBatch`:
 
-  1. prior resolution — shared prior from player state, else the seed
-     (``rater.py:114-121``); queue-specific prior from the mode column, else
-     the shared prior (``rater.py:123-132``);
+  1. prior resolution — shared prior from player state, else the
+     (precomputed) seed (``rater.py:114-121``); queue-specific prior from
+     the mode column, else the shared prior (``rater.py:123-132``);
   2. match quality from the **queue-specific** matchup — the reference's
      comment says "shared" but its code passes ``matchup`` (``rater.py:140-141``);
      we preserve the code's behavior;
@@ -18,6 +18,14 @@ This module composes the full semantics of the reference's ``rate_match``
   5. gating — unsupported modes mutate nothing (``rater.py:83-85``); AFK /
      invalid-roster matches get quality=0 and any_afk=True but **no** rating
      update (``rater.py:90-106``).
+
+TPU shape discipline: the state is touched with exactly ONE whole-row
+gather (``table[idx] -> [B, 2, T, 16]``) and ONE whole-row scatter of the
+modified rows. Column selection uses one-hot reductions, never per-element
+gathers — measured ~300x faster on v5e (see state.py docstring). Scattering
+full rows is correct because a superstep is conflict-free: each player row
+is written by at most one match, so untouched columns rewrite their own
+just-gathered values.
 
 Correctness precondition: no player index may appear twice among the ratable
 matches of one batch (the scatters would collide). The scheduler in
@@ -36,8 +44,17 @@ import jax.numpy as jnp
 
 from analyzer_tpu.config import RatingConfig
 from analyzer_tpu.core import constants
-from analyzer_tpu.core.seeding import trueskill_seed
-from analyzer_tpu.core.state import MatchBatch, PlayerState
+from analyzer_tpu.core.state import (
+    COL_SEED_MU,
+    COL_SEED_SIGMA,
+    MU_HI,
+    MU_LO,
+    N_COLS,
+    SIGMA_HI,
+    SIGMA_LO,
+    MatchBatch,
+    PlayerState,
+)
 from analyzer_tpu.ops import trueskill as ts
 
 
@@ -53,6 +70,7 @@ from analyzer_tpu.ops import trueskill as ts
         "any_afk",
         "write_quality",
         "updated",
+        "new_rows",
     ],
     meta_fields=[],
 )
@@ -68,6 +86,7 @@ class RateOutputs:
     write_quality [B]       whether quality/any_afk are written at all
                             (False for unsupported modes and batch padding)
     updated       [B]       whether ratings were written (ratable matches)
+    new_rows      [B,2,T,W] the fully-updated state rows, ready to scatter
     """
 
     quality: jnp.ndarray
@@ -79,54 +98,53 @@ class RateOutputs:
     any_afk: jnp.ndarray
     write_quality: jnp.ndarray
     updated: jnp.ndarray
+    new_rows: jnp.ndarray
 
 
-def _mode_col(mode_id: jnp.ndarray) -> jnp.ndarray:
-    """Rating-state column for a mode id: column 0 is the shared rating, so
-    mode i lives at column i+1. Unsupported (-1) clamps to column 1; callers
-    must mask those matches out (they never read or write state)."""
-    return jnp.clip(mode_id, 0, None) + 1
+def _mode_onehot(mode_id: jnp.ndarray, dtype) -> jnp.ndarray:
+    """[B, N_COLS] one-hot of the mode's rating column (mode i -> col i+1;
+    col 0 is the shared rating). Unsupported (-1) clamps to col 1; callers
+    must mask those matches out (they never write state)."""
+    col = jnp.clip(mode_id, 0, None) + 1
+    return (col[:, None] == jnp.arange(N_COLS)[None, :]).astype(dtype)
 
 
-def resolve_priors(state: PlayerState, batch: MatchBatch, cfg: RatingConfig):
-    """Gathers priors for every slot and applies the seed/shared fallbacks.
+def rate_batch(state: PlayerState, batch: MatchBatch, cfg: RatingConfig) -> RateOutputs:
+    """Computes all rating outputs for a batch without touching the state."""
+    if state.seed_cfg is not None and state.seed_cfg != cfg:
+        # Trace-time check (both are static): the seed columns were baked
+        # with state.seed_cfg; rating with a different config would silently
+        # seed unrated players with the wrong UNKNOWN_PLAYER_SIGMA.
+        raise ValueError(
+            f"state seeds were built with {state.seed_cfg}, but rate_batch "
+            f"was called with {cfg}; rebuild the state via "
+            "PlayerState.create(..., cfg=cfg)"
+        )
+    rows = state.table[batch.player_idx]  # [B,2,T,W] — the ONE gather
+    dtype = rows.dtype
+    mask = batch.slot_mask
 
-    Returns (mu_sh, sigma_sh, mu_q, sigma_q, had_shared) with shape [B,2,T];
-    ``had_shared`` is the reference's ``player.trueskill_mu is not None``
-    test (``rater.py:115,150``) needed for the delta rule.
-    """
-    idx = batch.player_idx  # padding slots already point at the padding row
-    mu_cols = state.mu[idx]  # [B,2,T,C]
-    sigma_cols = state.sigma[idx]
+    mu_cols = rows[..., MU_LO:MU_HI]  # [B,2,T,C]
+    sigma_cols = rows[..., SIGMA_LO:SIGMA_HI]
+    seed_mu = rows[..., COL_SEED_MU]
+    seed_sigma = rows[..., COL_SEED_SIGMA]
 
-    shared_mu_p = mu_cols[..., constants.SHARED_COL]
-    shared_sigma_p = sigma_cols[..., constants.SHARED_COL]
+    shared_mu_p = mu_cols[..., 0]
+    shared_sigma_p = sigma_cols[..., 0]
 
-    mode_col = _mode_col(batch.mode_id)[:, None, None, None]
-    q_mu_p = jnp.take_along_axis(mu_cols, mode_col, axis=-1)[..., 0]
-    q_sigma_p = jnp.take_along_axis(sigma_cols, mode_col, axis=-1)[..., 0]
-
-    seed_mu, seed_sigma = trueskill_seed(
-        state.rank_points_ranked[idx],
-        state.rank_points_blitz[idx],
-        state.skill_tier[idx],
-        cfg,
-    )
+    onehot = _mode_onehot(batch.mode_id, dtype)  # [B,C]
+    oh = onehot[:, None, None, :]  # [B,1,1,C]
+    # One-hot column select; NaN-safe (NaN * 0 is avoided via where).
+    q_mu_p = jnp.where(oh > 0, mu_cols, 0.0).sum(-1)
+    q_sigma_p = jnp.where(oh > 0, sigma_cols, 0.0).sum(-1)
+    had_mode = ~jnp.isnan(jnp.where(oh > 0, mu_cols, 0.0)).any(-1)
 
     had_shared = ~jnp.isnan(shared_mu_p)
     mu_sh = jnp.where(had_shared, shared_mu_p, seed_mu)
     sigma_sh = jnp.where(had_shared, shared_sigma_p, seed_sigma)
 
-    had_mode = ~jnp.isnan(q_mu_p)
     mu_q = jnp.where(had_mode, q_mu_p, mu_sh)
     sigma_q = jnp.where(had_mode, q_sigma_p, sigma_sh)
-    return mu_sh, sigma_sh, mu_q, sigma_q, had_shared
-
-
-def rate_batch(state: PlayerState, batch: MatchBatch, cfg: RatingConfig) -> RateOutputs:
-    """Computes all rating outputs for a batch without touching the state."""
-    mu_sh, sigma_sh, mu_q, sigma_q, had_shared = resolve_priors(state, batch, cfg)
-    mask = batch.slot_mask
 
     quality = ts.quality(mu_q, sigma_q, mask, cfg)  # queue matchup quirk
     new_sh_mu, new_sh_sigma = ts.two_team_update(mu_sh, sigma_sh, mask, batch.winner, cfg)
@@ -136,6 +154,19 @@ def rate_batch(state: PlayerState, batch: MatchBatch, cfg: RatingConfig) -> Rate
         had_shared & mask,
         (new_sh_mu - new_sh_sigma) - (mu_sh - sigma_sh),
         0.0,
+    )
+
+    # Assemble the updated rows: col 0 <- shared posterior, mode col <-
+    # queue posterior, everything else keeps its gathered value (incl. NaN
+    # never-rated markers and the seed columns).
+    shared_hot = (jnp.arange(N_COLS) == 0)[None, None, None, :]
+    mode_hot = oh > 0
+    new_mu_cols = jnp.where(shared_hot, new_sh_mu[..., None], mu_cols)
+    new_mu_cols = jnp.where(mode_hot, new_q_mu[..., None], new_mu_cols)
+    new_sigma_cols = jnp.where(shared_hot, new_sh_sigma[..., None], sigma_cols)
+    new_sigma_cols = jnp.where(mode_hot, new_q_sigma[..., None], new_sigma_cols)
+    new_rows = jnp.concatenate(
+        [new_mu_cols, new_sigma_cols, rows[..., 2 * N_COLS :]], axis=-1
     )
 
     ratable = batch.ratable
@@ -149,26 +180,21 @@ def rate_batch(state: PlayerState, batch: MatchBatch, cfg: RatingConfig) -> Rate
         any_afk=batch.supported & batch.afk,
         write_quality=batch.supported,
         updated=ratable,
+        new_rows=new_rows,
     )
 
 
 def apply_outputs(
     state: PlayerState, batch: MatchBatch, out: RateOutputs
 ) -> PlayerState:
-    """Scatters posteriors into the player table. Masked / non-ratable slots
-    are routed to the padding row, so shapes stay static and no collision can
-    occur as long as the batch is conflict-free."""
+    """Scatters the updated rows into the player table — ONE whole-row
+    scatter. Masked / non-ratable slots are routed to the padding row, so
+    shapes stay static and no collision can occur as long as the batch is
+    conflict-free."""
     do = out.updated[:, None, None] & batch.slot_mask
     idx = jnp.where(do, batch.player_idx, state.pad_row)
-
-    mu = state.mu.at[idx, constants.SHARED_COL].set(out.shared_mu)
-    sigma = state.sigma.at[idx, constants.SHARED_COL].set(out.shared_sigma)
-
-    mode_col = jnp.broadcast_to(_mode_col(batch.mode_id)[:, None, None], idx.shape)
-    mu = mu.at[idx, mode_col].set(out.mode_mu)
-    sigma = sigma.at[idx, mode_col].set(out.mode_sigma)
-
-    return dataclasses.replace(state, mu=mu, sigma=sigma)
+    table = state.table.at[idx].set(out.new_rows)
+    return dataclasses.replace(state, table=table)
 
 
 def rate_and_apply(
@@ -182,7 +208,7 @@ def rate_and_apply(
 rate_and_apply_jit = jax.jit(rate_and_apply, static_argnames=("cfg",))
 
 # Hot-loop variant: donates the state so XLA scatters into the existing HBM
-# buffers instead of allocating a fresh [P+1, 7] table per superstep. Use in
+# buffers instead of allocating a fresh table per superstep. Use in
 # ``state = rate_and_apply_step(state, batch, cfg)[0]`` loops ONLY — the
 # passed-in state is invalidated. (The scan runner in sched.runner donates
 # its whole chunk the same way.)

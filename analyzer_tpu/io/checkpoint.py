@@ -7,23 +7,29 @@ player table living in HBM, restarts lose state — so snapshots are explicit:
 the full PlayerState plus the stream cursor (index of the next unrated
 match), making re-rate idempotent from any snapshot.
 
-Format: a single ``.npz`` (atomic rename on save). Orbax is a heavier
-dependency than this state shape needs — the whole table is a handful of
-dense arrays — but the layout is orbax-compatible (a flat dict of arrays)
-if sharded async checkpointing becomes necessary at multi-host scale.
+Format: a single ``.npz`` (atomic rename on save). The packed table carries
+mu/sigma AND the precomputed seed columns, and the RatingConfig that baked
+the seeds is stored alongside, so a restore needs no re-seeding and keeps
+the seed/config consistency check intact. Orbax is a heavier dependency
+than this state shape needs — the whole table is a handful of dense arrays
+— but the layout is orbax-compatible (a flat dict of arrays) if sharded
+async checkpointing becomes necessary at multi-host scale.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import os
 
 import jax.numpy as jnp
 import numpy as np
 
+from analyzer_tpu.config import RatingConfig
 from analyzer_tpu.core.state import PlayerState
 
-_FIELDS = ("mu", "sigma", "rank_points_ranked", "rank_points_blitz", "skill_tier")
-_FORMAT_VERSION = 1
+_FIELDS = ("table", "rank_points_ranked", "rank_points_blitz", "skill_tier")
+_CFG_FIELDS = tuple(f.name for f in dataclasses.fields(RatingConfig))
+_FORMAT_VERSION = 2
 
 
 def save_checkpoint(path: str, state: PlayerState, cursor: int = 0) -> None:
@@ -31,6 +37,9 @@ def save_checkpoint(path: str, state: PlayerState, cursor: int = 0) -> None:
     arrays = {f: np.asarray(getattr(state, f)) for f in _FIELDS}
     arrays["cursor"] = np.int64(cursor)
     arrays["format_version"] = np.int64(_FORMAT_VERSION)
+    cfg = state.seed_cfg
+    if cfg is not None:
+        arrays["seed_cfg"] = np.asarray([float(getattr(cfg, f)) for f in _CFG_FIELDS])
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
@@ -43,5 +52,11 @@ def load_checkpoint(path: str) -> tuple[PlayerState, int]:
         version = int(z["format_version"])
         if version != _FORMAT_VERSION:
             raise ValueError(f"checkpoint format {version} != {_FORMAT_VERSION}")
-        state = PlayerState(**{f: jnp.asarray(z[f]) for f in _FIELDS})
+        cfg = None
+        if "seed_cfg" in z:
+            vals = z["seed_cfg"]
+            cfg = RatingConfig(**dict(zip(_CFG_FIELDS, (float(v) for v in vals))))
+        state = PlayerState(
+            **{f: jnp.asarray(z[f]) for f in _FIELDS}, seed_cfg=cfg
+        )
         return state, int(z["cursor"])

@@ -88,19 +88,35 @@ def synthetic_stream(
     player_idx = np.full((n, 2, t_max), -1, dtype=np.int32)
     afk = rng.random(n) < afk_rate
 
-    # Sample 2*team_size distinct players per match (vectorized draw with
-    # rejection fix-up for the rare duplicate).
-    flat = rng.choice(p, size=(n, 2 * t_max), p=weights)
-    for i in range(n):
-        k = 2 * team_size[i]
-        row = flat[i, :k]
-        uniq = np.unique(row)
-        while uniq.size < k:
-            extra = rng.choice(p, size=k - uniq.size, p=weights)
-            uniq = np.unique(np.concatenate([uniq, extra]))
-        row = rng.permutation(uniq[:k])
-        player_idx[i, 0, : team_size[i]] = row[: team_size[i]]
-        player_idx[i, 1, : team_size[i]] = row[team_size[i] : k]
+    # Sample 2*team_size distinct players per match, fully vectorized:
+    # draw with replacement, then iteratively redraw only the rows that
+    # still contain duplicates (converges in a few rounds).
+    k_max = 2 * t_max
+    flat = rng.choice(p, size=(n, k_max), p=weights)
+    need = np.arange(n)
+    for _ in range(64):
+        rows = flat[need]
+        srt = np.sort(rows, axis=1)
+        dup = (srt[:, 1:] == srt[:, :-1]).any(axis=1)
+        need = need[dup]
+        if need.size == 0:
+            break
+        flat[need] = rng.choice(p, size=(need.size, k_max), p=weights)
+    else:
+        # Pathological weights: fix the stragglers exactly, one by one.
+        for i in need:
+            uniq = np.unique(flat[i])
+            while uniq.size < k_max:
+                extra = rng.choice(p, size=k_max - uniq.size, p=weights)
+                uniq = np.unique(np.concatenate([uniq, extra]))
+            flat[i] = rng.permutation(uniq[:k_max])
+
+    cols = np.arange(t_max)[None, :]
+    ts_col = team_size[:, None]
+    team0 = np.where(cols < ts_col, flat[:, :t_max], -1).astype(np.int32)
+    team1 = np.where(cols < ts_col, flat[:, t_max : 2 * t_max], -1).astype(np.int32)
+    player_idx[:, 0] = team0
+    player_idx[:, 1] = team1
 
     # Outcome from latent skills: P(team0 wins) = logistic(gap / scale).
     skill = players.latent_skill

@@ -1,0 +1,20 @@
+"""Device-mesh data parallelism for the rating pipeline.
+
+The reference scales out with AMQP competing consumers racing on a shared
+MySQL table (``worker.py:91-92``; SURVEY.md section 2.5) — workers never
+talk to each other and last-commit-wins on conflicts. The TPU design keeps
+the throughput model (data parallelism over matches) but makes the shared
+state exact: the player table is **replicated** across the mesh, each
+superstep's batch is **sharded** over the ``data`` axis, and the per-match
+posterior writes ride ICI through one small ``all_gather`` so every replica
+applies the identical scatter. Conflict-freedom within a superstep (the
+scheduler's invariant) makes the combine exact — no last-commit-wins races.
+"""
+
+from analyzer_tpu.parallel.mesh import (
+    make_mesh,
+    rate_history_sharded,
+    sharded_step_fn,
+)
+
+__all__ = ["make_mesh", "rate_history_sharded", "sharded_step_fn"]

@@ -1,0 +1,139 @@
+"""shard_map data parallelism: sharded batches, replicated state.
+
+Design (SURVEY.md section 7, step 5):
+
+  * The player table (a few M rows x 7 (mu, sigma) pairs ~ tens of MB) is far
+    below per-chip HBM, so it is replicated; sharding it would turn every
+    prior gather into an all_to_all.
+  * Each superstep's ``[B, ...]`` batch is sharded over the ``data`` mesh
+    axis: every chip gathers priors and runs the closed-form update for its
+    ``B/D`` matches only.
+  * The posterior writes are exchanged with one ``all_gather`` of the
+    batch-shaped update tensors (KBs — not the table), and every replica
+    applies the identical full-batch scatter. Because a superstep is
+    conflict-free *globally*, replicas stay bit-identical with no
+    last-write ambiguity (the reference instead let AMQP workers race on
+    MySQL, last-commit-wins — SURVEY.md section 2.5).
+  * The scan over supersteps lives inside one jitted computation per chunk,
+    so ICI transfers overlap with compute and the table stays in HBM.
+
+Multi-host runs use the same code: ``jax.distributed.initialize()`` +
+a global mesh makes ``all_gather`` ride ICI within a slice and DCN across
+slices; the host feed stays sharded by process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.core.state import MatchBatch, PlayerState
+from analyzer_tpu.core.update import rate_batch
+from analyzer_tpu.sched.superstep import PackedSchedule
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """A 1-D ``data`` mesh over the first ``n_devices`` local devices."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def _scatter_rows(
+    state: PlayerState,
+    player_idx: jnp.ndarray,
+    slot_mask: jnp.ndarray,
+    updated: jnp.ndarray,
+    new_rows: jnp.ndarray,
+) -> PlayerState:
+    """Applies a full batch of row writes (identical on each replica)."""
+    do = updated[:, None, None] & slot_mask
+    idx = jnp.where(do, player_idx, state.pad_row)
+    return dataclasses.replace(state, table=state.table.at[idx].set(new_rows))
+
+
+def sharded_step_fn(mesh: Mesh, cfg: RatingConfig):
+    """Builds the jitted, shard_map'd chunk runner.
+
+    Returns ``run(state, pidx, mask, winner, mode, afk) -> state`` scanning
+    over the leading superstep axis; the batch axis (second) is sharded over
+    ``data``, state is replicated and donated.
+    """
+
+    def scan_chunk(state: PlayerState, pidx, mask, winner, mode, afk):
+        def step(st, xs):
+            lp, lm, lw, lmo, la = xs  # local [B/D, ...] shard
+            local = MatchBatch(
+                player_idx=lp, slot_mask=lm, winner=lw, mode_id=lmo, afk=la
+            )
+            out = rate_batch(st, local, cfg)
+            # One ICI exchange of the batch-shaped updates; then every
+            # replica applies the same scatter, staying bit-identical.
+            g = jax.tree.map(
+                lambda x: jax.lax.all_gather(x, DATA_AXIS, axis=0, tiled=True),
+                (lp, lm, out.updated, out.new_rows),
+            )
+            return _scatter_rows(st, *g), None
+
+        state, _ = jax.lax.scan(step, state, (pidx, mask, winner, mode, afk))
+        return state
+
+    bspec = P(None, DATA_AXIS)  # [S, B, ...]: shard the batch axis
+    # check_vma=False: the varying-manual-axes checker can't see that the
+    # post-all_gather scatter keeps state bit-identical across replicas
+    # (it types all_gather outputs as varying); replication is guaranteed
+    # by construction here and asserted in tests/test_parallel.py.
+    shmapped = jax.shard_map(
+        scan_chunk,
+        mesh=mesh,
+        in_specs=(P(), bspec, bspec, bspec, bspec, bspec),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(shmapped, donate_argnums=(0,))
+
+
+def rate_history_sharded(
+    state: PlayerState,
+    sched: PackedSchedule,
+    cfg: RatingConfig,
+    mesh: Mesh | None = None,
+    steps_per_chunk: int = 1024,
+) -> PlayerState:
+    """Full-history re-rate, data-parallel over the mesh. Returns final state.
+
+    ``sched.batch_size`` must be divisible by the mesh size (pack with
+    ``batch_size = k * n_devices``).
+    """
+    mesh = mesh or make_mesh()
+    n_dev = mesh.devices.size
+    if sched.batch_size % n_dev:
+        raise ValueError(
+            f"batch_size {sched.batch_size} not divisible by mesh size {n_dev}"
+        )
+    step_fn = sharded_step_fn(mesh, cfg)
+
+    replicated = NamedSharding(mesh, P())
+    state = jax.device_put(state, replicated)  # reshards without host detour
+    batch_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
+
+    for start in range(0, sched.n_steps, steps_per_chunk):
+        sl = slice(start, min(start + steps_per_chunk, sched.n_steps))
+        arrays = (
+            jax.device_put(sched.player_idx[sl], batch_sharding),
+            jax.device_put(sched.slot_mask[sl], batch_sharding),
+            jax.device_put(sched.winner[sl], batch_sharding),
+            jax.device_put(sched.mode_id[sl], batch_sharding),
+            jax.device_put(sched.afk[sl], batch_sharding),
+        )
+        state = step_fn(state, *arrays)
+    return state

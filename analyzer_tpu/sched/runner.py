@@ -59,7 +59,11 @@ def _scan_chunk(state: PlayerState, arrays, cfg: RatingConfig, collect: bool):
             player_idx=pidx, slot_mask=mask, winner=winner, mode_id=mode, afk=afk
         )
         st, out = rate_and_apply(st, batch, cfg)
-        return st, out if collect else None
+        if not collect:
+            return st, None
+        # Drop the [B,2,T,16] state rows from the collected ys — they are
+        # scatter plumbing, not a per-match output, and would dominate memory.
+        return st, dataclasses.replace(out, new_rows=None)
 
     return jax.lax.scan(step, state, arrays)
 
@@ -69,7 +73,7 @@ def rate_history(
     sched: PackedSchedule,
     cfg: RatingConfig,
     collect: bool = False,
-    steps_per_chunk: int = 1024,
+    steps_per_chunk: int = 8192,
 ) -> tuple[PlayerState, HistoryOutputs | None]:
     """Rates a full packed history. Returns the final state and, when
     ``collect``, per-match outputs reordered back to stream order."""

@@ -174,10 +174,18 @@ def _assign_supersteps_py(stream: MatchStream) -> np.ndarray:
 def pack_schedule(
     stream: MatchStream,
     pad_row: int,
-    batch_size: int = 512,
+    batch_size: int | None = None,
     team_size: int = MAX_TEAM_SIZE,
+    batch_multiple: int = 8,
+    max_batch_size: int = 4096,
 ) -> PackedSchedule:
     """Packs a stream into ``[S, B, ...]`` superstep batches.
+
+    ``batch_size=None`` picks it automatically: the 95th percentile of
+    superstep widths rounded up to ``batch_multiple`` (device compute per
+    step is nearly width-independent below ~512, but host->device transfer
+    scales with S x B, so padding to the widest step wastes bandwidth on
+    heavy-tailed schedules whose width histogram has a long thin tail).
 
     Steps whose match count exceeds ``batch_size`` are split into several
     consecutive batches (still conflict-free — subsets of a conflict-free set).
@@ -190,6 +198,17 @@ def pack_schedule(
     if t_in > team_size:
         raise ValueError(f"stream team size {t_in} exceeds pack team size {team_size}")
     steps = assign_supersteps(stream)
+
+    if batch_size is None:
+        ratable_steps = steps[steps >= 0]
+        if ratable_steps.size:
+            widths = np.bincount(ratable_steps)
+            p95 = float(np.percentile(widths, 95))
+        else:
+            p95 = 1.0
+        batch_size = int(
+            min(max_batch_size, max(batch_multiple, -(-p95 // batch_multiple) * batch_multiple))
+        )
 
     ratable_order = np.flatnonzero(steps >= 0)
     # Stable sort by step: within a step, stream order is preserved.

@@ -1,0 +1,136 @@
+"""Headline benchmark: full-history rating-update throughput.
+
+Prints ONE JSON line:
+  {"metric": "matches_per_sec_per_chip", "value": N, "unit": "matches/s",
+   "vs_baseline": N}
+
+``vs_baseline`` is measured throughput / the north-star target rate from
+BASELINE.json (~10M matches in <5 min on a v5e-8 = 33.3k matches/s pod
+= 4,167 matches/s/chip sustained). >1.0 beats the target.
+
+The benchmark builds a synthetic heavy-tailed match history (the shape the
+reference consumes from MySQL, SURVEY.md section 3.2), packs it into
+conflict-free supersteps, and times the chunked scan of closed-form
+TrueSkill updates on the default JAX device (the real TPU chip under the
+driver). Scheduler packing runs host-side and is reported separately on
+stderr — the JSON value is the device rating-update throughput, matching
+BASELINE.json's "matches/sec/chip rating-update throughput" metric.
+
+Workload shape: players ~ matches/3 with moderately heavy-tailed activity
+(concentration 0.8) — the profile of a ladder where the hottest players
+play a few hundred matches, giving dependency chains (superstep depth) in
+the hundreds, like a real multi-year 10M-match history. The scheduler's
+conflict-free supersteps are the unit of device work; batch width is
+auto-sized from the width histogram (sched.pack_schedule).
+
+Env knobs: BENCH_MATCHES (default 500000), BENCH_PLAYERS (default
+BENCH_MATCHES//3), BENCH_BATCH (default 0 = auto), BENCH_REPEATS (default
+3), BENCH_CONC (default 0.8).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# North-star: 10M matches / 300 s / 8 chips (BASELINE.json, BASELINE.md).
+BASELINE_MATCHES_PER_SEC_PER_CHIP = 10_000_000 / 300.0 / 8.0
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    n_matches = int(os.environ.get("BENCH_MATCHES", 500_000))
+    n_players = int(os.environ.get("BENCH_PLAYERS", max(n_matches // 3, 100)))
+    batch = int(os.environ.get("BENCH_BATCH", 0)) or None
+    repeats = int(os.environ.get("BENCH_REPEATS", 3))
+    conc = float(os.environ.get("BENCH_CONC", 0.8))
+
+    import jax
+
+    from analyzer_tpu.config import RatingConfig
+    from analyzer_tpu.core.state import PlayerState
+    from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
+    from analyzer_tpu.sched import pack_schedule
+    from analyzer_tpu.sched.runner import _scan_chunk
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind}), "
+        f"{n_matches} matches / {n_players} players, batch={batch}")
+
+    cfg = RatingConfig()
+    t0 = time.perf_counter()
+    players = synthetic_players(n_players, seed=42)
+    stream = synthetic_stream(
+        n_matches, players, seed=42, activity_concentration=conc
+    )
+    t_gen = time.perf_counter() - t0
+    state0 = PlayerState.create(
+        n_players,
+        rank_points_ranked=players.rank_points_ranked,
+        rank_points_blitz=players.rank_points_blitz,
+        skill_tier=players.skill_tier,
+    )
+
+    t0 = time.perf_counter()
+    sched = pack_schedule(stream, pad_row=state0.pad_row, batch_size=batch)
+    t_pack = time.perf_counter() - t0
+    log(f"generate: {t_gen:.2f}s; pack: {t_pack:.2f}s -> {sched.n_steps} steps, "
+        f"occupancy {sched.occupancy:.3f}")
+
+    # Move the whole packed schedule to device once (it is the benchmark's
+    # working set; streaming/double-buffering is exercised via chunking).
+    # Chunks are large: per-dispatch overhead on the tunneled dev chip is
+    # ~100 ms, so the step count per call must amortize it.
+    steps_per_chunk = max(1, min(8192, sched.n_steps))
+    chunks = []
+    for start in range(0, sched.n_steps, steps_per_chunk):
+        chunks.append(sched.device_arrays(start, min(start + steps_per_chunk, sched.n_steps)))
+
+    def run():
+        state = jax.device_put(jax.tree.map(np.asarray, state0))
+        for arrays in chunks:
+            state, _ = _scan_chunk(state, arrays, cfg, False)
+        # Fetch a value: on the tunneled dev chip block_until_ready can
+        # return at enqueue; a host fetch must wait for real completion.
+        np.asarray(state.table[:1])
+        return state
+
+    t0 = time.perf_counter()
+    state = run()  # warmup + compile
+    t_warm = time.perf_counter() - t0
+    log(f"warmup (incl. compile): {t_warm:.2f}s")
+
+    times = []
+    for r in range(repeats):
+        t0 = time.perf_counter()
+        state = run()
+        times.append(time.perf_counter() - t0)
+        log(f"repeat {r}: {times[-1]:.3f}s")
+
+    best = min(times)
+    rate = sched.n_matches / best
+    mu = np.asarray(state.mu)[: state0.n_players]
+    rated = ~np.isnan(mu[:, 0])
+    log(f"sanity: {int(rated.sum())} players rated, "
+        f"mean shared mu {float(np.nanmean(mu[rated, 0])):.1f}")
+    assert np.isfinite(mu[rated, 0]).all()
+
+    print(json.dumps({
+        "metric": "matches_per_sec_per_chip",
+        "value": round(rate, 1),
+        "unit": "matches/s",
+        "vs_baseline": round(rate / BASELINE_MATCHES_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

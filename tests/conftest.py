@@ -10,11 +10,19 @@ validates the pjit path.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The environment's sitecustomize imports jax at interpreter startup and
+# pins the platform to the real accelerator, so env vars alone are too late
+# — override through the live config as well. Functional tests always run
+# on the virtual 8-device CPU mesh (perf runs go through bench.py).
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

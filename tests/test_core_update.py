@@ -154,11 +154,7 @@ class TestPriorResolution:
     def test_mode_prior_falls_back_to_shared(self):
         state = make_state()
         # give player 0 a shared rating but no ranked rating
-        state.mu.block_until_ready()
-        mu = state.mu.at[0, 0].set(2000.0)
-        sigma = state.sigma.at[0, 0].set(100.0)
-        import dataclasses
-        state = dataclasses.replace(state, mu=mu, sigma=sigma)
+        state = state.set_rating(0, constants.SHARED_COL, 2000.0, 100.0)
         batch = make_batch([([0, 1, 2], [3, 4, 5], 0)])
         out = rate_batch(state, batch, CFG)
         # delta defined only for players with an existing shared rating
@@ -194,6 +190,17 @@ class TestChecks:
             winner=batch.winner, mode_id=batch.mode_id,
             afk=jnp.asarray([False, True]))  # second match AFK -> no scatter
         check_conflict_free(batch)  # must not raise
+
+    def test_seed_cfg_mismatch_rejected(self):
+        # Seeds are baked at create() time; rating with a different config
+        # must fail loudly instead of silently ignoring the env override.
+        other = RatingConfig(unknown_player_sigma=800.0)
+        state = PlayerState.create(12, skill_tier=np.full(12, 15))
+        batch = make_batch([([0, 1, 2], [3, 4, 5], 0)])
+        with pytest.raises(ValueError, match="seed"):
+            rate_batch(state, batch, other)
+        state800 = PlayerState.create(12, skill_tier=np.full(12, 15), cfg=other)
+        rate_batch(state800, batch, other)  # matching cfg: fine
 
     def test_skill_tier_check(self):
         state = PlayerState.create(3, skill_tier=np.asarray([15, 30, 0]))

@@ -1,0 +1,61 @@
+"""Mesh data parallelism on the virtual 8-device CPU mesh.
+
+The invariant: the sharded run must produce exactly the single-device
+scheduled result (which itself matches the sequential oracle —
+tests/test_sched.py), for meshes of 1, 2, 4 and 8 devices.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.core.state import PlayerState
+from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
+from analyzer_tpu.parallel import make_mesh, rate_history_sharded
+from analyzer_tpu.sched import pack_schedule, rate_history
+
+CFG = RatingConfig()
+
+
+def setup(n_matches=200, n_players=60, batch_size=32, seed=11):
+    players = synthetic_players(n_players, seed=seed)
+    stream = synthetic_stream(n_matches, players, seed=seed)
+    state = PlayerState.create(
+        n_players,
+        rank_points_ranked=players.rank_points_ranked,
+        rank_points_blitz=players.rank_points_blitz,
+        skill_tier=players.skill_tier,
+    )
+    sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=batch_size)
+    return state, sched
+
+
+class TestShardedHistory:
+    @pytest.mark.parametrize("n_dev", [1, 2, 4, 8])
+    def test_matches_single_device(self, n_dev):
+        if len(jax.devices()) < n_dev:
+            pytest.skip(f"need {n_dev} devices")
+        state, sched = setup()
+        base, _ = rate_history(state, sched, CFG)
+
+        mesh = make_mesh(n_dev)
+        sharded = rate_history_sharded(state, sched, CFG, mesh=mesh, steps_per_chunk=13)
+
+        p = state.n_players
+        np.testing.assert_allclose(
+            np.asarray(sharded.mu)[:p], np.asarray(base.mu)[:p], rtol=1e-6, equal_nan=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(sharded.sigma)[:p],
+            np.asarray(base.sigma)[:p],
+            rtol=1e-6,
+            equal_nan=True,
+        )
+
+    def test_batch_size_divisibility_enforced(self):
+        state, sched = setup(batch_size=30)
+        if len(jax.devices()) < 8:
+            pytest.skip("need 8 devices")
+        with pytest.raises(ValueError, match="not divisible"):
+            rate_history_sharded(state, sched, CFG, mesh=make_mesh(8))
