@@ -8,12 +8,12 @@ match/player tensors, scaled over a TPU mesh with XLA collectives instead of
 RabbitMQ competing consumers.
 
 Layers (bottom up):
-  ops       closed-form rating kernels (TrueSkill two-team, Elo, quality)
-  core      tensor schemas: match batches (SoA) + player rating state
-  sched     chronology-respecting conflict-free superstep scheduler
-  parallel  device-mesh data parallelism (shard_map + psum over ICI)
-  models    win-probability heads (logistic, MLP) trained with optax
-  io        synthetic/CSV match streams, host feed, checkpointing
+  ops       closed-form rating kernels (TrueSkill two-team, quality, win prob)
+  core      packed player-state table + SoA match batches + the superstep kernel
+  sched     chronology-respecting conflict-free superstep scheduler + scan runner
+  parallel  device-mesh data parallelism (shard_map, all_gather over ICI)
+  models    Elo rater + win-probability heads (logistic, MLP) trained with optax
+  io        synthetic/CSV match streams, checkpoint/resume
   service   broker/store/worker shell mirroring the reference service
   rater     reference-compatible object API (get_trueskill_seed, rate_match)
 """

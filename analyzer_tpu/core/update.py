@@ -184,17 +184,31 @@ def rate_batch(state: PlayerState, batch: MatchBatch, cfg: RatingConfig) -> Rate
     )
 
 
+def scatter_rows(
+    state: PlayerState,
+    player_idx: jnp.ndarray,
+    slot_mask: jnp.ndarray,
+    updated: jnp.ndarray,
+    new_rows: jnp.ndarray,
+) -> PlayerState:
+    """The ONE whole-row scatter: masked / non-ratable slots are routed to
+    the padding row, so shapes stay static and no collision can occur as
+    long as the batch is conflict-free. Shared by the single-device path
+    (:func:`apply_outputs`) and the replicated-mesh path
+    (:mod:`analyzer_tpu.parallel.mesh`) so the routing invariant lives in
+    exactly one place."""
+    do = updated[:, None, None] & slot_mask
+    idx = jnp.where(do, player_idx, state.pad_row)
+    return dataclasses.replace(state, table=state.table.at[idx].set(new_rows))
+
+
 def apply_outputs(
     state: PlayerState, batch: MatchBatch, out: RateOutputs
 ) -> PlayerState:
-    """Scatters the updated rows into the player table — ONE whole-row
-    scatter. Masked / non-ratable slots are routed to the padding row, so
-    shapes stay static and no collision can occur as long as the batch is
-    conflict-free."""
-    do = out.updated[:, None, None] & batch.slot_mask
-    idx = jnp.where(do, batch.player_idx, state.pad_row)
-    table = state.table.at[idx].set(out.new_rows)
-    return dataclasses.replace(state, table=table)
+    """Scatters the updated rows into the player table."""
+    return scatter_rows(
+        state, batch.player_idx, batch.slot_mask, out.updated, out.new_rows
+    )
 
 
 def rate_and_apply(

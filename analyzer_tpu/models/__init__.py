@@ -1,0 +1,28 @@
+"""Rating and win-probability model zoo (BASELINE.json configs 1, 3, 4).
+
+The reference's only model is the TrueSkill update in ``rater.py``; the
+framework's north-star config list adds an Elo pairwise rater, a logistic
+win-probability head over rating features, and an MLP outcome predictor.
+All three follow the same TPU shape discipline as the TrueSkill core:
+static-shape batches, jit-compiled pure functions, optax-free hand-rolled
+SGD/Adam steps that scan over minibatches on device.
+"""
+
+from analyzer_tpu.models.elo import EloConfig, elo_history, elo_rate_batch
+from analyzer_tpu.models.features import N_FEATURES, history_features, match_features
+from analyzer_tpu.models.logistic import LogisticModel, train_logistic
+from analyzer_tpu.models.mlp import MLPModel, init_mlp, train_mlp
+
+__all__ = [
+    "EloConfig",
+    "elo_history",
+    "elo_rate_batch",
+    "match_features",
+    "history_features",
+    "N_FEATURES",
+    "LogisticModel",
+    "train_logistic",
+    "MLPModel",
+    "init_mlp",
+    "train_mlp",
+]

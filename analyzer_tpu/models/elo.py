@@ -1,0 +1,107 @@
+"""Elo pairwise/team rater (BASELINE.json config 1).
+
+Closed-form like the TrueSkill kernel but with a single scalar per player:
+team rating = mean of members, expected score from the logistic curve, and
+every member of a team moves by the same K-scaled surprise. Runs over the
+SAME conflict-free superstep schedule as TrueSkill (sched.pack_schedule),
+so chronology and scatter-safety come for free, and the state is a packed
+``[P+1, 1]``-style row table for the fast row-gather path.
+
+The reference has no Elo implementation; this is the harness-validation
+model from BASELINE.json ("Elo pairwise rater on 1k-match CSV") — simple
+enough to check the scheduler/scan machinery end-to-end by hand.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analyzer_tpu.sched.superstep import PackedSchedule
+
+
+@dataclasses.dataclass(frozen=True)
+class EloConfig:
+    initial: float = 1500.0
+    k: float = 32.0
+    scale: float = 400.0
+
+
+def create_elo_table(n_players: int, cfg: EloConfig = EloConfig()) -> jnp.ndarray:
+    """``[P+1]`` ratings, all at the initial value (padding row included)."""
+    return jnp.full((n_players + 1,), cfg.initial, jnp.float32)
+
+
+def elo_rate_batch(
+    table: jnp.ndarray,
+    player_idx: jnp.ndarray,
+    slot_mask: jnp.ndarray,
+    winner: jnp.ndarray,
+    ratable: jnp.ndarray,
+    pad_row: int,
+    cfg: EloConfig,
+):
+    """One conflict-free batch of team-Elo updates.
+
+    Returns (new_table, expected0) where expected0 is P(team 0 wins) under
+    the logistic curve — the pairwise-prediction output.
+    """
+    maskf = slot_mask.astype(table.dtype)
+    r = table[player_idx]  # [B,2,T] — row gather
+    n = jnp.maximum(maskf.sum(-1), 1.0)  # [B,2]
+    team_r = (r * maskf).sum(-1) / n  # [B,2] mean rating
+    diff = (team_r[:, 0] - team_r[:, 1]) / cfg.scale
+    expected0 = 1.0 / (1.0 + jnp.power(10.0, -diff))  # [B]
+
+    score0 = (winner == 0).astype(table.dtype)
+    delta0 = cfg.k * (score0 - expected0)  # team 0 members; team 1 gets -delta0
+    delta = jnp.stack([delta0, -delta0], axis=1)[:, :, None]  # [B,2,1]
+
+    do = ratable[:, None, None] & slot_mask
+    idx = jnp.where(do, player_idx, pad_row)
+    new_table = table.at[idx].add(jnp.where(do, delta, 0.0))
+    return new_table, expected0
+
+
+def elo_history(
+    sched: PackedSchedule,
+    n_players: int,
+    cfg: EloConfig = EloConfig(),
+    steps_per_chunk: int = 8192,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Full-history Elo re-rate over a packed schedule.
+
+    Returns (ratings [P], expected0 [N] in stream order) — the latter is the
+    model's win prediction for every match, made from pre-match ratings.
+    """
+    pad_row = n_players  # schedules pack against the player-table pad row
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def run_chunk(table, arrays):
+        pidx, mask, win, mode, afk = arrays
+
+        def step(tb, xs):
+            p, m, w, mo, a = xs
+            ratable = (mo >= 0) & ~a
+            tb, exp0 = elo_rate_batch(tb, p, m, w, ratable, pad_row, cfg)
+            return tb, exp0
+
+        return jax.lax.scan(step, table, (pidx, mask, win, mode, afk))
+
+    table = create_elo_table(n_players, cfg)
+    exps = []
+    for start in range(0, sched.n_steps, steps_per_chunk):
+        stop = min(start + steps_per_chunk, sched.n_steps)
+        table, exp0 = run_chunk(table, sched.device_arrays(start, stop))
+        exps.append(np.asarray(exp0))
+
+    flat = np.concatenate(exps, axis=0).reshape(-1)  # [S*B]
+    src = sched.match_idx.reshape(-1)
+    sel = src >= 0
+    expected = np.zeros(sched.n_matches, np.float32)
+    expected[src[sel]] = flat[sel]
+    return np.asarray(table)[:n_players], expected

@@ -1,0 +1,105 @@
+"""Match feature extraction for the win-probability heads.
+
+Features per match, built from the *pre-match* rating state (no leakage:
+the history runner's collected outputs are posteriors, so features here are
+reconstructed from a separate forward pass or from prior snapshots):
+
+    0    shared-mu sum difference (team0 - team1), mu0-normalized
+    1    shared-sigma sum (both teams), sigma0-normalized (uncertainty)
+    2    TrueSkill win probability Phi(diff / c)  (ops.trueskill)
+    3    match quality (draw probability proxy)
+    4..9 one-hot game mode (6 modes)
+
+10 features total — the "player-rating features" of BASELINE config 3. The
+reference exposes no such head; hero-draft features would concatenate here
+when draft data exists in the stream.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.core import constants
+from analyzer_tpu.core.state import MU_HI, MU_LO, SIGMA_HI, SIGMA_LO, COL_SEED_MU, COL_SEED_SIGMA, PlayerState
+from analyzer_tpu.ops import trueskill as ts
+
+N_FEATURES = 4 + constants.N_MODES
+
+
+def match_features(
+    state: PlayerState,
+    player_idx: jnp.ndarray,
+    slot_mask: jnp.ndarray,
+    mode_id: jnp.ndarray,
+    cfg: RatingConfig,
+) -> jnp.ndarray:
+    """``[B, N_FEATURES]`` from the current state (prior to these matches)."""
+    rows = state.table[player_idx]  # [B,2,T,W]
+    maskf = slot_mask.astype(rows.dtype)
+
+    mu_sh = rows[..., MU_LO]
+    sg_sh = rows[..., SIGMA_LO]
+    seed_mu = rows[..., COL_SEED_MU]
+    seed_sg = rows[..., COL_SEED_SIGMA]
+    has = ~jnp.isnan(mu_sh)
+    mu = jnp.where(has, mu_sh, seed_mu)
+    sg = jnp.where(has, sg_sh, seed_sg)
+
+    team_mu = (mu * maskf).sum(-1)  # [B,2]
+    mu_diff = (team_mu[:, 0] - team_mu[:, 1]) / cfg.mu0
+    sg_sum = (sg * maskf).sum(-1).sum(-1) / (cfg.sigma0 * 6.0)
+
+    p_win = ts.win_probability(mu, sg, slot_mask, cfg)
+    quality = ts.quality(mu, sg, slot_mask, cfg)
+
+    onehot = (
+        jnp.clip(mode_id, 0, None)[:, None] == jnp.arange(constants.N_MODES)[None, :]
+    ).astype(rows.dtype)
+
+    return jnp.concatenate(
+        [mu_diff[:, None], sg_sum[:, None], p_win[:, None], quality[:, None], onehot],
+        axis=1,
+    )
+
+
+def history_features(state, sched, cfg: RatingConfig, steps_per_chunk: int = 8192):
+    """Leak-free training data for the win-prob heads: one scan over the
+    packed schedule that computes each match's features from the PRE-match
+    state, then applies the rating update. Returns ``[N, F]`` features in
+    stream order (numpy) plus the final state."""
+    import dataclasses
+    from functools import partial
+
+    import numpy as np
+
+    from analyzer_tpu.core.state import MatchBatch
+    from analyzer_tpu.core.update import rate_and_apply
+
+    @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+    def run_chunk(st, arrays, cfg):
+        def step(s, xs):
+            pidx, mask, win, mode, afk = xs
+            batch = MatchBatch(
+                player_idx=pidx, slot_mask=mask, winner=win, mode_id=mode, afk=afk
+            )
+            feats = match_features(s, pidx, mask, mode, cfg)
+            s, _ = rate_and_apply(s, batch, cfg)
+            return s, feats
+
+        return jax.lax.scan(step, st, arrays)
+
+    state = jax.tree.map(jnp.copy, state)
+    chunks = []
+    for start in range(0, sched.n_steps, steps_per_chunk):
+        stop = min(start + steps_per_chunk, sched.n_steps)
+        state, feats = run_chunk(state, sched.device_arrays(start, stop), cfg)
+        chunks.append(np.asarray(feats))
+
+    flat = np.concatenate(chunks, axis=0).reshape(-1, N_FEATURES)
+    src = sched.match_idx.reshape(-1)
+    sel = src >= 0
+    out = np.zeros((sched.n_matches, N_FEATURES), np.float32)
+    out[src[sel]] = flat[sel]
+    return out, state

@@ -1,0 +1,86 @@
+"""Logistic win-probability head (BASELINE.json config 3).
+
+A single sigmoid over the match features — trained with Adam (optax) via a
+jitted epoch scan over static-shape minibatches. The label is "team 0 won";
+the model calibrates the TrueSkill-derived features against observed
+outcomes (e.g. learning how much rating gap actually predicts a win per
+mode). Everything runs on device; the training loop is one lax.scan per
+epoch, not a Python-per-batch loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+@partial(
+    jax.tree_util.register_dataclass, data_fields=["w", "b"], meta_fields=[]
+)
+@dataclasses.dataclass
+class LogisticModel:
+    w: jnp.ndarray  # [F]
+    b: jnp.ndarray  # []
+
+    def predict(self, x: jnp.ndarray) -> jnp.ndarray:
+        """P(team 0 wins), ``[B]`` for ``x [B, F]``."""
+        return jax.nn.sigmoid(x @ self.w + self.b)
+
+
+def _nll(model: LogisticModel, x, y, mask):
+    p = jnp.clip(model.predict(x), 1e-7, 1 - 1e-7)
+    ll = y * jnp.log(p) + (1 - y) * jnp.log1p(-p)
+    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def train_logistic(
+    features: np.ndarray,
+    team0_won: np.ndarray,
+    epochs: int = 30,
+    batch_size: int = 4096,
+    lr: float = 0.05,
+    seed: int = 0,
+) -> tuple[LogisticModel, float]:
+    """Trains on ``[N, F]`` features; returns (model, final mean NLL)."""
+    n, f = features.shape
+    n_batches = max(1, -(-n // batch_size))
+    padded = n_batches * batch_size
+    x = np.zeros((padded, f), np.float32)
+    y = np.zeros((padded,), np.float32)
+    m = np.zeros((padded,), np.float32)
+    x[:n] = features
+    y[:n] = team0_won
+    m[:n] = 1.0
+
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(padded)
+    xb = jnp.asarray(x[perm].reshape(n_batches, batch_size, f))
+    yb = jnp.asarray(y[perm].reshape(n_batches, batch_size))
+    mb = jnp.asarray(m[perm].reshape(n_batches, batch_size))
+
+    model = LogisticModel(w=jnp.zeros((f,), jnp.float32), b=jnp.zeros((), jnp.float32))
+    opt = optax.adam(lr)
+    opt_state = opt.init(model)
+
+    @jax.jit
+    def epoch(carry, _):
+        model, opt_state = carry
+
+        def step(c, batch):
+            mdl, ost = c
+            bx, by, bm = batch
+            loss, grads = jax.value_and_grad(_nll)(mdl, bx, by, bm)
+            updates, ost = opt.update(grads, ost)
+            mdl = optax.apply_updates(mdl, updates)
+            return (mdl, ost), loss
+
+        (model, opt_state), losses = jax.lax.scan(step, (model, opt_state), (xb, yb, mb))
+        return (model, opt_state), losses.mean()
+
+    (model, _), losses = jax.lax.scan(epoch, (model, opt_state), None, length=epochs)
+    return model, float(np.asarray(losses)[-1])

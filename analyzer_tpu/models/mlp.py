@@ -1,0 +1,112 @@
+"""MLP match-outcome predictor (BASELINE.json config 4).
+
+A small bfloat16-friendly MLP over match features (extensible to full
+telemetry — items, gold, KDA — by widening the feature vector). Layers are
+sized for MXU tiling (multiples of 8/128 would matter at telemetry scale;
+at 10 features the model is VPU-bound and latency-trivial). Training: Adam,
+jitted epoch scans, identical harness to the logistic head so the two are
+drop-in comparable on log-loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["w1", "b1", "w2", "b2", "w3", "b3"],
+    meta_fields=[],
+)
+@dataclasses.dataclass
+class MLPModel:
+    w1: jnp.ndarray
+    b1: jnp.ndarray
+    w2: jnp.ndarray
+    b2: jnp.ndarray
+    w3: jnp.ndarray
+    b3: jnp.ndarray
+
+    def logits(self, x: jnp.ndarray) -> jnp.ndarray:
+        h = jax.nn.relu(x @ self.w1 + self.b1)
+        h = jax.nn.relu(h @ self.w2 + self.b2)
+        return (h @ self.w3 + self.b3)[..., 0]
+
+    def predict(self, x: jnp.ndarray) -> jnp.ndarray:
+        """P(team 0 wins), ``[B]``."""
+        return jax.nn.sigmoid(self.logits(x))
+
+
+def init_mlp(n_features: int, hidden: int = 64, seed: int = 0) -> MLPModel:
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+    s1 = (2.0 / n_features) ** 0.5
+    s2 = (2.0 / hidden) ** 0.5
+    return MLPModel(
+        w1=jax.random.normal(k1, (n_features, hidden), jnp.float32) * s1,
+        b1=jnp.zeros((hidden,), jnp.float32),
+        w2=jax.random.normal(k2, (hidden, hidden), jnp.float32) * s2,
+        b2=jnp.zeros((hidden,), jnp.float32),
+        w3=jax.random.normal(k3, (hidden, 1), jnp.float32) * s2,
+        b3=jnp.zeros((1,), jnp.float32),
+    )
+
+
+def _nll(model: MLPModel, x, y, mask):
+    logits = model.logits(x)
+    ll = -optax.sigmoid_binary_cross_entropy(logits, y)
+    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def train_mlp(
+    features: np.ndarray,
+    team0_won: np.ndarray,
+    hidden: int = 64,
+    epochs: int = 30,
+    batch_size: int = 4096,
+    lr: float = 1e-3,
+    seed: int = 0,
+) -> tuple[MLPModel, float]:
+    """Trains on ``[N, F]`` features; returns (model, final mean NLL)."""
+    n, f = features.shape
+    n_batches = max(1, -(-n // batch_size))
+    padded = n_batches * batch_size
+    x = np.zeros((padded, f), np.float32)
+    y = np.zeros((padded,), np.float32)
+    m = np.zeros((padded,), np.float32)
+    x[:n] = features
+    y[:n] = team0_won
+    m[:n] = 1.0
+
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(padded)
+    xb = jnp.asarray(x[perm].reshape(n_batches, batch_size, f))
+    yb = jnp.asarray(y[perm].reshape(n_batches, batch_size))
+    mb = jnp.asarray(m[perm].reshape(n_batches, batch_size))
+
+    model = init_mlp(f, hidden, seed)
+    opt = optax.adam(lr)
+    opt_state = opt.init(model)
+
+    @jax.jit
+    def epoch(carry, _):
+        model, opt_state = carry
+
+        def step(c, batch):
+            mdl, ost = c
+            bx, by, bm = batch
+            loss, grads = jax.value_and_grad(_nll)(mdl, bx, by, bm)
+            updates, ost = opt.update(grads, ost)
+            mdl = optax.apply_updates(mdl, updates)
+            return (mdl, ost), loss
+
+        (model, opt_state), losses = jax.lax.scan(step, (model, opt_state), (xb, yb, mb))
+        return (model, opt_state), losses.mean()
+
+    (model, _), losses = jax.lax.scan(epoch, (model, opt_state), None, length=epochs)
+    return model, float(np.asarray(losses)[-1])

@@ -24,8 +24,6 @@ slices; the host feed stays sharded by process.
 
 from __future__ import annotations
 
-import dataclasses
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -33,41 +31,43 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from analyzer_tpu.config import RatingConfig
 from analyzer_tpu.core.state import MatchBatch, PlayerState
-from analyzer_tpu.core.update import rate_batch
+from analyzer_tpu.core.update import rate_batch, scatter_rows
 from analyzer_tpu.sched.superstep import PackedSchedule
 
 DATA_AXIS = "data"
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
-    """A 1-D ``data`` mesh over the first ``n_devices`` local devices."""
+    """A 1-D ``data`` mesh over the first ``n_devices`` local devices.
+    Raises when fewer devices exist than asked for — silently truncating
+    would run at lower parallelism than the caller sized the batch for."""
     if devices is None:
         devices = jax.devices()
         if n_devices is not None:
+            if len(devices) < n_devices:
+                raise ValueError(
+                    f"asked for a {n_devices}-device mesh but only "
+                    f"{len(devices)} devices are available"
+                )
             devices = devices[:n_devices]
     return Mesh(np.asarray(devices), (DATA_AXIS,))
 
 
-def _scatter_rows(
-    state: PlayerState,
-    player_idx: jnp.ndarray,
-    slot_mask: jnp.ndarray,
-    updated: jnp.ndarray,
-    new_rows: jnp.ndarray,
-) -> PlayerState:
-    """Applies a full batch of row writes (identical on each replica)."""
-    do = updated[:, None, None] & slot_mask
-    idx = jnp.where(do, player_idx, state.pad_row)
-    return dataclasses.replace(state, table=state.table.at[idx].set(new_rows))
+_step_fn_cache: dict = {}
 
 
 def sharded_step_fn(mesh: Mesh, cfg: RatingConfig):
-    """Builds the jitted, shard_map'd chunk runner.
+    """Builds (and memoizes — jit cache can't see through fresh closures)
+    the jitted, shard_map'd chunk runner.
 
     Returns ``run(state, pidx, mask, winner, mode, afk) -> state`` scanning
     over the leading superstep axis; the batch axis (second) is sharded over
     ``data``, state is replicated and donated.
     """
+    key = (tuple(d.id for d in mesh.devices.flat), cfg)
+    cached = _step_fn_cache.get(key)
+    if cached is not None:
+        return cached
 
     def scan_chunk(state: PlayerState, pidx, mask, winner, mode, afk):
         def step(st, xs):
@@ -82,7 +82,7 @@ def sharded_step_fn(mesh: Mesh, cfg: RatingConfig):
                 lambda x: jax.lax.all_gather(x, DATA_AXIS, axis=0, tiled=True),
                 (lp, lm, out.updated, out.new_rows),
             )
-            return _scatter_rows(st, *g), None
+            return scatter_rows(st, *g), None
 
         state, _ = jax.lax.scan(step, state, (pidx, mask, winner, mode, afk))
         return state
@@ -99,7 +99,9 @@ def sharded_step_fn(mesh: Mesh, cfg: RatingConfig):
         out_specs=P(),
         check_vma=False,
     )
-    return jax.jit(shmapped, donate_argnums=(0,))
+    fn = jax.jit(shmapped, donate_argnums=(0,))
+    _step_fn_cache[key] = fn
+    return fn
 
 
 def rate_history_sharded(
@@ -123,7 +125,10 @@ def rate_history_sharded(
     step_fn = sharded_step_fn(mesh, cfg)
 
     replicated = NamedSharding(mesh, P())
-    state = jax.device_put(state, replicated)  # reshards without host detour
+    # Copy before resharding: device_put is a no-op alias when the input
+    # already matches, and the donated step would then free the CALLER's
+    # buffers (same guard as sched.runner.rate_history).
+    state = jax.device_put(jax.tree.map(jnp.copy, state), replicated)
     batch_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
 
     for start in range(0, sched.n_steps, steps_per_chunk):

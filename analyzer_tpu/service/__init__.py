@@ -1,0 +1,24 @@
+"""Service shell: the reference ``worker.py`` re-imagined around the TPU core.
+
+The reference is a RabbitMQ consumer that loads match graphs from MySQL,
+rates them one at a time, and fans results out (``worker.py:85-199``). The
+shell here keeps its *semantics* — micro-batching with an idle flush,
+whole-batch dead-lettering, per-message ack, the notify/crunch/sew/telesuck
+fan-out, chronological processing — but the rating path is the vectorized
+scheduler + jit-compiled superstep kernel, and the authoritative player
+state is the HBM-resident table (the store is a write-behind mirror, not
+the source of truth during a batch).
+
+Pluggable edges: ``Broker`` (in-memory always; pika adapter when installed)
+and ``MatchStore`` (in-memory object graphs; a SQLAlchemy adapter would
+slot in the same way). Transactionality is by construction: a batch's
+outputs are fully computed by pure functions before any mutation is
+applied, so an exception mid-compute leaves store and state untouched
+(mirroring the reference's single commit/rollback, ``worker.py:194-199``).
+"""
+
+from analyzer_tpu.service.broker import Broker, InMemoryBroker, Message
+from analyzer_tpu.service.store import InMemoryStore
+from analyzer_tpu.service.worker import Worker
+
+__all__ = ["Broker", "InMemoryBroker", "Message", "InMemoryStore", "Worker"]

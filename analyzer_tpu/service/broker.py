@@ -1,0 +1,136 @@
+"""Message broker edge: in-memory queues plus an optional pika adapter.
+
+The reference talks to RabbitMQ through pika 0.10's blocking API
+(``worker.py:85-92``): durable queues, bounded prefetch, per-message
+ack/nack, publish with headers. This module models exactly the subset the
+worker needs, with an in-memory implementation for tests/embedded use and
+a pika adapter that activates only when pika is importable (it is not a
+baked dependency of this framework).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import deque
+from typing import Iterator, Protocol
+
+
+@dataclasses.dataclass
+class Message:
+    body: bytes
+    headers: dict | None = None
+    delivery_tag: int = 0
+
+
+class Broker(Protocol):
+    def declare_queue(self, name: str) -> None: ...
+
+    def publish(self, queue: str, body: bytes, headers: dict | None = None) -> None: ...
+
+    def publish_topic(
+        self, exchange: str, routing_key: str, body: bytes
+    ) -> None: ...
+
+    def get(self, queue: str, limit: int) -> list[Message]: ...
+
+    def ack(self, delivery_tag: int) -> None: ...
+
+    def nack(self, delivery_tag: int, requeue: bool = False) -> None: ...
+
+
+class InMemoryBroker:
+    """Queues as deques with unacked-message redelivery semantics: ``get``
+    moves messages to an in-flight map; ``nack(requeue=True)`` or
+    ``requeue_unacked`` (crash simulation) returns them, ``ack`` drops them
+    — the delivery contract the reference relies on for crash recovery
+    (SURVEY.md section 5.3)."""
+
+    def __init__(self) -> None:
+        self.queues: dict[str, deque[Message]] = {}
+        self.topics: list[tuple[str, str, bytes]] = []
+        self._unacked: dict[int, tuple[str, Message]] = {}
+        self._tags = itertools.count(1)
+
+    def declare_queue(self, name: str) -> None:
+        self.queues.setdefault(name, deque())
+
+    def publish(self, queue: str, body: bytes, headers: dict | None = None) -> None:
+        self.declare_queue(queue)
+        self.queues[queue].append(Message(body=body, headers=dict(headers or {})))
+
+    def publish_topic(self, exchange: str, routing_key: str, body: bytes) -> None:
+        self.topics.append((exchange, routing_key, body))
+
+    def get(self, queue: str, limit: int) -> list[Message]:
+        self.declare_queue(queue)
+        out = []
+        q = self.queues[queue]
+        while q and len(out) < limit:
+            msg = q.popleft()
+            msg = dataclasses.replace(msg, delivery_tag=next(self._tags))
+            self._unacked[msg.delivery_tag] = (queue, msg)
+            out.append(msg)
+        return out
+
+    def ack(self, delivery_tag: int) -> None:
+        self._unacked.pop(delivery_tag, None)
+
+    def nack(self, delivery_tag: int, requeue: bool = False) -> None:
+        entry = self._unacked.pop(delivery_tag, None)
+        if entry and requeue:
+            queue, msg = entry
+            self.queues[queue].appendleft(msg)
+
+    def requeue_unacked(self) -> None:
+        """Simulates a consumer crash: the broker redelivers everything."""
+        for queue, msg in list(self._unacked.values()):
+            self.queues[queue].appendleft(msg)
+        self._unacked.clear()
+
+    def qsize(self, queue: str) -> int:
+        return len(self.queues.get(queue, ()))
+
+
+def make_pika_broker(uri: str):
+    """RabbitMQ adapter; raises ImportError when pika is absent. Kept thin:
+    the Worker only needs the 6-method Broker protocol."""
+    import pika  # gated: not a baked dependency
+
+    class PikaBroker:
+        def __init__(self, uri: str) -> None:
+            self._conn = pika.BlockingConnection(pika.URLParameters(uri))
+            self._ch = self._conn.channel()
+
+        def declare_queue(self, name: str) -> None:
+            self._ch.queue_declare(queue=name, durable=True)
+
+        def publish(self, queue: str, body: bytes, headers: dict | None = None) -> None:
+            props = pika.BasicProperties(headers=headers or {})
+            self._ch.basic_publish("", queue, body, props)
+
+        def publish_topic(self, exchange: str, routing_key: str, body: bytes) -> None:
+            self._ch.basic_publish(exchange, routing_key, body)
+
+        def get(self, queue: str, limit: int):
+            out = []
+            for _ in range(limit):
+                method, props, body = self._ch.basic_get(queue)
+                if method is None:
+                    break
+                out.append(
+                    Message(
+                        body=body,
+                        headers=getattr(props, "headers", None) or {},
+                        delivery_tag=method.delivery_tag,
+                    )
+                )
+            return out
+
+        def ack(self, delivery_tag: int) -> None:
+            self._ch.basic_ack(delivery_tag)
+
+        def nack(self, delivery_tag: int, requeue: bool = False) -> None:
+            self._ch.basic_nack(delivery_tag, requeue=requeue)
+
+    return PikaBroker(uri)

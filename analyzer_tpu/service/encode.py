@@ -1,0 +1,166 @@
+"""Object graph <-> tensor codec for the service path.
+
+The worker loads the reference's duck-typed match graphs (match -> rosters
+-> participants -> player / participant_items) and rates them through the
+vectorized scheduler + kernel, not the one-match object API. This module
+packs a list of loaded match objects into a MatchStream + PlayerState and
+scatters the HistoryOutputs back onto the objects with exactly the writes
+``rater.py:140-169`` performs (quality, shared mu/sigma + delta snapshots,
+mode mu/sigma, any_afk), preserving the gating rules for AFK/unsupported
+matches (``rater.py:83-106``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.core import constants
+from analyzer_tpu.core.seeding import trueskill_seed
+from analyzer_tpu.core.state import (
+    COL_SEED_MU,
+    COL_SEED_SIGMA,
+    MAX_TEAM_SIZE,
+    MU_LO,
+    SIGMA_LO,
+    TABLE_WIDTH,
+    PlayerState,
+)
+from analyzer_tpu.sched.superstep import MatchStream
+
+
+class EncodedBatch:
+    """A batch of match objects packed for the tensor path, with the maps
+    needed to write results back."""
+
+    def __init__(self, matches, cfg: RatingConfig):
+        self.matches = list(matches)
+        self.cfg = cfg
+
+        # Player rows: one per distinct player object (by api_id).
+        self.row_of: dict[str, int] = {}
+        self.player_at: list[object] = []
+        for m in self.matches:
+            for part in getattr(m, "participants", []):
+                player = part.player[0]
+                if player.api_id not in self.row_of:
+                    self.row_of[player.api_id] = len(self.player_at)
+                    self.player_at.append(player)
+        p = len(self.player_at)
+        self.n_players = p
+
+        # State table from object attributes (NaN for SQL NULL / None).
+        table = np.full((p + 1, TABLE_WIDTH), np.nan, np.float32)
+        rr = np.full((p + 1,), np.nan, np.float32)
+        rb = np.full((p + 1,), np.nan, np.float32)
+        ti = np.zeros((p + 1,), np.int32)
+        for r, player in enumerate(self.player_at):
+            for c, col in enumerate(constants.RATING_COLUMNS):
+                mu = getattr(player, f"{col}_mu", None)
+                if mu is not None:
+                    table[r, MU_LO + c] = float(mu)
+                    table[r, SIGMA_LO + c] = float(getattr(player, f"{col}_sigma"))
+            if player.rank_points_ranked is not None:
+                rr[r] = float(player.rank_points_ranked)
+            if player.rank_points_blitz is not None:
+                rb[r] = float(player.rank_points_blitz)
+            tier = player.skill_tier
+            if tier is not None:
+                if not (constants.MIN_SKILL_TIER <= tier <= constants.MAX_SKILL_TIER):
+                    # The reference KeyErrors on out-of-table tiers
+                    # (rater.py:60); surface it at encode time.
+                    raise KeyError(
+                        f"player {player.api_id}: skill_tier {tier} outside "
+                        f"[{constants.MIN_SKILL_TIER}, {constants.MAX_SKILL_TIER}]"
+                    )
+                ti[r] = int(tier)
+        seed_mu, seed_sigma = trueskill_seed(
+            jnp.asarray(rr), jnp.asarray(rb), jnp.asarray(ti), cfg
+        )
+        table[:, COL_SEED_MU] = np.asarray(seed_mu)
+        table[:, COL_SEED_SIGMA] = np.asarray(seed_sigma)
+        self.state = PlayerState(
+            table=jnp.asarray(table),
+            rank_points_ranked=jnp.asarray(rr),
+            rank_points_blitz=jnp.asarray(rb),
+            skill_tier=jnp.asarray(ti),
+            seed_cfg=cfg,
+        )
+
+        # Match tensors.
+        n = len(self.matches)
+        idx = np.full((n, 2, MAX_TEAM_SIZE), -1, np.int32)
+        winner = np.zeros((n,), np.int32)
+        mode = np.full((n,), constants.UNSUPPORTED_MODE_ID, np.int32)
+        afk = np.zeros((n,), bool)
+        # slot -> participant object, for the per-participant write-back
+        self.slot_part: list[list[list[object]]] = []
+        for i, m in enumerate(self.matches):
+            mode[i] = constants.MODE_TO_ID.get(m.game_mode, constants.UNSUPPORTED_MODE_ID)
+            rosters = list(m.rosters)
+            parts_grid: list[list[object]] = [[], []]
+            bad = len(rosters) != 2
+            if not bad:
+                wins = [bool(r.winner) for r in rosters]
+                if wins[0] == wins[1]:
+                    raise ValueError(
+                        f"match {m.api_id}: rosters must have exactly one "
+                        f"winner, got winner flags {wins}"
+                    )
+                winner[i] = 0 if wins[0] else 1
+                for t, roster in enumerate(rosters):
+                    plist = list(roster.participants)
+                    if len(plist) > MAX_TEAM_SIZE:
+                        raise ValueError(
+                            f"match {m.api_id}: team of {len(plist)} exceeds "
+                            f"max team size {MAX_TEAM_SIZE}"
+                        )
+                    for s, part in enumerate(plist):
+                        idx[i, t, s] = self.row_of[part.player[0].api_id]
+                    parts_grid[t] = plist
+            anyafk = bad or any(
+                p.went_afk == 1 for p in getattr(m, "participants", [])
+            )
+            afk[i] = anyafk
+            self.slot_part.append(parts_grid)
+
+        self.stream = MatchStream(
+            player_idx=idx, winner=winner, mode_id=mode, afk=afk
+        )
+
+    def write_back(self, outs) -> None:
+        """Applies HistoryOutputs (stream order) to the object graph with
+        the reference's write set. Caller guarantees outs covers
+        ``self.matches`` 1:1."""
+        for i, m in enumerate(self.matches):
+            mode_id = int(self.stream.mode_id[i])
+            if mode_id == constants.UNSUPPORTED_MODE_ID:
+                continue  # rater.py:83-85 — untouched
+            col = constants.RATING_COLUMNS[mode_id + 1]
+            if not outs.updated[i]:
+                # AFK/invalid gate: quality=0, any_afk=True everywhere,
+                # no rating writes (rater.py:102-106).
+                m.trueskill_quality = 0
+                for part in m.participants:
+                    part.participant_items[0].any_afk = True
+                continue
+            m.trueskill_quality = float(outs.quality[i])
+            for t in range(2):
+                for s, part in enumerate(self.slot_part[i][t]):
+                    player = part.player[0]
+                    sh_mu = float(outs.shared_mu[i, t, s])
+                    sh_sg = float(outs.shared_sigma[i, t, s])
+                    part.trueskill_mu = sh_mu
+                    part.trueskill_sigma = sh_sg
+                    part.trueskill_delta = float(outs.delta[i, t, s])
+                    player.trueskill_mu = sh_mu
+                    player.trueskill_sigma = sh_sg
+                    q_mu = float(outs.mode_mu[i, t, s])
+                    q_sg = float(outs.mode_sigma[i, t, s])
+                    setattr(player, f"{col}_mu", q_mu)
+                    setattr(player, f"{col}_sigma", q_sg)
+                    items = part.participant_items[0]
+                    items.any_afk = False
+                    setattr(items, f"{col}_mu", q_mu)
+                    setattr(items, f"{col}_sigma", q_sg)

@@ -1,0 +1,171 @@
+"""The worker: consume match ids, rate in batches, commit, fan out.
+
+Mirrors the reference's control flow (``worker.py:95-166``) with the
+vectorized rating path swapped in:
+
+  * micro-batcher — accumulate messages; flush at ``batch_size`` or after
+    ``idle_timeout`` seconds from the first queued message
+    (``worker.py:95-101``);
+  * process — dedupe ids, load chronologically, encode to tensors, run the
+    conflict-free scheduler + jitted kernel, write back
+    (``worker.py:169-199``; outputs are fully computed before any mutation,
+    giving the reference's single-transaction semantics by construction);
+  * failure policy — any exception dead-letters the WHOLE batch to
+    ``<queue>_failed`` and nacks without requeue (``worker.py:110-120``);
+  * fan-out — per-message ack; notify via topic exchange with the message's
+    ``notify`` header; optional crunch/sew forwards of the raw body;
+    optional telesuck publish of each telemetry URL with a
+    ``match_api_id`` header (``worker.py:122-166``);
+  * metrics — matches/sec counter, the BASELINE.json first-class output
+    (SURVEY.md section 5.5: the reference has only debug logs).
+"""
+
+from __future__ import annotations
+
+import time
+
+from analyzer_tpu.config import RatingConfig, ServiceConfig
+from analyzer_tpu.logging_utils import get_logger
+from analyzer_tpu.sched import pack_schedule, rate_history
+from analyzer_tpu.service.broker import Broker, Message
+from analyzer_tpu.service.encode import EncodedBatch
+
+logger = get_logger(__name__)
+
+
+class Worker:
+    def __init__(
+        self,
+        broker: Broker,
+        store,
+        config: ServiceConfig | None = None,
+        rating_config: RatingConfig | None = None,
+        clock=time.monotonic,
+    ) -> None:
+        self.broker = broker
+        self.store = store
+        self.config = config or ServiceConfig.from_env()
+        self.rating_config = rating_config or RatingConfig.from_env()
+        self.clock = clock
+        self.queue: list[Message] = []
+        self._first_message_at: float | None = None
+        self.matches_rated = 0
+        self.batches_failed = 0
+        self._started_at = clock()
+
+        c = self.config
+        # The reference declares queue/failed/crunch/telesuck but NOT sew
+        # (worker.py:87-90) — sew is assumed to exist; we keep that contract.
+        broker.declare_queue(c.queue)
+        broker.declare_queue(c.failed_queue)
+        broker.declare_queue(c.crunch_queue)
+        broker.declare_queue(c.telesuck_queue)
+
+    # -- micro-batcher ----------------------------------------------------
+    def poll(self) -> bool:
+        """One consumer iteration: pull what's available, flush when the
+        batch is full or the idle timer expired. Returns True if a flush
+        happened."""
+        room = self.config.batch_size - len(self.queue)
+        if room > 0:
+            got = self.broker.get(self.config.queue, room)
+            if got and self._first_message_at is None:
+                self._first_message_at = self.clock()
+            self.queue.extend(got)
+        full = len(self.queue) >= self.config.batch_size
+        idle = (
+            self._first_message_at is not None
+            and self.clock() - self._first_message_at >= self.config.idle_timeout
+        )
+        if self.queue and (full or idle):
+            self.try_process()
+            return True
+        return False
+
+    def run(self, max_flushes: int | None = None, poll_interval: float = 0.01) -> None:
+        """Blocking consume loop (the reference's ``start_consuming``)."""
+        flushes = 0
+        while max_flushes is None or flushes < max_flushes:
+            if self.poll():
+                flushes += 1
+            else:
+                time.sleep(poll_interval)
+
+    # -- batch pipeline ---------------------------------------------------
+    def try_process(self) -> None:
+        """The reference's ``try_process`` (``worker.py:103-166``)."""
+        batch = self.queue
+        self.queue = []
+        self._first_message_at = None
+        try:
+            rated_ids = self.process([m.body.decode() for m in batch])
+        except Exception as err:  # noqa: BLE001 — policy: any error dead-letters
+            logger.error("batch failed: %s", err)
+            self.batches_failed += 1
+            for msg in batch:
+                self.broker.publish(self.config.failed_queue, msg.body, msg.headers)
+                self.broker.nack(msg.delivery_tag, requeue=False)
+            return
+
+        logger.info("acking batch")
+        for msg in batch:
+            self.broker.ack(msg.delivery_tag)
+            notify = (msg.headers or {}).get("notify")
+            if notify:
+                self.broker.publish_topic("amq.topic", notify, b"analyze_update")
+            if self.config.do_crunch_match:
+                self.broker.publish(self.config.crunch_queue, msg.body)
+            if self.config.do_sew_match:
+                self.broker.publish(self.config.sew_queue, msg.body)
+            if self.config.do_telesuck_match:
+                mid = msg.body.decode()
+                for url in self.store.asset_urls(mid):
+                    self.broker.publish(
+                        self.config.telesuck_queue,
+                        url.encode(),
+                        headers={"match_api_id": mid},
+                    )
+
+    def process(self, ids: list[str]) -> list[str]:
+        """Rates one batch of match ids. Pure until the final write-back:
+        an exception anywhere leaves objects and state untouched."""
+        matches = self.store.load_batch(ids)
+        logger.info("processing batch of %s matches", len(matches))
+        if not matches:
+            return []
+        enc = EncodedBatch(matches, self.rating_config)
+        sched = pack_schedule(enc.stream, pad_row=enc.state.pad_row)
+        _, outs = rate_history(enc.state, sched, self.rating_config, collect=True)
+        enc.write_back(outs)
+        self.matches_rated += len(matches)
+        return [m.api_id for m in matches]
+
+    # -- observability ----------------------------------------------------
+    @property
+    def matches_per_sec(self) -> float:
+        dt = self.clock() - self._started_at
+        return self.matches_rated / dt if dt > 0 else 0.0
+
+
+def main() -> None:
+    """``python -m analyzer_tpu.service.worker`` — the reference's
+    ``python3 worker.py`` entry point (``worker.py:219-221``), requiring a
+    live RabbitMQ (pika installed) to be useful. Embedded/in-process use
+    goes through Worker(InMemoryBroker(), InMemoryStore()) instead."""
+    config = ServiceConfig.from_env()
+    from analyzer_tpu.service.broker import make_pika_broker
+
+    broker = make_pika_broker(config.rabbitmq_uri)
+    if config.database_uri:
+        raise NotImplementedError(
+            "SQL match store adapter not wired; ingest matches into an "
+            "InMemoryStore (service.store) or extend it with the automap "
+            "schema of the reference (worker.py:38-83)"
+        )
+    from analyzer_tpu.service.store import InMemoryStore
+
+    Worker(broker, InMemoryStore(), config).run()
+
+
+if __name__ == "__main__":
+    main()

@@ -53,6 +53,20 @@ class TestShardedHistory:
             equal_nan=True,
         )
 
+    def test_caller_state_survives(self):
+        # Regression: the donated sharded scan must not free the caller's
+        # buffers (device_put can alias when sharding already matches).
+        state, sched = setup(n_matches=40, n_players=30, batch_size=8)
+        mesh = make_mesh(1)
+        a = rate_history_sharded(state, sched, CFG, mesh=mesh)
+        b = rate_history_sharded(state, sched, CFG, mesh=mesh)  # state reusable
+        np.testing.assert_array_equal(np.asarray(a.table), np.asarray(b.table))
+        assert np.isnan(np.asarray(state.table)[:, 0]).all()  # untouched
+
+    def test_insufficient_devices_rejected(self):
+        with pytest.raises(ValueError, match="devices"):
+            make_mesh(1024)
+
     def test_batch_size_divisibility_enforced(self):
         state, sched = setup(batch_size=30)
         if len(jax.devices()) < 8:

@@ -1,0 +1,178 @@
+"""Service shell: batching, failure policy, fan-out, end-to-end rating.
+
+The reference leaves worker.py entirely untested (SURVEY.md section 4);
+here the whole shell runs in-process against the in-memory broker/store,
+covering the parts the reference's ops relied on AMQP for: whole-batch
+dead-lettering, per-message ack, crash redelivery, idle-timeout flushes,
+and the notify/crunch/sew/telesuck fan-out (``worker.py:95-166``).
+"""
+
+import numpy as np
+import pytest
+
+from analyzer_tpu.config import RatingConfig, ServiceConfig
+from analyzer_tpu.service import InMemoryBroker, InMemoryStore, Worker
+from tests.fakes import fake_match, fake_participant, fake_player, fake_roster
+
+
+def mk_match(api_id, created_at=0, mode="ranked", players=None, afk=False):
+    def part(p):
+        return fake_participant(player=p, went_afk=1 if afk else 0)
+
+    players = players or [fake_player(skill_tier=15, api_id=f"{api_id}-p{i}") for i in range(6)]
+    m = fake_match(
+        mode,
+        [fake_roster(True, [part(p) for p in players[:3]]),
+         fake_roster(False, [part(p) for p in players[3:]])],
+        api_id=api_id,
+    )
+    m.created_at = created_at
+    return m
+
+
+@pytest.fixture()
+def rig():
+    broker = InMemoryBroker()
+    store = InMemoryStore()
+    cfg = ServiceConfig(batch_size=4, idle_timeout=0.0)
+    worker = Worker(broker, store, cfg, RatingConfig())
+    return broker, store, worker
+
+
+class TestPipeline:
+    def test_end_to_end_rating(self, rig):
+        broker, store, worker = rig
+        for i in range(4):
+            store.add_match(mk_match(f"m{i}", created_at=i))
+            broker.publish("analyze", f"m{i}".encode())
+        assert worker.poll()
+        m0 = store.matches["m0"]
+        w = m0.rosters[0].participants[0].player[0]
+        l = m0.rosters[1].participants[0].player[0]
+        assert w.trueskill_mu is not None and l.trueskill_mu is not None
+        assert w.trueskill_mu > l.trueskill_mu
+        assert 0 < m0.trueskill_quality < 1
+        assert w.trueskill_ranked_mu is not None
+        assert worker.matches_rated == 4
+        assert broker.qsize("analyze") == 0
+        assert not broker._unacked  # all acked
+
+    def test_shared_player_chronology(self, rig):
+        # One player in two matches: the second update must build on the
+        # first (sequential semantics through the scheduler).
+        broker, store, worker = rig
+        shared = fake_player(skill_tier=15, api_id="shared")
+        others = [fake_player(skill_tier=15, api_id=f"o{i}") for i in range(10)]
+        m1 = mk_match("m1", created_at=1, players=[shared] + others[:5])
+        m2 = mk_match("m2", created_at=2, players=[shared] + others[5:])
+        store.add_match(m1)
+        store.add_match(m2)
+        mu_after = {}
+        for mid in ("m1", "m2"):
+            broker.publish("analyze", mid.encode())
+        worker.config = ServiceConfig(batch_size=2, idle_timeout=0.0)
+        assert worker.poll()
+        # shared player won twice: mu grew monotonically across matches
+        p1 = m1.rosters[0].participants[0]
+        p2 = m2.rosters[0].participants[0]
+        assert p2.player[0] is shared
+        assert p2.trueskill_mu > p1.trueskill_mu > 1500
+
+    def test_afk_and_unsupported(self, rig):
+        broker, store, worker = rig
+        store.add_match(mk_match("afk", created_at=0, afk=True))
+        store.add_match(mk_match("odd", created_at=1, mode="aral"))
+        ok = mk_match("ok", created_at=2)
+        store.add_match(ok)
+        for mid in ("afk", "odd", "ok"):
+            broker.publish("analyze", mid.encode())
+        worker.config = ServiceConfig(batch_size=3, idle_timeout=0.0)
+        assert worker.poll()
+        afk = store.matches["afk"]
+        assert afk.trueskill_quality == 0
+        assert afk.rosters[0].participants[0].participant_items[0].any_afk is True
+        assert afk.rosters[0].participants[0].player[0].trueskill_mu is None
+        odd = store.matches["odd"]
+        assert odd.trueskill_quality is None  # untouched
+        assert ok.rosters[0].participants[0].player[0].trueskill_mu is not None
+
+    def test_dedupe_and_unknown_ids(self, rig):
+        broker, store, worker = rig
+        store.add_match(mk_match("m0"))
+        for b in (b"m0", b"m0", b"missing", b"m0"):
+            broker.publish("analyze", b)
+        assert worker.poll()
+        assert worker.matches_rated == 1  # deduped, unknown skipped
+
+
+class TestFailurePolicy:
+    def test_whole_batch_dead_letters(self, rig):
+        broker, store, worker = rig
+        store.add_match(mk_match("good", created_at=0))
+        bad = mk_match("bad", created_at=1)
+        bad.rosters[0].winner = False  # no winner -> encode raises
+        store.add_match(bad)
+        broker.publish("analyze", b"good")
+        broker.publish("analyze", b"bad")
+        worker.config = ServiceConfig(batch_size=2, idle_timeout=0.0)
+        assert worker.poll()
+        assert worker.batches_failed == 1
+        assert broker.qsize("analyze_failed") == 2  # whole batch, incl. good
+        assert store.matches["good"].rosters[0].participants[0].player[0].trueskill_mu is None
+        assert not broker._unacked
+
+    def test_crash_redelivery(self, rig):
+        broker, store, worker = rig
+        store.add_match(mk_match("m0"))
+        broker.publish("analyze", b"m0")
+        msgs = broker.get("analyze", 10)  # consumer took it, then crashed
+        broker.requeue_unacked()
+        assert broker.qsize("analyze") == 1
+
+    def test_tier_keyerror_dead_letters(self, rig):
+        broker, store, worker = rig
+        m = mk_match("t30", created_at=0)
+        m.rosters[0].participants[0].player[0].skill_tier = 30  # rater.py:60
+        store.add_match(m)
+        broker.publish("analyze", b"t30")
+        worker.config = ServiceConfig(batch_size=1, idle_timeout=0.0)
+        assert worker.poll()
+        assert worker.batches_failed == 1
+        assert broker.qsize("analyze_failed") == 1
+
+
+class TestFanOut:
+    def test_notify_crunch_sew_telesuck(self, rig):
+        broker, store, _ = rig
+        cfg = ServiceConfig(
+            batch_size=1,
+            idle_timeout=0.0,
+            do_crunch_match=True,
+            do_sew_match=True,
+            do_telesuck_match=True,
+        )
+        worker = Worker(broker, store, cfg, RatingConfig())
+        store.add_match(mk_match("m0"))
+        store.add_asset("m0", "https://t.example/t1.json")
+        store.add_asset("m0", "https://t.example/t2.json")
+        broker.publish("analyze", b"m0", headers={"notify": "room-7"})
+        assert worker.poll()
+        assert ("amq.topic", "room-7", b"analyze_update") in broker.topics
+        assert broker.qsize("crunch_global") == 1
+        assert broker.qsize("sew") == 1
+        tele = broker.queues["telesuck"]
+        assert len(tele) == 2
+        assert tele[0].headers == {"match_api_id": "m0"}
+
+    def test_idle_timeout_flush(self):
+        broker = InMemoryBroker()
+        store = InMemoryStore()
+        t = [0.0]
+        cfg = ServiceConfig(batch_size=100, idle_timeout=1.0)
+        worker = Worker(broker, store, cfg, RatingConfig(), clock=lambda: t[0])
+        store.add_match(mk_match("m0"))
+        broker.publish("analyze", b"m0")
+        assert not worker.poll()  # batch not full, timer not expired
+        t[0] = 1.5
+        assert worker.poll()  # idle flush
+        assert worker.matches_rated == 1
