@@ -67,8 +67,12 @@ def match_features(
 def history_features(state, sched, cfg: RatingConfig, steps_per_chunk: int = 8192):
     """Leak-free training data for the win-prob heads: one scan over the
     packed schedule that computes each match's features from the PRE-match
-    state, then applies the rating update. Returns ``[N, F]`` features in
-    stream order (numpy) plus the final state."""
+    state, then applies the rating update.
+
+    Returns ``(features [N, F], ratable [N] bool, final_state)`` in stream
+    order. Train only on ``ratable`` rows: non-ratable matches (unsupported
+    mode / AFK) still get feature rows for shape-stability, but their mode
+    one-hot is a clamped placeholder and their winner label is meaningless."""
     import dataclasses
     from functools import partial
 
@@ -102,4 +106,7 @@ def history_features(state, sched, cfg: RatingConfig, steps_per_chunk: int = 819
     sel = src >= 0
     out = np.zeros((sched.n_matches, N_FEATURES), np.float32)
     out[src[sel]] = flat[sel]
-    return out, state
+    ratable = np.zeros((sched.n_matches,), bool)
+    flat_ratable = ((sched.mode_id >= 0) & ~sched.afk).reshape(-1)
+    ratable[src[sel]] = flat_ratable[sel]
+    return out, ratable, state

@@ -18,6 +18,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from analyzer_tpu.models.training import train_minibatch
+
 
 @partial(
     jax.tree_util.register_dataclass,
@@ -73,40 +75,7 @@ def train_mlp(
     seed: int = 0,
 ) -> tuple[MLPModel, float]:
     """Trains on ``[N, F]`` features; returns (model, final mean NLL)."""
-    n, f = features.shape
-    n_batches = max(1, -(-n // batch_size))
-    padded = n_batches * batch_size
-    x = np.zeros((padded, f), np.float32)
-    y = np.zeros((padded,), np.float32)
-    m = np.zeros((padded,), np.float32)
-    x[:n] = features
-    y[:n] = team0_won
-    m[:n] = 1.0
-
-    rng = np.random.default_rng(seed)
-    perm = rng.permutation(padded)
-    xb = jnp.asarray(x[perm].reshape(n_batches, batch_size, f))
-    yb = jnp.asarray(y[perm].reshape(n_batches, batch_size))
-    mb = jnp.asarray(m[perm].reshape(n_batches, batch_size))
-
-    model = init_mlp(f, hidden, seed)
-    opt = optax.adam(lr)
-    opt_state = opt.init(model)
-
-    @jax.jit
-    def epoch(carry, _):
-        model, opt_state = carry
-
-        def step(c, batch):
-            mdl, ost = c
-            bx, by, bm = batch
-            loss, grads = jax.value_and_grad(_nll)(mdl, bx, by, bm)
-            updates, ost = opt.update(grads, ost)
-            mdl = optax.apply_updates(mdl, updates)
-            return (mdl, ost), loss
-
-        (model, opt_state), losses = jax.lax.scan(step, (model, opt_state), (xb, yb, mb))
-        return (model, opt_state), losses.mean()
-
-    (model, _), losses = jax.lax.scan(epoch, (model, opt_state), None, length=epochs)
-    return model, float(np.asarray(losses)[-1])
+    model = init_mlp(features.shape[1], hidden, seed)
+    return train_minibatch(
+        model, _nll, features, team0_won, epochs, batch_size, lr, seed
+    )

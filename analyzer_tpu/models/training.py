@@ -1,0 +1,65 @@
+"""Shared minibatch training harness for the win-probability heads.
+
+One implementation of the pad-to-static-shape, permute, and jitted
+epoch/step ``lax.scan`` loop, parameterized by model and loss — this is
+what makes the logistic and MLP heads genuinely drop-in comparable (same
+batching, same masking, same optimizer step structure).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def train_minibatch(
+    model,
+    loss_fn,
+    features: np.ndarray,
+    labels: np.ndarray,
+    epochs: int,
+    batch_size: int,
+    lr: float,
+    seed: int,
+):
+    """Adam over jitted epoch scans. ``loss_fn(model, x, y, mask)`` must be
+    a masked mean so the static-shape padding rows contribute nothing.
+    Returns (trained model, final epoch mean loss)."""
+    n, f = features.shape
+    n_batches = max(1, -(-n // batch_size))
+    padded = n_batches * batch_size
+    x = np.zeros((padded, f), np.float32)
+    y = np.zeros((padded,), np.float32)
+    m = np.zeros((padded,), np.float32)
+    x[:n] = features
+    y[:n] = labels
+    m[:n] = 1.0
+
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(padded)
+    xb = jnp.asarray(x[perm].reshape(n_batches, batch_size, f))
+    yb = jnp.asarray(y[perm].reshape(n_batches, batch_size))
+    mb = jnp.asarray(m[perm].reshape(n_batches, batch_size))
+
+    opt = optax.adam(lr)
+    opt_state = opt.init(model)
+
+    @jax.jit
+    def epoch(carry, _):
+        mdl, ost = carry
+
+        def step(c, batch):
+            mdl, ost = c
+            bx, by, bm = batch
+            loss, grads = jax.value_and_grad(loss_fn)(mdl, bx, by, bm)
+            updates, ost = opt.update(grads, ost)
+            mdl = optax.apply_updates(mdl, updates)
+            return (mdl, ost), loss
+
+        (mdl, ost), losses = jax.lax.scan(step, (mdl, ost), (xb, yb, mb))
+        return (mdl, ost), losses.mean()
+
+    (model, _), losses = jax.lax.scan(epoch, (model, opt_state), None, length=epochs)
+    return model, float(np.asarray(losses)[-1])

@@ -1,0 +1,75 @@
+"""ctypes loader/builder for the native superstep packer (packer.cc).
+
+Compiles on first import (g++ -O3 -shared -fPIC, rebuilt when the source
+is newer than the library) and exposes ``assign_supersteps`` with the same
+contract as the numpy fallback in superstep.py. Import fails -> the caller
+falls back to pure Python; any numerical divergence is a bug (tested
+equal in tests/test_sched.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "packer.cc")
+_LIB = os.path.join(_DIR, "_packer.so")
+
+
+def _build() -> None:
+    # Atomic: compile to a temp name, rename over. Concurrent importers
+    # either see the finished .so or rebuild harmlessly.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
+            check=True,
+            capture_output=True,
+        )
+        os.replace(tmp, _LIB)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+    try:
+        _build()
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        raise ImportError(f"native packer build failed: {e}") from e
+
+_lib = ctypes.CDLL(_LIB)
+_lib.assign_supersteps.argtypes = [
+    ctypes.POINTER(ctypes.c_int32),
+    ctypes.c_int64,
+    ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_uint8),
+    ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_int64),
+]
+_lib.assign_supersteps.restype = None
+
+
+def assign_supersteps(stream) -> np.ndarray:
+    n = stream.n_matches
+    out = np.empty(n, dtype=np.int64)
+    if n == 0:
+        return out
+    idx = np.ascontiguousarray(stream.player_idx.reshape(n, -1), dtype=np.int32)
+    ratable = np.ascontiguousarray(stream.ratable, dtype=np.uint8)
+    n_players = int(idx.max()) + 1
+    _lib.assign_supersteps(
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n,
+        idx.shape[1],
+        ratable.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n_players,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out

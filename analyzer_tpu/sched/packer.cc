@@ -1,0 +1,48 @@
+// Native superstep assignment — the host-side hot loop of the scheduler.
+//
+// ASAP schedule over the chronological match stream (see superstep.py for
+// the invariant): step(match) = 1 + max(last step of each of its players).
+// The recurrence is inherently sequential (each match depends on the
+// running per-player last-step table), so it cannot be vectorized in
+// numpy; at 10M matches the Python fallback costs tens of seconds while
+// this loop is memory-bound on the last-step table and runs in well under
+// a second. Built on demand by _native.py (g++ -O3 -shared) and loaded via
+// ctypes — no pybind11 dependency.
+//
+// Contract (mirrors _assign_supersteps_py):
+//   idx       [n_matches, slots] int32 player rows, -1 for empty slots
+//   ratable   [n_matches] uint8, 0 => step -1 (no state access)
+//   out       [n_matches] int64 superstep index, -1 for non-ratable
+
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+extern "C" {
+
+void assign_supersteps(const int32_t* idx, int64_t n_matches,
+                       int64_t slots, const uint8_t* ratable,
+                       int64_t n_players, int64_t* out) {
+  std::vector<int64_t> last(static_cast<size_t>(n_players > 0 ? n_players : 1),
+                            -1);
+  for (int64_t i = 0; i < n_matches; ++i) {
+    if (!ratable[i]) {
+      out[i] = -1;
+      continue;
+    }
+    const int32_t* row = idx + i * slots;
+    int64_t s = -1;
+    for (int64_t j = 0; j < slots; ++j) {
+      const int32_t p = row[j];
+      if (p >= 0 && last[p] > s) s = last[p];
+    }
+    ++s;
+    out[i] = s;
+    for (int64_t j = 0; j < slots; ++j) {
+      const int32_t p = row[j];
+      if (p >= 0) last[p] = s;
+    }
+  }
+}
+
+}  // extern "C"

@@ -71,31 +71,45 @@ class TestElo:
 class TestFeaturesAndHeads:
     def test_feature_shapes_and_sanity(self, history):
         players, stream, state, sched = history
-        feats, final = history_features(state, sched, CFG)
+        feats, ratable, final = history_features(state, sched, CFG)
         assert feats.shape == (stream.n_matches, N_FEATURES)
         assert np.isfinite(feats).all()
+        np.testing.assert_array_equal(ratable, stream.ratable)
         # win-prob feature is a probability
         assert (feats[:, 2] >= 0).all() and (feats[:, 2] <= 1).all()
         # mode one-hot sums to 1 for supported modes
         sel = stream.mode_id >= 0
         assert np.allclose(feats[sel, 4:].sum(1), 1.0)
 
+    def test_ratable_mask_filters_gated_matches(self):
+        players = synthetic_players(100, seed=5)
+        stream = synthetic_stream(
+            400, players, seed=5, afk_rate=0.2, unsupported_rate=0.1
+        )
+        state = PlayerState.create(100, skill_tier=players.skill_tier)
+        sched = pack_schedule(stream, pad_row=state.pad_row)
+        feats, ratable, _ = history_features(state, sched, CFG)
+        np.testing.assert_array_equal(ratable, stream.ratable)
+        assert ratable.sum() < stream.n_matches  # gate actually fired
+
     def test_logistic_learns(self, history):
         players, stream, state, sched = history
-        feats, _ = history_features(state, sched, CFG)
+        feats, ratable, _ = history_features(state, sched, CFG)
         y = (stream.winner == 0).astype(np.float32)
-        model, nll = train_logistic(feats, y, epochs=60, batch_size=512)
+        model, nll = train_logistic(feats[ratable], y[ratable], epochs=60, batch_size=512)
         assert nll < 0.69, nll  # beats uninformed ln2
-        p = np.asarray(model.predict(feats))
-        acc = ((p > 0.5) == (y > 0.5)).mean()
+        p = np.asarray(model.predict(feats[ratable]))
+        acc = ((p > 0.5) == (y[ratable] > 0.5)).mean()
         assert acc > 0.6, acc
 
     def test_mlp_learns(self, history):
         players, stream, state, sched = history
-        feats, _ = history_features(state, sched, CFG)
+        feats, ratable, _ = history_features(state, sched, CFG)
         y = (stream.winner == 0).astype(np.float32)
-        model, nll = train_mlp(feats, y, epochs=60, batch_size=512, hidden=32)
+        model, nll = train_mlp(
+            feats[ratable], y[ratable], epochs=60, batch_size=512, hidden=32
+        )
         assert nll < 0.69, nll
-        p = np.asarray(model.predict(feats))
-        acc = ((p > 0.5) == (y > 0.5)).mean()
+        p = np.asarray(model.predict(feats[ratable]))
+        acc = ((p > 0.5) == (y[ratable] > 0.5)).mean()
         assert acc > 0.6, acc

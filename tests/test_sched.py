@@ -100,6 +100,21 @@ class TestAssignment:
         assert assign_supersteps(stream).tolist() == [0, 1, 2, 3, 4]
 
 
+class TestNativePacker:
+    def test_matches_python_fallback(self):
+        from analyzer_tpu.sched import _native
+        from analyzer_tpu.sched.superstep import _assign_supersteps_py
+
+        stream, _ = small_stream(n_matches=500, n_players=80, seed=9)
+        np.testing.assert_array_equal(
+            _native.assign_supersteps(stream), _assign_supersteps_py(stream)
+        )
+
+    def test_used_by_default(self):
+        # the gated import must succeed in this environment (g++ is baked in)
+        from analyzer_tpu.sched import _native  # noqa: F401
+
+
 class TestPacking:
     def test_batches_conflict_free_and_complete(self):
         stream, state = small_stream()
