@@ -1,0 +1,190 @@
+"""Command-line interface: ``python -m analyzer_tpu.cli <cmd>``.
+
+The reference's only entry point is ``python3 worker.py`` (env-var config,
+``worker.py:219-221``). The CLI keeps that (``worker`` subcommand) and adds
+the offline paths the reference delegates to its database for: full-history
+re-rates from CSV streams with checkpoint/resume, the Elo harness
+(BASELINE.json config 1: "Elo pairwise rater on 1k-match CSV"), synthetic
+stream generation, and the benchmark.
+
+Subcommands:
+  synth   generate a synthetic match-history CSV
+  rate    TrueSkill full-history re-rate of a CSV stream (checkpoint/resume)
+  elo     Elo re-rate of a CSV stream + prediction accuracy
+  bench   the headline throughput benchmark (one JSON line)
+  worker  the broker-consuming service loop (needs pika)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def _load_stream(path: str):
+    from analyzer_tpu.io.csv_codec import load_stream_csv
+
+    stream = load_stream_csv(path)
+    n_players = int(stream.player_idx.max()) + 1 if stream.n_matches else 0
+    return stream, n_players
+
+
+def cmd_synth(args) -> int:
+    from analyzer_tpu.io.csv_codec import save_stream_csv
+    from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
+
+    players = synthetic_players(args.players, seed=args.seed)
+    stream = synthetic_stream(
+        args.matches, players, seed=args.seed,
+        activity_concentration=args.concentration,
+    )
+    save_stream_csv(args.out, stream)
+    print(f"wrote {stream.n_matches} matches / {args.players} players to {args.out}")
+    return 0
+
+
+def cmd_rate(args) -> int:
+    from analyzer_tpu.config import RatingConfig
+    from analyzer_tpu.core.state import PlayerState
+    from analyzer_tpu.io.checkpoint import load_checkpoint, save_checkpoint
+    from analyzer_tpu.sched import pack_schedule, rate_history
+    from analyzer_tpu.utils import PhaseTimer, trace
+
+    cfg = RatingConfig.from_env()
+    if args.resume and not args.checkpoint:
+        print("error: --resume requires --checkpoint", file=sys.stderr)
+        return 2
+    timer = PhaseTimer()
+    with timer.phase("load"):
+        stream, n_players = _load_stream(args.csv)
+    cursor = 0
+    if args.resume:
+        with timer.phase("restore"):
+            state, cursor = load_checkpoint(args.checkpoint)
+        print(f"resumed at match {cursor}/{stream.n_matches}", file=sys.stderr)
+    else:
+        state = PlayerState.create(n_players, cfg=cfg)
+    with timer.phase("pack"):
+        sched = pack_schedule(
+            stream.slice(cursor, stream.n_matches), pad_row=state.pad_row
+        )
+    with timer.phase("rate"), trace(args.trace):
+        state, _ = rate_history(state, sched, cfg)
+        np.asarray(state.table[:1])  # force completion for honest timing
+    if args.checkpoint:
+        with timer.phase("checkpoint"):
+            save_checkpoint(args.checkpoint, state, cursor=stream.n_matches)
+    mu = np.asarray(state.mu)[:n_players, 0]
+    rated = ~np.isnan(mu)
+    print(
+        json.dumps(
+            {
+                "matches": stream.n_matches - cursor,
+                "players_rated": int(rated.sum()),
+                "mean_mu": round(float(mu[rated].mean()), 2) if rated.any() else None,
+                "supersteps": sched.n_steps,
+                "occupancy": round(sched.occupancy, 3),
+                "phases": {k: round(v, 3) for k, v in timer.report().items()},
+            }
+        )
+    )
+    return 0
+
+
+def cmd_elo(args) -> int:
+    from analyzer_tpu.models import elo_history
+    from analyzer_tpu.sched import pack_schedule
+
+    stream, n_players = _load_stream(args.csv)
+    sched = pack_schedule(stream, pad_row=n_players)
+    ratings, expected = elo_history(sched, n_players)
+    ratable = stream.ratable
+    acc = (
+        float(((expected[ratable] > 0.5) == (stream.winner[ratable] == 0)).mean())
+        if ratable.any()
+        else None
+    )
+    if args.out:
+        np.savez(args.out, ratings=ratings, expected=expected)
+    print(
+        json.dumps(
+            {
+                "matches": stream.n_matches,
+                "players": n_players,
+                "mean_rating": round(float(ratings.mean()), 2),
+                "prediction_accuracy": round(acc, 4) if acc is not None else None,
+            }
+        )
+    )
+    return 0
+
+
+def cmd_bench(args) -> int:
+    # bench.py lives at the repo root (the driver's benchmark contract),
+    # not inside the package — load it by path so the subcommand works
+    # from any working directory.
+    import importlib.util
+    import os
+
+    import analyzer_tpu
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(analyzer_tpu.__file__))),
+        "bench.py",
+    )
+    if not os.path.exists(path):
+        print(f"error: bench.py not found at {path}", file=sys.stderr)
+        return 2
+    spec = importlib.util.spec_from_file_location("bench", path)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    bench.main()
+    return 0
+
+
+def cmd_worker(args) -> int:
+    from analyzer_tpu.service.worker import main as worker_main
+
+    worker_main()
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="analyzer_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("synth", help="generate a synthetic match-history CSV")
+    s.add_argument("--matches", type=int, default=1000)
+    s.add_argument("--players", type=int, default=300)
+    s.add_argument("--seed", type=int, default=0)
+    s.add_argument("--concentration", type=float, default=0.8)
+    s.add_argument("--out", required=True)
+    s.set_defaults(fn=cmd_synth)
+
+    s = sub.add_parser("rate", help="TrueSkill full-history re-rate of a CSV")
+    s.add_argument("--csv", required=True)
+    s.add_argument("--checkpoint", help="state snapshot path (.npz)")
+    s.add_argument("--resume", action="store_true", help="resume from --checkpoint")
+    s.add_argument("--trace", help="jax.profiler trace output dir")
+    s.set_defaults(fn=cmd_rate)
+
+    s = sub.add_parser("elo", help="Elo re-rate of a CSV + accuracy")
+    s.add_argument("--csv", required=True)
+    s.add_argument("--out", help="npz output for ratings/predictions")
+    s.set_defaults(fn=cmd_elo)
+
+    s = sub.add_parser("bench", help="headline throughput benchmark")
+    s.set_defaults(fn=cmd_bench)
+
+    s = sub.add_parser("worker", help="broker-consuming service loop")
+    s.set_defaults(fn=cmd_worker)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -27,6 +27,17 @@ full rows is correct because a superstep is conflict-free: each player row
 is written by at most one match, so untouched columns rewrite their own
 just-gathered values.
 
+Measured cost split on v5e (B=512, P=1M, honest fetch-timed): gather+all
+compute ~35 us/superstep; the row scatter ~370 us and dominates. All XLA
+scatter variants (set/add, unique_indices, promise_in_bounds, pre-sorted)
+measure the same — the lowering serializes ~72 ns/row. A Pallas kernel
+with a pipelined per-row DMA ring was attempted and is architecturally
+blocked: Mosaic requires DMA slices lane-aligned to 128 floats, and state
+rows are 16 floats (padding the table to 128-wide rows would 8x HBM for a
+DMA-issue-bound loop that projects slower than XLA's scatter). At ~1.1M
+matches/s/chip the scatter floor is ~260x the BASELINE target, so this
+stands as the documented bound rather than a TODO.
+
 Correctness precondition: no player index may appear twice among the ratable
 matches of one batch (the scatters would collide). The scheduler in
 :mod:`analyzer_tpu.sched` constructs batches with that property; a debug

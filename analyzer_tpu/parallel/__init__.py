@@ -16,5 +16,12 @@ from analyzer_tpu.parallel.mesh import (
     rate_history_sharded,
     sharded_step_fn,
 )
+from analyzer_tpu.parallel.multihost import initialize_distributed, process_slice
 
-__all__ = ["make_mesh", "rate_history_sharded", "sharded_step_fn"]
+__all__ = [
+    "make_mesh",
+    "rate_history_sharded",
+    "sharded_step_fn",
+    "initialize_distributed",
+    "process_slice",
+]

@@ -1,0 +1,68 @@
+"""Multi-host (multi-slice) execution: the DCN story.
+
+The reference scales across machines by pointing more worker processes at
+one RabbitMQ (SURVEY.md section 2.5) — no inter-worker communication at
+all, consistency left to MySQL races. The TPU-native equivalent is a
+single global computation over all hosts' chips:
+
+  * ``jax.distributed.initialize()`` (coordinator address + process id
+    from the environment) joins every host into one runtime;
+  * the SAME mesh/shard_map code in :mod:`analyzer_tpu.parallel.mesh` then
+    spans all chips — ``jax.devices()`` is global, ``all_gather`` of the
+    posterior rows rides ICI within a slice and DCN across slices (it is
+    batch-shaped, KBs per superstep, so DCN latency hides under compute);
+  * each process feeds only its own shard of the packed schedule
+    (``process_slice`` below): device_put of a globally-sharded array from
+    per-host shards is how JAX expects multi-host input to arrive.
+
+This module only wires the initialization; it is exercised in CI by the
+single-process degenerate case (initialize() is skipped when no
+coordinator is configured), and the mesh code it feeds is the same code
+the 8-device virtual CPU tests pin down.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Joins the global runtime when multi-host env/args are present.
+
+    Returns True if distributed mode is active. No-ops (returns False) for
+    single-host runs, so callers can unconditionally call it first.
+    Environment fallbacks: COORDINATOR_ADDRESS, NUM_PROCESSES, PROCESS_ID
+    (the standard jax.distributed knobs).
+    """
+    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if not coordinator_address:
+        return False
+    kwargs = {"coordinator_address": coordinator_address}
+    num_processes = num_processes or int(os.environ.get("NUM_PROCESSES", 0)) or None
+    process_id = (
+        process_id
+        if process_id is not None
+        else (int(os.environ["PROCESS_ID"]) if "PROCESS_ID" in os.environ else None)
+    )
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    return True
+
+
+def process_slice(n: int) -> slice:
+    """This process's contiguous shard of an ``n``-item host-side feed
+    (schedule chunks, CSV rows): process i of P gets [i*n/P, (i+1)*n/P)."""
+    p = jax.process_count()
+    i = jax.process_index()
+    lo = i * n // p
+    hi = (i + 1) * n // p
+    return slice(lo, hi)

@@ -41,7 +41,10 @@ def _build() -> None:
 if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
     try:
         _build()
-    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+    except (subprocess.CalledProcessError, OSError) as e:
+        # OSError covers FileNotFoundError (no g++) and PermissionError
+        # (read-only package dir) — all must surface as ImportError so the
+        # caller's numpy fallback engages instead of crashing.
         raise ImportError(f"native packer build failed: {e}") from e
 
 _lib = ctypes.CDLL(_LIB)

@@ -197,6 +197,16 @@ def pack_schedule(
     t_in = stream.team_size
     if t_in > team_size:
         raise ValueError(f"stream team size {t_in} exceeds pack team size {team_size}")
+    if n and int(stream.player_idx.max()) >= pad_row:
+        # The kernel's gather/scatter clamps out-of-bounds indices (JAX
+        # default), which would silently read/write the wrong player's row
+        # — e.g. resuming from a checkpoint whose table predates newly
+        # added players. Fail loudly instead.
+        raise ValueError(
+            f"stream references player row {int(stream.player_idx.max())} but the "
+            f"player table only has rows 0..{pad_row - 1} (pad_row={pad_row}); "
+            "rebuild the state with enough players"
+        )
     steps = assign_supersteps(stream)
 
     if batch_size is None:

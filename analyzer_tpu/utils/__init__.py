@@ -1,0 +1,7 @@
+"""Cross-cutting utilities: observability (phase timers, counters,
+profiler traces) that the reference lacks entirely (SURVEY.md section 5.1:
+no profiler hooks, no timing, no metrics — only debug logs)."""
+
+from analyzer_tpu.utils.profiling import PhaseTimer, Counters, trace
+
+__all__ = ["PhaseTimer", "Counters", "trace"]

@@ -1,0 +1,89 @@
+"""Observability: phase timing, throughput counters, XLA profiler traces.
+
+The reference imports ``time`` and never uses it (``worker.py:4``); its
+only observability is debug logging (SURVEY.md section 5.1/5.5). Here the
+pipeline's phases — generate/ingest, schedule packing, host->device
+transfer, device compute — are first-class measurements, because on TPU
+the balance between them IS the performance model (host packing and
+transfer overlap device compute in a well-fed pipeline).
+
+``trace`` wraps ``jax.profiler.trace`` so a full XLA trace (viewable in
+TensorBoard / Perfetto) can be captured around any history run with one
+line; it no-ops gracefully where the backend can't profile.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from collections import defaultdict
+
+
+@dataclasses.dataclass
+class PhaseTimer:
+    """Accumulating wall-clock phase timer.
+
+    >>> t = PhaseTimer()
+    >>> with t.phase("pack"):
+    ...     do_packing()
+    >>> t.report()   # {'pack': 1.23}
+    """
+
+    totals: dict = dataclasses.field(default_factory=lambda: defaultdict(float))
+    counts: dict = dataclasses.field(default_factory=lambda: defaultdict(int))
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def report(self) -> dict[str, float]:
+        return dict(self.totals)
+
+    def summary(self) -> str:
+        total = sum(self.totals.values()) or 1.0
+        parts = [
+            f"{k}={v:.3f}s({100 * v / total:.0f}%)"
+            for k, v in sorted(self.totals.items(), key=lambda kv: -kv[1])
+        ]
+        return " ".join(parts)
+
+
+@dataclasses.dataclass
+class Counters:
+    """Monotonic counters with rate computation — the matches/sec/chip
+    number BASELINE.json tracks, generalized."""
+
+    values: dict = dataclasses.field(default_factory=lambda: defaultdict(int))
+    _t0: float = dataclasses.field(default_factory=time.perf_counter)
+
+    def add(self, name: str, n: int = 1) -> None:
+        self.values[name] += n
+
+    def rate(self, name: str) -> float:
+        dt = time.perf_counter() - self._t0
+        return self.values[name] / dt if dt > 0 else 0.0
+
+    def report(self) -> dict[str, int]:
+        return dict(self.values)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None):
+    """XLA profiler trace around a block; None disables, and backends that
+    can't profile degrade to a no-op instead of failing the run."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    try:
+        with jax.profiler.trace(log_dir):
+            yield
+    except Exception:  # noqa: BLE001 — observability must not kill the run
+        yield

@@ -1,0 +1,85 @@
+"""CLI: synth -> rate (with checkpoint/resume) -> elo round-trips."""
+
+import json
+
+import numpy as np
+import pytest
+
+from analyzer_tpu.cli import main
+
+
+def run(capsys, *argv):
+    rc = main(list(argv))
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    return out[-1] if out else ""
+
+
+class TestCli:
+    def test_synth_rate_elo(self, tmp_path, capsys):
+        csv = str(tmp_path / "s.csv")
+        run(capsys, "synth", "--matches", "200", "--players", "60", "--out", csv)
+
+        ck = str(tmp_path / "ck.npz")
+        line = run(capsys, "rate", "--csv", csv, "--checkpoint", ck)
+        stats = json.loads(line)
+        assert stats["matches"] == 200
+        assert stats["players_rated"] > 0
+        assert 0 < stats["occupancy"] <= 1
+        assert "rate" in stats["phases"]
+
+        line = run(capsys, "elo", "--csv", csv)
+        elo = json.loads(line)
+        assert elo["matches"] == 200
+        assert elo["prediction_accuracy"] is not None
+
+    def test_resume_continues(self, tmp_path, capsys):
+        csv = str(tmp_path / "s.csv")
+        run(capsys, "synth", "--matches", "100", "--players", "40", "--out", csv)
+        ck = str(tmp_path / "ck.npz")
+        # first full pass writes the checkpoint with cursor at end
+        run(capsys, "rate", "--csv", csv, "--checkpoint", ck)
+        # resume: cursor == n_matches -> zero new matches processed
+        line = run(capsys, "rate", "--csv", csv, "--checkpoint", ck, "--resume")
+        stats = json.loads(line)
+        assert stats["matches"] == 0
+
+    def test_resume_requires_checkpoint(self, tmp_path, capsys):
+        csv = str(tmp_path / "s.csv")
+        run(capsys, "synth", "--matches", "10", "--players", "12", "--out", csv)
+        assert main(["rate", "--csv", csv, "--resume"]) == 2
+
+    def test_grown_stream_rejected_on_resume(self, tmp_path, capsys):
+        # Checkpoint for a small player table + a stream referencing new
+        # players must fail loudly, not clamp-scatter onto the wrong row.
+        import numpy as np
+
+        from analyzer_tpu.config import RatingConfig
+        from analyzer_tpu.core.state import PlayerState
+        from analyzer_tpu.sched import pack_schedule
+
+        state = PlayerState.create(10)
+        idx = np.full((1, 2, 5), -1, np.int32)
+        idx[0, 0, :3] = [0, 1, 15]  # player 15 doesn't exist
+        idx[0, 1, :3] = [2, 3, 4]
+        from analyzer_tpu.sched.superstep import MatchStream
+
+        stream = MatchStream(
+            player_idx=idx,
+            winner=np.zeros(1, np.int32),
+            mode_id=np.ones(1, np.int32),
+            afk=np.zeros(1, bool),
+        )
+        with pytest.raises(ValueError, match="player row 15"):
+            pack_schedule(stream, pad_row=state.pad_row)
+
+    def test_phase_timer(self):
+        from analyzer_tpu.utils import PhaseTimer
+
+        t = PhaseTimer()
+        with t.phase("a"):
+            pass
+        with t.phase("a"):
+            pass
+        assert t.counts["a"] == 2
+        assert "a=" in t.summary()

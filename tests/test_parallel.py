@@ -67,6 +67,13 @@ class TestShardedHistory:
         with pytest.raises(ValueError, match="devices"):
             make_mesh(1024)
 
+    def test_multihost_degenerate_single_process(self):
+        from analyzer_tpu.parallel import initialize_distributed, process_slice
+
+        assert initialize_distributed() is False  # no coordinator -> no-op
+        s = process_slice(100)
+        assert (s.start, s.stop) == (0, 100)  # single process owns the feed
+
     def test_batch_size_divisibility_enforced(self):
         state, sched = setup(batch_size=30)
         if len(jax.devices()) < 8:
