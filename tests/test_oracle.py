@@ -1,0 +1,107 @@
+"""Numerical parity of the float32 TPU kernels vs the 50-digit mpmath
+oracle — the reference's own precision (``rater.py:8``). SURVEY.md section
+7 hard part #2 asks for documented error bounds; these tests ARE them:
+
+  * v(t): rel error < 2e-5 for t > -8, < 5e-5 over all of [-30, 10]
+    (the log-space form; naive phi/Phi is Inf/NaN below t ~ -12 in f32)
+  * w(t): < 2e-5 rel for t > -2 (the common case), < 5e-4 absolute through
+    the physical band, < 1e-4 in the asymptotic-series tail (t <= -10)
+  * full two-team update: mu rel error < 1e-5, sigma rel error < 1e-4
+    across fresh/veteran/upset/5v5 matchups
+  * quality: rel error < 1e-5
+
+The reference's own parity tests are range-based (e.g. ``1300 < mu-sigma <
+1700``, worker_test.py:76) — orders of magnitude looser than these bounds.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.ops import normal
+from analyzer_tpu.ops import oracle
+from analyzer_tpu.ops import trueskill as ts
+
+CFG = RatingConfig()
+
+
+class TestVW:
+    def test_v_w_accuracy_over_range(self):
+        t = np.concatenate(
+            [np.linspace(-30, 10, 401), np.asarray([-1e-3, 0.0, 1e-3])]
+        )
+        v32 = np.asarray(normal.v_win(jnp.asarray(t, jnp.float32)), np.float64)
+        w32 = np.asarray(normal.w_win(jnp.asarray(t, jnp.float32)), np.float64)
+        for i, ti in enumerate(t):
+            vo = float(oracle.v_win(ti))
+            wo = float(oracle.w_win(ti))
+            # v: log-space form, < 5e-5 relative over the whole range
+            # (< 2e-5 in the physical |t| < 8 regime)
+            bound_v = 2e-5 if ti > -8 else 5e-5
+            assert abs(v32[i] - vo) / max(vo, 1e-30) < bound_v, (ti, v32[i], vo)
+            # w: direct form for t > -10, asymptotic series beyond.
+            # Cancellation in v*(v+t) grows as t goes negative: < 2e-5
+            # for t > -2 (the common case), < 5e-4 through the physical
+            # band, < 1e-4 in the series tail (t <= -10).
+            if ti > -2:
+                bound_w = 2e-5 * wo + 1e-7
+            elif ti > -10:
+                bound_w = 5e-4
+            else:
+                bound_w = 1e-4
+            assert abs(w32[i] - wo) < bound_w, (ti, w32[i], wo)
+
+    def test_naive_form_would_fail(self):
+        # documents WHY the log-space form exists: naive phi/Phi is not
+        # finite where the kernel must operate
+        t = jnp.asarray([-15.0, -20.0], jnp.float32)
+        naive = jnp.exp(normal.log_pdf(t)) / normal.cdf(t)
+        assert not np.isfinite(np.asarray(naive)).all()
+        assert np.isfinite(np.asarray(normal.v_win(t))).all()
+
+
+def kernel_update(mu, sigma, winner):
+    t = max(len(mu[0]), len(mu[1]))
+    mu_a = np.zeros((1, 2, t), np.float32)
+    sg_a = np.ones((1, 2, t), np.float32)
+    mask = np.zeros((1, 2, t), bool)
+    for ti in range(2):
+        for si, m in enumerate(mu[ti]):
+            mu_a[0, ti, si] = m
+            sg_a[0, ti, si] = sigma[ti][si]
+            mask[0, ti, si] = True
+    nm, ns = ts.two_team_update(
+        jnp.asarray(mu_a), jnp.asarray(sg_a), jnp.asarray(mask),
+        jnp.asarray([winner], jnp.int32), CFG,
+    )
+    q = ts.quality(jnp.asarray(mu_a), jnp.asarray(sg_a), jnp.asarray(mask), CFG)
+    return np.asarray(nm)[0], np.asarray(ns)[0], float(q[0])
+
+
+MATCHUPS = [
+    # (name, mu, sigma, winner)
+    ("fresh 3v3", [[2000.0] * 3, [2000.0] * 3], [[500.0] * 3, [500.0] * 3], 0),
+    ("veterans", [[1800.0, 2100.0, 1500.0], [1900.0, 2000.0, 1700.0]],
+     [[60.0, 45.0, 80.0], [55.0, 70.0, 65.0]], 1),
+    ("upset", [[900.0] * 3, [2800.0] * 3], [[200.0] * 3, [150.0] * 3], 0),
+    ("5v5 mixed", [[1500.0, 2000.0, 1200.0, 1710.0, 1303.0]] * 2,
+     [[333.3, 90.0, 400.0, 120.0, 250.0]] * 2, 1),
+    ("asymmetric sigma", [[1500.0] * 3, [1500.0] * 3],
+     [[1000.0, 10.0, 333.0], [500.0, 500.0, 500.0]], 0),
+]
+
+
+class TestUpdateParity:
+    @pytest.mark.parametrize("name,mu,sigma,winner", MATCHUPS)
+    def test_vs_oracle(self, name, mu, sigma, winner):
+        nm, ns, q = kernel_update(mu, sigma, winner)
+        om, os_ = oracle.two_team_update(mu, sigma, winner, CFG.beta, CFG.tau)
+        oq = float(oracle.quality(mu, sigma, CFG.beta))
+        for ti in range(2):
+            for si in range(len(mu[ti])):
+                rm = abs(nm[ti, si] - float(om[ti][si])) / abs(float(om[ti][si]))
+                rs = abs(ns[ti, si] - float(os_[ti][si])) / abs(float(os_[ti][si]))
+                assert rm < 1e-5, (name, ti, si, rm)
+                assert rs < 1e-4, (name, ti, si, rs)
+        assert abs(q - oq) / max(oq, 1e-12) < 1e-5, (name, q, oq)
