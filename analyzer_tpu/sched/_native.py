@@ -57,22 +57,56 @@ _lib.assign_supersteps.argtypes = [
     ctypes.POINTER(ctypes.c_int64),
 ]
 _lib.assign_supersteps.restype = None
+_lib.assign_batches_first_fit.argtypes = [
+    ctypes.POINTER(ctypes.c_int32),
+    ctypes.c_int64,
+    ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_uint8),
+    ctypes.c_int64,
+    ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_int64),
+]
+_lib.assign_batches_first_fit.restype = None
+
+
+def _prep(stream):
+    n = stream.n_matches
+    idx = np.ascontiguousarray(
+        stream.player_idx.reshape(n, 2 * stream.team_size), dtype=np.int32
+    )
+    ratable = np.ascontiguousarray(stream.ratable, dtype=np.uint8)
+    n_players = int(idx.max()) + 1 if n else 1
+    return n, idx, ratable, n_players
 
 
 def assign_supersteps(stream) -> np.ndarray:
-    n = stream.n_matches
+    n, idx, ratable, n_players = _prep(stream)
     out = np.empty(n, dtype=np.int64)
     if n == 0:
         return out
-    idx = np.ascontiguousarray(stream.player_idx.reshape(n, -1), dtype=np.int32)
-    ratable = np.ascontiguousarray(stream.ratable, dtype=np.uint8)
-    n_players = int(idx.max()) + 1
     _lib.assign_supersteps(
         idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         n,
         idx.shape[1],
         ratable.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         n_players,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out
+
+
+def assign_batches_first_fit(stream, capacity: int) -> np.ndarray:
+    n, idx, ratable, n_players = _prep(stream)
+    out = np.empty(n, dtype=np.int64)
+    if n == 0:
+        return out
+    _lib.assign_batches_first_fit(
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n,
+        idx.shape[1],
+        ratable.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n_players,
+        capacity,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
     return out

@@ -45,4 +45,74 @@ void assign_supersteps(const int32_t* idx, int64_t n_matches,
   }
 }
 
+// Capacity-aware first-fit batch assignment ("levelized" scheduling).
+//
+// ASAP minimizes *depth* but produces a heavy-tailed width histogram: a few
+// wide steps and a long thin tail, so fixed-width batches run half empty
+// (occupancy ~0.5 on realistic ladders). First-fit instead assigns each
+// ratable match, in stream order, to the EARLIEST batch that (a) is
+// strictly later than every one of its players' previous match's batch and
+// (b) still has free capacity. Per-player chronology is preserved by (a);
+// conflict-freedom within a batch follows because a player's matches get
+// strictly increasing batch indices. A disjoint-set "next batch with
+// space" pointer makes the whole pass O(n alpha(n)).
+//
+//   capacity  slots per batch (B)
+//   out       [n_matches] int64 batch index, -1 for non-ratable matches
+
+void assign_batches_first_fit(const int32_t* idx, int64_t n_matches,
+                              int64_t slots, const uint8_t* ratable,
+                              int64_t n_players, int64_t capacity,
+                              int64_t* out) {
+  std::vector<int64_t> last(static_cast<size_t>(n_players > 0 ? n_players : 1),
+                            -1);
+  std::vector<int64_t> fill;       // per-batch occupancy
+  std::vector<int64_t> next_free;  // DSU skip pointer: first batch >= b with space
+
+  auto ensure = [&](int64_t b) {
+    while (static_cast<int64_t>(fill.size()) <= b) {
+      fill.push_back(0);
+      next_free.push_back(static_cast<int64_t>(next_free.size()));
+    }
+  };
+  auto find = [&](int64_t b) {
+    ensure(b);
+    int64_t root = b;
+    while (true) {
+      ensure(root);
+      if (next_free[root] == root) break;
+      root = next_free[root];
+    }
+    while (next_free[b] != root) {  // path compression
+      int64_t nb = next_free[b];
+      next_free[b] = root;
+      b = nb;
+    }
+    return root;
+  };
+
+  for (int64_t i = 0; i < n_matches; ++i) {
+    if (!ratable[i]) {
+      out[i] = -1;
+      continue;
+    }
+    const int32_t* row = idx + i * slots;
+    int64_t floor_b = 0;
+    for (int64_t j = 0; j < slots; ++j) {
+      const int32_t p = row[j];
+      if (p >= 0 && last[p] + 1 > floor_b) floor_b = last[p] + 1;
+    }
+    const int64_t b = find(floor_b);
+    out[i] = b;
+    if (++fill[b] == capacity) {
+      ensure(b + 1);
+      next_free[b] = b + 1;
+    }
+    for (int64_t j = 0; j < slots; ++j) {
+      const int32_t p = row[j];
+      if (p >= 0) last[p] = b;
+    }
+  }
+}
+
 }  // extern "C"

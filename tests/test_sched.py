@@ -110,9 +110,54 @@ class TestNativePacker:
             _native.assign_supersteps(stream), _assign_supersteps_py(stream)
         )
 
+    def test_first_fit_matches_python_fallback(self):
+        from analyzer_tpu.sched import _native
+        from analyzer_tpu.sched.superstep import _assign_batches_first_fit_py
+
+        stream, _ = small_stream(n_matches=500, n_players=80, seed=9)
+        for cap in (1, 7, 32):
+            np.testing.assert_array_equal(
+                _native.assign_batches_first_fit(stream, cap),
+                _assign_batches_first_fit_py(stream, cap),
+            )
+
     def test_used_by_default(self):
         # the gated import must succeed in this environment (g++ is baked in)
         from analyzer_tpu.sched import _native  # noqa: F401
+
+
+class TestFirstFit:
+    def test_capacity_and_chronology(self):
+        from analyzer_tpu.sched import assign_batches
+
+        stream, _ = small_stream(n_matches=400, n_players=60, seed=13)
+        cap = 16
+        ba = assign_batches(stream, cap)
+        ratable = stream.ratable
+        assert (ba[~ratable] == -1).all()
+        assert (ba[ratable] >= 0).all()
+        # capacity respected
+        _, counts = np.unique(ba[ratable], return_counts=True)
+        assert counts.max() <= cap
+        # per-player batch ids strictly increase in stream order
+        last = {}
+        for i in np.flatnonzero(ratable):
+            for p in stream.player_idx[i].ravel():
+                if p < 0:
+                    continue
+                assert ba[i] > last.get(p, -1)
+                last[p] = ba[i]
+
+    def test_levels_better_than_asap_slicing(self):
+        # First-fit occupancy must beat (or match) the depth-based bound on
+        # a heavy-tailed stream.
+        from analyzer_tpu.sched import assign_supersteps
+
+        players = synthetic_players(100, seed=17)
+        stream = synthetic_stream(800, players, seed=17, activity_concentration=1.2)
+        state = PlayerState.create(100, skill_tier=players.skill_tier)
+        sched = pack_schedule(stream, pad_row=state.pad_row)
+        assert sched.occupancy > 0.8, sched.occupancy
 
 
 class TestPacking:
