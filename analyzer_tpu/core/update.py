@@ -132,6 +132,18 @@ def rate_batch(state: PlayerState, batch: MatchBatch, cfg: RatingConfig) -> Rate
             "PlayerState.create(..., cfg=cfg)"
         )
     rows = state.table[batch.player_idx]  # [B,2,T,W] — the ONE gather
+    return rate_gathered(rows, batch, cfg)
+
+
+def rate_gathered(
+    rows: jnp.ndarray, batch: MatchBatch, cfg: RatingConfig
+) -> RateOutputs:
+    """:func:`rate_batch` on pre-gathered state rows ``[B,2,T,W]``.
+
+    Split out so the sharded-table mesh path
+    (:mod:`analyzer_tpu.parallel.mesh`) can assemble ``rows`` from per-shard
+    contributions (psum over the mesh) instead of a full-table gather. The
+    caller is responsible for the seed_cfg compatibility check."""
     dtype = rows.dtype
     mask = batch.slot_mask
 
@@ -204,10 +216,9 @@ def scatter_rows(
 ) -> PlayerState:
     """The ONE whole-row scatter: masked / non-ratable slots are routed to
     the padding row, so shapes stay static and no collision can occur as
-    long as the batch is conflict-free. Shared by the single-device path
-    (:func:`apply_outputs`) and the replicated-mesh path
-    (:mod:`analyzer_tpu.parallel.mesh`) so the routing invariant lives in
-    exactly one place."""
+    long as the batch is conflict-free. (The sharded-table mesh path in
+    :mod:`analyzer_tpu.parallel.mesh` instead scatters host-precomputed
+    compacted per-shard row lists — see its ``build_routing``.)"""
     do = updated[:, None, None] & slot_mask
     idx = jnp.where(do, player_idx, state.pad_row)
     return dataclasses.replace(state, table=state.table.at[idx].set(new_rows))

@@ -117,6 +117,22 @@ class PackedSchedule:
         the schedule (padding slots burn identical FLOPs)."""
         return self.n_matches / max(self.match_idx.size, 1)
 
+    @property
+    def ratable(self) -> np.ndarray:
+        """``[S, B]`` — matches that actually write rating state. The host
+        mirror of ``MatchBatch.ratable`` (``rater.py:102-106`` gating); keep
+        the two in lockstep."""
+        return (self.mode_id >= 0) & ~self.afk
+
+    @property
+    def valid_slots(self) -> np.ndarray:
+        """``[S, B, 2, T]`` — slots whose player row is actually written by
+        a superstep (real player in a ratable match). This is the exact set
+        the device scatter commits (``update.py: scatter_rows``'s
+        ``updated & slot_mask``); the sharded-table routing
+        (``parallel.mesh.build_routing``) must cover exactly these."""
+        return self.slot_mask & self.ratable[:, :, None, None]
+
     def step_batch(self, s: int) -> MatchBatch:
         """Materializes superstep ``s`` as a device MatchBatch."""
         return MatchBatch(
