@@ -102,11 +102,16 @@ def cmd_elo(args) -> int:
     sched = pack_schedule(stream, pad_row=n_players)
     ratings, expected = elo_history(sched, n_players)
     ratable = stream.ratable
-    acc = (
-        float(((expected[ratable] > 0.5) == (stream.winner[ratable] == 0)).mean())
-        if ratable.any()
-        else None
-    )
+    if ratable.any():
+        # Exact-tie predictions (expected == 0.5, e.g. two fresh teams)
+        # score half credit instead of silently counting as "team 1 wins".
+        exp = expected[ratable]
+        hit = np.where(
+            exp == 0.5, 0.5, (exp > 0.5) == (stream.winner[ratable] == 0)
+        )
+        acc = float(hit.mean())
+    else:
+        acc = None
     if args.out:
         np.savez(args.out, ratings=ratings, expected=expected)
     print(

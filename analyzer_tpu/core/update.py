@@ -122,13 +122,20 @@ def _mode_onehot(mode_id: jnp.ndarray, dtype) -> jnp.ndarray:
 
 def rate_batch(state: PlayerState, batch: MatchBatch, cfg: RatingConfig) -> RateOutputs:
     """Computes all rating outputs for a batch without touching the state."""
-    if state.seed_cfg is not None and state.seed_cfg != cfg:
+    if (
+        state.seed_cfg is not None
+        and state.seed_cfg.unknown_player_sigma != cfg.unknown_player_sigma
+    ):
         # Trace-time check (both are static): the seed columns were baked
         # with state.seed_cfg; rating with a different config would silently
-        # seed unrated players with the wrong UNKNOWN_PLAYER_SIGMA.
+        # seed unrated players with the wrong UNKNOWN_PLAYER_SIGMA. Only
+        # that field feeds the seed columns (core/seeding.py), so
+        # dynamics-only changes (e.g. a TAU env override on a loaded
+        # checkpoint) are legitimate and pass.
         raise ValueError(
-            f"state seeds were built with {state.seed_cfg}, but rate_batch "
-            f"was called with {cfg}; rebuild the state via "
+            f"state seeds were built with UNKNOWN_PLAYER_SIGMA="
+            f"{state.seed_cfg.unknown_player_sigma}, but rate_batch was "
+            f"called with {cfg.unknown_player_sigma}; rebuild the state via "
             "PlayerState.create(..., cfg=cfg)"
         )
     rows = state.table[batch.player_idx]  # [B,2,T,W] — the ONE gather

@@ -61,6 +61,7 @@ def synthetic_stream(
     afk_rate: float = 0.02,
     unsupported_rate: float = 0.005,
     activity_concentration: float = 1.2,
+    max_activity_share: float | None = None,
 ) -> MatchStream:
     """Samples a chronologically ordered stream of two-team matches.
 
@@ -68,6 +69,15 @@ def synthetic_stream(
     toward a hot head of active players, deepening the superstep dependency
     chain like real ladder traffic would). Winners are sampled from the
     latent-skill gap through a logistic link.
+
+    ``max_activity_share`` caps any single player's expected share of match
+    slots. Unbounded Zipf gives the top player ~1/H(P, s) of ALL slots
+    (~1.6% at P=300k, s=0.8) — i.e. one player "playing" 11% of a 2M-match
+    history, which no human can (and which pins the superstep schedule at
+    the depth of that player's match chain). A real multi-year ladder's
+    hardest grinder plays a few thousand matches of tens of millions; pass
+    e.g. ``1e-4`` (top player in ~0.08% of matches at ~8 slots/match) for
+    that physically plausible profile. ``None`` keeps the raw Zipf weights.
     """
     rng = np.random.default_rng(seed)
     p = players.n_players
@@ -76,6 +86,17 @@ def synthetic_stream(
     # Heavy-tailed activity weights.
     ranks = np.arange(1, p + 1, dtype=np.float64)
     weights = 1.0 / ranks**activity_concentration
+    if max_activity_share is not None:
+        # Clip-and-renormalize until stable: clipping raises everyone
+        # else's share, which can push new players over the cap. A cap
+        # below 1/P is infeasible (uniform is the floor); the loop then
+        # just converges toward uniform weights.
+        cap = max(max_activity_share, 1.0 / p)
+        for _ in range(64):
+            clipped = np.minimum(weights, cap * weights.sum())
+            if np.array_equal(clipped, weights):
+                break
+            weights = clipped
     rng.shuffle(weights)
     weights /= weights.sum()
 

@@ -5,7 +5,9 @@ the history runner's collected outputs are posteriors, so features here are
 reconstructed from a separate forward pass or from prior snapshots):
 
     0    shared-mu sum difference (team0 - team1), mu0-normalized
-    1    shared-sigma sum (both teams), sigma0-normalized (uncertainty)
+    1    mean shared sigma over the match's real players,
+         sigma0-normalized (uncertainty) — per-player mean, not a sum, so
+         the scale is comparable between 3v3 (6 players) and 5v5 (10)
     2    TrueSkill win probability Phi(diff / c)  (ops.trueskill)
     3    match quality (draw probability proxy)
     4..9 one-hot game mode (6 modes)
@@ -49,7 +51,8 @@ def match_features(
 
     team_mu = (mu * maskf).sum(-1)  # [B,2]
     mu_diff = (team_mu[:, 0] - team_mu[:, 1]) / cfg.mu0
-    sg_sum = (sg * maskf).sum(-1).sum(-1) / (cfg.sigma0 * 6.0)
+    n_active = jnp.maximum(maskf.sum((-2, -1)), 1.0)  # [B] real players
+    sg_sum = (sg * maskf).sum((-2, -1)) / (cfg.sigma0 * n_active)
 
     p_win = ts.win_probability(mu, sg, slot_mask, cfg)
     quality = ts.quality(mu, sg, slot_mask, cfg)

@@ -288,13 +288,17 @@ def rate_history_sharded(
         raise ValueError(
             f"batch_size {sched.batch_size} not divisible by mesh size {n_dev}"
         )
-    if state.seed_cfg is not None and state.seed_cfg != cfg:
+    if (
+        state.seed_cfg is not None
+        and state.seed_cfg.unknown_player_sigma != cfg.unknown_player_sigma
+    ):
         # Same contract as rate_batch (core/update.py) — checked here once
         # because the sharded path assembles rows itself via rate_gathered.
         raise ValueError(
-            f"state seeds were built with {state.seed_cfg}, but the sharded "
-            f"rater was called with {cfg}; rebuild the state via "
-            "PlayerState.create(..., cfg=cfg)"
+            f"state seeds were built with UNKNOWN_PLAYER_SIGMA="
+            f"{state.seed_cfg.unknown_player_sigma}, but the sharded rater "
+            f"was called with {cfg.unknown_player_sigma}; rebuild the state "
+            "via PlayerState.create(..., cfg=cfg)"
         )
 
     n_rows = state.table.shape[0]

@@ -55,6 +55,7 @@ class EncodedBatch:
         rr = np.full((p + 1,), np.nan, np.float32)
         rb = np.full((p + 1,), np.nan, np.float32)
         ti = np.zeros((p + 1,), np.int32)
+        bad_tier: dict[int, object] = {}  # row -> out-of-table tier value
         for r, player in enumerate(self.player_at):
             for c, col in enumerate(constants.RATING_COLUMNS):
                 mu = getattr(player, f"{col}_mu", None)
@@ -68,13 +69,19 @@ class EncodedBatch:
             tier = player.skill_tier
             if tier is not None:
                 if not (constants.MIN_SKILL_TIER <= tier <= constants.MAX_SKILL_TIER):
-                    # The reference KeyErrors on out-of-table tiers
-                    # (rater.py:60); surface it at encode time.
-                    raise KeyError(
-                        f"player {player.api_id}: skill_tier {tier} outside "
-                        f"[{constants.MIN_SKILL_TIER}, {constants.MAX_SKILL_TIER}]"
+                    # The reference KeyErrors on out-of-table tiers, but
+                    # only when get_trueskill_seed actually consults the
+                    # table — i.e. the player has no shared rating and no
+                    # nonzero rank points AND appears in a ratable match
+                    # (rater.py:44-60,115-119). Record now, decide after
+                    # the match tensors are built; meanwhile clamp like
+                    # the tensor path so the (unused) baked seed is sane.
+                    bad_tier[r] = tier
+                    ti[r] = int(
+                        min(max(tier, constants.MIN_SKILL_TIER), constants.MAX_SKILL_TIER)
                     )
-                ti[r] = int(tier)
+                else:
+                    ti[r] = int(tier)
         seed_mu, seed_sigma = trueskill_seed(
             jnp.asarray(rr), jnp.asarray(rb), jnp.asarray(ti), cfg
         )
@@ -128,6 +135,32 @@ class EncodedBatch:
         self.stream = MatchStream(
             player_idx=idx, winner=winner, mode_id=mode, afk=afk
         )
+
+        if bad_tier:
+            # Reference-faithful KeyError gating (rater.py:44-60,115-119):
+            # an out-of-table tier only raises when the tier table would
+            # actually be consulted — the player is in at least one RATABLE
+            # match (AFK/unsupported matches return before seeding), has no
+            # shared rating, and has no nonzero rank points (0/None are
+            # "missing", the fallback-1 contract).
+            ratable = (mode >= 0) & ~afk
+            used = np.unique(idx[ratable])
+            used = used[used >= 0]
+            for r in used:
+                r = int(r)
+                if r not in bad_tier:
+                    continue
+                no_shared = np.isnan(table[r, MU_LO])
+                no_points = (np.isnan(rr[r]) or rr[r] == 0) and (
+                    np.isnan(rb[r]) or rb[r] == 0
+                )
+                if no_shared and no_points:
+                    raise KeyError(
+                        f"player {self.player_at[r].api_id}: skill_tier "
+                        f"{bad_tier[r]} outside [{constants.MIN_SKILL_TIER}, "
+                        f"{constants.MAX_SKILL_TIER}] and the seed would be "
+                        "consulted (no shared rating, no rank points)"
+                    )
 
     def write_back(self, outs) -> None:
         """Applies HistoryOutputs (stream order) to the object graph with

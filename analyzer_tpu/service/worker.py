@@ -113,10 +113,13 @@ class Worker:
             notify = (msg.headers or {}).get("notify")
             if notify:
                 self.broker.publish_topic("amq.topic", notify, b"analyze_update")
+            # Forwards keep the original message headers, as the reference
+            # republishes with properties=prop (worker.py:136-147) so
+            # downstream consumers still see e.g. the notify header.
             if self.config.do_crunch_match:
-                self.broker.publish(self.config.crunch_queue, msg.body)
+                self.broker.publish(self.config.crunch_queue, msg.body, msg.headers)
             if self.config.do_sew_match:
-                self.broker.publish(self.config.sew_queue, msg.body)
+                self.broker.publish(self.config.sew_queue, msg.body, msg.headers)
             if self.config.do_telesuck_match:
                 mid = msg.body.decode()
                 for url in self.store.asset_urls(mid):

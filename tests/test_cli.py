@@ -33,6 +33,28 @@ class TestCli:
         assert elo["matches"] == 200
         assert elo["prediction_accuracy"] is not None
 
+    def test_elo_exact_ties_score_half(self, tmp_path, capsys):
+        # Disjoint fresh players: every Elo prediction is exactly 0.5.
+        # Accuracy must be 0.5 (half credit per tie), not 1.0 or 0.0 from
+        # silently counting ties as "team 0 predicted" (VERDICT round 1).
+        import numpy as np
+
+        from analyzer_tpu.io.csv_codec import save_stream_csv
+        from analyzer_tpu.sched import MatchStream
+
+        n = 8
+        idx = np.arange(n * 6, dtype=np.int32).reshape(n, 2, 3)
+        stream = MatchStream(
+            player_idx=idx,
+            winner=np.array([0, 1] * (n // 2), np.int32),
+            mode_id=np.ones(n, np.int32),
+            afk=np.zeros(n, bool),
+        )
+        csv = str(tmp_path / "ties.csv")
+        save_stream_csv(csv, stream)
+        line = run(capsys, "elo", "--csv", csv)
+        assert json.loads(line)["prediction_accuracy"] == 0.5
+
     def test_resume_continues(self, tmp_path, capsys):
         csv = str(tmp_path / "s.csv")
         run(capsys, "synth", "--matches", "100", "--players", "40", "--out", csv)

@@ -81,6 +81,25 @@ class TestFeaturesAndHeads:
         sel = stream.mode_id >= 0
         assert np.allclose(feats[sel, 4:].sum(1), 1.0)
 
+    def test_sigma_feature_scale_mode_independent(self):
+        # Feature 1 is the per-player mean sigma (sigma0-normalized): for
+        # fresh tier-seeded players it must be ~equal for a 3v3 and a 5v5
+        # batch, not 10/6 apart (the round-1 bug normalized by a hard-coded
+        # 6.0 — VERDICT round 1).
+        import jax.numpy as jnp
+
+        from analyzer_tpu.models.features import match_features
+
+        state = PlayerState.create(10, skill_tier=np.full(10, 15, np.int32))
+        idx3 = jnp.asarray(np.arange(6, dtype=np.int32).reshape(1, 2, 3))
+        idx3 = jnp.pad(idx3, ((0, 0), (0, 0), (0, 2)), constant_values=10)
+        mask3 = jnp.asarray(np.array([[[1, 1, 1, 0, 0]] * 2], dtype=bool))
+        idx5 = jnp.asarray(np.arange(10, dtype=np.int32).reshape(1, 2, 5))
+        mask5 = jnp.ones((1, 2, 5), bool)
+        f3 = match_features(state, idx3, mask3, jnp.asarray([1]), CFG)
+        f5 = match_features(state, idx5, mask5, jnp.asarray([4]), CFG)
+        np.testing.assert_allclose(f3[0, 1], f5[0, 1], rtol=1e-6)
+
     def test_ratable_mask_filters_gated_matches(self):
         players = synthetic_players(100, seed=5)
         stream = synthetic_stream(

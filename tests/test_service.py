@@ -140,6 +140,34 @@ class TestFailurePolicy:
         assert worker.batches_failed == 1
         assert broker.qsize("analyze_failed") == 1
 
+    def test_tier_keyerror_only_when_seed_consulted(self, rig):
+        # The reference only raises inside get_trueskill_seed, which is
+        # reached for players with no shared rating and no rank points
+        # (rater.py:44-60,115-119). A tier-30 player who already has a
+        # rating, or has rank points, or only appears in an AFK match,
+        # rates/processes fine.
+        broker, store, worker = rig
+        rated = mk_match("rated", created_at=0)
+        p = rated.rosters[0].participants[0].player[0]
+        p.skill_tier = 30
+        p.trueskill_mu, p.trueskill_sigma = 2000.0, 100.0
+        points = mk_match("points", created_at=1)
+        q = points.rosters[0].participants[0].player[0]
+        q.skill_tier = 30
+        q.rank_points_ranked = 1700.0
+        afk = mk_match("afk30", created_at=2, afk=True)
+        afk.rosters[0].participants[0].player[0].skill_tier = 30
+        for m in (rated, points, afk):
+            store.add_match(m)
+            broker.publish("analyze", m.api_id.encode())
+        worker.config = ServiceConfig(batch_size=3, idle_timeout=0.0)
+        assert worker.poll()
+        assert worker.batches_failed == 0
+        assert p.trueskill_mu != 2000.0  # updated, not dead-lettered
+        assert q.trueskill_mu is not None
+        # points-seeded: conservative estimate anchors at the points
+        assert afk.trueskill_quality == 0  # AFK gate ran, no KeyError
+
 
 class TestFanOut:
     def test_notify_crunch_sew_telesuck(self, rig):
