@@ -10,15 +10,19 @@ state is the HBM-resident table (the store is a write-behind mirror, not
 the source of truth during a batch).
 
 Pluggable edges: ``Broker`` (in-memory always; pika adapter when installed)
-and ``MatchStore`` (in-memory object graphs; a SQLAlchemy adapter would
-slot in the same way). Transactionality is by construction: a batch's
+and the match store (in-memory object graphs, or ``SqlStore`` — the
+reference's reflected-SQL layer on DB-API, sqlite tested end-to-end, MySQL
+via gated drivers). Transactionality is by construction: a batch's
 outputs are fully computed by pure functions before any mutation is
 applied, so an exception mid-compute leaves store and state untouched
 (mirroring the reference's single commit/rollback, ``worker.py:194-199``).
 """
 
 from analyzer_tpu.service.broker import Broker, InMemoryBroker, Message
+from analyzer_tpu.service.sql_store import SqlStore
 from analyzer_tpu.service.store import InMemoryStore
 from analyzer_tpu.service.worker import Worker
 
-__all__ = ["Broker", "InMemoryBroker", "Message", "InMemoryStore", "Worker"]
+__all__ = [
+    "Broker", "InMemoryBroker", "Message", "InMemoryStore", "SqlStore", "Worker",
+]

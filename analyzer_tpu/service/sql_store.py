@@ -1,0 +1,341 @@
+"""SQL match store: the reference's reflected-MySQL layer on raw DB-API.
+
+The reference's L2 is SQLAlchemy automap — the schema is *reflected at
+runtime*, never declared in code (``worker.py:43-46``), then the match →
+roster → participant → player / participant_items graph is eager-loaded
+with ``selectinload`` and written back with one transaction per batch
+(``worker.py:169-199``). This adapter keeps every one of those contracts on
+plain DB-API 2.0 instead of SQLAlchemy (not installed in this image; an ORM
+wrapper would be dead code the tests can never run — the fate the round-1
+review flagged for the pika adapter):
+
+  * runtime reflection — table/column sets are discovered from the live
+    database (``PRAGMA table_info`` / ``SHOW COLUMNS``), so the loaded
+    column set and the write-back column set adapt to the deployed schema
+    exactly as automap does; rating columns the schema lacks are silently
+    dropped at commit, which is literally automap's behavior (setattr of a
+    non-column name is a plain Python attribute the ORM never flushes).
+  * selectin eager loading — one query per relationship level keyed by the
+    parent ids (``worker.py:176-191``'s ``selectinload`` chain), matches
+    ordered by ``created_at`` ascending, ids deduped (``worker.py:172,176``).
+  * single-transaction write-back — ``commit()`` flushes every rating
+    column of the loaded graph with ``executemany`` and commits once;
+    any error rolls back and re-raises (``worker.py:194-199``).
+  * ``asset_urls`` — the telesuck query (``SELECT url FROM asset WHERE
+    match_api_id = ?``, ``worker.py:150-153``), autocommit read like the
+    reference's separate throwaway session (``worker.py:124-126``).
+
+Drivers: ``sqlite://`` URIs use the stdlib ``sqlite3`` (what the tests
+exercise end-to-end); ``mysql://`` URIs try the reference's cymysql pin
+first (``requirements.txt:1``), then pymysql/MySQLdb — gated imports, same
+policy as the pika broker adapter.
+
+Loaded objects are ``types.SimpleNamespace`` graphs shaped exactly like the
+parity-test fakes (``tests/fakes.py``; the reference's ``worker_test.py:6-63``
+strategy), so the whole encode → rate → write_back path is indifferent to
+whether a match came from SQL or memory.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import Iterable
+from urllib.parse import urlparse, unquote
+
+from analyzer_tpu.core.constants import RATING_COLUMNS
+from analyzer_tpu.logging_utils import get_logger
+
+logger = get_logger(__name__)
+
+# The de-facto feature schema of the rating path: the reference's load_only
+# column lists (worker.py:176-191). 5v5 columns are absent there and filled
+# by lazy loading at runtime in SQLAlchemy; here reflection adds whichever
+# rating pairs the live schema actually has (an eager superset, documented
+# divergence — there is no lazy loading without an ORM session).
+MATCH_COLS = ("api_id", "game_mode", "created_at")
+ROSTER_COLS = ("api_id", "match_api_id", "winner")
+PARTICIPANT_COLS = (
+    "api_id", "match_api_id", "roster_api_id",
+    "player_api_id", "skill_tier", "went_afk",
+)
+PLAYER_BASE_COLS = ("api_id", "rank_points_ranked", "rank_points_blitz")
+
+REQUIRED_TABLES = (
+    "match", "asset", "roster", "participant", "participant_items", "player",
+)
+
+
+def _connect(uri: str):
+    """Opens a DB-API connection + paramstyle marker for the URI."""
+    parsed = urlparse(uri)
+    scheme = parsed.scheme.split("+")[0]
+    if scheme == "sqlite":
+        import sqlite3
+
+        # sqlite:///rel.db | sqlite:////abs.db | sqlite:// (in-memory)
+        path = (parsed.netloc or "") + (parsed.path or "")
+        if path.startswith("/") and not path.startswith("//"):
+            path = path[1:]
+        elif path.startswith("//"):
+            path = path[1:]
+        conn = sqlite3.connect(path or ":memory:")
+        return conn, "qmark", "sqlite"
+    if scheme == "mysql":
+        last: Exception | None = None
+        for drv in ("cymysql", "pymysql", "MySQLdb"):
+            try:
+                mod = __import__(drv)
+            except ImportError as err:  # gated like the pika adapter
+                last = err
+                continue
+            conn = mod.connect(
+                host=parsed.hostname or "localhost",
+                port=parsed.port or 3306,
+                user=unquote(parsed.username or ""),
+                passwd=unquote(parsed.password or ""),
+                db=parsed.path.lstrip("/"),
+            )
+            return conn, "format", "mysql"
+        raise ImportError(
+            f"no MySQL driver available for {uri!r} (tried cymysql, pymysql, "
+            f"MySQLdb — the reference pins cymysql, requirements.txt:1): {last}"
+        )
+    raise ValueError(f"unsupported DATABASE_URI scheme: {parsed.scheme!r}")
+
+
+class SqlStore:
+    """Match store over a SQL database, satisfying the worker's store
+    protocol (``load_batch``, ``asset_urls``) plus the transactional
+    ``commit``/``rollback`` the reference performs per batch."""
+
+    def __init__(self, uri: str) -> None:
+        self.uri = uri
+        self.conn, self._paramstyle, self._dialect = _connect(uri)
+        self.columns = self._reflect()
+        missing = [t for t in REQUIRED_TABLES if t not in self.columns]
+        if missing:
+            raise RuntimeError(
+                f"schema reflection: required tables missing from {uri!r}: "
+                f"{missing} (the reference reflects match/asset/roster/"
+                "participant/participant_stats/participant_items/player, "
+                "worker.py:50-83)"
+            )
+        # participant_stats is reflected but never loaded nor written —
+        # the reference wires it (worker.py:75-78) and never touches it.
+        self._rating_cols = {
+            table: [
+                c
+                for col in RATING_COLUMNS
+                for c in (f"{col}_mu", f"{col}_sigma")
+                if c in self.columns[table]
+            ]
+            for table in ("player", "participant_items")
+        }
+
+    # -- reflection -------------------------------------------------------
+    def _reflect(self) -> dict[str, list[str]]:
+        cur = self.conn.cursor()
+        out: dict[str, list[str]] = {}
+        if self._dialect == "sqlite":
+            cur.execute(
+                "SELECT name FROM sqlite_master WHERE type='table'"
+            )
+            tables = [r[0] for r in cur.fetchall()]
+            for t in tables:
+                cur.execute(f'PRAGMA table_info("{t}")')
+                out[t] = [r[1] for r in cur.fetchall()]
+        else:
+            cur.execute("SHOW TABLES")
+            tables = [r[0] for r in cur.fetchall()]
+            for t in tables:
+                cur.execute(f"SHOW COLUMNS FROM `{t}`")
+                out[t] = [r[0] for r in cur.fetchall()]
+        cur.close()
+        return out
+
+    # -- query helpers ----------------------------------------------------
+    def _ph(self, n: int) -> str:
+        mark = "?" if self._paramstyle == "qmark" else "%s"
+        return ",".join([mark] * n)
+
+    def _q(self, name: str) -> str:
+        return f'"{name}"' if self._dialect == "sqlite" else f"`{name}`"
+
+    def _select_in(self, table: str, cols: Iterable[str], key: str,
+                   values: list, order_by: str | None = None) -> list[tuple]:
+        if not values:
+            return []
+        cols = list(cols)
+        cur = self.conn.cursor()
+        # Chunk the IN list defensively (the reference bounds per-query row
+        # streaming with yield_per(CHUNKSIZE)=100, worker.py:191; huge IN
+        # lists are the DB-API analog of that concern).
+        rows: list[tuple] = []
+        for i in range(0, len(values), 500):
+            chunk = values[i : i + 500]
+            sql = (
+                f"SELECT {', '.join(self._q(c) for c in cols)} "
+                f"FROM {self._q(table)} "
+                f"WHERE {self._q(key)} IN ({self._ph(len(chunk))})"
+            )
+            if order_by:
+                sql += f" ORDER BY {self._q(order_by)} ASC"
+            cur.execute(sql, chunk)
+            rows.extend(cur.fetchall())
+        cur.close()
+        if order_by and len(values) > 500:
+            idx = cols.index(order_by)
+            rows.sort(key=lambda r: r[idx])
+        return rows
+
+    # -- store protocol ---------------------------------------------------
+    def load_batch(self, ids: Iterable[str]) -> list:
+        """Dedupe + load the eager object graph, matches ordered by
+        ``created_at`` ascending (``worker.py:172,176-191``)."""
+        seen = list(dict.fromkeys(ids))
+        match_rows = self._select_in(
+            "match", MATCH_COLS, "api_id", seen, order_by="created_at"
+        )
+        matches: list[SimpleNamespace] = []
+        mids = []
+        for api_id, game_mode, created_at in match_rows:
+            m = SimpleNamespace(
+                api_id=api_id, game_mode=game_mode, created_at=created_at,
+                trueskill_quality=None, rosters=[], participants=[],
+            )
+            matches.append(m)
+            mids.append(api_id)
+
+        # selectin level 1: rosters of the batch's matches
+        by_match: dict[str, SimpleNamespace] = {m.api_id: m for m in matches}
+        rosters: dict[str, SimpleNamespace] = {}
+        for api_id, match_api_id, winner in self._select_in(
+            "roster", ROSTER_COLS, "match_api_id", mids
+        ):
+            r = SimpleNamespace(
+                api_id=api_id, match_api_id=match_api_id, winner=winner,
+                participants=[],
+            )
+            rosters[api_id] = r
+            by_match[match_api_id].rosters.append(r)
+
+        # selectin level 2: participants (keyed by match, attached to both
+        # match.participants and roster.participants like the double
+        # relationship wiring at worker.py:52-66)
+        part_rows = self._select_in(
+            "participant", PARTICIPANT_COLS, "match_api_id", mids
+        )
+        player_ids = list(dict.fromkeys(r[3] for r in part_rows))
+        # selectin level 3: players, full reflected rating column set.
+        # player.skill_tier is not in the reference's load_only list
+        # (worker.py:184-190) but get_trueskill_seed reads it lazily
+        # (rater.py:57-60); reflection loads it eagerly when it exists.
+        player_cols = list(PLAYER_BASE_COLS) + self._rating_cols["player"]
+        if "skill_tier" in self.columns["player"]:
+            player_cols.insert(len(PLAYER_BASE_COLS), "skill_tier")
+        players: dict[str, SimpleNamespace] = {}
+        for row in self._select_in("player", player_cols, "api_id", player_ids):
+            p = SimpleNamespace(**dict(zip(player_cols, row)))
+            if not hasattr(p, "skill_tier"):
+                p.skill_tier = None
+            for col in RATING_COLUMNS:  # absent schema columns read as None
+                for c in (f"{col}_mu", f"{col}_sigma"):
+                    if not hasattr(p, c):
+                        setattr(p, c, None)
+            players[p.api_id] = p
+
+        # selectin level 3b: participant_items rows
+        items_cols = ["api_id", "participant_api_id", "any_afk"]
+        items_cols += self._rating_cols["participant_items"]
+        items_by_part: dict[str, list[SimpleNamespace]] = {}
+        part_ids = [r[0] for r in part_rows]
+        for row in self._select_in(
+            "participant_items", items_cols, "participant_api_id", part_ids
+        ):
+            it = SimpleNamespace(**dict(zip(items_cols, row)))
+            for col in RATING_COLUMNS[1:]:
+                for c in (f"{col}_mu", f"{col}_sigma"):
+                    if not hasattr(it, c):
+                        setattr(it, c, None)
+            items_by_part.setdefault(it.participant_api_id, []).append(it)
+
+        for api_id, match_api_id, roster_api_id, player_api_id, skill_tier, went_afk in part_rows:
+            part = SimpleNamespace(
+                api_id=api_id,
+                match_api_id=match_api_id,
+                roster_api_id=roster_api_id,
+                player_api_id=player_api_id,
+                skill_tier=skill_tier,
+                went_afk=went_afk,
+                trueskill_mu=None,
+                trueskill_sigma=None,
+                trueskill_delta=None,
+                player=[players[player_api_id]],
+                participant_items=items_by_part.get(api_id, []),
+            )
+            by_match[match_api_id].participants.append(part)
+            if roster_api_id in rosters:
+                rosters[roster_api_id].participants.append(part)
+        return matches
+
+    def asset_urls(self, match_api_id: str) -> list[str]:
+        rows = self._select_in("asset", ("url",), "match_api_id", [match_api_id])
+        # Release the read snapshot the SELECT opened — the reference uses a
+        # throwaway autocommit session here (worker.py:124-126); on MySQL a
+        # lingering REPEATABLE READ snapshot would hide newly ingested rows
+        # from the next load_batch. Never reached with writes pending: the
+        # worker commits before fan-out. No-op on sqlite.
+        self.conn.rollback()
+        return [r[0] for r in rows]
+
+    # -- transaction ------------------------------------------------------
+    def commit(self, matches: list) -> None:
+        """Flushes the batch graph's rating columns in one transaction
+        (the reference's single ``db.commit()`` with rollback-and-reraise,
+        ``worker.py:194-199``)."""
+        try:
+            cur = self.conn.cursor()
+            mark = "?" if self._paramstyle == "qmark" else "%s"
+
+            def update(table: str, cols: list[str], key: str, objs: list):
+                # Filter against the live schema FIRST, then build rows —
+                # columns the deployed schema lacks are dropped, exactly as
+                # automap never flushes a non-column attribute.
+                cols = [c for c in cols if c in self.columns[table]]
+                if not objs or not cols:
+                    return
+                sql = (
+                    f"UPDATE {self._q(table)} SET "
+                    + ", ".join(f"{self._q(c)} = {mark}" for c in cols)
+                    + f" WHERE {self._q(key)} = {mark}"
+                )
+                rows = [
+                    tuple(getattr(o, c, None) for c in cols) + (getattr(o, key),)
+                    for o in objs
+                ]
+                cur.executemany(sql, rows)
+
+            parts = [p for m in matches for p in m.participants]
+            players = {p.player[0].api_id: p.player[0] for p in parts}
+            items = [it for p in parts for it in p.participant_items]
+
+            update("match", ["trueskill_quality"], "api_id", matches)
+            update("participant",
+                   ["trueskill_mu", "trueskill_sigma", "trueskill_delta"],
+                   "api_id", parts)
+            update("player", self._rating_cols["player"], "api_id",
+                   list(players.values()))
+            update("participant_items",
+                   ["any_afk"] + self._rating_cols["participant_items"],
+                   "api_id", items)
+            cur.close()
+            self.conn.commit()
+        except Exception:
+            self.conn.rollback()
+            raise
+
+    def rollback(self) -> None:
+        self.conn.rollback()
+
+    def close(self) -> None:
+        self.conn.close()

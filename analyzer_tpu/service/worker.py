@@ -102,6 +102,13 @@ class Worker:
         except Exception as err:  # noqa: BLE001 — policy: any error dead-letters
             logger.error("batch failed: %s", err)
             self.batches_failed += 1
+            # Close out any read transaction load_batch's SELECTs opened
+            # (the reference's rollback-then-close, worker.py:195-199);
+            # without this a MySQL connection would pin a stale snapshot
+            # and the next load_batch would miss newly ingested matches.
+            rollback = getattr(self.store, "rollback", None)
+            if rollback is not None:
+                rollback()
             for msg in batch:
                 self.broker.publish(self.config.failed_queue, msg.body, msg.headers)
                 self.broker.nack(msg.delivery_tag, requeue=False)
@@ -140,6 +147,12 @@ class Worker:
         sched = pack_schedule(enc.stream, pad_row=enc.state.pad_row)
         _, outs = rate_history(enc.state, sched, self.rating_config, collect=True)
         enc.write_back(outs)
+        # Transactional stores (SqlStore) flush the mutated graph in one
+        # commit, rolling back internally on error (worker.py:194-199);
+        # the in-memory store's objects ARE the store, nothing to flush.
+        commit = getattr(self.store, "commit", None)
+        if commit is not None:
+            commit(matches)
         self.matches_rated += len(matches)
         return [m.api_id for m in matches]
 
@@ -160,14 +173,14 @@ def main() -> None:
 
     broker = make_pika_broker(config.rabbitmq_uri)
     if config.database_uri:
-        raise NotImplementedError(
-            "SQL match store adapter not wired; ingest matches into an "
-            "InMemoryStore (service.store) or extend it with the automap "
-            "schema of the reference (worker.py:38-83)"
-        )
-    from analyzer_tpu.service.store import InMemoryStore
+        from analyzer_tpu.service.sql_store import SqlStore
 
-    Worker(broker, InMemoryStore(), config).run()
+        store = SqlStore(config.database_uri)
+    else:
+        from analyzer_tpu.service.store import InMemoryStore
+
+        store = InMemoryStore()
+    Worker(broker, store, config).run()
 
 
 if __name__ == "__main__":
