@@ -60,21 +60,74 @@ def cmd_rate(args) -> int:
     timer = PhaseTimer()
     with timer.phase("load"):
         stream, n_players = _load_stream(args.csv)
-    cursor = 0
+    cursor, start_step = 0, 0
+    ck = None
     if args.resume:
         with timer.phase("restore"):
-            state, cursor = load_checkpoint(args.checkpoint)
-        print(f"resumed at match {cursor}/{stream.n_matches}", file=sys.stderr)
+            ck = load_checkpoint(args.checkpoint)
+        state, cursor, start_step = ck.state, ck.cursor, ck.step_cursor
+        print(
+            f"resumed at match {cursor}/{stream.n_matches}"
+            + (f", superstep {start_step}" if start_step else ""),
+            file=sys.stderr,
+        )
     else:
         state = PlayerState.create(n_players, cfg=cfg)
     with timer.phase("pack"):
         sched = pack_schedule(
             stream.slice(cursor, stream.n_matches), pad_row=state.pad_row
         )
+    if start_step:
+        # A mid-schedule cursor is only meaningful against the identical
+        # schedule: packing is deterministic, so a fingerprint mismatch
+        # means the stream file or packing policy changed — resuming would
+        # double-apply updates. Fail loudly (io/checkpoint.py).
+        if sched.fingerprint != ck.schedule_fingerprint:
+            print(
+                "error: checkpoint was taken mid-schedule but the packed "
+                "schedule no longer matches (stream file or packing policy "
+                "changed); re-rate from scratch or from a full-run checkpoint",
+                file=sys.stderr,
+            )
+            return 2
+    for flag in ("checkpoint_every", "stop_after_steps"):
+        val = getattr(args, flag)
+        if val is not None and val <= 0:
+            print(f"error: --{flag.replace('_', '-')} must be positive",
+                  file=sys.stderr)
+            return 2
+    finished = args.stop_after_steps is None or args.stop_after_steps >= sched.n_steps
+    on_chunk = None
+    if args.checkpoint and args.checkpoint_every:
+        every = args.checkpoint_every
+        fingerprint = sched.fingerprint
+        last_saved = start_step
+
+        def on_chunk(st, next_step):
+            nonlocal last_saved
+            # Honor the requested cadence even when chunks are smaller, and
+            # don't duplicate the final save the finished branch will write.
+            if next_step - last_saved < every or (
+                finished and next_step >= sched.n_steps
+            ):
+                return
+            last_saved = next_step
+            save_checkpoint(
+                args.checkpoint, st, cursor=cursor,
+                step_cursor=next_step, schedule_fingerprint=fingerprint,
+            )
     with timer.phase("rate"), trace(args.trace):
-        state, _ = rate_history(state, sched, cfg)
+        state, _ = rate_history(
+            state, sched, cfg,
+            start_step=start_step,
+            stop_after=args.stop_after_steps,
+            steps_per_chunk=(
+                min(8192, args.checkpoint_every) if args.checkpoint_every else 8192
+            ),
+            on_chunk=on_chunk,
+        )
         np.asarray(state.table[:1])  # force completion for honest timing
-    if args.checkpoint:
+    if args.checkpoint and finished:
         with timer.phase("checkpoint"):
             save_checkpoint(args.checkpoint, state, cursor=stream.n_matches)
     mu = np.asarray(state.mu)[:n_players, 0]
@@ -173,6 +226,16 @@ def main(argv=None) -> int:
     s.add_argument("--csv", required=True)
     s.add_argument("--checkpoint", help="state snapshot path (.npz)")
     s.add_argument("--resume", action="store_true", help="resume from --checkpoint")
+    s.add_argument(
+        "--checkpoint-every", type=int, metavar="STEPS",
+        help="also snapshot every N supersteps mid-run (crash blast radius; "
+        "the reference commits every 500-match batch, worker.py:194)",
+    )
+    s.add_argument(
+        "--stop-after-steps", type=int, metavar="STEPS",
+        help="stop at a chunk boundary at/after this superstep (bounded runs; "
+        "with --checkpoint-every the run is resumable from the snapshot)",
+    )
     s.add_argument("--trace", help="jax.profiler trace output dir")
     s.set_defaults(fn=cmd_rate)
 

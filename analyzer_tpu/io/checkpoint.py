@@ -1,11 +1,27 @@
 """Rating-state snapshots with a resume cursor.
 
 The reference needs no checkpoint subsystem because MySQL *is* the
-checkpoint: every batch commit persists all player state, and a restarted
-worker resumes from the broker queue (SURVEY.md section 5.3-5.4). With the
-player table living in HBM, restarts lose state — so snapshots are explicit:
-the full PlayerState plus the stream cursor (index of the next unrated
-match), making re-rate idempotent from any snapshot.
+checkpoint: every 500-match batch commit persists all player state
+(``worker.py:194``), so its blast radius on crash is one batch, and a
+restarted worker resumes from the broker queue (SURVEY.md section 5.3-5.4).
+With the player table living in HBM, restarts lose state — so snapshots are
+explicit, and they are taken *mid-run* at superstep granularity so a long
+re-rate has the same bounded blast radius.
+
+Cursor semantics — two levels, because superstep packing is not
+stream-prefix monotone (a late-stream match between fresh players can be
+scheduled into an early superstep, so "state after step s" is not "state
+after match m" for any m):
+
+  * ``cursor`` — the stream offset the current schedule was packed from;
+    matches before it are fully applied. A finished run stores
+    ``cursor = n_matches, step_cursor = 0``.
+  * ``step_cursor`` — progress within the deterministic packed schedule of
+    ``stream[cursor:]``. Resume re-packs that slice (packing is a pure
+    function of the stream) and re-enters the scan at this superstep.
+  * ``schedule_fingerprint`` — hash of the packed schedule, verified on
+    resume so a changed stream file or packing policy fails loudly instead
+    of silently double-applying updates.
 
 Format: a single ``.npz`` (atomic rename on save). The packed table carries
 mu/sigma AND the precomputed seed columns, and the RatingConfig that baked
@@ -29,13 +45,30 @@ from analyzer_tpu.core.state import PlayerState
 
 _FIELDS = ("table", "rank_points_ranked", "rank_points_blitz", "skill_tier")
 _CFG_FIELDS = tuple(f.name for f in dataclasses.fields(RatingConfig))
-_FORMAT_VERSION = 2
+_FORMAT_VERSION = 3
 
 
-def save_checkpoint(path: str, state: PlayerState, cursor: int = 0) -> None:
-    """Writes state + cursor atomically (tmp file + rename)."""
+@dataclasses.dataclass(frozen=True)
+class Checkpoint:
+    state: PlayerState
+    cursor: int  # stream offset the schedule was packed from
+    step_cursor: int = 0  # superstep progress within that schedule
+    schedule_fingerprint: str | None = None
+
+
+def save_checkpoint(
+    path: str,
+    state: PlayerState,
+    cursor: int = 0,
+    step_cursor: int = 0,
+    schedule_fingerprint: str | None = None,
+) -> None:
+    """Writes state + cursors atomically (tmp file + rename)."""
     arrays = {f: np.asarray(getattr(state, f)) for f in _FIELDS}
     arrays["cursor"] = np.int64(cursor)
+    arrays["step_cursor"] = np.int64(step_cursor)
+    if schedule_fingerprint is not None:
+        arrays["schedule_fingerprint"] = np.bytes_(schedule_fingerprint.encode())
     arrays["format_version"] = np.int64(_FORMAT_VERSION)
     cfg = state.seed_cfg
     if cfg is not None:
@@ -46,11 +79,12 @@ def save_checkpoint(path: str, state: PlayerState, cursor: int = 0) -> None:
     os.replace(tmp, path)
 
 
-def load_checkpoint(path: str) -> tuple[PlayerState, int]:
-    """Returns (state, cursor). Raises on version mismatch."""
+def load_checkpoint(path: str) -> Checkpoint:
+    """Raises on unknown format version (v2 round-1 snapshots still load —
+    they predate step cursors and read as finished-schedule checkpoints)."""
     with np.load(path) as z:
         version = int(z["format_version"])
-        if version != _FORMAT_VERSION:
+        if version not in (2, _FORMAT_VERSION):
             raise ValueError(f"checkpoint format {version} != {_FORMAT_VERSION}")
         cfg = None
         if "seed_cfg" in z:
@@ -59,4 +93,12 @@ def load_checkpoint(path: str) -> tuple[PlayerState, int]:
         state = PlayerState(
             **{f: jnp.asarray(z[f]) for f in _FIELDS}, seed_cfg=cfg
         )
-        return state, int(z["cursor"])
+        fingerprint = None
+        if "schedule_fingerprint" in z:
+            fingerprint = bytes(z["schedule_fingerprint"]).decode()
+        return Checkpoint(
+            state=state,
+            cursor=int(z["cursor"]),
+            step_cursor=int(z["step_cursor"]) if "step_cursor" in z else 0,
+            schedule_fingerprint=fingerprint,
+        )

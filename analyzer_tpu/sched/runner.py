@@ -74,25 +74,53 @@ def rate_history(
     cfg: RatingConfig,
     collect: bool = False,
     steps_per_chunk: int = 8192,
+    start_step: int = 0,
+    stop_after: int | None = None,
+    on_chunk=None,
 ) -> tuple[PlayerState, HistoryOutputs | None]:
-    """Rates a full packed history. Returns the final state and, when
-    ``collect``, per-match outputs reordered back to stream order."""
-    n_steps = sched.n_steps
+    """Rates a packed history. Returns the final state and, when
+    ``collect``, per-match outputs reordered back to stream order.
+
+    ``start_step`` re-enters the scan mid-schedule (checkpoint resume;
+    the caller is responsible for passing the state snapshot taken at that
+    step). ``stop_after`` ends the run at a chunk boundary at or after that
+    step (testing / bounded ops runs). ``on_chunk(state, next_step)`` fires
+    after each chunk with the superstep index the next chunk would start
+    at — the periodic-checkpoint hook (io/checkpoint.py); fetching the
+    state there costs one device sync, the price of a bounded crash blast
+    radius (the reference pays per 500-match commit, worker.py:194).
+    """
+    n_steps = sched.n_steps if stop_after is None else min(stop_after, sched.n_steps)
     # The chunked scan donates its carry; copy once at entry so the caller's
     # state stays valid (the table is small — tens of MB at 10M players).
     state = jax.tree.map(jnp.copy, state)
     outs = [] if collect else None
-    for start in range(0, n_steps, steps_per_chunk):
-        stop = min(start + steps_per_chunk, n_steps)
-        arrays = sched.device_arrays(start, stop)
-        state, ys = _scan_chunk(state, arrays, cfg, collect)
+    # Double-buffered feed: the [S',B,...] slab for chunk k+1 is put on
+    # device while chunk k's scan runs. jax dispatch is async, so the only
+    # host blocking in the loop is the staging copy of the NEXT slab —
+    # which overlaps the device executing the CURRENT chunk.
+    starts = list(range(start_step, n_steps, steps_per_chunk))
+    arrays = (
+        sched.device_arrays(starts[0], min(starts[0] + steps_per_chunk, n_steps))
+        if starts
+        else None
+    )
+    for i, start in enumerate(starts):
+        state, ys = _scan_chunk(state, arrays, cfg, collect)  # async dispatch
+        arrays = None  # let the consumed slab free as soon as the scan is done
+        if i + 1 < len(starts):  # stage k+1's slab while k executes
+            arrays = sched.device_arrays(
+                starts[i + 1], min(starts[i + 1] + steps_per_chunk, n_steps)
+            )
         if collect:
             outs.append(jax.tree.map(np.asarray, ys))
+        if on_chunk is not None:
+            on_chunk(state, min(start + steps_per_chunk, n_steps))
     if not collect:
         return state, None
 
     n = sched.n_matches
-    flat_idx = sched.match_idx.reshape(-1)
+    flat_idx = sched.match_idx[start_step:n_steps].reshape(-1)
     sel = flat_idx >= 0
     dest = flat_idx[sel]
 

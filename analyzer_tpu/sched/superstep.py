@@ -26,6 +26,8 @@ version as the always-available fallback.
 from __future__ import annotations
 
 import dataclasses
+import functools
+import hashlib
 
 import numpy as np
 
@@ -132,6 +134,24 @@ class PackedSchedule:
         ``updated & slot_mask``); the sharded-table routing
         (``parallel.mesh.build_routing``) must cover exactly these."""
         return self.slot_mask & self.ratable[:, :, None, None]
+
+    @functools.cached_property
+    def fingerprint(self) -> str:
+        """Content hash of the packed schedule. Packing is a pure function
+        of the stream slice, so this identifies "the same work in the same
+        order" across processes — mid-run checkpoints store it and resume
+        verifies it, failing loudly if the stream file or packing policy
+        changed underneath a step cursor (io/checkpoint.py). Every field
+        the device kernel consumes is hashed: a stream edit that keeps the
+        packing layout but changes e.g. a match's mode would otherwise
+        resume cleanly and leave pre/post-cursor steps rated under
+        different inputs."""
+        h = hashlib.sha1()
+        h.update(np.asarray(self.player_idx.shape, np.int64).tobytes())
+        for field in (self.player_idx, self.slot_mask, self.winner,
+                      self.mode_id, self.afk, self.match_idx):
+            h.update(np.ascontiguousarray(field).tobytes())
+        return h.hexdigest()
 
     def step_batch(self, s: int) -> MatchBatch:
         """Materializes superstep ``s`` as a device MatchBatch."""

@@ -66,6 +66,46 @@ class TestCli:
         stats = json.loads(line)
         assert stats["matches"] == 0
 
+    def test_kill_and_resume_matches_single_run(self, tmp_path, capsys):
+        """Interrupted run (--stop-after-steps) + resume == one-shot run,
+        bit-identical final state in the checkpoint."""
+        csv = str(tmp_path / "s.csv")
+        run(capsys, "synth", "--matches", "300", "--players", "50", "--out", csv)
+
+        ck_full = str(tmp_path / "full.npz")
+        run(capsys, "rate", "--csv", csv, "--checkpoint", ck_full)
+
+        ck = str(tmp_path / "interrupted.npz")
+        run(
+            capsys, "rate", "--csv", csv, "--checkpoint", ck,
+            "--checkpoint-every", "3", "--stop-after-steps", "6",
+        )
+        from analyzer_tpu.io.checkpoint import load_checkpoint
+
+        mid = load_checkpoint(ck)
+        assert mid.step_cursor >= 6 and mid.schedule_fingerprint
+        line = run(capsys, "rate", "--csv", csv, "--checkpoint", ck, "--resume")
+        assert json.loads(line)["supersteps"] > 0
+        a = load_checkpoint(ck_full)
+        b = load_checkpoint(ck)
+        assert b.cursor == 300 and b.step_cursor == 0
+        np.testing.assert_array_equal(
+            np.asarray(a.state.table), np.asarray(b.state.table)
+        )
+
+    def test_resume_rejects_changed_schedule(self, tmp_path, capsys):
+        csv = str(tmp_path / "s.csv")
+        run(capsys, "synth", "--matches", "200", "--players", "40", "--out", csv)
+        ck = str(tmp_path / "ck.npz")
+        run(
+            capsys, "rate", "--csv", csv, "--checkpoint", ck,
+            "--checkpoint-every", "2", "--stop-after-steps", "4",
+        )
+        csv2 = str(tmp_path / "s2.csv")  # different stream under same cursor
+        run(capsys, "synth", "--matches", "200", "--players", "40",
+            "--seed", "7", "--out", csv2)
+        assert main(["rate", "--csv", csv2, "--checkpoint", ck, "--resume"]) == 2
+
     def test_resume_requires_checkpoint(self, tmp_path, capsys):
         csv = str(tmp_path / "s.csv")
         run(capsys, "synth", "--matches", "10", "--players", "12", "--out", csv)

@@ -67,9 +67,93 @@ class TestCheckpoint:
         state = PlayerState.create(10, skill_tier=np.full(10, 5))
         path = str(tmp_path / "ck.npz")
         save_checkpoint(path, state, cursor=42)
-        restored, cursor = load_checkpoint(path)
-        assert cursor == 42
+        ck = load_checkpoint(path)
+        assert ck.cursor == 42
+        assert ck.step_cursor == 0 and ck.schedule_fingerprint is None
         np.testing.assert_array_equal(
-            np.asarray(state.skill_tier), np.asarray(restored.skill_tier)
+            np.asarray(state.skill_tier), np.asarray(ck.state.skill_tier)
         )
-        assert np.isnan(np.asarray(restored.mu)).all()
+        assert np.isnan(np.asarray(ck.state.mu)).all()
+
+    def test_step_cursor_and_fingerprint_roundtrip(self, tmp_path):
+        state = PlayerState.create(4)
+        path = str(tmp_path / "ck.npz")
+        save_checkpoint(
+            path, state, cursor=7, step_cursor=123, schedule_fingerprint="ab" * 20
+        )
+        ck = load_checkpoint(path)
+        assert (ck.cursor, ck.step_cursor) == (7, 123)
+        assert ck.schedule_fingerprint == "ab" * 20
+
+
+class TestPeriodicCheckpoint:
+    """Kill-and-resume: a run interrupted at any chunk boundary, resumed
+    from its snapshot, must end bit-identical to an uninterrupted run —
+    the bounded-blast-radius contract (the reference's per-batch commit,
+    worker.py:194)."""
+
+    def _fixture(self):
+        from analyzer_tpu.config import RatingConfig
+        from analyzer_tpu.sched import pack_schedule
+
+        players = synthetic_players(60, seed=8)
+        stream = synthetic_stream(400, players, seed=8)
+        cfg = RatingConfig()
+        state = PlayerState.create(60, cfg=cfg)
+        sched = pack_schedule(stream, pad_row=state.pad_row)
+        return cfg, state, sched
+
+    def test_fingerprint_is_deterministic_and_content_bound(self):
+        from analyzer_tpu.sched import pack_schedule
+
+        players = synthetic_players(60, seed=8)
+        s1 = synthetic_stream(400, players, seed=8)
+        s2 = synthetic_stream(400, players, seed=9)
+        a = pack_schedule(s1, pad_row=60).fingerprint
+        b = pack_schedule(s1, pad_row=60).fingerprint
+        c = pack_schedule(s2, pad_row=60).fingerprint
+        assert a == b != c
+
+    def test_resume_mid_schedule_is_bit_identical(self, tmp_path):
+        from analyzer_tpu.sched import rate_history
+
+        cfg, state, sched = self._fixture()
+        full, _ = rate_history(state, sched, cfg)
+
+        path = str(tmp_path / "mid.npz")
+        saves = []
+
+        def on_chunk(st, next_step):
+            save_checkpoint(path, st, cursor=0, step_cursor=next_step,
+                            schedule_fingerprint=sched.fingerprint)
+            saves.append(next_step)
+
+        # "crash" partway: stop at a chunk boundary mid-schedule
+        stop = max(1, sched.n_steps // 2)
+        rate_history(
+            state, sched, cfg,
+            steps_per_chunk=4, stop_after=stop, on_chunk=on_chunk,
+        )
+        assert saves and saves[-1] < sched.n_steps
+
+        ck = load_checkpoint(path)
+        assert ck.schedule_fingerprint == sched.fingerprint
+        resumed, _ = rate_history(
+            ck.state, sched, cfg, start_step=ck.step_cursor
+        )
+        np.testing.assert_array_equal(
+            np.asarray(full.table), np.asarray(resumed.table)
+        )
+
+    def test_collect_outputs_cover_resumed_range_only(self):
+        from analyzer_tpu.sched import rate_history
+
+        cfg, state, sched = self._fixture()
+        mid, _ = rate_history(state, sched, cfg, stop_after=4, steps_per_chunk=4)
+        _, outs = rate_history(mid, sched, cfg, start_step=4, collect=True)
+        later = sched.match_idx[4:]
+        later = later[later >= 0]
+        assert outs.updated[later].any()
+        earlier = sched.match_idx[:4]
+        earlier = earlier[earlier >= 0]
+        assert not outs.updated[earlier].any()

@@ -289,8 +289,9 @@ class TestRunnerOracle:
         mid, _ = rate_history(state, s1, CFG)
         path = str(tmp_path / "ck.npz")
         save_checkpoint(path, mid, cursor=half)
-        restored, cursor = load_checkpoint(path)
-        assert cursor == half
+        ck = load_checkpoint(path)
+        restored = ck.state
+        assert ck.cursor == half
         s2 = pack_schedule(
             stream.slice(half, stream.n_matches), pad_row=state.pad_row, batch_size=16
         )
