@@ -57,6 +57,12 @@ def cmd_rate(args) -> int:
     if args.resume and not args.checkpoint:
         print("error: --resume requires --checkpoint", file=sys.stderr)
         return 2
+    for flag in ("checkpoint_every", "stop_after_steps"):
+        val = getattr(args, flag)
+        if val is not None and val <= 0:
+            print(f"error: --{flag.replace('_', '-')} must be positive",
+                  file=sys.stderr)
+            return 2
     timer = PhaseTimer()
     with timer.phase("load"):
         stream, n_players = _load_stream(args.csv)
@@ -74,8 +80,12 @@ def cmd_rate(args) -> int:
     else:
         state = PlayerState.create(n_players, cfg=cfg)
     with timer.phase("pack"):
+        # Windowed: the big gather tensors materialize inside the runner's
+        # prefetch loop, overlapped with the device scan.
         sched = pack_schedule(
-            stream.slice(cursor, stream.n_matches), pad_row=state.pad_row
+            stream.slice(cursor, stream.n_matches),
+            pad_row=state.pad_row,
+            windowed=True,
         )
     if start_step:
         # A mid-schedule cursor is only meaningful against the identical
@@ -90,24 +100,26 @@ def cmd_rate(args) -> int:
                 file=sys.stderr,
             )
             return 2
-    for flag in ("checkpoint_every", "stop_after_steps"):
-        val = getattr(args, flag)
-        if val is not None and val <= 0:
-            print(f"error: --{flag.replace('_', '-')} must be positive",
-                  file=sys.stderr)
-            return 2
     finished = args.stop_after_steps is None or args.stop_after_steps >= sched.n_steps
+    effective_stop = (
+        sched.n_steps if finished else min(args.stop_after_steps, sched.n_steps)
+    )
     on_chunk = None
-    if args.checkpoint and args.checkpoint_every:
-        every = args.checkpoint_every
+    if args.checkpoint and (args.checkpoint_every or not finished):
+        # Periodic saves at the requested cadence; a bounded run also
+        # always snapshots at its stop boundary — otherwise
+        # --stop-after-steps would compute and then discard device work.
+        every = args.checkpoint_every or sched.n_steps + 1
         fingerprint = sched.fingerprint
         last_saved = start_step
 
         def on_chunk(st, next_step):
             nonlocal last_saved
-            # Honor the requested cadence even when chunks are smaller, and
-            # don't duplicate the final save the finished branch will write.
-            if next_step - last_saved < every or (
+            # Honor the cadence even when chunks are smaller; don't
+            # duplicate the final save the finished branch will write.
+            due = next_step - last_saved >= every
+            at_bound = not finished and next_step >= effective_stop
+            if (not due and not at_bound) or (
                 finished and next_step >= sched.n_steps
             ):
                 return
@@ -122,7 +134,7 @@ def cmd_rate(args) -> int:
             start_step=start_step,
             stop_after=args.stop_after_steps,
             steps_per_chunk=(
-                min(8192, args.checkpoint_every) if args.checkpoint_every else 8192
+                min(8192, args.checkpoint_every) if args.checkpoint_every else None
             ),
             on_chunk=on_chunk,
         )
@@ -233,8 +245,8 @@ def main(argv=None) -> int:
     )
     s.add_argument(
         "--stop-after-steps", type=int, metavar="STEPS",
-        help="stop at a chunk boundary at/after this superstep (bounded runs; "
-        "with --checkpoint-every the run is resumable from the snapshot)",
+        help="stop after this superstep (bounded runs; a snapshot is always "
+        "written at the stop boundary when --checkpoint is set)",
     )
     s.add_argument("--trace", help="jax.profiler trace output dir")
     s.set_defaults(fn=cmd_rate)

@@ -22,6 +22,66 @@ from analyzer_tpu.sched.superstep import MatchStream
 _MODE_TEAM_SIZE = np.array([3, 3, 3, 3, 5, 5], dtype=np.int32)
 
 
+class _AliasSampler:
+    """Walker alias method: O(P) build, O(1) per draw.
+
+    ``rng.choice(p=weights)`` costs a ~20-probe binary search per draw
+    (log2 of the population) — ~37 s for the 100M draws of a 10M-match
+    generation. The alias table replaces that with two table reads per
+    draw (~5x faster end to end). Build is the standard Vose two-stack
+    pairing; exactness: every draw is distributed exactly per ``weights``.
+    """
+
+    def __init__(self, weights: np.ndarray) -> None:
+        p = weights.shape[0]
+        scaled = weights * (p / weights.sum())
+        self.alias = np.arange(p, dtype=np.int64)
+        self.prob = scaled.copy()
+        prob, alias = self.prob, self.alias
+        # Bulk-pairing Vose: each round pairs m smalls with m distinct
+        # larges elementwise (a different processing order than the
+        # classic one-at-a-time stacks, but the same invariant: a paired
+        # small cell is finalized, the large keeps its residual). Queues
+        # are flat ring buffers so a round is pure numpy with no
+        # reslicing copies; every cell is enqueued at most twice, so the
+        # build is O(P) with a handful of vector ops per round.
+        # Capacity: qs sees each cell at most twice (initial + one
+        # large-turned-small); ql sees initial larges plus one re-enqueue
+        # per pairing, and pairings = finalized smalls <= 2p.
+        qs = np.empty(2 * p + 1, np.int64)
+        ql = np.empty(3 * p + 1, np.int64)
+        init_s = np.flatnonzero(scaled < 1.0)
+        init_l = np.flatnonzero(scaled >= 1.0)
+        qs[: init_s.size] = init_s
+        ql[: init_l.size] = init_l
+        sh, st = 0, init_s.size  # small queue head/tail
+        lh, lt = 0, init_l.size  # large queue head/tail
+        while sh < st and lh < lt:
+            m = min(st - sh, lt - lh)
+            s = qs[sh : sh + m]
+            l = ql[lh : lh + m]
+            sh += m
+            lh += m
+            alias[s] = l
+            prob[l] -= 1.0 - prob[s]
+            lp = prob[l]
+            new_small = l[lp < 1.0]
+            new_large = l[lp >= 1.0]
+            qs[st : st + new_small.size] = new_small
+            st += new_small.size
+            ql[lt : lt + new_large.size] = new_large
+            lt += new_large.size
+        # Numerical leftovers on either queue have prob ~= 1.
+        prob[qs[sh:st]] = 1.0
+        prob[ql[lh:lt]] = 1.0
+
+    def draw(self, rng: np.random.Generator, size) -> np.ndarray:
+        n = int(np.prod(size))
+        cell = rng.integers(0, self.prob.shape[0], size=n)
+        keep = rng.random(n) < self.prob[cell]
+        return np.where(keep, cell, self.alias[cell]).reshape(size)
+
+
 @dataclasses.dataclass
 class SyntheticPlayers:
     """Latent skills + observable seed features for a synthetic population."""
@@ -113,7 +173,8 @@ def synthetic_stream(
     # draw with replacement, then iteratively redraw only the rows that
     # still contain duplicates (converges in a few rounds).
     k_max = 2 * t_max
-    flat = rng.choice(p, size=(n, k_max), p=weights)
+    sampler = _AliasSampler(weights)
+    flat = sampler.draw(rng, (n, k_max))
     need = np.arange(n)
     for _ in range(64):
         rows = flat[need]
@@ -122,13 +183,13 @@ def synthetic_stream(
         need = need[dup]
         if need.size == 0:
             break
-        flat[need] = rng.choice(p, size=(need.size, k_max), p=weights)
+        flat[need] = sampler.draw(rng, (need.size, k_max))
     else:
         # Pathological weights: fix the stragglers exactly, one by one.
         for i in need:
             uniq = np.unique(flat[i])
             while uniq.size < k_max:
-                extra = rng.choice(p, size=k_max - uniq.size, p=weights)
+                extra = sampler.draw(rng, (k_max - uniq.size,))
                 uniq = np.unique(np.concatenate([uniq, extra]))
             flat[i] = rng.permutation(uniq[:k_max])
 

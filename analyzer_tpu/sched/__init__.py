@@ -13,6 +13,7 @@ shared player, each safely rated as one batched kernel call — and drives a
 from analyzer_tpu.sched.superstep import (
     MatchStream,
     PackedSchedule,
+    WindowedSchedule,
     assign_batches,
     assign_supersteps,
     pack_schedule,
@@ -22,6 +23,7 @@ from analyzer_tpu.sched.runner import HistoryOutputs, rate_history
 __all__ = [
     "MatchStream",
     "PackedSchedule",
+    "WindowedSchedule",
     "assign_batches",
     "assign_supersteps",
     "pack_schedule",

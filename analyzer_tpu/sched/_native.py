@@ -65,6 +65,8 @@ _lib.assign_batches_first_fit.argtypes = [
     ctypes.c_int64,
     ctypes.c_int64,
     ctypes.POINTER(ctypes.c_int64),
+    ctypes.POINTER(ctypes.c_int64),
+    ctypes.POINTER(ctypes.c_int64),
 ]
 _lib.assign_batches_first_fit.restype = None
 
@@ -95,11 +97,24 @@ def assign_supersteps(stream) -> np.ndarray:
     return out
 
 
-def assign_batches_first_fit(stream, capacity: int) -> np.ndarray:
+def assign_batches_first_fit(
+    stream, capacity: int, progress: np.ndarray | None = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """Returns (batch_id, slot_in_batch), each [N] int64, -1 for
+    non-ratable. ``progress`` (optional [2] int64 array) is published
+    periodically by the C loop — (matches processed, batch watermark) —
+    and can be polled from another thread while this call runs (ctypes
+    releases the GIL for the duration)."""
     n, idx, ratable, n_players = _prep(stream)
     out = np.empty(n, dtype=np.int64)
+    out_slot = np.empty(n, dtype=np.int64)
     if n == 0:
-        return out
+        return out, out_slot
+    prog_ptr = (
+        progress.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        if progress is not None
+        else ctypes.POINTER(ctypes.c_int64)()
+    )
     _lib.assign_batches_first_fit(
         idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         n,
@@ -108,5 +123,7 @@ def assign_batches_first_fit(stream, capacity: int) -> np.ndarray:
         n_players,
         capacity,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        out_slot.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        prog_ptr,
     )
-    return out
+    return out, out_slot

@@ -59,11 +59,21 @@ void assign_supersteps(const int32_t* idx, int64_t n_matches,
 //
 //   capacity  slots per batch (B)
 //   out       [n_matches] int64 batch index, -1 for non-ratable matches
+//   out_slot  [n_matches] int64 slot within the batch (fill order = stream
+//             order within a batch), -1 for non-ratable — lets the packer
+//             build the slot->match map with one scatter instead of a sort
+//   progress  [2] int64, published periodically with release semantics:
+//             progress[0] = matches processed so far, progress[1] = the
+//             watermark (first batch that can still receive matches; every
+//             batch below it is final). A consumer thread can materialize
+//             and feed windows below the watermark while this loop is
+//             still running (the GIL is released during the call).
 
 void assign_batches_first_fit(const int32_t* idx, int64_t n_matches,
                               int64_t slots, const uint8_t* ratable,
                               int64_t n_players, int64_t capacity,
-                              int64_t* out) {
+                              int64_t* out, int64_t* out_slot,
+                              int64_t* progress) {
   std::vector<int64_t> last(static_cast<size_t>(n_players > 0 ? n_players : 1),
                             -1);
   std::vector<int64_t> fill;       // per-batch occupancy
@@ -91,27 +101,42 @@ void assign_batches_first_fit(const int32_t* idx, int64_t n_matches,
     return root;
   };
 
+  constexpr int64_t kPublishEvery = 16384;
   for (int64_t i = 0; i < n_matches; ++i) {
     if (!ratable[i]) {
       out[i] = -1;
-      continue;
+      out_slot[i] = -1;
+    } else {
+      const int32_t* row = idx + i * slots;
+      int64_t floor_b = 0;
+      for (int64_t j = 0; j < slots; ++j) {
+        const int32_t p = row[j];
+        if (p >= 0 && last[p] + 1 > floor_b) floor_b = last[p] + 1;
+      }
+      const int64_t b = find(floor_b);
+      out[i] = b;
+      out_slot[i] = fill[b];
+      if (++fill[b] == capacity) {
+        ensure(b + 1);
+        next_free[b] = b + 1;
+      }
+      for (int64_t j = 0; j < slots; ++j) {
+        const int32_t p = row[j];
+        if (p >= 0) last[p] = b;
+      }
     }
-    const int32_t* row = idx + i * slots;
-    int64_t floor_b = 0;
-    for (int64_t j = 0; j < slots; ++j) {
-      const int32_t p = row[j];
-      if (p >= 0 && last[p] + 1 > floor_b) floor_b = last[p] + 1;
+    if (progress && (i + 1) % kPublishEvery == 0) {
+      const int64_t wm = find(0);
+      __atomic_store_n(&progress[1], wm, __ATOMIC_RELAXED);
+      // Release: out/out_slot writes for [0, i] are visible before the
+      // published progress count.
+      __atomic_store_n(&progress[0], i + 1, __ATOMIC_RELEASE);
     }
-    const int64_t b = find(floor_b);
-    out[i] = b;
-    if (++fill[b] == capacity) {
-      ensure(b + 1);
-      next_free[b] = b + 1;
-    }
-    for (int64_t j = 0; j < slots; ++j) {
-      const int32_t p = row[j];
-      if (p >= 0) last[p] = b;
-    }
+  }
+  if (progress) {
+    __atomic_store_n(&progress[1], static_cast<int64_t>(fill.size()),
+                     __ATOMIC_RELAXED);
+    __atomic_store_n(&progress[0], n_matches, __ATOMIC_RELEASE);
   }
 }
 

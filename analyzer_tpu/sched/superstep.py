@@ -83,31 +83,22 @@ class MatchStream:
         )
 
 
-@dataclasses.dataclass
-class PackedSchedule:
-    """The stream packed into ``[S, B, ...]`` static-shape superstep batches.
+_FINGERPRINT_WINDOW = 4096
 
-    match_idx ``[S, B]`` maps each packed slot back to its stream position
-    (-1 for padding) so per-match outputs can be scattered back into
-    chronological order. ``player_idx`` padding slots already point at
-    ``pad_row`` (the player-table padding row), ready for the device gather.
-    """
 
-    player_idx: np.ndarray  # [S, B, 2, T] int32
-    slot_mask: np.ndarray  # [S, B, 2, T] bool
-    winner: np.ndarray  # [S, B] int32
-    mode_id: np.ndarray  # [S, B] int32
-    afk: np.ndarray  # [S, B] bool
-    match_idx: np.ndarray  # [S, B] int32
-    pad_row: int
+class _ScheduleBase:
+    """Shared surface of the eager and windowed schedule containers. Both
+    expose the ``[S, B]`` per-slot scalars as attributes; they differ only
+    in how the ``[S, B, 2, T]`` gather tensors are produced
+    (``host_window``)."""
 
     @property
     def n_steps(self) -> int:
-        return self.player_idx.shape[0]
+        return self.match_idx.shape[0]
 
     @property
     def batch_size(self) -> int:
-        return self.player_idx.shape[1]
+        return self.match_idx.shape[1]
 
     @property
     def n_matches(self) -> int:
@@ -126,6 +117,57 @@ class PackedSchedule:
         the two in lockstep."""
         return (self.mode_id >= 0) & ~self.afk
 
+    @functools.cached_property
+    def fingerprint(self) -> str:
+        """Content hash of the packed schedule. Packing is a pure function
+        of the stream slice, so this identifies "the same work in the same
+        order" across processes — mid-run checkpoints store it and resume
+        verifies it, failing loudly if the stream file or packing policy
+        changed underneath a step cursor (io/checkpoint.py). Every field
+        the device kernel consumes is hashed (via ``host_window``, in
+        fixed-size windows so the eager and windowed forms of the same
+        schedule digest identically): a stream edit that keeps the packing
+        layout but changes e.g. a match's mode would otherwise resume
+        cleanly and leave pre/post-cursor steps rated under different
+        inputs."""
+        h = hashlib.sha1()
+        h.update(
+            np.asarray(
+                (self.n_steps, self.batch_size, self.pad_row), np.int64
+            ).tobytes()
+        )
+        for start in range(0, self.n_steps, _FINGERPRINT_WINDOW):
+            stop = min(start + _FINGERPRINT_WINDOW, self.n_steps)
+            for field in self.host_window(start, stop):
+                h.update(np.ascontiguousarray(field).tobytes())
+            h.update(np.ascontiguousarray(self.match_idx[start:stop]).tobytes())
+        return h.hexdigest()
+
+    def device_arrays(self, start: int = 0, stop: int | None = None):
+        """The ``[S', B, ...]`` slab for a lax.scan over steps start..stop."""
+        if stop is None:
+            stop = self.n_steps
+        return tuple(jnp.asarray(a) for a in self.host_window(start, stop))
+
+
+@dataclasses.dataclass
+class PackedSchedule(_ScheduleBase):
+    """The stream packed into ``[S, B, ...]`` static-shape superstep batches.
+
+    match_idx ``[S, B]`` maps each packed slot back to its stream position
+    (-1 for padding) so per-match outputs can be scattered back into
+    chronological order. ``player_idx`` padding slots already point at
+    ``pad_row`` (the player-table padding row), ready for the device gather.
+    """
+
+    player_idx: np.ndarray  # [S, B, 2, T] int32
+    slot_mask: np.ndarray  # [S, B, 2, T] bool
+    winner: np.ndarray  # [S, B] int32
+    mode_id: np.ndarray  # [S, B] int32
+    afk: np.ndarray  # [S, B] bool
+    match_idx: np.ndarray  # [S, B] int32
+    pad_row: int
+
     @property
     def valid_slots(self) -> np.ndarray:
         """``[S, B, 2, T]`` — slots whose player row is actually written by
@@ -135,23 +177,15 @@ class PackedSchedule:
         (``parallel.mesh.build_routing``) must cover exactly these."""
         return self.slot_mask & self.ratable[:, :, None, None]
 
-    @functools.cached_property
-    def fingerprint(self) -> str:
-        """Content hash of the packed schedule. Packing is a pure function
-        of the stream slice, so this identifies "the same work in the same
-        order" across processes — mid-run checkpoints store it and resume
-        verifies it, failing loudly if the stream file or packing policy
-        changed underneath a step cursor (io/checkpoint.py). Every field
-        the device kernel consumes is hashed: a stream edit that keeps the
-        packing layout but changes e.g. a match's mode would otherwise
-        resume cleanly and leave pre/post-cursor steps rated under
-        different inputs."""
-        h = hashlib.sha1()
-        h.update(np.asarray(self.player_idx.shape, np.int64).tobytes())
-        for field in (self.player_idx, self.slot_mask, self.winner,
-                      self.mode_id, self.afk, self.match_idx):
-            h.update(np.ascontiguousarray(field).tobytes())
-        return h.hexdigest()
+    def host_window(self, start: int, stop: int):
+        sl = slice(start, stop)
+        return (
+            self.player_idx[sl],
+            self.slot_mask[sl],
+            self.winner[sl],
+            self.mode_id[sl],
+            self.afk[sl],
+        )
 
     def step_batch(self, s: int) -> MatchBatch:
         """Materializes superstep ``s`` as a device MatchBatch."""
@@ -163,15 +197,69 @@ class PackedSchedule:
             afk=jnp.asarray(self.afk[s]),
         )
 
-    def device_arrays(self, start: int = 0, stop: int | None = None):
-        """The ``[S', B, ...]`` slab for a lax.scan over steps start..stop."""
-        sl = slice(start, stop)
-        return (
-            jnp.asarray(self.player_idx[sl]),
-            jnp.asarray(self.slot_mask[sl]),
-            jnp.asarray(self.winner[sl]),
-            jnp.asarray(self.mode_id[sl]),
-            jnp.asarray(self.afk[sl]),
+
+@dataclasses.dataclass
+class WindowedSchedule(_ScheduleBase):
+    """A packed schedule whose ``[S, B, 2, T]`` gather tensors are
+    materialized per window, on demand, from the slot->match map.
+
+    The ``[S, B]`` scalars are eager (~13 bytes per slot); the per-player
+    tensors (~50 bytes per slot — the bulk of eager packing time and
+    memory) are built inside :meth:`host_window`. Fed through
+    ``rate_history``'s prefetch loop, that materialization happens while
+    the device is scanning the PREVIOUS chunk — the host feed overlaps
+    compute instead of serializing in front of it (SURVEY.md section
+    7.7's double-buffered feed), and the peak host footprint is two
+    windows instead of the whole ``[S, B, 2, T]`` schedule.
+    """
+
+    stream: MatchStream
+    winner: np.ndarray  # [S, B] int32
+    mode_id: np.ndarray  # [S, B] int32
+    afk: np.ndarray  # [S, B] bool
+    match_idx: np.ndarray  # [S, B] int32
+    pad_row: int
+    team_size: int
+
+    def host_window(self, start: int, stop: int):
+        mi = self.match_idx[start:stop]
+        if self.stream.n_matches == 0:  # all-padding (inert) schedule
+            shape = mi.shape + (2, self.team_size)
+            return (
+                np.full(shape, self.pad_row, np.int32),
+                np.zeros(shape, bool),
+                self.winner[start:stop],
+                self.mode_id[start:stop],
+                self.afk[start:stop],
+            )
+        valid = mi >= 0
+        rows = np.clip(mi, 0, None)
+        pidx = self.stream.player_idx[rows]  # [W, B, 2, t_in]
+        mask = (pidx >= 0) & valid[..., None, None]
+        pidx = np.where(mask, pidx, self.pad_row).astype(np.int32)
+        t_in = self.stream.team_size
+        if t_in < self.team_size:
+            shape = mi.shape + (2, self.team_size - t_in)
+            pidx = np.concatenate(
+                [pidx, np.full(shape, self.pad_row, np.int32)], axis=-1
+            )
+            mask = np.concatenate([mask, np.zeros(shape, bool)], axis=-1)
+        return (pidx, mask, self.winner[start:stop],
+                self.mode_id[start:stop], self.afk[start:stop])
+
+    def materialize(self) -> PackedSchedule:
+        """The eager equivalent (identical arrays and fingerprint) — for
+        consumers that need the full tensors at once (mesh routing,
+        ``step_batch``)."""
+        pidx, mask, winner, mode_id, afk = self.host_window(0, self.n_steps)
+        return PackedSchedule(
+            player_idx=pidx,
+            slot_mask=mask,
+            winner=winner,
+            mode_id=mode_id,
+            afk=afk,
+            match_idx=self.match_idx,
+            pad_row=self.pad_row,
         )
 
 
@@ -207,7 +295,9 @@ def _assign_supersteps_py(stream: MatchStream) -> np.ndarray:
     return steps
 
 
-def assign_batches(stream: MatchStream, capacity: int) -> np.ndarray:
+def assign_batches(
+    stream: MatchStream, capacity: int, progress: np.ndarray | None = None
+) -> tuple[np.ndarray, np.ndarray]:
     """Capacity-aware first-fit batch index per match (levelized schedule).
 
     Each ratable match, in stream order, goes to the EARLIEST batch that is
@@ -220,21 +310,30 @@ def assign_batches(stream: MatchStream, capacity: int) -> np.ndarray:
     occupancy goes from ~0.5 to ~1 on heavy-tailed ladders, and total
     scattered rows (the kernel's cost driver) shrink proportionally.
 
-    Returns ``[N]`` int64 batch ids, -1 for non-ratable matches.
+    Returns ``([N] batch id, [N] slot within batch)`` int64, -1 for
+    non-ratable matches. Slot order within a batch is stream order (fill
+    order), so ``batch * capacity + slot`` is a collision-free flat slot
+    map with no sort needed. ``progress`` see
+    :func:`_native.assign_batches_first_fit`.
     """
     try:
         from analyzer_tpu.sched import _native
 
-        return _native.assign_batches_first_fit(stream, capacity)
+        return _native.assign_batches_first_fit(stream, capacity, progress)
     except ImportError:
-        return _assign_batches_first_fit_py(stream, capacity)
+        return _assign_batches_first_fit_py(stream, capacity, progress)
 
 
-def _assign_batches_first_fit_py(stream: MatchStream, capacity: int) -> np.ndarray:
+def _assign_batches_first_fit_py(
+    stream: MatchStream, capacity: int, progress: np.ndarray | None = None
+) -> tuple[np.ndarray, np.ndarray]:
     n = stream.n_matches
     out = np.full(n, -1, dtype=np.int64)
+    out_slot = np.full(n, -1, dtype=np.int64)
     if n == 0:
-        return out
+        if progress is not None:
+            progress[:] = (0, 0)
+        return out, out_slot
     n_players = int(stream.player_idx.max()) + 1
     last = np.full(max(n_players, 1), -1, dtype=np.int64)
     fill: list[int] = []
@@ -267,12 +366,15 @@ def _assign_batches_first_fit_py(stream: MatchStream, capacity: int) -> np.ndarr
         floor_b = int(last[players].max()) + 1 if players.size else 0
         b = find(floor_b)
         out[i] = b
+        out_slot[i] = fill[b]
         fill[b] += 1
         if fill[b] == capacity:
             ensure(b + 1)
             next_free[b] = b + 1
         last[players] = b
-    return out
+    if progress is not None:
+        progress[:] = (n, len(fill))
+    return out, out_slot
 
 
 # v5e-measured device cost model for auto batch sizing (fetch-timed on the
@@ -343,7 +445,8 @@ def pack_schedule(
     team_size: int = MAX_TEAM_SIZE,
     batch_multiple: int = 8,
     max_batch_size: int = 4096,
-) -> PackedSchedule:
+    windowed: bool = False,
+) -> "PackedSchedule | WindowedSchedule":
     """Packs a stream into ``[S, B, ...]`` conflict-free batches via
     capacity-aware first-fit (see :func:`assign_batches`).
 
@@ -357,6 +460,12 @@ def pack_schedule(
     Non-ratable matches are backfilled into padding slots of existing
     batches wherever there is room (their relative order does not matter:
     they read and write no rating state), falling back to extra batches.
+
+    ``windowed=True`` returns the lazy :class:`WindowedSchedule` — the
+    large gather tensors are materialized per window inside the runner's
+    prefetch loop, overlapping the device scan; use it for large streams
+    fed to ``rate_history``. The default eager form is for consumers that
+    touch the full tensors (mesh routing, ``step_batch``).
     """
     n = stream.n_matches
     t_in = stream.team_size
@@ -378,59 +487,51 @@ def pack_schedule(
             stream, batch_multiple=batch_multiple, max_batch_size=max_batch_size
         )
 
-    batches = assign_batches(stream, batch_size)
+    batches, slot_in_batch = assign_batches(stream, batch_size)
 
-    ratable_order = np.flatnonzero(batches >= 0)
-    # Stable sort by batch: within a batch, stream order is preserved.
-    ratable_order = ratable_order[
-        np.argsort(batches[ratable_order], kind="stable")
-    ]
+    ratable_idx = np.flatnonzero(batches >= 0)
     filler = np.flatnonzero(batches < 0)
-    n_rate_batches = int(batches.max()) + 1 if ratable_order.size else 0
+    n_rate_batches = int(batches.max()) + 1 if ratable_idx.size else 0
 
     # Free slots left in those batches, to backfill with non-ratable matches.
-    free = n_rate_batches * batch_size - ratable_order.size
+    free = n_rate_batches * batch_size - ratable_idx.size
     extra_batches = max(0, -(-(filler.size - free) // batch_size)) if filler.size else 0
     s_total = max(n_rate_batches + extra_batches, 1)
 
-    shape_bt = (s_total, batch_size)
-    out = PackedSchedule(
-        player_idx=np.full(shape_bt + (2, team_size), pad_row, dtype=np.int32),
-        slot_mask=np.zeros(shape_bt + (2, team_size), dtype=bool),
-        winner=np.zeros(shape_bt, dtype=np.int32),
-        mode_id=np.full(shape_bt, constants.UNSUPPORTED_MODE_ID, dtype=np.int32),
-        afk=np.zeros(shape_bt, dtype=bool),
-        match_idx=np.full(shape_bt, -1, dtype=np.int32),
-        pad_row=pad_row,
-    )
-
-    # Flat slot assignment (vectorized — this runs over 10M+ matches):
-    # within a batch, slots fill in stream order; fillers take every
-    # remaining slot anywhere.
-    slot_of = np.empty(ratable_order.size + filler.size, dtype=np.int64)
-    pos = ratable_order.size
-    if ratable_order.size:
-        ba = batches[ratable_order]  # sorted ascending (stable)
-        group_ids, counts = np.unique(ba, return_counts=True)
-        group_start = np.concatenate(([0], np.cumsum(counts)[:-1]))
-        in_group = np.arange(ratable_order.size) - np.repeat(group_start, counts)
-        slot_of[:pos] = ba * batch_size + in_group
+    # One scatter builds the slot->match map: the assigner already names
+    # each ratable match's (batch, slot-within-batch) — slot order within a
+    # batch is stream order by construction — and fillers take the free
+    # slots in ascending order (their placement is arbitrary: they read and
+    # write no rating state).
+    slot_to_match = np.full(s_total * batch_size, -1, dtype=np.int32)
+    if ratable_idx.size:
+        slot_to_match[
+            batches[ratable_idx] * batch_size + slot_in_batch[ratable_idx]
+        ] = ratable_idx
     if filler.size:
-        taken = np.zeros(s_total * batch_size, dtype=bool)
-        taken[slot_of[:pos]] = True
-        free_slots = np.flatnonzero(~taken)
-        slot_of[pos : pos + filler.size] = free_slots[: filler.size]
+        free_slots = np.flatnonzero(slot_to_match < 0)
+        slot_to_match[free_slots[: filler.size]] = filler
+    match_idx = slot_to_match.reshape(s_total, batch_size)
 
-    order = np.concatenate([ratable_order, filler]).astype(np.int64)
-    flat = slot_of[: order.size]
-    bi, si = np.divmod(flat, batch_size)
-
-    mask_in = stream.player_idx >= 0
-    pidx = np.where(mask_in, stream.player_idx, pad_row)
-    out.player_idx[bi, si, :, :t_in] = pidx[order]
-    out.slot_mask[bi, si, :, :t_in] = mask_in[order]
-    out.winner[bi, si] = stream.winner[order]
-    out.mode_id[bi, si] = stream.mode_id[order]
-    out.afk[bi, si] = stream.afk[order]
-    out.match_idx[bi, si] = order.astype(np.int32)
-    return out
+    if n:
+        real = match_idx >= 0
+        rows = np.clip(match_idx, 0, None)
+        winner = np.where(real, stream.winner[rows], 0).astype(np.int32)
+        mode_id = np.where(
+            real, stream.mode_id[rows], constants.UNSUPPORTED_MODE_ID
+        ).astype(np.int32)
+        afk = np.where(real, stream.afk[rows], False)
+    else:  # empty stream still packs one all-padding (inert) step
+        winner = np.zeros(match_idx.shape, np.int32)
+        mode_id = np.full(match_idx.shape, constants.UNSUPPORTED_MODE_ID, np.int32)
+        afk = np.zeros(match_idx.shape, bool)
+    ws = WindowedSchedule(
+        stream=stream,
+        winner=winner,
+        mode_id=mode_id,
+        afk=afk,
+        match_idx=match_idx,
+        pad_row=pad_row,
+        team_size=team_size,
+    )
+    return ws if windowed else ws.materialize()

@@ -93,6 +93,28 @@ class TestCli:
             np.asarray(a.state.table), np.asarray(b.state.table)
         )
 
+    def test_bounded_run_always_checkpoints_at_stop(self, tmp_path, capsys):
+        """--stop-after-steps with --checkpoint but WITHOUT
+        --checkpoint-every must still persist the computed state at the
+        stop boundary (review round 2: device work was silently dropped)."""
+        csv = str(tmp_path / "s.csv")
+        run(capsys, "synth", "--matches", "200", "--players", "40", "--out", csv)
+        ck = str(tmp_path / "ck.npz")
+        run(capsys, "rate", "--csv", csv, "--checkpoint", ck,
+            "--stop-after-steps", "5")
+        from analyzer_tpu.io.checkpoint import load_checkpoint
+
+        mid = load_checkpoint(ck)
+        assert mid.step_cursor == 5 and mid.schedule_fingerprint
+        # and the run is resumable to the same final state as one shot
+        ck_full = str(tmp_path / "full.npz")
+        run(capsys, "rate", "--csv", csv, "--checkpoint", ck_full)
+        run(capsys, "rate", "--csv", csv, "--checkpoint", ck, "--resume")
+        a, b = load_checkpoint(ck_full), load_checkpoint(ck)
+        np.testing.assert_array_equal(
+            np.asarray(a.state.table), np.asarray(b.state.table)
+        )
+
     def test_resume_rejects_changed_schedule(self, tmp_path, capsys):
         csv = str(tmp_path / "s.csv")
         run(capsys, "synth", "--matches", "200", "--players", "40", "--out", csv)

@@ -35,6 +35,19 @@ class TestSynthetic:
             ids = s.player_idx[i][s.player_idx[i] >= 0]
             assert len(np.unique(ids)) == len(ids)
 
+    def test_alias_sampler_matches_weights(self):
+        from analyzer_tpu.io.synthetic import _AliasSampler
+
+        rng = np.random.default_rng(6)
+        w = rng.random(50) ** 3 + 1e-6
+        w /= w.sum()
+        sampler = _AliasSampler(w)
+        draws = sampler.draw(np.random.default_rng(7), (200_000,))
+        freq = np.bincount(draws, minlength=50) / draws.size
+        np.testing.assert_allclose(freq, w, atol=0.004)
+        # prob table is a valid alias structure: all mass accounted for
+        assert (sampler.prob >= 0).all() and (sampler.prob <= 1 + 1e-9).all()
+
     def test_seed_features_present(self):
         players = synthetic_players(500, seed=4)
         assert np.isfinite(players.rank_points_ranked).any()
@@ -157,3 +170,17 @@ class TestPeriodicCheckpoint:
         earlier = sched.match_idx[:4]
         earlier = earlier[earlier >= 0]
         assert not outs.updated[earlier].any()
+
+    def test_collect_from_final_step_returns_empty_outputs(self):
+        # resume exactly at the end: no chunks run, outputs all-zero
+        from analyzer_tpu.sched import rate_history
+
+        cfg, state, sched = self._fixture()
+        final, outs = rate_history(
+            state, sched, cfg, start_step=sched.n_steps, collect=True
+        )
+        assert outs.updated.shape == (sched.n_matches,)
+        assert not outs.updated.any()
+        np.testing.assert_array_equal(
+            np.asarray(final.table), np.asarray(state.table)
+        )

@@ -116,14 +116,28 @@ class TestNativePacker:
 
         stream, _ = small_stream(n_matches=500, n_players=80, seed=9)
         for cap in (1, 7, 32):
-            np.testing.assert_array_equal(
-                _native.assign_batches_first_fit(stream, cap),
-                _assign_batches_first_fit_py(stream, cap),
-            )
+            nb, ns = _native.assign_batches_first_fit(stream, cap)
+            pb, ps = _assign_batches_first_fit_py(stream, cap)
+            np.testing.assert_array_equal(nb, pb)
+            np.testing.assert_array_equal(ns, ps)
 
     def test_used_by_default(self):
         # the gated import must succeed in this environment (g++ is baked in)
         from analyzer_tpu.sched import _native  # noqa: F401
+
+    def test_first_fit_publishes_progress(self):
+        """The (processed, watermark) publication consumed by a streaming
+        feeder thread: final values must be (n, total batches) and agree
+        between the native and python paths."""
+        from analyzer_tpu.sched import _native
+        from analyzer_tpu.sched.superstep import _assign_batches_first_fit_py
+
+        stream, _ = small_stream(n_matches=500, n_players=80, seed=9)
+        for impl in (_native.assign_batches_first_fit, _assign_batches_first_fit_py):
+            progress = np.zeros(2, np.int64)
+            ba, _ = impl(stream, 16, progress)
+            assert progress[0] == stream.n_matches
+            assert progress[1] == int(ba.max()) + 1
 
 
 class TestFirstFit:
@@ -132,7 +146,7 @@ class TestFirstFit:
 
         stream, _ = small_stream(n_matches=400, n_players=60, seed=13)
         cap = 16
-        ba = assign_batches(stream, cap)
+        ba, slots = assign_batches(stream, cap)
         ratable = stream.ratable
         assert (ba[~ratable] == -1).all()
         assert (ba[ratable] >= 0).all()
@@ -190,6 +204,50 @@ class TestPacking:
         sched = pack_schedule(stream, pad_row=100, batch_size=3)
         assert sched.n_steps == 3
         assert sched.n_matches == 8
+
+    def test_windowed_equals_eager(self):
+        """The lazy schedule must be indistinguishable from the eager one:
+        same arrays window by window, same fingerprint, same rate_history
+        result."""
+        stream, state = small_stream(n_matches=300, n_players=40, seed=21)
+        eager = pack_schedule(stream, pad_row=state.pad_row, batch_size=16)
+        lazy = pack_schedule(
+            stream, pad_row=state.pad_row, batch_size=16, windowed=True
+        )
+        assert lazy.n_steps == eager.n_steps
+        assert lazy.n_matches == eager.n_matches
+        np.testing.assert_array_equal(lazy.match_idx, eager.match_idx)
+        for start in (0, 3):
+            lw = lazy.host_window(start, min(start + 5, lazy.n_steps))
+            ew = eager.host_window(start, min(start + 5, eager.n_steps))
+            for a, b in zip(lw, ew):
+                np.testing.assert_array_equal(a, b)
+        assert lazy.fingerprint == eager.fingerprint
+        m = lazy.materialize()
+        np.testing.assert_array_equal(m.player_idx, eager.player_idx)
+        np.testing.assert_array_equal(m.slot_mask, eager.slot_mask)
+
+        fe, _ = rate_history(state, eager, CFG)
+        fl, _ = rate_history(state, lazy, CFG, steps_per_chunk=7)
+        np.testing.assert_array_equal(
+            np.asarray(fe.table), np.asarray(fl.table)
+        )
+
+    def test_windowed_pads_narrow_stream_to_team_size(self):
+        # 3-wide stream packed at team_size=5: windows must pad the team
+        # axis with inert pad_row slots exactly like the eager packer.
+        idx = np.arange(24, dtype=np.int32).reshape(4, 2, 3)
+        stream = MatchStream(
+            player_idx=idx,
+            winner=np.zeros(4, np.int32),
+            mode_id=np.ones(4, np.int32),
+            afk=np.zeros(4, bool),
+        )
+        eager = pack_schedule(stream, pad_row=50, batch_size=4)
+        lazy = pack_schedule(stream, pad_row=50, batch_size=4, windowed=True)
+        for a, b in zip(lazy.host_window(0, 1), eager.host_window(0, 1)):
+            np.testing.assert_array_equal(a, b)
+        assert lazy.host_window(0, 1)[0].shape[-1] == 5
 
     def test_occupancy(self):
         stream, state = small_stream(n_matches=300, n_players=200)
