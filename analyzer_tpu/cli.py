@@ -46,6 +46,22 @@ def cmd_synth(args) -> int:
     return 0
 
 
+def _rate_stats(stream, cursor, n_players, state, sched, timer, **extra) -> str:
+    """The shared stats line of the single-device and --mesh rate paths."""
+    mu = np.asarray(state.mu)[:n_players, 0]
+    rated = ~np.isnan(mu)
+    stats = {
+        "matches": stream.n_matches - cursor,
+        "players_rated": int(rated.sum()),
+        "mean_mu": round(float(mu[rated].mean()), 2) if rated.any() else None,
+        "supersteps": sched.n_steps,
+        "occupancy": round(sched.occupancy, 3),
+        **extra,
+        "phases": {k: round(v, 3) for k, v in timer.report().items()},
+    }
+    return json.dumps(stats)
+
+
 def cmd_rate(args) -> int:
     from analyzer_tpu.config import RatingConfig
     from analyzer_tpu.core.state import PlayerState
@@ -63,7 +79,19 @@ def cmd_rate(args) -> int:
             print(f"error: --{flag.replace('_', '-')} must be positive",
                   file=sys.stderr)
             return 2
+    if args.mesh is not None and args.mesh < 0:
+        print("error: --mesh must be >= 0 (0 = all devices)", file=sys.stderr)
+        return 2
+    if args.mesh is not None and (args.checkpoint_every or args.stop_after_steps):
+        print(
+            "error: --mesh does not support --checkpoint-every/"
+            "--stop-after-steps yet (whole-run checkpoints only)",
+            file=sys.stderr,
+        )
+        return 2
     timer = PhaseTimer()
+    if args.mesh is not None:
+        return _rate_mesh(args, cfg, timer)
     with timer.phase("load"):
         stream, n_players = _load_stream(args.csv)
     cursor, start_step = 0, 0
@@ -142,20 +170,74 @@ def cmd_rate(args) -> int:
     if args.checkpoint and finished:
         with timer.phase("checkpoint"):
             save_checkpoint(args.checkpoint, state, cursor=stream.n_matches)
-    mu = np.asarray(state.mu)[:n_players, 0]
-    rated = ~np.isnan(mu)
-    print(
-        json.dumps(
-            {
-                "matches": stream.n_matches - cursor,
-                "players_rated": int(rated.sum()),
-                "mean_mu": round(float(mu[rated].mean()), 2) if rated.any() else None,
-                "supersteps": sched.n_steps,
-                "occupancy": round(sched.occupancy, 3),
-                "phases": {k: round(v, 3) for k, v in timer.report().items()},
-            }
-        )
+    print(_rate_stats(stream, cursor, n_players, state, sched, timer))
+    return 0
+
+
+def _rate_mesh(args, cfg, timer) -> int:
+    """The ``--mesh`` re-rate: data-parallel over an ICI/DCN device mesh.
+
+    Single host: ``--mesh N`` shards over the first N local devices.
+    Multi-host: set the ``jax.distributed`` env (COORDINATOR_ADDRESS,
+    NUM_PROCESSES, PROCESS_ID), run the same command on every host with
+    ``--mesh 0`` (= all global devices); each process feeds only its
+    addressable shards of the identical deterministic schedule, the psum
+    rides ICI within a slice and DCN across (parallel/mesh.py), and
+    process 0 writes the checkpoint and stats."""
+    import math
+
+    from analyzer_tpu.core.state import PlayerState
+    from analyzer_tpu.io.checkpoint import load_checkpoint, save_checkpoint
+    from analyzer_tpu.parallel import (
+        initialize_distributed,
+        make_mesh,
+        rate_history_sharded,
     )
+    from analyzer_tpu.sched import choose_batch_size, pack_schedule
+    from analyzer_tpu.utils import trace
+
+    import jax
+
+    distributed = initialize_distributed()
+    with timer.phase("load"):
+        stream, n_players = _load_stream(args.csv)
+    cursor = 0
+    if args.resume:
+        with timer.phase("restore"):
+            ck = load_checkpoint(args.checkpoint)
+        state, cursor = ck.state, ck.cursor
+        if ck.step_cursor:
+            print(
+                "error: --mesh cannot resume a mid-schedule checkpoint; "
+                "finish it single-device first",
+                file=sys.stderr,
+            )
+            return 2
+    else:
+        state = PlayerState.create(n_players, cfg=cfg)
+    mesh = make_mesh(args.mesh or None)  # 0 = all (global) devices
+    n_dev = int(mesh.devices.size)
+    with timer.phase("pack"):
+        work = stream.slice(cursor, stream.n_matches)
+        # The cost model may pick a width below the mesh size on deep
+        # chain-bound ladders; the sharded batch axis needs B % D == 0.
+        b = choose_batch_size(work, batch_multiple=math.lcm(8, n_dev))
+        b = -(-b // n_dev) * n_dev
+        sched = pack_schedule(work, pad_row=state.pad_row, batch_size=b)
+    with timer.phase("rate"), trace(args.trace):
+        state = rate_history_sharded(state, sched, cfg, mesh=mesh)
+        np.asarray(state.table[:1])
+    lead = not distributed or jax.process_index() == 0
+    if args.checkpoint and lead:
+        with timer.phase("checkpoint"):
+            save_checkpoint(args.checkpoint, state, cursor=stream.n_matches)
+    if lead:
+        print(
+            _rate_stats(
+                stream, cursor, n_players, state, sched, timer,
+                mesh_devices=n_dev, processes=jax.process_count(),
+            )
+        )
     return 0
 
 
@@ -249,6 +331,12 @@ def main(argv=None) -> int:
         "written at the stop boundary when --checkpoint is set)",
     )
     s.add_argument("--trace", help="jax.profiler trace output dir")
+    s.add_argument(
+        "--mesh", type=int, metavar="N",
+        help="data-parallel re-rate over a device mesh: N devices, or 0 for "
+        "all (global under jax.distributed — set COORDINATOR_ADDRESS/"
+        "NUM_PROCESSES/PROCESS_ID and run on every host)",
+    )
     s.set_defaults(fn=cmd_rate)
 
     s = sub.add_parser("elo", help="Elo re-rate of a CSV + accuracy")

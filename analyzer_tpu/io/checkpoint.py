@@ -45,7 +45,11 @@ from analyzer_tpu.core.state import PlayerState
 
 _FIELDS = ("table", "rank_points_ranked", "rank_points_blitz", "skill_tier")
 _CFG_FIELDS = tuple(f.name for f in dataclasses.fields(RatingConfig))
-_FORMAT_VERSION = 3
+# v4: schedule fingerprints switched to the stream-content scheme
+# (sched/superstep.py _ScheduleBase.fingerprint) — v3 mid-schedule digests
+# are incomparable, so resuming one is refused with a clear error instead
+# of the misleading "stream file changed".
+_FORMAT_VERSION = 4
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,12 +84,21 @@ def save_checkpoint(
 
 
 def load_checkpoint(path: str) -> Checkpoint:
-    """Raises on unknown format version (v2 round-1 snapshots still load —
-    they predate step cursors and read as finished-schedule checkpoints)."""
+    """Raises on unknown format version. Older finished-run snapshots
+    still load (v2 predates step cursors; v3 differs only in fingerprint
+    scheme); a v3 MID-schedule snapshot is refused — its fingerprint can
+    never match a v4 digest, so resuming it would always be rejected with
+    a misleading "stream changed" error downstream."""
     with np.load(path) as z:
         version = int(z["format_version"])
-        if version not in (2, _FORMAT_VERSION):
+        if version not in (2, 3, _FORMAT_VERSION):
             raise ValueError(f"checkpoint format {version} != {_FORMAT_VERSION}")
+        if version == 3 and "step_cursor" in z and int(z["step_cursor"]) > 0:
+            raise ValueError(
+                "mid-schedule checkpoint written under the old (v3) "
+                "fingerprint scheme cannot be resumed by this version; "
+                "re-rate from scratch or from a finished-run checkpoint"
+            )
         cfg = None
         if "seed_cfg" in z:
             vals = z["seed_cfg"]

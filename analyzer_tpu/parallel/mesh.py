@@ -194,6 +194,22 @@ def _from_shard_major(table, n_shards: int, rows_per_shard: int):
     )
 
 
+def _put_global(arr, sharding: NamedSharding):
+    """``device_put`` that also works when the mesh spans processes.
+
+    Single-process: plain ``device_put``. Multi-process (after
+    ``jax.distributed.initialize``): every process holds the same host
+    array (packing is deterministic, so each host computes an identical
+    schedule) and materializes ONLY its addressable devices' shards —
+    ``make_array_from_callback`` invokes the callback just for local
+    shard indices, which is the per-process slice of the feed
+    (``multihost.process_slice`` semantics, done per device)."""
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    arr = np.asarray(arr)
+    return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
+
+
 _step_fn_cache: dict = {}
 
 
@@ -318,20 +334,20 @@ def rate_history_sharded(
             [table, jnp.full((pad, width), jnp.nan, table.dtype)]
         )
     table = _to_shard_major(table, n_dev, rps)
-    table = jax.device_put(table, NamedSharding(mesh, P(DATA_AXIS, None)))
+    table = _put_global(table, NamedSharding(mesh, P(DATA_AXIS, None)))
 
     batch_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
     route_sharding = NamedSharding(mesh, P(None, DATA_AXIS, None))
     for start in range(0, sched.n_steps, steps_per_chunk):
         sl = slice(start, min(start + steps_per_chunk, sched.n_steps))
         arrays = (
-            jax.device_put(sched.player_idx[sl], batch_sharding),
-            jax.device_put(sched.slot_mask[sl], batch_sharding),
-            jax.device_put(sched.winner[sl], batch_sharding),
-            jax.device_put(sched.mode_id[sl], batch_sharding),
-            jax.device_put(sched.afk[sl], batch_sharding),
-            jax.device_put(routing.sel[sl], route_sharding),
-            jax.device_put(routing.dst[sl], route_sharding),
+            _put_global(sched.player_idx[sl], batch_sharding),
+            _put_global(sched.slot_mask[sl], batch_sharding),
+            _put_global(sched.winner[sl], batch_sharding),
+            _put_global(sched.mode_id[sl], batch_sharding),
+            _put_global(sched.afk[sl], batch_sharding),
+            _put_global(routing.sel[sl], route_sharding),
+            _put_global(routing.dst[sl], route_sharding),
         )
         table = step_fn(table, *arrays)
     # Undo the shard-major reorder under jit with a replicated output

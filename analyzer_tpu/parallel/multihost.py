@@ -15,10 +15,14 @@ single global computation over all hosts' chips:
     (``process_slice`` below): device_put of a globally-sharded array from
     per-host shards is how JAX expects multi-host input to arrive.
 
-This module only wires the initialization; it is exercised in CI by the
-single-process degenerate case (initialize() is skipped when no
-coordinator is configured), and the mesh code it feeds is the same code
-the 8-device virtual CPU tests pin down.
+Driven end-to-end by ``python -m analyzer_tpu.cli rate --mesh 0`` (see
+``cli._rate_mesh``: same command on every host with the jax.distributed
+env set; each process feeds only its addressable shards via
+``parallel.mesh._put_global``), and exercised in CI by a REAL 2-process
+CPU cluster — ``tests/test_multihost.py`` forms a 2x2-device global mesh
+over Gloo and requires the sharded re-rate to be bit-identical to a
+single-device run, psum crossing the process boundary the way DCN
+traffic would.
 """
 
 from __future__ import annotations

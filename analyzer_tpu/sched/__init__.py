@@ -16,6 +16,7 @@ from analyzer_tpu.sched.superstep import (
     WindowedSchedule,
     assign_batches,
     assign_supersteps,
+    choose_batch_size,
     pack_schedule,
 )
 from analyzer_tpu.sched.runner import HistoryOutputs, rate_history
@@ -26,6 +27,7 @@ __all__ = [
     "WindowedSchedule",
     "assign_batches",
     "assign_supersteps",
+    "choose_batch_size",
     "pack_schedule",
     "HistoryOutputs",
     "rate_history",

@@ -102,6 +102,7 @@ void assign_batches_first_fit(const int32_t* idx, int64_t n_matches,
   };
 
   constexpr int64_t kPublishEvery = 16384;
+  int64_t max_b = -1;  // highest batch actually assigned
   for (int64_t i = 0; i < n_matches; ++i) {
     if (!ratable[i]) {
       out[i] = -1;
@@ -115,6 +116,7 @@ void assign_batches_first_fit(const int32_t* idx, int64_t n_matches,
       }
       const int64_t b = find(floor_b);
       out[i] = b;
+      if (b > max_b) max_b = b;
       out_slot[i] = fill[b];
       if (++fill[b] == capacity) {
         ensure(b + 1);
@@ -134,8 +136,10 @@ void assign_batches_first_fit(const int32_t* idx, int64_t n_matches,
     }
   }
   if (progress) {
-    __atomic_store_n(&progress[1], static_cast<int64_t>(fill.size()),
-                     __ATOMIC_RELAXED);
+    // Final watermark = batches actually used, NOT fill.size(): filling a
+    // batch to exactly capacity pre-creates an empty successor that no
+    // match may ever land in.
+    __atomic_store_n(&progress[1], max_b + 1, __ATOMIC_RELAXED);
     __atomic_store_n(&progress[0], n_matches, __ATOMIC_RELEASE);
   }
 }

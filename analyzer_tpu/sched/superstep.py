@@ -83,9 +83,6 @@ class MatchStream:
         )
 
 
-_FINGERPRINT_WINDOW = 4096
-
-
 class _ScheduleBase:
     """Shared surface of the eager and windowed schedule containers. Both
     expose the ``[S, B]`` per-slot scalars as attributes; they differ only
@@ -123,24 +120,36 @@ class _ScheduleBase:
         of the stream slice, so this identifies "the same work in the same
         order" across processes — mid-run checkpoints store it and resume
         verifies it, failing loudly if the stream file or packing policy
-        changed underneath a step cursor (io/checkpoint.py). Every field
-        the device kernel consumes is hashed (via ``host_window``, in
-        fixed-size windows so the eager and windowed forms of the same
-        schedule digest identically): a stream edit that keeps the packing
-        layout but changes e.g. a match's mode would otherwise resume
-        cleanly and leave pre/post-cursor steps rated under different
-        inputs."""
+        changed underneath a step cursor (io/checkpoint.py).
+
+        Everything the device kernel consumes is bound: the ``[S, B]``
+        scalars directly, and the gather tensors through their generators —
+        ``match_idx`` + the stream's ``player_idx`` determine every window
+        byte-for-byte, so hashing those is equivalent to hashing the
+        materialized tensors WITHOUT paying a full materialization pass on
+        a windowed schedule (a 10M-match resumable run would otherwise
+        rebuild all [S,B,2,T] tensors just to hash them). Eager schedules
+        made by ``pack_schedule`` retain the stream and digest identically
+        to their windowed form; only a hand-built PackedSchedule (no
+        stream) falls back to hashing its materialized tensors, under a
+        distinct scheme tag so the two can never collide."""
         h = hashlib.sha1()
+        stream = getattr(self, "stream", None)
         h.update(
             np.asarray(
-                (self.n_steps, self.batch_size, self.pad_row), np.int64
+                (self.n_steps, self.batch_size, self.pad_row, self.team_size),
+                np.int64,
             ).tobytes()
         )
-        for start in range(0, self.n_steps, _FINGERPRINT_WINDOW):
-            stop = min(start + _FINGERPRINT_WINDOW, self.n_steps)
-            for field in self.host_window(start, stop):
-                h.update(np.ascontiguousarray(field).tobytes())
-            h.update(np.ascontiguousarray(self.match_idx[start:stop]).tobytes())
+        if stream is not None:
+            h.update(b"stream-v1")
+            h.update(np.ascontiguousarray(stream.player_idx).tobytes())
+        else:
+            h.update(b"materialized-v1")
+            h.update(np.ascontiguousarray(self.player_idx).tobytes())
+            h.update(np.ascontiguousarray(self.slot_mask).tobytes())
+        for field in (self.match_idx, self.winner, self.mode_id, self.afk):
+            h.update(np.ascontiguousarray(field).tobytes())
         return h.hexdigest()
 
     def device_arrays(self, start: int = 0, stop: int | None = None):
@@ -167,6 +176,14 @@ class PackedSchedule(_ScheduleBase):
     afk: np.ndarray  # [S, B] bool
     match_idx: np.ndarray  # [S, B] int32
     pad_row: int
+    # Retained by pack_schedule so `fingerprint` digests identically to the
+    # windowed form without touching the materialized tensors; None for a
+    # hand-built schedule (fingerprint then falls back to hashing those).
+    stream: "MatchStream | None" = None
+
+    @property
+    def team_size(self) -> int:
+        return self.player_idx.shape[-1]
 
     @property
     def valid_slots(self) -> np.ndarray:
@@ -260,6 +277,7 @@ class WindowedSchedule(_ScheduleBase):
             afk=afk,
             match_idx=self.match_idx,
             pad_row=self.pad_row,
+            stream=self.stream,
         )
 
 
@@ -373,7 +391,9 @@ def _assign_batches_first_fit_py(
             next_free[b] = b + 1
         last[players] = b
     if progress is not None:
-        progress[:] = (n, len(fill))
+        # Batches actually used — len(fill) can include an empty trailing
+        # batch pre-created when the last one filled to exact capacity.
+        progress[:] = (n, int(out.max()) + 1)
     return out, out_slot
 
 

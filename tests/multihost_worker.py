@@ -1,0 +1,68 @@
+"""Worker script for the 2-process multi-host test (tests/test_multihost.py).
+
+Each process joins a jax.distributed CPU cluster (2 processes x 2 virtual
+devices = one 4-device global mesh), builds the SAME deterministic
+schedule, runs the sharded re-rate — priors psum'd across the process
+boundary, scatters sharded — and process 0 verifies the result is
+bit-identical to a local single-device run. Exit code is the contract.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> int:
+    coordinator, process_id = sys.argv[1], int(sys.argv[2])
+
+    from analyzer_tpu.parallel import initialize_distributed
+
+    assert initialize_distributed(
+        coordinator_address=coordinator, num_processes=2, process_id=process_id
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4, len(jax.devices())
+
+    import numpy as np
+
+    from analyzer_tpu.config import RatingConfig
+    from analyzer_tpu.core.state import PlayerState
+    from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
+    from analyzer_tpu.parallel import make_mesh, rate_history_sharded
+    from analyzer_tpu.sched import pack_schedule, rate_history
+
+    cfg = RatingConfig()
+    players = synthetic_players(50, seed=19)
+    stream = synthetic_stream(150, players, seed=19)
+    state = PlayerState.create(
+        50,
+        rank_points_ranked=players.rank_points_ranked,
+        rank_points_blitz=players.rank_points_blitz,
+        skill_tier=players.skill_tier,
+    )
+    sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=16)
+
+    mesh = make_mesh()  # all 4 global devices
+    assert mesh.devices.size == 4
+    sharded = rate_history_sharded(state, sched, cfg, mesh=mesh, steps_per_chunk=7)
+    got = np.asarray(sharded.table)[: state.n_players]
+
+    # Local single-device oracle on this process's first device.
+    base, _ = rate_history(state, sched, cfg)
+    want = np.asarray(base.table)[: state.n_players]
+
+    if not np.array_equal(got, want, equal_nan=True):
+        print(f"proc {process_id}: MISMATCH", file=sys.stderr)
+        return 1
+    print(f"proc {process_id}: bit-identical over 2-process mesh", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

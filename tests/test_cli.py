@@ -128,6 +128,36 @@ class TestCli:
             "--seed", "7", "--out", csv2)
         assert main(["rate", "--csv", csv2, "--checkpoint", ck, "--resume"]) == 2
 
+    def test_mesh_rate_matches_single_device(self, tmp_path, capsys):
+        """`rate --mesh 4` (sharded table + scatter over the virtual CPU
+        mesh) must write a checkpoint bit-identical to the single-device
+        path's."""
+        csv = str(tmp_path / "s.csv")
+        run(capsys, "synth", "--matches", "300", "--players", "50", "--out", csv)
+        ck1 = str(tmp_path / "single.npz")
+        run(capsys, "rate", "--csv", csv, "--checkpoint", ck1)
+        ck4 = str(tmp_path / "mesh4.npz")
+        line = run(capsys, "rate", "--csv", csv, "--checkpoint", ck4,
+                   "--mesh", "4")
+        stats = json.loads(line)
+        assert stats["mesh_devices"] == 4 and stats["matches"] == 300
+        from analyzer_tpu.io.checkpoint import load_checkpoint
+
+        a, b = load_checkpoint(ck1), load_checkpoint(ck4)
+        assert b.cursor == 300
+        # All real player rows bit-identical; the padding row (last) is
+        # excluded — the single-device scatter parks padded slots there
+        # while the mesh routing drops them, and it is never read back.
+        np.testing.assert_array_equal(
+            np.asarray(a.state.table)[:-1], np.asarray(b.state.table)[:-1]
+        )
+
+    def test_mesh_rejects_mid_schedule_flags(self, tmp_path, capsys):
+        csv = str(tmp_path / "s.csv")
+        run(capsys, "synth", "--matches", "20", "--players", "12", "--out", csv)
+        assert main(["rate", "--csv", csv, "--mesh", "2",
+                     "--checkpoint-every", "4"]) == 2
+
     def test_resume_requires_checkpoint(self, tmp_path, capsys):
         csv = str(tmp_path / "s.csv")
         run(capsys, "synth", "--matches", "10", "--players", "12", "--out", csv)

@@ -1,0 +1,59 @@
+"""Multi-host (multi-process) execution: a REAL 2-process CPU cluster.
+
+The reference's scale-out story (competing AMQP consumers, SURVEY.md
+section 2.5) ran only in production; round 1 here tested just the
+degenerate single-process path. This test forms an actual
+``jax.distributed`` cluster of two processes (2 virtual CPU devices each,
+one 4-device global mesh, Gloo collectives across the process boundary)
+and requires the sharded re-rate to be bit-identical to a single-device
+run — the same invariant the in-process 8-device tests pin down, now with
+the psum crossing processes the way DCN traffic would.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestTwoProcessCluster:
+    def test_sharded_rate_bit_identical_across_processes(self):
+        coordinator = f"127.0.0.1:{_free_port()}"
+        env = {
+            k: v
+            for k, v in os.environ.items()
+            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+        }
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        procs = [
+            subprocess.Popen(
+                [sys.executable, WORKER, coordinator, str(i)],
+                cwd=REPO,
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            for i in range(2)
+        ]
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise
+            outs.append(out)
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"process {i} failed:\n{out}"
+            assert "bit-identical over 2-process mesh" in out

@@ -139,6 +139,27 @@ class TestNativePacker:
             assert progress[0] == stream.n_matches
             assert progress[1] == int(ba.max()) + 1
 
+    def test_progress_watermark_exact_capacity_fill(self):
+        """Filling the last batch to exactly its capacity pre-creates an
+        empty successor; the final watermark must count batches actually
+        used (review round 2: fill.size() overstated by one)."""
+        from analyzer_tpu.sched import _native
+        from analyzer_tpu.sched.superstep import _assign_batches_first_fit_py
+
+        # 32 disjoint matches at capacity 16 -> exactly 2 full batches
+        idx = np.arange(32 * 6, dtype=np.int32).reshape(32, 2, 3)
+        stream = MatchStream(
+            player_idx=idx,
+            winner=np.zeros(32, np.int32),
+            mode_id=np.ones(32, np.int32),
+            afk=np.zeros(32, bool),
+        )
+        for impl in (_native.assign_batches_first_fit, _assign_batches_first_fit_py):
+            progress = np.zeros(2, np.int64)
+            ba, _ = impl(stream, 16, progress)
+            assert int(ba.max()) + 1 == 2
+            assert progress[1] == 2, impl
+
 
 class TestFirstFit:
     def test_capacity_and_chronology(self):
