@@ -30,13 +30,15 @@ just-gathered values.
 Measured cost split on v5e (B=512, P=1M, honest fetch-timed): gather+all
 compute ~35 us/superstep; the row scatter ~370 us and dominates. All XLA
 scatter variants (set/add, unique_indices, promise_in_bounds, pre-sorted)
-measure the same — the lowering serializes ~72 ns/row. A Pallas kernel
-with a pipelined per-row DMA ring was attempted and is architecturally
-blocked: Mosaic requires DMA slices lane-aligned to 128 floats, and state
-rows are 16 floats (padding the table to 128-wide rows would 8x HBM for a
-DMA-issue-bound loop that projects slower than XLA's scatter). At ~1.1M
-matches/s/chip the scatter floor is ~260x the BASELINE target, so this
-stands as the documented bound rather than a TODO.
+measure the same — the lowering serializes ~72 ns/row. The round-2
+head-to-head (``experiments/scatter_floor.py``, BASELINE.md "Scatter
+floor") measured the lane-aligned alternatives and the production path
+WINS: a [P,128] table costs ~470 ns/row under XLA and ~380-410 ns/row
+under a Pallas per-row DMA ring (8-32 copies in flight — descriptor-issue
+bound, 512B moved per 64B updated), and Mosaic still rejects DMA on the
+native 16-float rows (128-lane alignment). At ~1M matches/s/chip the
+scatter floor is ~230x the BASELINE target; this is the measured bound,
+not a TODO.
 
 Correctness precondition: no player index may appear twice among the ratable
 matches of one batch (the scatters would collide). The scheduler in

@@ -1,0 +1,169 @@
+"""Scatter-floor measurement (round-1 review item #9): is XLA's ~100ns/row
+row scatter actually the floor, or does a lane-aligned table unlock a
+faster Pallas DMA path?
+
+Candidates for the superstep row scatter (the measured v5e bottleneck):
+  A. xla16     — production path: table [P,16], ``table.at[idx].set(rows)``
+  B. xla128    — lane-aligned: table [P,128] (8x HBM), same XLA scatter
+  C. pallas16  — per-row DMA ring on the native 16-float rows
+  D. pallas128 — per-row DMA ring on the lane-aligned table (NSEM copies
+     in flight; rows land in VMEM, table stays in HBM, output aliased)
+
+Harness mirrors the real runner's scan shape: per-step indices/rows arrive
+as scan xs (like ``sched.device_arrays`` slabs), the table is the donated
+carry, runs are fetch-timed with a fresh table per call.
+
+MEASURED (v5e single chip via tunnel, P=1.5M, R=5120 rows/step — see
+BASELINE.md "Scatter floor" for the recorded numbers):
+  xla16       ~134 ns/row   <- best; the production path stands
+  xla128      ~470 ns/row   (8x dead bytes per row)
+  pallas16    FAILS to compile (Mosaic: DMA slices must be lane-aligned
+              to 128 floats; 16-float rows are not — the round-1 blocker,
+              reconfirmed)
+  pallas128   ~410 ns/row @ 8 in-flight, ~378 @ 32 — descriptor-issue
+              bound: deeper queues barely help, and every copy moves 512B
+              to update 64B
+
+Conclusion: the row scatter is latency/issue-bound, not bandwidth-bound.
+Padding rows to the 128-lane tile just multiplies dead traffic; a DMA
+engine pays ~2-3us per descriptor amortized, which 8-32 in-flight copies
+cannot hide below XLA's scatter lowering. XLA's 16-wide scatter remains
+the documented floor (~72-134 ns/row depending on tunnel conditions).
+
+Usage: ``python experiments/scatter_floor.py`` (runs on the default
+device; expects a TPU for meaningful numbers).
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+P = 1_500_000
+R = 5120  # rows per superstep: B=512 matches x 10 player slots
+NSEM = 8  # in-flight DMA copies (32 measured within ~8% of 8)
+
+rng = np.random.default_rng(0)
+
+
+def make_xs(s_steps, width):
+    idx = np.stack(
+        [rng.choice(P, size=R, replace=False) for _ in range(8)]
+    ).astype(np.int32)
+    idx = jnp.asarray(idx[np.arange(s_steps) % 8])  # [S, R]
+    rows = jnp.asarray(rng.random((8, R, width)), jnp.float32)
+    rows = rows[np.arange(s_steps) % 8]  # [S, R, W]
+    return idx, rows
+
+
+def timeit(make_fn, width, s_steps):
+    fn = make_fn()
+    idx, rows = make_xs(s_steps, width)
+    table = jnp.zeros((P, width), jnp.float32)
+    out = fn(table, idx, rows)
+    np.asarray(out[:1])  # compile+complete
+    best = np.inf
+    for _ in range(3):
+        table = jnp.zeros((P, width), jnp.float32)
+        np.asarray(table[:1])
+        t0 = time.perf_counter()
+        out = fn(table, idx, rows)
+        np.asarray(out[:1])
+        best = min(best, time.perf_counter() - t0)
+    return best / s_steps
+
+
+def make_xla():
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run(table, idx, rows):
+        def step(tbl, xs):
+            i, r = xs
+            return tbl.at[i].set(r), None
+        tbl, _ = jax.lax.scan(step, table, (idx, rows))
+        return tbl
+    return run
+
+
+def pallas_kernel(idx_ref, rows_ref, table_ref, out_ref, sem):
+    def body(r, _):
+        slot = jax.lax.rem(r, NSEM)
+
+        @pl.when(r >= NSEM)
+        def _():
+            pltpu.make_async_copy(
+                rows_ref.at[r - NSEM], out_ref.at[idx_ref[r - NSEM]],
+                sem.at[slot],
+            ).wait()
+
+        pltpu.make_async_copy(
+            rows_ref.at[r], out_ref.at[idx_ref[r]], sem.at[slot]
+        ).start()
+        return 0
+
+    jax.lax.fori_loop(0, R, body, 0, unroll=True)
+
+    def drain(k, _):
+        r = R - NSEM + k
+
+        @pl.when(r >= 0)
+        def _():
+            pltpu.make_async_copy(
+                rows_ref.at[r], out_ref.at[idx_ref[r]],
+                sem.at[jax.lax.rem(r, NSEM)],
+            ).wait()
+        return 0
+
+    jax.lax.fori_loop(0, NSEM, drain, 0)
+
+
+def make_pallas(width):
+    def maker():
+        scatter = pl.pallas_call(
+            pallas_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                in_specs=[
+                    pl.BlockSpec(memory_space=pltpu.ANY),  # rows
+                    pl.BlockSpec(memory_space=pltpu.ANY),  # table (HBM)
+                ],
+                out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+                scratch_shapes=[pltpu.SemaphoreType.DMA((NSEM,))],
+            ),
+            out_shape=jax.ShapeDtypeStruct((P, width), jnp.float32),
+            input_output_aliases={2: 0},
+        )
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def run(table, idx, rows):
+            def step(tbl, xs):
+                i, r = xs
+                return scatter(i, r, tbl), None
+            tbl, _ = jax.lax.scan(step, table, (idx, rows))
+            return tbl
+        return run
+    return maker
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind}); P={P} R={R}", flush=True)
+    for name, width, maker, s in (
+        ("xla16", 16, make_xla, 400),
+        ("xla128", 128, make_xla, 50),
+        ("pallas16", 16, make_pallas(16), 400),
+        ("pallas128", 128, make_pallas(128), 50),
+    ):
+        try:
+            per_step = timeit(maker, width, s)
+            print(f"{name:10s}: {per_step*1e6:8.1f} us/step  "
+                  f"{per_step/R*1e9:6.1f} ns/row", flush=True)
+        except Exception as e:  # noqa: BLE001 — experiment: report and continue
+            print(f"{name:10s}: FAILED {type(e).__name__}: {str(e)[:250]}",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()
