@@ -19,7 +19,7 @@ from analyzer_tpu.sched.superstep import (
     choose_batch_size,
     pack_schedule,
 )
-from analyzer_tpu.sched.runner import HistoryOutputs, rate_history
+from analyzer_tpu.sched.runner import HistoryOutputs, rate_history, rate_stream
 
 __all__ = [
     "MatchStream",
@@ -31,4 +31,5 @@ __all__ = [
     "pack_schedule",
     "HistoryOutputs",
     "rate_history",
+    "rate_stream",
 ]

@@ -98,17 +98,42 @@ def assign_supersteps(stream) -> np.ndarray:
 
 
 def assign_batches_first_fit(
-    stream, capacity: int, progress: np.ndarray | None = None
+    stream,
+    capacity: int,
+    progress: np.ndarray | None = None,
+    out: np.ndarray | None = None,
+    out_slot: np.ndarray | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Returns (batch_id, slot_in_batch), each [N] int64, -1 for
     non-ratable. ``progress`` (optional [2] int64 array) is published
     periodically by the C loop — (matches processed, batch watermark) —
     and can be polled from another thread while this call runs (ctypes
-    releases the GIL for the duration)."""
+    releases the GIL for the duration). ``out``/``out_slot`` let that
+    consumer pre-allocate the result buffers and read entries below the
+    published progress count while the loop is still filling the rest
+    (the release store on ``progress[0]`` orders the writes)."""
     n, idx, ratable, n_players = _prep(stream)
-    out = np.empty(n, dtype=np.int64)
-    out_slot = np.empty(n, dtype=np.int64)
+    if out is None:
+        out = np.empty(n, dtype=np.int64)
+    if out_slot is None:
+        out_slot = np.empty(n, dtype=np.int64)
+    for name, buf in (("out", out), ("out_slot", out_slot)):
+        # The C loop writes n int64 entries through the raw pointer — an
+        # undersized/non-contiguous/wrong-dtype buffer would corrupt the
+        # heap, so validate loudly.
+        if (
+            buf.dtype != np.int64
+            or buf.size != n
+            or not buf.flags["C_CONTIGUOUS"]
+        ):
+            raise ValueError(
+                f"{name} must be a C-contiguous int64 array of size {n}, "
+                f"got dtype={buf.dtype} size={buf.size} "
+                f"contiguous={buf.flags['C_CONTIGUOUS']}"
+            )
     if n == 0:
+        if progress is not None:
+            progress[:] = (0, 0)
         return out, out_slot
     prog_ptr = (
         progress.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))

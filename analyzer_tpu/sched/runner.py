@@ -125,13 +125,19 @@ def rate_history(
     if not collect:
         return state, None
 
-    n = sched.n_matches
+    team = sched.host_window(0, 1)[0].shape[-1]
     flat_idx = sched.match_idx[start_step:n_steps].reshape(-1)
+    return state, _gather_outputs(outs, flat_idx, sched.n_matches, team)
+
+
+def _gather_outputs(
+    outs: list, flat_idx: np.ndarray, n: int, team: int
+) -> HistoryOutputs:
+    """Scatters per-slot collected chunk outputs back to stream order.
+    Zero chunks (resume at/past the end) yields all-zero outputs with
+    `updated` all-False — same shapes as a real run."""
     sel = flat_idx >= 0
     dest = flat_idx[sel]
-    # Zero-chunk run (start_step at/past the end): all-zero outputs, same
-    # shapes as a real run — `updated` is all-False, nothing was rated.
-    team = sched.host_window(0, 1)[0].shape[-1]
     empty_shapes = {
         "quality": (), "shared_mu": (2, team), "shared_sigma": (2, team),
         "delta": (2, team), "mode_mu": (2, team), "mode_sigma": (2, team),
@@ -151,7 +157,7 @@ def rate_history(
         out[dest] = full[sel]
         return out
 
-    return state, HistoryOutputs(
+    return HistoryOutputs(
         quality=gather("quality"),
         shared_mu=gather("shared_mu"),
         shared_sigma=gather("shared_sigma"),
@@ -161,3 +167,203 @@ def rate_history(
         any_afk=gather("any_afk"),
         updated=gather("updated"),
     )
+
+
+def rate_stream(
+    state: PlayerState,
+    stream,
+    cfg: RatingConfig,
+    collect: bool = False,
+    batch_size: int | None = None,
+    steps_per_chunk: int | None = None,
+    poll_interval: float = 0.002,
+    team_size: int | None = None,
+) -> tuple[PlayerState, HistoryOutputs | None]:
+    """Rates a raw MatchStream with the schedule built CONCURRENTLY with
+    the device scan — the fully-streamed feed.
+
+    ``rate_history`` overlaps window *materialization* with the scan but
+    still pays the whole first-fit assignment as a sequential prefix
+    (~2 s of a 10M-match run). Here the assignment runs on a worker
+    thread (ctypes releases the GIL for the native loop); this consumer
+    scatters newly assigned slots into the slot->match map, backfills
+    non-ratable fillers into each window's padding slots as it goes (same
+    occupancy as the offline packer), and dispatches every complete
+    window while the assigner is still running. End-to-end wall time
+    approaches ``choose_batch_size + max(assign, device scan)``.
+
+    Cross-thread protocol (portable — no acquire/release pairing with
+    the C loop is assumed): the output buffers are prefilled with a
+    sentinel; aligned int64 stores don't tear, so a racy read sees
+    either the sentinel or the final value, and the consumer trims its
+    frontier at the first sentinel. Batch finality is DERIVED from the
+    consumed data (a batch is final once its fill count reaches the
+    capacity — first-fit never reopens a full batch) rather than read
+    from the C loop's watermark, whose release stores would need acquire
+    loads Python can't express. ``Thread.join`` is the one trusted
+    synchronization point, after which the buffers are read plainly.
+
+    Deterministic: window boundaries are fixed multiples of
+    ``steps_per_chunk`` and fillers are consumed in stream order, so the
+    emitted schedule — and therefore the final state and outputs — is a
+    pure function of (stream, batch_size, steps_per_chunk), independent
+    of thread timing. Final state is bit-identical to
+    ``rate_history(pack_schedule(stream))``; per-match outputs are equal
+    as well (filler PLACEMENT may differ from the offline packer's, but
+    non-ratable matches produce the same gate outputs wherever they sit).
+    """
+    import threading
+    import time as _time
+
+    from analyzer_tpu.sched.superstep import (
+        assign_batches,
+        choose_batch_size,
+        materialize_gather_window,
+        materialize_scalar_window,
+    )
+    from analyzer_tpu.core.state import MAX_TEAM_SIZE
+
+    n = stream.n_matches
+    team = team_size or max(MAX_TEAM_SIZE, stream.team_size)
+    if stream.team_size > team:
+        raise ValueError(
+            f"stream team size {stream.team_size} exceeds team_size {team}"
+        )
+    pad_row = state.pad_row
+    state = jax.tree.map(jnp.copy, state)
+    if n == 0:
+        return state, (_gather_outputs([], np.empty(0, np.int32), 0, team)
+                       if collect else None)
+    if int(stream.player_idx.max()) >= pad_row:
+        raise ValueError(
+            f"stream references player row {int(stream.player_idx.max())} "
+            f"but the player table only has rows 0..{pad_row - 1}"
+        )
+
+    b = batch_size or choose_batch_size(stream)
+    spc = steps_per_chunk or min(8192, max(256, -(-n // b) // 8 or 1))
+
+    sentinel = np.iinfo(np.int64).min
+    progress = np.zeros(2, np.int64)
+    out_b = np.full(n, sentinel, np.int64)
+    out_s = np.full(n, sentinel, np.int64)
+    worker_err: list[BaseException] = []
+
+    def work():
+        try:
+            assign_batches(stream, b, progress, out_b, out_s)
+        except BaseException as e:  # noqa: BLE001 — re-raised on the consumer
+            worker_err.append(e)
+
+    worker = threading.Thread(target=work, daemon=True)
+    worker.start()
+
+    fillers = np.flatnonzero(~stream.ratable)
+    n_fill = 0  # fillers placed so far
+    cap_steps = max(-(-n // b) + 2, 2)
+    slot_map = np.full(cap_steps * b, -1, np.int32)
+    fill_count = np.zeros(cap_steps, np.int32)
+    done_m = 0  # matches scattered into slot_map
+    emitted = 0  # steps dispatched to the device
+    watermark = 0  # prefix of batches known full (final)
+    outs = [] if collect else None
+
+    def grow(min_steps: int) -> None:
+        nonlocal slot_map, fill_count, cap_steps
+        if min_steps <= cap_steps:
+            return
+        while cap_steps < min_steps:
+            cap_steps *= 2
+        bigger = np.full(cap_steps * b, -1, np.int32)
+        bigger[: slot_map.size] = slot_map
+        slot_map = bigger
+        bigger_c = np.zeros(cap_steps, np.int32)
+        bigger_c[: fill_count.size] = fill_count
+        fill_count = bigger_c
+
+    def scatter_new(p: int) -> None:
+        """Consumes assignment entries [done_m, p), trimming at the first
+        not-yet-visible (sentinel) entry, and advances the derived
+        watermark over newly full batches."""
+        nonlocal done_m, watermark
+        if p <= done_m:
+            return
+        nb = out_b[done_m:p]
+        unwritten = np.flatnonzero(nb == sentinel)
+        if unwritten.size:
+            p = done_m + int(unwritten[0])
+            nb = out_b[done_m:p]
+            if p <= done_m:
+                return
+        ns = out_s[done_m:p]
+        live = nb >= 0
+        if live.any():
+            grow(int(nb[live].max()) + 1)
+            slot_map[nb[live] * b + ns[live]] = (
+                np.flatnonzero(live) + done_m
+            ).astype(np.int32)
+            counts = np.bincount(nb[live])
+            fill_count[: counts.size] += counts.astype(np.int32)
+            while watermark < cap_steps and fill_count[watermark] >= b:
+                watermark += 1
+        done_m = p
+
+    def emit(e1: int) -> None:
+        """Dispatches steps [emitted, e1), backfilling fillers into the
+        window's free slots (stream order — deterministic)."""
+        nonlocal state, emitted, n_fill
+        e0 = emitted
+        win = slot_map[e0 * b : e1 * b]  # view: backfill lands in slot_map
+        if n_fill < fillers.size:
+            free = np.flatnonzero(win < 0)
+            take = min(free.size, fillers.size - n_fill)
+            if take:
+                win[free[:take]] = fillers[n_fill : n_fill + take].astype(np.int32)
+                n_fill += take
+        mi = win.reshape(e1 - e0, b)
+        pidx, mask = materialize_gather_window(stream, mi, pad_row, team)
+        winner, mode_id, afk = materialize_scalar_window(stream, mi)
+        arrays = tuple(
+            jnp.asarray(a) for a in (pidx, mask, winner, mode_id, afk)
+        )
+        new_state, ys = _scan_chunk(state, arrays, cfg, collect)
+        state = new_state
+        if collect:
+            outs.append(jax.tree.map(np.asarray, ys))
+        emitted = e1
+
+    while worker.is_alive():
+        scatter_new(int(progress[0]))
+        advanced = False
+        while watermark - emitted >= spc:
+            emit(emitted + spc)
+            advanced = True
+        if not advanced:
+            _time.sleep(poll_interval)
+    worker.join()
+    if worker_err:
+        raise RuntimeError("schedule assignment failed") from worker_err[0]
+    scatter_new(n)
+    assert done_m == n  # join() synchronizes; every entry must be visible
+    ratable_b = out_b[out_b >= 0]
+    total_b = int(ratable_b.max()) + 1 if ratable_b.size else 0
+
+    # Tail: remaining fillers overflow into extra all-filler batches after
+    # the assigner's final batch (same rule as pack_schedule's fallback).
+    left = fillers.size - n_fill
+    if left:
+        free_rest = int(
+            (slot_map[emitted * b : total_b * b] < 0).sum()
+        ) if total_b > emitted else 0
+        extra = max(0, -(-(left - free_rest) // b))
+    else:
+        extra = 0
+    s_total = max(total_b + extra, emitted, 1)
+    grow(s_total)
+    while emitted < s_total:
+        emit(min(emitted + spc, s_total))
+
+    if not collect:
+        return state, None
+    flat_idx = slot_map[: s_total * b]
+    return state, _gather_outputs(outs, flat_idx, n, team)

@@ -239,28 +239,9 @@ class WindowedSchedule(_ScheduleBase):
     team_size: int
 
     def host_window(self, start: int, stop: int):
-        mi = self.match_idx[start:stop]
-        if self.stream.n_matches == 0:  # all-padding (inert) schedule
-            shape = mi.shape + (2, self.team_size)
-            return (
-                np.full(shape, self.pad_row, np.int32),
-                np.zeros(shape, bool),
-                self.winner[start:stop],
-                self.mode_id[start:stop],
-                self.afk[start:stop],
-            )
-        valid = mi >= 0
-        rows = np.clip(mi, 0, None)
-        pidx = self.stream.player_idx[rows]  # [W, B, 2, t_in]
-        mask = (pidx >= 0) & valid[..., None, None]
-        pidx = np.where(mask, pidx, self.pad_row).astype(np.int32)
-        t_in = self.stream.team_size
-        if t_in < self.team_size:
-            shape = mi.shape + (2, self.team_size - t_in)
-            pidx = np.concatenate(
-                [pidx, np.full(shape, self.pad_row, np.int32)], axis=-1
-            )
-            mask = np.concatenate([mask, np.zeros(shape, bool)], axis=-1)
+        pidx, mask = materialize_gather_window(
+            self.stream, self.match_idx[start:stop], self.pad_row, self.team_size
+        )
         return (pidx, mask, self.winner[start:stop],
                 self.mode_id[start:stop], self.afk[start:stop])
 
@@ -279,6 +260,58 @@ class WindowedSchedule(_ScheduleBase):
             pad_row=self.pad_row,
             stream=self.stream,
         )
+
+
+def materialize_gather_window(
+    stream: MatchStream, match_idx: np.ndarray, pad_row: int, team_size: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Builds the ``[W, B, 2, team_size]`` (player_idx, slot_mask) gather
+    tensors for a window of the slot->match map — the shared materializer
+    of :class:`WindowedSchedule` and the streaming runner
+    (``sched.runner.rate_stream``). Padding slots (match_idx < 0) point at
+    ``pad_row`` with a False mask; a 3-wide stream packed at team_size=5
+    pads the team axis the same way."""
+    if stream.n_matches == 0:  # all-padding (inert) schedule
+        shape = match_idx.shape + (2, team_size)
+        return np.full(shape, pad_row, np.int32), np.zeros(shape, bool)
+    valid = match_idx >= 0
+    rows = np.clip(match_idx, 0, None)
+    pidx = stream.player_idx[rows]  # [W, B, 2, t_in]
+    mask = (pidx >= 0) & valid[..., None, None]
+    pidx = np.where(mask, pidx, pad_row).astype(np.int32)
+    t_in = stream.team_size
+    if t_in < team_size:
+        shape = match_idx.shape + (2, team_size - t_in)
+        pidx = np.concatenate(
+            [pidx, np.full(shape, pad_row, np.int32)], axis=-1
+        )
+        mask = np.concatenate([mask, np.zeros(shape, bool)], axis=-1)
+    return pidx, mask
+
+
+def materialize_scalar_window(
+    stream: MatchStream, match_idx: np.ndarray
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Builds the (winner, mode_id, afk) per-slot scalars for a window of
+    the slot->match map, with the packer's padding values (winner 0,
+    ``UNSUPPORTED_MODE_ID``, afk False). The single owner of the padding
+    convention — used by ``pack_schedule`` and the streaming runner; the
+    ratable gate (``mode_id >= 0``) depends on it."""
+    if stream.n_matches == 0:
+        return (
+            np.zeros(match_idx.shape, np.int32),
+            np.full(match_idx.shape, constants.UNSUPPORTED_MODE_ID, np.int32),
+            np.zeros(match_idx.shape, bool),
+        )
+    real = match_idx >= 0
+    rows = np.clip(match_idx, 0, None)
+    return (
+        np.where(real, stream.winner[rows], 0).astype(np.int32),
+        np.where(
+            real, stream.mode_id[rows], constants.UNSUPPORTED_MODE_ID
+        ).astype(np.int32),
+        np.where(real, stream.afk[rows], False),
+    )
 
 
 def assign_supersteps(stream: MatchStream) -> np.ndarray:
@@ -314,7 +347,11 @@ def _assign_supersteps_py(stream: MatchStream) -> np.ndarray:
 
 
 def assign_batches(
-    stream: MatchStream, capacity: int, progress: np.ndarray | None = None
+    stream: MatchStream,
+    capacity: int,
+    progress: np.ndarray | None = None,
+    out: np.ndarray | None = None,
+    out_slot: np.ndarray | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Capacity-aware first-fit batch index per match (levelized schedule).
 
@@ -337,17 +374,31 @@ def assign_batches(
     try:
         from analyzer_tpu.sched import _native
 
-        return _native.assign_batches_first_fit(stream, capacity, progress)
+        return _native.assign_batches_first_fit(
+            stream, capacity, progress, out, out_slot
+        )
     except ImportError:
-        return _assign_batches_first_fit_py(stream, capacity, progress)
+        return _assign_batches_first_fit_py(
+            stream, capacity, progress, out, out_slot
+        )
 
 
 def _assign_batches_first_fit_py(
-    stream: MatchStream, capacity: int, progress: np.ndarray | None = None
+    stream: MatchStream,
+    capacity: int,
+    progress: np.ndarray | None = None,
+    out: np.ndarray | None = None,
+    out_slot: np.ndarray | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     n = stream.n_matches
-    out = np.full(n, -1, dtype=np.int64)
-    out_slot = np.full(n, -1, dtype=np.int64)
+    if out is None:
+        out = np.full(n, -1, dtype=np.int64)
+    else:  # the loop below only writes ratable entries
+        out.fill(-1)
+    if out_slot is None:
+        out_slot = np.full(n, -1, dtype=np.int64)
+    else:
+        out_slot.fill(-1)
     if n == 0:
         if progress is not None:
             progress[:] = (0, 0)
@@ -533,18 +584,7 @@ def pack_schedule(
         slot_to_match[free_slots[: filler.size]] = filler
     match_idx = slot_to_match.reshape(s_total, batch_size)
 
-    if n:
-        real = match_idx >= 0
-        rows = np.clip(match_idx, 0, None)
-        winner = np.where(real, stream.winner[rows], 0).astype(np.int32)
-        mode_id = np.where(
-            real, stream.mode_id[rows], constants.UNSUPPORTED_MODE_ID
-        ).astype(np.int32)
-        afk = np.where(real, stream.afk[rows], False)
-    else:  # empty stream still packs one all-padding (inert) step
-        winner = np.zeros(match_idx.shape, np.int32)
-        mode_id = np.full(match_idx.shape, constants.UNSUPPORTED_MODE_ID, np.int32)
-        afk = np.zeros(match_idx.shape, bool)
+    winner, mode_id, afk = materialize_scalar_window(stream, match_idx)
     ws = WindowedSchedule(
         stream=stream,
         winner=winner,

@@ -149,6 +149,22 @@ def main() -> None:
     log(f"end-to-end rate_history (overlapped windowed feed): {t_e2e:.2f}s "
         f"= {t_e2e / best:.2f}x device-only time")
 
+    # Fully-streamed: the first-fit ASSIGNMENT also overlaps the scan
+    # (worker thread + watermark, sched/runner.py rate_stream). This is
+    # the true end-to-end from a raw stream: includes choose_batch_size,
+    # assignment, packing, transfers, and the scan.
+    from analyzer_tpu.sched import rate_stream
+
+    stream_times = []
+    for r in range(3):
+        t0 = time.perf_counter()
+        s_state, _ = rate_stream(state_dev, stream, cfg)
+        np.asarray(s_state.table[:1])
+        stream_times.append(time.perf_counter() - t0)
+    t_stream = min(stream_times[1:])
+    log(f"end-to-end rate_stream (assignment overlapped too): {t_stream:.2f}s "
+        f"= {t_stream / best:.2f}x device-only time")
+
     mu = np.asarray(state.mu)[: state0.n_players]
     rated = ~np.isnan(mu[:, 0])
     log(f"sanity: {int(rated.sum())} players rated, "

@@ -270,6 +270,103 @@ class TestPacking:
             np.testing.assert_array_equal(a, b)
         assert lazy.host_window(0, 1)[0].shape[-1] == 5
 
+    def test_rate_stream_matches_rate_history(self):
+        """The fully-streamed feed (schedule built concurrently with the
+        scan) must be bit-identical in state to the offline pack + scan,
+        and produce the same per-match outputs, across chunk sizes."""
+        from analyzer_tpu.sched import rate_stream
+
+        stream, state = small_stream(n_matches=400, n_players=60, seed=23)
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=16)
+        base, base_outs = rate_history(state, sched, CFG, collect=True)
+        for spc in (3, 7, 64):
+            got, outs = rate_stream(
+                state, stream, CFG, collect=True, batch_size=16,
+                steps_per_chunk=spc,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(base.table)[:-1], np.asarray(got.table)[:-1],
+                err_msg=f"spc={spc}",
+            )
+            np.testing.assert_array_equal(base_outs.updated, outs.updated)
+            np.testing.assert_array_equal(base_outs.quality, outs.quality)
+            np.testing.assert_array_equal(base_outs.shared_mu, outs.shared_mu)
+            np.testing.assert_array_equal(base_outs.any_afk, outs.any_afk)
+
+    def test_rate_stream_filler_heavy(self):
+        # 60% non-ratable: fillers must overflow into extra batches and
+        # still produce identical state/outputs to the offline path.
+        from analyzer_tpu.sched import rate_stream
+
+        players = synthetic_players(40, seed=29)
+        stream = synthetic_stream(200, players, seed=29, afk_rate=0.6)
+        state = PlayerState.create(40, skill_tier=players.skill_tier)
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=8)
+        base, base_outs = rate_history(state, sched, CFG, collect=True)
+        got, outs = rate_stream(
+            state, stream, CFG, collect=True, batch_size=8, steps_per_chunk=5
+        )
+        np.testing.assert_array_equal(
+            np.asarray(base.table)[:-1], np.asarray(got.table)[:-1]
+        )
+        np.testing.assert_array_equal(base_outs.updated, outs.updated)
+        np.testing.assert_array_equal(base_outs.any_afk, outs.any_afk)
+
+    def test_rate_stream_propagates_assigner_failure(self, monkeypatch):
+        # An exception on the assignment worker thread must surface as a
+        # RuntimeError, never as silently corrupt results.
+        import analyzer_tpu.sched.superstep as ss
+        from analyzer_tpu.sched import rate_stream
+
+        def boom(*a, **k):
+            raise MemoryError("synthetic assigner failure")
+
+        monkeypatch.setattr(ss, "assign_batches", boom)
+        stream, state = small_stream(n_matches=30, n_players=12, seed=5)
+        with pytest.raises(RuntimeError, match="assignment failed"):
+            rate_stream(state, stream, CFG, batch_size=4)
+
+    def test_rate_stream_rejects_narrow_team_size(self):
+        from analyzer_tpu.sched import rate_stream
+
+        players = synthetic_players(30, seed=6)
+        stream = synthetic_stream(50, players, seed=6)  # includes 5v5
+        state = PlayerState.create(30)
+        with pytest.raises(ValueError, match="team size"):
+            rate_stream(state, stream, CFG, batch_size=4, team_size=3)
+
+    def test_native_out_buffer_validation(self):
+        from analyzer_tpu.sched import _native
+
+        stream, _ = small_stream(n_matches=20, n_players=10, seed=7)
+        with pytest.raises(ValueError, match="C-contiguous int64"):
+            _native.assign_batches_first_fit(
+                stream, 4, out=np.empty(5, np.int64)
+            )
+        with pytest.raises(ValueError, match="C-contiguous int64"):
+            _native.assign_batches_first_fit(
+                stream, 4, out_slot=np.empty(20, np.int32)
+            )
+
+    def test_rate_stream_empty_and_caller_state_safe(self):
+        from analyzer_tpu.sched import rate_stream
+        from analyzer_tpu.sched.superstep import MatchStream as MS
+
+        stream, state = small_stream(n_matches=50, n_players=20, seed=31)
+        before = np.asarray(state.table).copy()
+        rate_stream(state, stream, CFG, batch_size=8)
+        np.testing.assert_array_equal(before, np.asarray(state.table))
+
+        empty = MS(
+            player_idx=np.zeros((0, 2, 3), np.int32),
+            winner=np.zeros(0, np.int32),
+            mode_id=np.zeros(0, np.int32),
+            afk=np.zeros(0, bool),
+        )
+        st, outs = rate_stream(state, empty, CFG, collect=True)
+        assert outs.updated.shape == (0,)
+        np.testing.assert_array_equal(before, np.asarray(st.table))
+
     def test_occupancy(self):
         stream, state = small_stream(n_matches=300, n_players=200)
         sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=32)
