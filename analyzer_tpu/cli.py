@@ -46,6 +46,54 @@ def cmd_synth(args) -> int:
     return 0
 
 
+def _checkpoint_hook(args, sched, cursor, start_step, finished, lead=True):
+    """The shared periodic/bounded-run snapshot closure of the
+    single-device and --mesh rate paths (None when no saves can be due).
+    Periodic saves honor --checkpoint-every; a bounded run always
+    snapshots at its stop boundary; the finished branch's final save is
+    never duplicated.
+
+    Multi-host discipline: the hook must run on EVERY process — the mesh
+    runner hands the state as a lazy thunk whose evaluation is a
+    cross-process collective (the unshard gather), and the cadence
+    decision is a pure function of ``next_step``, so all processes make
+    the same call and the SPMD program never diverges. Only the actual
+    file write is gated to the lead process. The thunk is evaluated
+    strictly AFTER the cadence decision, so skipped chunks never pay the
+    cross-mesh gather."""
+    from analyzer_tpu.io.checkpoint import save_checkpoint
+
+    if not args.checkpoint:
+        return None
+    if not args.checkpoint_every and finished:
+        return None
+    every = args.checkpoint_every or sched.n_steps + 1
+    fingerprint = sched.fingerprint
+    effective_stop = (
+        sched.n_steps if finished else min(args.stop_after_steps, sched.n_steps)
+    )
+    last_saved = start_step
+
+    def on_chunk(st, next_step):
+        nonlocal last_saved
+        due = next_step - last_saved >= every
+        at_bound = not finished and next_step >= effective_stop
+        if (not due and not at_bound) or (
+            finished and next_step >= sched.n_steps
+        ):
+            return
+        last_saved = next_step
+        if callable(st):  # mesh path: collective snapshot, all processes
+            st = st()
+        if lead:
+            save_checkpoint(
+                args.checkpoint, st, cursor=cursor,
+                step_cursor=next_step, schedule_fingerprint=fingerprint,
+            )
+
+    return on_chunk
+
+
 def _rate_stats(stream, cursor, n_players, state, sched, timer, **extra) -> str:
     """The shared stats line of the single-device and --mesh rate paths."""
     mu = np.asarray(state.mu)[:n_players, 0]
@@ -81,13 +129,6 @@ def cmd_rate(args) -> int:
             return 2
     if args.mesh is not None and args.mesh < 0:
         print("error: --mesh must be >= 0 (0 = all devices)", file=sys.stderr)
-        return 2
-    if args.mesh is not None and (args.checkpoint_every or args.stop_after_steps):
-        print(
-            "error: --mesh does not support --checkpoint-every/"
-            "--stop-after-steps yet (whole-run checkpoints only)",
-            file=sys.stderr,
-        )
         return 2
     timer = PhaseTimer()
     if args.mesh is not None:
@@ -129,33 +170,7 @@ def cmd_rate(args) -> int:
             )
             return 2
     finished = args.stop_after_steps is None or args.stop_after_steps >= sched.n_steps
-    effective_stop = (
-        sched.n_steps if finished else min(args.stop_after_steps, sched.n_steps)
-    )
-    on_chunk = None
-    if args.checkpoint and (args.checkpoint_every or not finished):
-        # Periodic saves at the requested cadence; a bounded run also
-        # always snapshots at its stop boundary — otherwise
-        # --stop-after-steps would compute and then discard device work.
-        every = args.checkpoint_every or sched.n_steps + 1
-        fingerprint = sched.fingerprint
-        last_saved = start_step
-
-        def on_chunk(st, next_step):
-            nonlocal last_saved
-            # Honor the cadence even when chunks are smaller; don't
-            # duplicate the final save the finished branch will write.
-            due = next_step - last_saved >= every
-            at_bound = not finished and next_step >= effective_stop
-            if (not due and not at_bound) or (
-                finished and next_step >= sched.n_steps
-            ):
-                return
-            last_saved = next_step
-            save_checkpoint(
-                args.checkpoint, st, cursor=cursor,
-                step_cursor=next_step, schedule_fingerprint=fingerprint,
-            )
+    on_chunk = _checkpoint_hook(args, sched, cursor, start_step, finished)
     with timer.phase("rate"), trace(args.trace):
         state, _ = rate_history(
             state, sched, cfg,
@@ -199,20 +214,15 @@ def _rate_mesh(args, cfg, timer) -> int:
     import jax
 
     distributed = initialize_distributed()
+    lead = not distributed or jax.process_index() == 0
     with timer.phase("load"):
         stream, n_players = _load_stream(args.csv)
-    cursor = 0
+    cursor, start_step = 0, 0
+    ck = None
     if args.resume:
         with timer.phase("restore"):
             ck = load_checkpoint(args.checkpoint)
-        state, cursor = ck.state, ck.cursor
-        if ck.step_cursor:
-            print(
-                "error: --mesh cannot resume a mid-schedule checkpoint; "
-                "finish it single-device first",
-                file=sys.stderr,
-            )
-            return 2
+        state, cursor, start_step = ck.state, ck.cursor, ck.step_cursor
     else:
         state = PlayerState.create(n_players, cfg=cfg)
     mesh = make_mesh(args.mesh or None)  # 0 = all (global) devices
@@ -224,11 +234,32 @@ def _rate_mesh(args, cfg, timer) -> int:
         b = choose_batch_size(work, batch_multiple=math.lcm(8, n_dev))
         b = -(-b // n_dev) * n_dev
         sched = pack_schedule(work, pad_row=state.pad_row, batch_size=b)
+    if start_step and sched.fingerprint != ck.schedule_fingerprint:
+        # Same rule as the single-device path — a mid-schedule cursor is
+        # only valid against the identical schedule. Note the two paths
+        # pack with different batch widths, so their mid-schedule
+        # checkpoints are deliberately not interchangeable.
+        print(
+            "error: checkpoint was taken mid-schedule but the packed "
+            "schedule no longer matches (stream file, packing policy, or "
+            "mesh size changed); re-rate from scratch or from a "
+            "finished-run checkpoint",
+            file=sys.stderr,
+        )
+        return 2
+    finished = args.stop_after_steps is None or args.stop_after_steps >= sched.n_steps
+    on_chunk = _checkpoint_hook(args, sched, cursor, start_step, finished, lead)
     with timer.phase("rate"), trace(args.trace):
-        state = rate_history_sharded(state, sched, cfg, mesh=mesh)
+        state = rate_history_sharded(
+            state, sched, cfg, mesh=mesh,
+            start_step=start_step, stop_after=args.stop_after_steps,
+            on_chunk=on_chunk,
+            steps_per_chunk=(
+                min(1024, args.checkpoint_every) if args.checkpoint_every else 1024
+            ),
+        )
         np.asarray(state.table[:1])
-    lead = not distributed or jax.process_index() == 0
-    if args.checkpoint and lead:
+    if args.checkpoint and lead and finished:
         with timer.phase("checkpoint"):
             save_checkpoint(args.checkpoint, state, cursor=stream.n_matches)
     if lead:

@@ -292,11 +292,22 @@ def rate_history_sharded(
     cfg: RatingConfig,
     mesh: Mesh | None = None,
     steps_per_chunk: int = 1024,
+    start_step: int = 0,
+    stop_after: int | None = None,
+    on_chunk=None,
 ) -> PlayerState:
     """Full-history re-rate, data-parallel over the mesh. Returns final state.
 
     ``sched.batch_size`` must be divisible by the mesh size (pack with
-    ``batch_size = k * n_devices``).
+    ``batch_size = k * n_devices``). ``start_step``/``stop_after``/
+    ``on_chunk`` mirror ``sched.rate_history``'s checkpoint-resume
+    surface, except ``on_chunk(snapshot, next_step)`` receives a ZERO-ARG
+    THUNK producing the fully-assembled (unsharded, row-major)
+    PlayerState: evaluating it is a cross-process collective, so a
+    multi-host hook must call it on every process or on none (make the
+    decision a pure function of ``next_step``); skipped chunks pay
+    nothing. One cross-mesh gather + device sync per taken snapshot is
+    the price of a bounded crash blast radius.
     """
     mesh = mesh or make_mesh()
     n_dev = mesh.devices.size
@@ -336,10 +347,20 @@ def rate_history_sharded(
     table = _to_shard_major(table, n_dev, rps)
     table = _put_global(table, NamedSharding(mesh, P(DATA_AXIS, None)))
 
+    # Undo the shard-major reorder under jit with a replicated output
+    # sharding: the result table is row-sharded across the mesh (possibly
+    # across processes on multi-host), where eager reshape/transpose/slice
+    # would raise on non-fully-addressable arrays.
+    unshard = jax.jit(
+        lambda t: _from_shard_major(t, n_dev, rps)[:n_rows],
+        out_shardings=NamedSharding(mesh, P()),
+    )
+
+    n_steps = sched.n_steps if stop_after is None else min(stop_after, sched.n_steps)
     batch_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
     route_sharding = NamedSharding(mesh, P(None, DATA_AXIS, None))
-    for start in range(0, sched.n_steps, steps_per_chunk):
-        sl = slice(start, min(start + steps_per_chunk, sched.n_steps))
+    for start in range(start_step, n_steps, steps_per_chunk):
+        sl = slice(start, min(start + steps_per_chunk, n_steps))
         arrays = (
             _put_global(sched.player_idx[sl], batch_sharding),
             _put_global(sched.slot_mask[sl], batch_sharding),
@@ -350,12 +371,16 @@ def rate_history_sharded(
             _put_global(routing.dst[sl], route_sharding),
         )
         table = step_fn(table, *arrays)
-    # Undo the shard-major reorder under jit with a replicated output
-    # sharding: the result table is row-sharded across the mesh (possibly
-    # across processes on multi-host), where eager reshape/transpose/slice
-    # would raise on non-fully-addressable arrays.
-    unshard = jax.jit(
-        lambda t: _from_shard_major(t, n_dev, rps)[:n_rows],
-        out_shardings=NamedSharding(mesh, P()),
-    )
+        if on_chunk is not None:
+            # The shard-major table is an internal layout; snapshots get
+            # the assembled row-major state via a LAZY thunk: unshard is
+            # a cross-process collective, so the hook must either call it
+            # on every process or on none (its cadence decision is a pure
+            # function of next_step — see cli._checkpoint_hook), and
+            # skipped chunks don't pay the gather. No donation on
+            # unshard, so `table` stays valid for the next chunk.
+            def snapshot(_t=table):
+                return dataclasses.replace(state, table=unshard(_t))
+
+            on_chunk(snapshot, min(start + steps_per_chunk, n_steps))
     return dataclasses.replace(state, table=unshard(table))

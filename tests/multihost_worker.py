@@ -50,8 +50,25 @@ def main() -> int:
 
     mesh = make_mesh()  # all 4 global devices
     assert mesh.devices.size == 4
-    sharded = rate_history_sharded(state, sched, cfg, mesh=mesh, steps_per_chunk=7)
+    # Periodic-snapshot hook with the multi-host discipline: the cadence
+    # decision is a pure function of next_step, the snapshot thunk (a
+    # cross-process collective) is evaluated by BOTH processes when due,
+    # and only process 0 would write. Exercises the SPMD-divergence
+    # regression: a lead-gated hook would hang here.
+    taken = []
+
+    def on_chunk(snapshot, next_step):
+        if next_step % 14 == 0:  # every other 7-step chunk
+            st = snapshot()
+            if jax.process_index() == 0:
+                taken.append((next_step, np.asarray(st.table).copy()))
+
+    sharded = rate_history_sharded(
+        state, sched, cfg, mesh=mesh, steps_per_chunk=7, on_chunk=on_chunk
+    )
     got = np.asarray(sharded.table)[: state.n_players]
+    if jax.process_index() == 0:
+        assert taken, "periodic snapshots should have fired"
 
     # Local single-device oracle on this process's first device.
     base, _ = rate_history(state, sched, cfg)

@@ -152,11 +152,39 @@ class TestCli:
             np.asarray(a.state.table)[:-1], np.asarray(b.state.table)[:-1]
         )
 
-    def test_mesh_rejects_mid_schedule_flags(self, tmp_path, capsys):
+    def test_mesh_kill_and_resume(self, tmp_path, capsys):
+        """Bounded --mesh run + resume == one-shot --mesh run, bit-identical
+        (the sharded path's checkpoint surface mirrors the single-device
+        one; mid-run snapshots are the assembled row-major state)."""
         csv = str(tmp_path / "s.csv")
-        run(capsys, "synth", "--matches", "20", "--players", "12", "--out", csv)
-        assert main(["rate", "--csv", csv, "--mesh", "2",
-                     "--checkpoint-every", "4"]) == 2
+        run(capsys, "synth", "--matches", "250", "--players", "40", "--out", csv)
+        ck_full = str(tmp_path / "full.npz")
+        run(capsys, "rate", "--csv", csv, "--checkpoint", ck_full, "--mesh", "2")
+        ck = str(tmp_path / "part.npz")
+        run(capsys, "rate", "--csv", csv, "--checkpoint", ck, "--mesh", "2",
+            "--checkpoint-every", "2", "--stop-after-steps", "4")
+        from analyzer_tpu.io.checkpoint import load_checkpoint
+
+        mid = load_checkpoint(ck)
+        assert mid.step_cursor == 4 and mid.schedule_fingerprint
+        run(capsys, "rate", "--csv", csv, "--checkpoint", ck, "--mesh", "2",
+            "--resume")
+        a, b = load_checkpoint(ck_full), load_checkpoint(ck)
+        assert b.cursor == 250 and b.step_cursor == 0
+        np.testing.assert_array_equal(
+            np.asarray(a.state.table)[:-1], np.asarray(b.state.table)[:-1]
+        )
+
+    def test_mesh_rejects_foreign_mid_schedule_checkpoint(self, tmp_path, capsys):
+        # A single-device mid-schedule checkpoint packs at a different
+        # width; the mesh path must refuse it rather than double-apply.
+        csv = str(tmp_path / "s.csv")
+        run(capsys, "synth", "--matches", "150", "--players", "30", "--out", csv)
+        ck = str(tmp_path / "sd.npz")
+        run(capsys, "rate", "--csv", csv, "--checkpoint", ck,
+            "--checkpoint-every", "2", "--stop-after-steps", "4")
+        assert main(["rate", "--csv", csv, "--checkpoint", ck, "--mesh", "2",
+                     "--resume"]) == 2
 
     def test_resume_requires_checkpoint(self, tmp_path, capsys):
         csv = str(tmp_path / "s.csv")
