@@ -8,9 +8,9 @@ re-rates from CSV streams with checkpoint/resume, the Elo harness
 stream generation, and the benchmark.
 
 Subcommands:
-  synth   generate a synthetic match-history CSV
-  rate    TrueSkill full-history re-rate of a CSV stream (checkpoint/resume)
-  elo     Elo re-rate of a CSV stream + prediction accuracy
+  synth   generate a synthetic match history (.csv or .npz by extension)
+  rate    TrueSkill full-history re-rate of a stream (checkpoint/resume)
+  elo     Elo re-rate of a stream + prediction accuracy
   bench   the headline throughput benchmark (one JSON line)
   worker  the broker-consuming service loop (needs pika)
 """
@@ -25,15 +25,15 @@ import numpy as np
 
 
 def _load_stream(path: str):
-    from analyzer_tpu.io.csv_codec import load_stream_csv
+    from analyzer_tpu.io.csv_codec import load_stream
 
-    stream = load_stream_csv(path)
+    stream = load_stream(path)
     n_players = int(stream.player_idx.max()) + 1 if stream.n_matches else 0
     return stream, n_players
 
 
 def cmd_synth(args) -> int:
-    from analyzer_tpu.io.csv_codec import save_stream_csv
+    from analyzer_tpu.io.csv_codec import save_stream
     from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
 
     players = synthetic_players(args.players, seed=args.seed)
@@ -41,7 +41,7 @@ def cmd_synth(args) -> int:
         args.matches, players, seed=args.seed,
         activity_concentration=args.concentration,
     )
-    save_stream_csv(args.out, stream)
+    save_stream(args.out, stream)
     print(f"wrote {stream.n_matches} matches / {args.players} players to {args.out}")
     return 0
 
@@ -339,16 +339,16 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="analyzer_tpu", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    s = sub.add_parser("synth", help="generate a synthetic match-history CSV")
+    s = sub.add_parser("synth", help="generate a synthetic match history (.csv/.npz)")
     s.add_argument("--matches", type=int, default=1000)
     s.add_argument("--players", type=int, default=300)
     s.add_argument("--seed", type=int, default=0)
     s.add_argument("--concentration", type=float, default=0.8)
-    s.add_argument("--out", required=True)
+    s.add_argument("--out", required=True, help=".csv (native parser) or .npz (binary)")
     s.set_defaults(fn=cmd_synth)
 
-    s = sub.add_parser("rate", help="TrueSkill full-history re-rate of a CSV")
-    s.add_argument("--csv", required=True)
+    s = sub.add_parser("rate", help="TrueSkill full-history re-rate of a stream")
+    s.add_argument("--csv", required=True, help="match stream, .csv or .npz")
     s.add_argument("--checkpoint", help="state snapshot path (.npz)")
     s.add_argument("--resume", action="store_true", help="resume from --checkpoint")
     s.add_argument(

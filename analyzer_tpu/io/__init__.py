@@ -8,14 +8,25 @@ feeding the scheduler, and explicit state snapshots with a resume cursor.
 """
 
 from analyzer_tpu.io.synthetic import synthetic_stream, synthetic_players
-from analyzer_tpu.io.csv_codec import load_stream_csv, save_stream_csv
+from analyzer_tpu.io.csv_codec import (
+    load_stream,
+    load_stream_csv,
+    load_stream_npz,
+    save_stream,
+    save_stream_csv,
+    save_stream_npz,
+)
 from analyzer_tpu.io.checkpoint import load_checkpoint, save_checkpoint
 
 __all__ = [
     "synthetic_stream",
     "synthetic_players",
+    "load_stream",
     "load_stream_csv",
+    "load_stream_npz",
+    "save_stream",
     "save_stream_csv",
+    "save_stream_npz",
     "load_checkpoint",
     "save_checkpoint",
 ]

@@ -1,0 +1,76 @@
+"""ctypes loader for the native CSV parser (fastcsv.cc).
+
+Compiled/loaded via the shared helper (``analyzer_tpu.native_build``):
+ImportError on ANY build or load failure so the caller's pure-python
+parser engages instead.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from analyzer_tpu.native_build import build_and_load
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_lib = build_and_load(
+    os.path.join(_DIR, "fastcsv.cc"), os.path.join(_DIR, "_fastcsv.so")
+)
+_lib.parse_stream_csv.argtypes = [
+    ctypes.c_char_p,
+    ctypes.c_int64,
+    ctypes.c_char_p,
+    ctypes.c_int64,
+    ctypes.c_int64,
+    ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_int32),
+    ctypes.POINTER(ctypes.c_int32),
+    ctypes.POINTER(ctypes.c_int32),
+    ctypes.POINTER(ctypes.c_uint8),
+    ctypes.POINTER(ctypes.c_int64),
+]
+_lib.parse_stream_csv.restype = ctypes.c_int64
+
+
+def parse_stream_csv(data: bytes, mode_names: list[str], max_team: int):
+    """Parses the writer's CSV format. Returns (player_idx [N,2,tmax],
+    winner, mode_id, afk) numpy arrays, or None when the data doesn't
+    match the fast path (caller falls back to the python parser).
+
+    Two passes: a write-free probe learns (rows, widest team) so the
+    arrays are allocated at exactly the data's width — a worst-case
+    ``max_team``-wide buffer would be ~1.3 GB of mostly padding at the
+    10M-row scale this parser exists for."""
+    if b'"' in data:
+        # Quoting is csv-module territory; the scanner would compare a
+        # quoted mode name literally and mis-map it. Rare -> python path.
+        return None
+    modes = "\n".join(mode_names).encode()
+    null_i32 = ctypes.POINTER(ctypes.c_int32)()
+    null_u8 = ctypes.POINTER(ctypes.c_uint8)()
+    tmax = np.zeros(1, np.int64)
+    tmax_ptr = tmax.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    n = _lib.parse_stream_csv(
+        data, len(data), modes, len(mode_names), max_team,
+        np.iinfo(np.int64).max,
+        null_i32, null_i32, null_i32, null_u8, tmax_ptr,
+    )
+    if n < 0:
+        return None  # malformed for the fast path; python parser decides
+    t = max(int(tmax[0]), 1)
+    player_idx = np.full((n, 2, t), -1, np.int32)
+    winner = np.zeros(n, np.int32)
+    mode_id = np.zeros(n, np.int32)
+    afk = np.zeros(n, np.uint8)
+    n2 = _lib.parse_stream_csv(
+        data, len(data), modes, len(mode_names), t, n,
+        player_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        winner.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        mode_id.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        afk.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        tmax_ptr,
+    )
+    assert n2 == n, (n2, n)  # same bytes, same grammar — cannot differ
+    return player_idx, winner, mode_id, afk.astype(bool)

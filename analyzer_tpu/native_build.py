@@ -1,0 +1,65 @@
+"""Shared build-and-load helper for the native (C++) host components.
+
+Both native extensions — the scheduler's first-fit assigner
+(``sched/packer.cc``) and the CSV scanner (``io/fastcsv.cc``) — compile on
+demand with g++ and load via ctypes (no pybind11 dependency). EVERY
+failure mode surfaces as ImportError so callers' pure-python fallbacks
+engage: missing g++, read-only package dir, a stale or corrupt ``.so``
+(e.g. one rsync'd from another architecture — ctypes raises OSError for
+an invalid ELF, which must not crash the program).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+
+def _compile(src: str, lib: str) -> None:
+    """Atomic compile: temp name + rename, so concurrent importers either
+    see the finished .so or rebuild harmlessly. Raises ImportError."""
+    tmp = None
+    try:
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(lib))
+        os.close(fd)
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, src],
+            check=True,
+            capture_output=True,
+        )
+        os.replace(tmp, lib)
+        tmp = None
+    except (subprocess.CalledProcessError, OSError) as e:
+        raise ImportError(f"native build failed for {src}: {e}") from e
+    finally:
+        if tmp is not None and os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def build_and_load(src: str, lib: str) -> ctypes.CDLL:
+    """Compiles ``src`` to ``lib`` when missing/stale and returns the CDLL.
+    Raises ImportError on ANY failure (build or load)."""
+    try:
+        stale = not os.path.exists(lib) or (
+            os.path.getmtime(lib) < os.path.getmtime(src)
+        )
+    except OSError as e:
+        raise ImportError(f"native source unavailable: {e}") from e
+    if stale:
+        _compile(src, lib)
+    try:
+        return ctypes.CDLL(lib)
+    except OSError as e:  # corrupt/foreign-arch .so — rebuild once, then give up
+        try:
+            os.unlink(lib)
+        except OSError:
+            pass
+        _compile(src, lib)
+        try:
+            return ctypes.CDLL(lib)
+        except OSError as e2:
+            raise ImportError(
+                f"native library unloadable: {e}; after rebuild: {e2}"
+            ) from e2

@@ -1,53 +1,25 @@
-"""ctypes loader/builder for the native superstep packer (packer.cc).
+"""ctypes loader for the native superstep packer (packer.cc).
 
-Compiles on first import (g++ -O3 -shared -fPIC, rebuilt when the source
-is newer than the library) and exposes ``assign_supersteps`` with the same
-contract as the numpy fallback in superstep.py. Import fails -> the caller
-falls back to pure Python; any numerical divergence is a bug (tested
-equal in tests/test_sched.py).
+Compiled/loaded via the shared helper (``analyzer_tpu.native_build``),
+exposing ``assign_supersteps``/``assign_batches_first_fit`` with the same
+contract as the numpy fallbacks in superstep.py. Import fails -> the
+caller falls back to pure Python; any numerical divergence is a bug
+(tested equal in tests/test_sched.py).
 """
 
 from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import tempfile
 
 import numpy as np
 
+from analyzer_tpu.native_build import build_and_load
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "packer.cc")
-_LIB = os.path.join(_DIR, "_packer.so")
-
-
-def _build() -> None:
-    # Atomic: compile to a temp name, rename over. Concurrent importers
-    # either see the finished .so or rebuild harmlessly.
-    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
-    os.close(fd)
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
-            check=True,
-            capture_output=True,
-        )
-        os.replace(tmp, _LIB)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-
-
-if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
-    try:
-        _build()
-    except (subprocess.CalledProcessError, OSError) as e:
-        # OSError covers FileNotFoundError (no g++) and PermissionError
-        # (read-only package dir) — all must surface as ImportError so the
-        # caller's numpy fallback engages instead of crashing.
-        raise ImportError(f"native packer build failed: {e}") from e
-
-_lib = ctypes.CDLL(_LIB)
+_lib = build_and_load(
+    os.path.join(_DIR, "packer.cc"), os.path.join(_DIR, "_packer.so")
+)
 _lib.assign_supersteps.argtypes = [
     ctypes.POINTER(ctypes.c_int32),
     ctypes.c_int64,
