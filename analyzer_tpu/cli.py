@@ -114,7 +114,7 @@ def cmd_rate(args) -> int:
     from analyzer_tpu.config import RatingConfig
     from analyzer_tpu.core.state import PlayerState
     from analyzer_tpu.io.checkpoint import load_checkpoint, save_checkpoint
-    from analyzer_tpu.sched import pack_schedule, rate_history
+    from analyzer_tpu.sched import pack_schedule, rate_history, rate_stream
     from analyzer_tpu.utils import PhaseTimer, trace
 
     cfg = RatingConfig.from_env()
@@ -148,6 +148,21 @@ def cmd_rate(args) -> int:
         )
     else:
         state = PlayerState.create(n_players, cfg=cfg)
+    if not args.checkpoint and args.stop_after_steps is None:
+        # No snapshots to coordinate: take the fully-streamed path —
+        # schedule assignment runs on a worker thread and overlaps the
+        # device scan (sched.rate_stream).
+        import types
+
+        stats: dict = {}
+        with timer.phase("rate"), trace(args.trace):
+            state, _ = rate_stream(state, stream, cfg, stats_out=stats)
+            np.asarray(state.table[:1])
+        sched_view = types.SimpleNamespace(
+            n_steps=stats["n_steps"], occupancy=stats["occupancy"]
+        )
+        print(_rate_stats(stream, cursor, n_players, state, sched_view, timer))
+        return 0
     with timer.phase("pack"):
         # Windowed: the big gather tensors materialize inside the runner's
         # prefetch loop, overlapped with the device scan.

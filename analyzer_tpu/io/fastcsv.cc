@@ -24,13 +24,22 @@
 
 namespace {
 
-// Parses a non-negative integer, advancing *p. Returns -1 if no digits.
+// Parses a non-negative integer, advancing *p. Returns -1 if no digits
+// or the value exceeds INT32_MAX — ids wrap to negative in the int32
+// output and would silently read as empty padding slots downstream,
+// where the python parser raises OverflowError; rejecting here routes
+// corrupt data to that loud path.
 inline int64_t parse_uint(const char** p, const char* end) {
   const char* s = *p;
   int64_t v = 0;
   bool any = false;
   while (s < end && *s >= '0' && *s <= '9') {
     v = v * 10 + (*s - '0');
+    if (v > INT32_MAX) {  // also bounds the digit run before int64 overflow
+      while (s < end && *s >= '0' && *s <= '9') ++s;
+      *p = s;
+      return -1;
+    }
     ++s;
     any = true;
   }

@@ -178,9 +178,13 @@ def rate_stream(
     steps_per_chunk: int | None = None,
     poll_interval: float = 0.002,
     team_size: int | None = None,
+    stats_out: dict | None = None,
 ) -> tuple[PlayerState, HistoryOutputs | None]:
     """Rates a raw MatchStream with the schedule built CONCURRENTLY with
-    the device scan — the fully-streamed feed.
+    the device scan — the fully-streamed feed. ``stats_out`` (optional
+    dict) receives n_steps / batch_size / occupancy after the run — the
+    schedule never exists as one object here, so these are the only
+    schedule-level observables.
 
     ``rate_history`` overlaps window *materialization* with the scan but
     still pays the whole first-fit assignment as a sequential prefix
@@ -232,6 +236,8 @@ def rate_stream(
     pad_row = state.pad_row
     state = jax.tree.map(jnp.copy, state)
     if n == 0:
+        if stats_out is not None:
+            stats_out.update(n_steps=0, batch_size=0, occupancy=0.0)
         return state, (_gather_outputs([], np.empty(0, np.int32), 0, team)
                        if collect else None)
     if int(stream.player_idx.max()) >= pad_row:
@@ -363,6 +369,10 @@ def rate_stream(
     while emitted < s_total:
         emit(min(emitted + spc, s_total))
 
+    if stats_out is not None:
+        stats_out.update(
+            n_steps=s_total, batch_size=b, occupancy=n / (s_total * b)
+        )
     if not collect:
         return state, None
     flat_idx = slot_map[: s_total * b]

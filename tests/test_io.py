@@ -1,6 +1,7 @@
 """IO: synthetic stream properties, CSV roundtrip, checkpoint roundtrip."""
 
 import numpy as np
+import pytest
 
 from analyzer_tpu.core import constants
 from analyzer_tpu.core.state import PlayerState
@@ -126,6 +127,21 @@ class TestNativeCsv:
         # quoted field — outside the fast path's grammar
         bad = b'match_id,mode,winner,afk,team0,team1\n0,"ranked",0,0,1;2;3,4;5;6\n'
         assert _native_csv.parse_stream_csv(bad, list(constants.MODES), 16) is None
+
+    def test_out_of_int32_ids_rejected_to_python_path(self):
+        """Ids above INT32_MAX must not wrap negative (= silently absent
+        player); the fast path rejects the row so the python parser's
+        OverflowError surfaces the corrupt data (review round 2)."""
+        from analyzer_tpu.io import _native_csv
+        from analyzer_tpu.io.csv_codec import load_stream_csv
+        from analyzer_tpu.core import constants
+
+        bad = b"0,ranked,1,0,3000000000;2;3,4;5;6\n"
+        assert _native_csv.parse_stream_csv(bad, list(constants.MODES), 16) is None
+        import io as _io
+
+        with pytest.raises(OverflowError):
+            load_stream_csv(_io.StringIO(bad.decode()))
 
     def test_no_header_and_no_trailing_newline(self):
         from analyzer_tpu.io import _native_csv

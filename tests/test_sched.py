@@ -195,6 +195,62 @@ class TestFirstFit:
         assert sched.occupancy > 0.8, sched.occupancy
 
 
+class TestScheduleProperties:
+    """Randomized sweep of the scheduler invariants across workload shapes
+    (seeds x concentrations x capacities): every match packed exactly
+    once, no player twice per step, per-player chronology strict, and the
+    streamed runner equal to the offline one."""
+
+    @pytest.mark.parametrize("seed,conc,cap", [
+        (101, 0.3, 8), (102, 1.5, 8), (103, 0.8, 1),
+        (104, 2.0, 64), (105, 0.0, 16),
+    ])
+    def test_invariants(self, seed, conc, cap):
+        players = synthetic_players(50, seed=seed)
+        stream = synthetic_stream(
+            250, players, seed=seed, activity_concentration=conc,
+            afk_rate=0.1, unsupported_rate=0.05,
+        )
+        state = PlayerState.create(50, skill_tier=players.skill_tier)
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=cap)
+
+        # completeness: each stream index appears exactly once
+        seen = sched.match_idx[sched.match_idx >= 0]
+        assert sorted(seen.tolist()) == list(range(stream.n_matches))
+
+        # conflict-freedom within each step
+        for s in range(sched.n_steps):
+            ids = sched.player_idx[s][sched.valid_slots[s]]
+            assert len(np.unique(ids)) == len(ids), f"collision step {s}"
+
+        # chronology: in STREAM order, each ratable match of a player
+        # lands in a strictly later step than their previous one
+        step_of = np.full(stream.n_matches, -1, np.int64)
+        si, bi = np.nonzero(sched.match_idx >= 0)
+        step_of[sched.match_idx[si, bi]] = si
+        last_step = {}
+        for m in range(stream.n_matches):
+            if not stream.ratable[m]:
+                continue
+            for p in stream.player_idx[m].ravel():
+                if p < 0:
+                    continue
+                assert last_step.get(int(p), -1) < step_of[m], (
+                    f"player {p} out of order at stream match {m}"
+                )
+                last_step[int(p)] = step_of[m]
+
+        from analyzer_tpu.sched import rate_stream
+
+        base, _ = rate_history(state, sched, CFG)
+        got, _ = rate_stream(state, stream, CFG, batch_size=cap,
+                             steps_per_chunk=6)
+        np.testing.assert_array_equal(
+            np.asarray(base.table)[:-1], np.asarray(got.table)[:-1],
+            err_msg=f"seed={seed} conc={conc} cap={cap}",
+        )
+
+
 class TestPacking:
     def test_batches_conflict_free_and_complete(self):
         stream, state = small_stream()
