@@ -3,8 +3,11 @@
 The reference's IO edge is RabbitMQ + MySQL (``worker.py:85-199``); its
 "checkpoint" is the database itself (every batch commit persists all player
 state — SURVEY.md section 5.4). Here the HBM-resident state is volatile, so
-this package provides the replacements: synthetic and CSV match streams for
-feeding the scheduler, and explicit state snapshots with a resume cursor.
+this package provides the replacements: synthetic match streams
+(alias-method sampling), CSV interchange with a native single-pass scanner
+(fastcsv.cc, ~30x the csv module; python fallback), binary .npz streams
+for bulk interchange, and explicit state snapshots with match + superstep
+cursors and a schedule fingerprint.
 """
 
 from analyzer_tpu.io.synthetic import synthetic_stream, synthetic_players

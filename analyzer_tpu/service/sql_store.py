@@ -106,10 +106,15 @@ def _connect(uri: str):
 class SqlStore:
     """Match store over a SQL database, satisfying the worker's store
     protocol (``load_batch``, ``asset_urls``) plus the transactional
-    ``commit``/``rollback`` the reference performs per batch."""
+    ``commit``/``rollback`` the reference performs per batch.
 
-    def __init__(self, uri: str) -> None:
+    ``chunk_size`` bounds per-query row batches (the IN-list split in
+    ``_select_in``) — the DB-API analog of the reference's
+    ``yield_per(CHUNKSIZE)`` row streaming (``worker.py:19,191``)."""
+
+    def __init__(self, uri: str, chunk_size: int = 100) -> None:
         self.uri = uri
+        self.chunk_size = max(int(chunk_size), 1)
         self.conn, self._paramstyle, self._dialect = _connect(uri)
         self.columns = self._reflect()
         missing = [t for t in REQUIRED_TABLES if t not in self.columns]
@@ -167,12 +172,13 @@ class SqlStore:
             return []
         cols = list(cols)
         cur = self.conn.cursor()
-        # Chunk the IN list defensively (the reference bounds per-query row
-        # streaming with yield_per(CHUNKSIZE)=100, worker.py:191; huge IN
-        # lists are the DB-API analog of that concern).
+        # Chunk the IN list (the reference bounds per-query row streaming
+        # with yield_per(CHUNKSIZE), worker.py:19,191; huge IN lists are
+        # the DB-API analog of that concern).
+        step = self.chunk_size
         rows: list[tuple] = []
-        for i in range(0, len(values), 500):
-            chunk = values[i : i + 500]
+        for i in range(0, len(values), step):
+            chunk = values[i : i + step]
             sql = (
                 f"SELECT {', '.join(self._q(c) for c in cols)} "
                 f"FROM {self._q(table)} "
@@ -183,9 +189,15 @@ class SqlStore:
             cur.execute(sql, chunk)
             rows.extend(cur.fetchall())
         cur.close()
-        if order_by and len(values) > 500:
+        if order_by and len(values) > step:
             idx = cols.index(order_by)
-            rows.sort(key=lambda r: r[idx])
+            # NULL-safe merge of the per-chunk ORDER BYs: None cannot be
+            # compared to str/datetime in python; sqlite sorts NULLs
+            # first, so mirror that.
+            # Tuple keys never compare the second element across the
+            # None/non-None boundary (the bool decides), and equal Nones
+            # need no ordering call.
+            rows.sort(key=lambda r: (r[idx] is not None, r[idx]))
         return rows
 
     # -- store protocol ---------------------------------------------------

@@ -175,7 +175,7 @@ def main() -> None:
     if config.database_uri:
         from analyzer_tpu.service.sql_store import SqlStore
 
-        store = SqlStore(config.database_uri)
+        store = SqlStore(config.database_uri, chunk_size=config.chunk_size)
     else:
         from analyzer_tpu.service.store import InMemoryStore
 

@@ -150,6 +150,25 @@ class TestLoad:
         assert part.participant_items[0].any_afk in (0, None, False)
         assert bool(m.rosters[0].winner) != bool(m.rosters[1].winner)
 
+    def test_chunked_load_preserves_order(self, db_path):
+        # chunk_size=1 forces one query per id; the cross-chunk re-sort
+        # must still deliver created_at ASC (worker.py:176)
+        store = SqlStore(f"sqlite:///{db_path}", chunk_size=1)
+        matches = store.load_batch(["m0", "m2", "m1"])
+        assert [m.api_id for m in matches] == ["m2", "m1", "m0"]
+        assert len(matches[0].participants) == 6
+
+    def test_chunked_load_null_created_at(self, db_path):
+        # NULL created_at rows must sort first (sqlite ASC semantics)
+        # across the python chunk merge, not TypeError the batch load.
+        db = sqlite3.connect(db_path)
+        db.execute("UPDATE match SET created_at = NULL WHERE api_id = 'm1'")
+        db.commit()
+        db.close()
+        store = SqlStore(f"sqlite:///{db_path}", chunk_size=1)
+        matches = store.load_batch(["m0", "m1", "m2"])
+        assert [m.api_id for m in matches] == ["m1", "m2", "m0"]
+
     def test_unknown_ids_skipped(self, db_path):
         store = SqlStore(f"sqlite:///{db_path}")
         assert [m.api_id for m in store.load_batch(["nope", "m1"])] == ["m1"]
