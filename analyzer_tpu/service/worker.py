@@ -82,10 +82,24 @@ class Worker:
             return True
         return False
 
-    def run(self, max_flushes: int | None = None, poll_interval: float = 0.01) -> None:
-        """Blocking consume loop (the reference's ``start_consuming``)."""
+    def run(
+        self,
+        max_flushes: int | None = None,
+        poll_interval: float = 0.01,
+        max_wall_s: float | None = None,
+    ) -> None:
+        """Blocking consume loop (the reference's ``start_consuming``).
+        ``max_wall_s`` bounds a ``max_flushes`` run in wall-clock time so
+        a test against a mis-seeded broker fails loudly instead of
+        spinning forever."""
         flushes = 0
+        deadline = None if max_wall_s is None else self.clock() + max_wall_s
         while max_flushes is None or flushes < max_flushes:
+            if deadline is not None and self.clock() > deadline:
+                raise TimeoutError(
+                    f"worker made {flushes}/{max_flushes} flushes in "
+                    f"{max_wall_s}s"
+                )
             if self.poll():
                 flushes += 1
             else:
@@ -163,11 +177,15 @@ class Worker:
         return self.matches_rated / dt if dt > 0 else 0.0
 
 
-def main() -> None:
+def main(max_flushes: int | None = None) -> Worker:
     """``python -m analyzer_tpu.service.worker`` — the reference's
     ``python3 worker.py`` entry point (``worker.py:219-221``), requiring a
     live RabbitMQ (pika installed) to be useful. Embedded/in-process use
-    goes through Worker(InMemoryBroker(), InMemoryStore()) instead."""
+    goes through Worker(InMemoryBroker(), InMemoryStore()) instead.
+    ``max_flushes`` bounds the consume loop (tests; None = forever like
+    the reference's ``start_consuming``; bounded runs get a 60 s
+    wall-clock deadline so they fail loudly rather than spin). Returns
+    the Worker for inspection after a bounded run."""
     config = ServiceConfig.from_env()
     from analyzer_tpu.service.broker import make_pika_broker
 
@@ -180,7 +198,12 @@ def main() -> None:
         from analyzer_tpu.service.store import InMemoryStore
 
         store = InMemoryStore()
-    Worker(broker, store, config).run()
+    worker = Worker(broker, store, config)
+    worker.run(
+        max_flushes=max_flushes,
+        max_wall_s=None if max_flushes is None else 60.0,
+    )
+    return worker
 
 
 if __name__ == "__main__":

@@ -148,6 +148,44 @@ class TestPikaAdapter:
         assert store.matches["m0"].trueskill_quality is not None
 
 
+class TestMainEntryPoint:
+    def test_main_wires_pika_and_sql_store(self, stub_pika, tmp_path, monkeypatch):
+        """The reference's __main__ path end-to-end: env config -> pika
+        broker -> SqlStore -> one bounded consume loop rates a published
+        match and commits it."""
+        from tests.test_sql_store import seed_db
+
+        db = str(tmp_path / "vg.db")
+        seed_db(db, n_matches=1)
+        monkeypatch.setenv("DATABASE_URI", f"sqlite:///{db}")
+        monkeypatch.setenv("BATCHSIZE", "1")
+        monkeypatch.setenv("IDLE_TIMEOUT", "0")
+        from analyzer_tpu.service.worker import main
+
+        # main() creates its own connection (the stub gives each
+        # BlockingConnection its own channel), so seed the queue on the
+        # very broker main() builds:
+        import analyzer_tpu.service.broker as broker_mod
+
+        orig = broker_mod.make_pika_broker
+
+        def seeded(uri):
+            b = orig(uri)
+            b.publish("analyze", b"m0")
+            return b
+
+        monkeypatch.setattr(broker_mod, "make_pika_broker", seeded)
+        worker = main(max_flushes=1)
+        assert worker.matches_rated == 1
+        import sqlite3
+
+        conn = sqlite3.connect(db)
+        assert conn.execute(
+            "SELECT trueskill_mu FROM player WHERE api_id='p0'"
+        ).fetchone()[0] is not None
+        conn.close()
+
+
 class TestNoPika:
     def test_cmd_worker_raises_cleanly_without_pika(self, monkeypatch):
         monkeypatch.delenv("DATABASE_URI", raising=False)
