@@ -127,6 +127,12 @@ def cmd_rate(args) -> int:
             print(f"error: --{flag.replace('_', '-')} must be positive",
                   file=sys.stderr)
             return 2
+    if args.checkpoint_every and not args.checkpoint:
+        # Silently writing nothing would defeat the flag's whole purpose
+        # (crash blast radius); --stop-after-steps alone stays legal as a
+        # bounded smoke run (stats only, state discarded).
+        print("error: --checkpoint-every requires --checkpoint", file=sys.stderr)
+        return 2
     if args.mesh is not None and args.mesh < 0:
         print("error: --mesh must be >= 0 (0 = all devices)", file=sys.stderr)
         return 2
@@ -219,6 +225,7 @@ def _rate_mesh(args, cfg, timer) -> int:
     from analyzer_tpu.core.state import PlayerState
     from analyzer_tpu.io.checkpoint import load_checkpoint, save_checkpoint
     from analyzer_tpu.parallel import (
+        assert_processes_agree,
         initialize_distributed,
         make_mesh,
         rate_history_sharded,
@@ -240,6 +247,14 @@ def _rate_mesh(args, cfg, timer) -> int:
         state, cursor, start_step = ck.state, ck.cursor, ck.step_cursor
     else:
         state = PlayerState.create(n_players, cfg=cfg)
+    # Every process must hold identical inputs before any is fed into the
+    # sharded table — a stale checkpoint copy or divergent stream file on
+    # one host would be silently wrong, not crash.
+    assert_processes_agree(
+        "rate --mesh inputs", state.table, stream.player_idx,
+        stream.winner, stream.mode_id, stream.afk, np.int64(cursor),
+        np.int64(start_step),
+    )
     mesh = make_mesh(args.mesh or None)  # 0 = all (global) devices
     n_dev = int(mesh.devices.size)
     with timer.phase("pack"):

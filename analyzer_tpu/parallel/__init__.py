@@ -22,7 +22,11 @@ from analyzer_tpu.parallel.mesh import (
     rate_history_sharded,
     sharded_step_fn,
 )
-from analyzer_tpu.parallel.multihost import initialize_distributed, process_slice
+from analyzer_tpu.parallel.multihost import (
+    assert_processes_agree,
+    initialize_distributed,
+    process_slice,
+)
 
 __all__ = [
     "Routing",
@@ -30,6 +34,7 @@ __all__ = [
     "make_mesh",
     "rate_history_sharded",
     "sharded_step_fn",
+    "assert_processes_agree",
     "initialize_distributed",
     "process_slice",
 ]

@@ -377,10 +377,21 @@ def rate_history_sharded(
             # a cross-process collective, so the hook must either call it
             # on every process or on none (its cadence decision is a pure
             # function of next_step — see cli._checkpoint_hook), and
-            # skipped chunks don't pay the gather. No donation on
-            # unshard, so `table` stays valid for the next chunk.
-            def snapshot(_t=table):
+            # skipped chunks don't pay the gather. The thunk must be
+            # consumed INSIDE the hook: the captured buffer is donated to
+            # the next chunk's step_fn, so deferred evaluation would be a
+            # use-after-donate — it raises loudly instead.
+            live = [True]
+
+            def snapshot(_t=table, _live=live):
+                if not _live[0]:
+                    raise RuntimeError(
+                        "snapshot thunk evaluated after on_chunk returned; "
+                        "the table buffer it captures is donated to the "
+                        "next chunk — consume it inside the hook"
+                    )
                 return dataclasses.replace(state, table=unshard(_t))
 
             on_chunk(snapshot, min(start + steps_per_chunk, n_steps))
+            live[0] = False
     return dataclasses.replace(state, table=unshard(table))

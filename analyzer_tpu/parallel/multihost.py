@@ -62,6 +62,39 @@ def initialize_distributed(
     return True
 
 
+def assert_processes_agree(label: str, *arrays) -> None:
+    """Verifies every process holds identical host-side inputs (digests
+    compared via a cross-process collective). No-op single-process.
+
+    The multi-host feed contract assumes each host computed the SAME
+    stream/state (deterministic packing from identical files); a stale
+    NFS copy of a checkpoint on one host would otherwise materialize a
+    globally inconsistent sharded table and produce silently wrong
+    ratings. Digest-compare is cheap (20 bytes over DCN) regardless of
+    array sizes."""
+    if jax.process_count() == 1:
+        return
+    import hashlib
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    h = hashlib.sha1()
+    for a in arrays:
+        h.update(np.ascontiguousarray(np.asarray(a)).tobytes())
+    digest = np.frombuffer(h.digest(), dtype=np.uint8).astype(np.int32)
+    try:
+        multihost_utils.assert_equal(
+            digest, f"{label}: processes disagree on host inputs"
+        )
+    except AssertionError as e:
+        raise RuntimeError(
+            f"{label}: host inputs differ across processes (stale checkpoint "
+            "copy / divergent stream file?) — aborting before feeding an "
+            "inconsistent sharded table"
+        ) from e
+
+
 def process_slice(n: int) -> slice:
     """This process's contiguous shard of an ``n``-item host-side feed
     (schedule chunks, CSV rows): process i of P gets [i*n/P, (i+1)*n/P)."""

@@ -125,9 +125,10 @@ def rate_history(
     if not collect:
         return state, None
 
-    team = sched.host_window(0, 1)[0].shape[-1]
     flat_idx = sched.match_idx[start_step:n_steps].reshape(-1)
-    return state, _gather_outputs(outs, flat_idx, sched.n_matches, team)
+    return state, _gather_outputs(
+        outs, flat_idx, sched.n_matches, sched.team_size
+    )
 
 
 def _gather_outputs(
@@ -295,13 +296,17 @@ def rate_stream(
         if p <= done_m:
             return
         nb = out_b[done_m:p]
-        unwritten = np.flatnonzero(nb == sentinel)
+        ns = out_s[done_m:p]
+        # Trim at the first entry where EITHER buffer still shows the
+        # sentinel: without acquire loads, out_b[i] can be visible while
+        # out_s[i] is not (and vice versa) on weakly-ordered CPUs.
+        unwritten = np.flatnonzero((nb == sentinel) | (ns == sentinel))
         if unwritten.size:
             p = done_m + int(unwritten[0])
-            nb = out_b[done_m:p]
             if p <= done_m:
                 return
-        ns = out_s[done_m:p]
+            nb = out_b[done_m:p]
+            ns = out_s[done_m:p]
         live = nb >= 0
         if live.any():
             grow(int(nb[live].max()) + 1)

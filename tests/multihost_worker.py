@@ -40,6 +40,21 @@ def main() -> int:
     cfg = RatingConfig()
     players = synthetic_players(50, seed=19)
     stream = synthetic_stream(150, players, seed=19)
+
+    # Cross-process input agreement: identical arrays pass...
+    from analyzer_tpu.parallel import assert_processes_agree
+
+    assert_processes_agree("worker inputs", stream.player_idx, stream.winner)
+    # ...and divergent ones must raise on every process.
+    poisoned = stream.winner.copy()
+    if process_id == 1:
+        poisoned[0] ^= 1
+    try:
+        assert_processes_agree("poisoned", poisoned)
+        print(f"proc {process_id}: POISONED AGREEMENT NOT DETECTED", file=sys.stderr)
+        return 1
+    except RuntimeError:
+        pass
     state = PlayerState.create(
         50,
         rank_points_ranked=players.rank_points_ranked,

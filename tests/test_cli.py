@@ -186,6 +186,11 @@ class TestCli:
         assert main(["rate", "--csv", csv, "--checkpoint", ck, "--mesh", "2",
                      "--resume"]) == 2
 
+    def test_checkpoint_every_requires_checkpoint(self, tmp_path, capsys):
+        csv = str(tmp_path / "s.csv")
+        run(capsys, "synth", "--matches", "10", "--players", "12", "--out", csv)
+        assert main(["rate", "--csv", csv, "--checkpoint-every", "4"]) == 2
+
     def test_resume_requires_checkpoint(self, tmp_path, capsys):
         csv = str(tmp_path / "s.csv")
         run(capsys, "synth", "--matches", "10", "--players", "12", "--out", csv)
