@@ -169,6 +169,66 @@ class TestFailurePolicy:
         assert afk.trueskill_quality == 0  # AFK gate ran, no KeyError
 
 
+class TestCompetingConsumers:
+    """The reference's scale-out topology (SURVEY.md section 2.5): N
+    workers on one durable queue, the broker load-balancing match ids,
+    shared state living in the store. Never tested upstream — it was an
+    operational property of AMQP. Here two Workers alternate polls on one
+    InMemoryBroker/InMemoryStore."""
+
+    def test_two_workers_split_the_queue(self):
+        broker = InMemoryBroker()
+        store = InMemoryStore()
+        cfg = ServiceConfig(batch_size=2, idle_timeout=0.0)
+        w1 = Worker(broker, store, cfg, RatingConfig())
+        w2 = Worker(broker, store, cfg, RatingConfig())
+        # 8 matches over disjoint player pools -> no cross-batch races
+        for i in range(8):
+            players = [
+                fake_player(skill_tier=15, api_id=f"m{i}-p{j}") for j in range(6)
+            ]
+            store.add_match(mk_match(f"m{i}", created_at=i, players=players))
+            broker.publish("analyze", f"m{i}".encode())
+        while broker.qsize("analyze"):
+            w1.poll()
+            w2.poll()
+        assert w1.matches_rated + w2.matches_rated == 8
+        assert w1.matches_rated > 0 and w2.matches_rated > 0  # both consumed
+        for i in range(8):
+            m = store.matches[f"m{i}"]
+            assert m.trueskill_quality is not None
+            winners = m.rosters[0].participants
+            losers = m.rosters[1].participants
+            assert all(
+                w.player[0].trueskill_mu > l.player[0].trueskill_mu
+                for w in winners for l in losers
+            )
+
+    def test_shared_player_across_workers_last_commit_wins(self):
+        """Two workers racing on a shared player mirror the reference's
+        unguarded DB race (last-commit-wins, SURVEY.md section 3.2) — the
+        batches each rate from the priors they loaded; whichever commits
+        last sets the player row. The EXACT path (conflict-free
+        supersteps) is the mesh runner; the service shell keeps the
+        reference's semantics."""
+        broker = InMemoryBroker()
+        store = InMemoryStore()
+        cfg = ServiceConfig(batch_size=1, idle_timeout=0.0)
+        w1 = Worker(broker, store, cfg, RatingConfig())
+        w2 = Worker(broker, store, cfg, RatingConfig())
+        shared = [fake_player(skill_tier=15, api_id=f"s{j}") for j in range(6)]
+        store.add_match(mk_match("m0", created_at=0, players=shared))
+        store.add_match(mk_match("m1", created_at=1, players=shared))
+        broker.publish("analyze", b"m0")
+        broker.publish("analyze", b"m1")
+        w1.poll()  # takes m0
+        w2.poll()  # takes m1 — loads priors AFTER w1's write-back
+        assert w1.matches_rated == 1 and w2.matches_rated == 1
+        # sequential polls here mean w2 saw w1's posteriors: two updates
+        mu = shared[0].trueskill_mu
+        assert mu is not None and mu > 2100  # two wins worth of movement
+
+
 class TestFanOut:
     def test_notify_crunch_sew_telesuck(self, rig):
         broker, store, _ = rig
