@@ -295,6 +295,7 @@ def rate_history_sharded(
     start_step: int = 0,
     stop_after: int | None = None,
     on_chunk=None,
+    routing: Routing | None = None,
 ) -> PlayerState:
     """Full-history re-rate, data-parallel over the mesh. Returns final state.
 
@@ -307,7 +308,10 @@ def rate_history_sharded(
     multi-host hook must call it on every process or on none (make the
     decision a pure function of ``next_step``); skipped chunks pay
     nothing. One cross-mesh gather + device sync per taken snapshot is
-    the price of a bounded crash blast radius.
+    the price of a bounded crash blast radius. ``routing`` lets callers
+    reuse a precomputed :func:`build_routing` across calls (benchmarks,
+    resumed runs on the same schedule); it is validated against the mesh
+    and table shape.
     """
     mesh = mesh or make_mesh()
     n_dev = mesh.devices.size
@@ -329,7 +333,14 @@ def rate_history_sharded(
         )
 
     n_rows = state.table.shape[0]
-    routing = build_routing(sched, n_rows, n_dev)
+    if routing is None:
+        routing = build_routing(sched, n_rows, n_dev)
+    elif routing.n_shards != n_dev or routing.rows_per_shard * n_dev < n_rows:
+        raise ValueError(
+            f"routing was built for {routing.n_shards} shards x "
+            f"{routing.rows_per_shard} rows; mesh has {n_dev} devices and "
+            f"the table {n_rows} rows"
+        )
     rps = routing.rows_per_shard
     step_fn = sharded_step_fn(mesh, cfg, rps)
 
