@@ -307,7 +307,9 @@ def cmd_elo(args) -> int:
     from analyzer_tpu.sched import pack_schedule
 
     stream, n_players = _load_stream(args.csv)
-    sched = pack_schedule(stream, pad_row=n_players)
+    # Windowed: elo_history consumes device_arrays/match_idx only, so the
+    # gather tensors materialize lazily here too.
+    sched = pack_schedule(stream, pad_row=n_players, windowed=True)
     ratings, expected = elo_history(sched, n_players)
     ratable = stream.ratable
     if ratable.any():

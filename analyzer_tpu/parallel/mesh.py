@@ -335,11 +335,19 @@ def rate_history_sharded(
     n_rows = state.table.shape[0]
     if routing is None:
         routing = build_routing(sched, n_rows, n_dev)
-    elif routing.n_shards != n_dev or routing.rows_per_shard * n_dev < n_rows:
+    elif (
+        routing.n_shards != n_dev
+        or routing.rows_per_shard * n_dev < n_rows
+        or routing.sel.shape[0] != sched.n_steps
+    ):
+        # A routing from a different packing of the same stream can match
+        # on shards/rows and still scatter the wrong slots — bind it to
+        # this schedule's step count too.
         raise ValueError(
             f"routing was built for {routing.n_shards} shards x "
-            f"{routing.rows_per_shard} rows; mesh has {n_dev} devices and "
-            f"the table {n_rows} rows"
+            f"{routing.rows_per_shard} rows x {routing.sel.shape[0]} steps; "
+            f"mesh has {n_dev} devices, the table {n_rows} rows, and the "
+            f"schedule {sched.n_steps} steps"
         )
     rps = routing.rows_per_shard
     step_fn = sharded_step_fn(mesh, cfg, rps)

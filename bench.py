@@ -160,11 +160,7 @@ def main() -> None:
     log(f"end-to-end rate_stream (assignment overlapped too): {t_stream:.2f}s "
         f"= {t_stream / best:.2f}x device-only time")
 
-    mu = np.asarray(state.mu)[: state0.n_players]
-    rated = ~np.isnan(mu[:, 0])
-    log(f"sanity: {int(rated.sum())} players rated, "
-        f"mean shared mu {float(np.nanmean(mu[rated, 0])):.1f}")
-    assert np.isfinite(mu[rated, 0]).all()
+    sanity(state, state0.n_players)
 
     emit_metric(rate)
 
@@ -183,6 +179,16 @@ def time_runs(run, repeats):
         times.append(time.perf_counter() - t0)
         log(f"repeat {r}: {times[-1]:.3f}s")
     return state, min(times)
+
+
+def sanity(state, n_players, extra=""):
+    """Shared result check of both benchmark paths: finite ratings for
+    (nearly) every player, logged with the mean."""
+    mu = np.asarray(state.mu)[:n_players]
+    rated = ~np.isnan(mu[:, 0])
+    log(f"sanity: {int(rated.sum())} players rated{extra}, "
+        f"mean shared mu {float(np.nanmean(mu[rated, 0])):.1f}")
+    assert np.isfinite(mu[rated, 0]).all()
 
 
 def emit_metric(rate):
@@ -227,11 +233,7 @@ def bench_mesh(n_mesh, stream, state0, cfg, batch, repeats, t_gen):
 
     state, best = time_runs(run, repeats)
     rate = sched.n_matches / best / n_mesh
-    mu = np.asarray(state.mu)[: state0.n_players]
-    rated = ~np.isnan(mu[:, 0])
-    log(f"sanity: {int(rated.sum())} players rated over {n_mesh} chips, "
-        f"mean shared mu {float(np.nanmean(mu[rated, 0])):.1f}")
-    assert np.isfinite(mu[rated, 0]).all()
+    sanity(state, state0.n_players, extra=f" over {n_mesh} chips")
     emit_metric(rate)
 
 

@@ -77,6 +77,23 @@ class TestShardedHistory:
                 want = [(int(i), int(idx[s, i])) for i in np.flatnonzero(valid[s])]
                 assert sorted(got) == sorted(want)
 
+    def test_prebuilt_routing_reused_and_validated(self):
+        if len(jax.devices()) < 2:
+            pytest.skip("need 2 devices")
+        state, sched = setup()
+        base, _ = rate_history(state, sched, CFG)
+        mesh = make_mesh(2)
+        routing = build_routing(sched, state.table.shape[0], 2)
+        got = rate_history_sharded(state, sched, CFG, mesh=mesh, routing=routing)
+        p = state.n_players
+        np.testing.assert_array_equal(
+            np.asarray(got.table)[:p], np.asarray(base.table)[:p]
+        )
+        # mismatched routing (built for 4 shards) is rejected loudly
+        wrong = build_routing(sched, state.table.shape[0], 4)
+        with pytest.raises(ValueError, match="routing was built"):
+            rate_history_sharded(state, sched, CFG, mesh=mesh, routing=wrong)
+
     def test_caller_state_survives(self):
         # Regression: the donated sharded scan must not free the caller's
         # buffers (device_put can alias when sharding already matches).
