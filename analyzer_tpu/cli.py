@@ -10,6 +10,7 @@ stream generation, and the benchmark.
 Subcommands:
   synth   generate a synthetic match history (.csv or .npz by extension)
   rate    TrueSkill full-history re-rate of a stream (checkpoint/resume)
+  train   win-probability heads (logistic/MLP) on leak-free rating features
   elo     Elo re-rate of a stream + prediction accuracy
   bench   the headline throughput benchmark (one JSON line)
   worker  the broker-consuming service loop (needs pika)
@@ -108,6 +109,14 @@ def _rate_stats(stream, cursor, n_players, state, sched, timer, **extra) -> str:
         "phases": {k: round(v, 3) for k, v in timer.report().items()},
     }
     return json.dumps(stats)
+
+
+def _half_credit_accuracy(p: np.ndarray, team0_won: np.ndarray) -> float:
+    """Prediction accuracy with exact ties (p == 0.5, e.g. two fresh
+    teams) scoring half credit instead of silently counting as "team 0
+    predicted" — shared by the elo and train evals."""
+    hit = np.where(p == 0.5, 0.5, (p > 0.5) == (team0_won == 1.0))
+    return float(hit.mean())
 
 
 def cmd_rate(args) -> int:
@@ -313,13 +322,9 @@ def cmd_elo(args) -> int:
     ratings, expected = elo_history(sched, n_players)
     ratable = stream.ratable
     if ratable.any():
-        # Exact-tie predictions (expected == 0.5, e.g. two fresh teams)
-        # score half credit instead of silently counting as "team 1 wins".
-        exp = expected[ratable]
-        hit = np.where(
-            exp == 0.5, 0.5, (exp > 0.5) == (stream.winner[ratable] == 0)
+        acc = _half_credit_accuracy(
+            expected[ratable], (stream.winner[ratable] == 0).astype(np.float32)
         )
-        acc = float(hit.mean())
     else:
         acc = None
     if args.out:
@@ -331,6 +336,83 @@ def cmd_elo(args) -> int:
                 "players": n_players,
                 "mean_rating": round(float(ratings.mean()), 2),
                 "prediction_accuracy": round(acc, 4) if acc is not None else None,
+            }
+        )
+    )
+    return 0
+
+
+def cmd_train(args) -> int:
+    """BASELINE configs 3-4: win-probability heads over rating features.
+
+    Features are leak-free (each match's row is computed from the
+    PRE-match rating state during one scan — models/features.py), and the
+    evaluation split is CHRONOLOGICAL: train on the first (1 - eval_frac)
+    of ratable matches, evaluate on the tail, matching how a deployed
+    predictor sees time. Exact-tie predictions score half credit, like
+    cmd_elo."""
+    from analyzer_tpu.config import RatingConfig
+    from analyzer_tpu.core.state import PlayerState
+    from analyzer_tpu.models import history_features, train_logistic, train_mlp
+    from analyzer_tpu.sched import pack_schedule
+    from analyzer_tpu.utils import PhaseTimer
+
+    if not (0.0 <= args.eval_frac < 1.0):
+        print("error: --eval-frac must be in [0, 1)", file=sys.stderr)
+        return 2
+    cfg = RatingConfig.from_env()
+    timer = PhaseTimer()
+    with timer.phase("load"):
+        stream, n_players = _load_stream(args.csv)
+    state = PlayerState.create(n_players, cfg=cfg)
+    with timer.phase("features"):
+        sched = pack_schedule(stream, pad_row=state.pad_row, windowed=True)
+        feats, ratable, _ = history_features(state, sched, cfg)
+    y = (stream.winner == 0).astype(np.float32)
+    rows = np.flatnonzero(ratable)  # stream order
+    if rows.size < 10:
+        print("error: too few ratable matches to train on", file=sys.stderr)
+        return 2
+    cut = max(1, int(rows.size * (1.0 - args.eval_frac)))
+    tr, ev = rows[:cut], rows[cut:]
+    with timer.phase("train"):
+        if args.model == "logistic":
+            model, nll = train_logistic(
+                feats[tr], y[tr], epochs=args.epochs, seed=args.seed
+            )
+        else:
+            model, nll = train_mlp(
+                feats[tr], y[tr], hidden=args.hidden,
+                epochs=args.epochs, seed=args.seed,
+            )
+    p = np.asarray(model.predict(feats[ev])) if ev.size else np.empty(0)
+    if ev.size:
+        acc = _half_credit_accuracy(p, y[ev])
+        eps = 1e-7
+        logloss = float(
+            -np.mean(
+                y[ev] * np.log(p + eps) + (1 - y[ev]) * np.log(1 - p + eps)
+            )
+        )
+    else:
+        acc = logloss = None
+    if args.out:
+        np.savez(
+            args.out,
+            model=args.model,
+            **{k: np.asarray(v) for k, v in vars(model).items()},
+        )
+    print(
+        json.dumps(
+            {
+                "model": args.model,
+                "matches": stream.n_matches,
+                "trained_on": int(tr.size),
+                "eval_on": int(ev.size),
+                "train_nll": round(float(nll), 4),
+                "eval_accuracy": round(acc, 4) if acc is not None else None,
+                "eval_logloss": round(logloss, 4) if logloss is not None else None,
+                "phases": {k: round(v, 3) for k, v in timer.report().items()},
             }
         )
     )
@@ -401,6 +483,21 @@ def main(argv=None) -> int:
         "NUM_PROCESSES/PROCESS_ID and run on every host)",
     )
     s.set_defaults(fn=cmd_rate)
+
+    s = sub.add_parser(
+        "train",
+        help="win-probability heads (logistic/MLP) on leak-free rating "
+        "features, chronological holdout eval",
+    )
+    s.add_argument("--csv", required=True, help="match stream, .csv or .npz")
+    s.add_argument("--model", choices=("logistic", "mlp"), default="logistic")
+    s.add_argument("--epochs", type=int, default=30)
+    s.add_argument("--hidden", type=int, default=64, help="MLP width")
+    s.add_argument("--eval-frac", type=float, default=0.2,
+                   help="chronological tail fraction held out for eval")
+    s.add_argument("--seed", type=int, default=0)
+    s.add_argument("--out", help="npz output for the trained weights")
+    s.set_defaults(fn=cmd_train)
 
     s = sub.add_parser("elo", help="Elo re-rate of a CSV + accuracy")
     s.add_argument("--csv", required=True)

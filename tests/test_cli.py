@@ -33,6 +33,25 @@ class TestCli:
         assert elo["matches"] == 200
         assert elo["prediction_accuracy"] is not None
 
+    def test_train_both_heads(self, tmp_path, capsys):
+        """BASELINE configs 3-4 from the CLI: leak-free features,
+        chronological holdout, better-than-chance accuracy, weights out."""
+        csv = str(tmp_path / "s.csv")
+        run(capsys, "synth", "--matches", "600", "--players", "80", "--out", csv)
+        out = str(tmp_path / "w.npz")
+        line = run(capsys, "train", "--csv", csv, "--model", "logistic",
+                   "--epochs", "40", "--out", out)
+        stats = json.loads(line)
+        assert stats["trained_on"] + stats["eval_on"] <= 600
+        assert stats["eval_accuracy"] > 0.5  # latent-skill signal learned
+        z = np.load(out)
+        assert "w" in z.files and str(z["model"]) == "logistic"
+
+        line = run(capsys, "train", "--csv", csv, "--model", "mlp",
+                   "--epochs", "15", "--hidden", "16")
+        stats = json.loads(line)
+        assert stats["model"] == "mlp" and stats["eval_logloss"] < 0.8
+
     def test_elo_exact_ties_score_half(self, tmp_path, capsys):
         # Disjoint fresh players: every Elo prediction is exactly 0.5.
         # Accuracy must be 0.5 (half credit per tie), not 1.0 or 0.0 from
