@@ -52,6 +52,7 @@ class Worker:
         self.matches_rated = 0
         self.batches_failed = 0
         self._started_at = clock()
+        self._stop_requested = False
 
         c = self.config
         # The reference declares queue/failed/crunch/telesuck but NOT sew
@@ -82,28 +83,69 @@ class Worker:
             return True
         return False
 
+    def request_stop(self) -> None:
+        """Asks the consume loop to exit after the current batch. Safe
+        from a signal handler (single flag write). The reference has no
+        graceful shutdown at all (``worker.py:219-221`` — SIGTERM kills
+        mid-batch and relies on broker redelivery); here an in-flight
+        batch always finishes its commit + acks first."""
+        self._stop_requested = True
+
     def run(
         self,
         max_flushes: int | None = None,
         poll_interval: float = 0.01,
         max_wall_s: float | None = None,
+        install_signal_handlers: bool = False,
     ) -> None:
         """Blocking consume loop (the reference's ``start_consuming``).
         ``max_wall_s`` bounds a ``max_flushes`` run in wall-clock time so
         a test against a mis-seeded broker fails loudly instead of
-        spinning forever."""
-        flushes = 0
-        deadline = None if max_wall_s is None else self.clock() + max_wall_s
-        while max_flushes is None or flushes < max_flushes:
-            if deadline is not None and self.clock() > deadline:
-                raise TimeoutError(
-                    f"worker made {flushes}/{max_flushes} flushes in "
-                    f"{max_wall_s}s"
+        spinning forever. ``install_signal_handlers`` wires SIGTERM and
+        SIGINT to :meth:`request_stop` (main-thread only)."""
+        # NOT reset here: a stop requested before run() must be honored
+        # (it is cleared on the stop exit below so the worker is reusable).
+        previous_handlers = {}
+        if install_signal_handlers:
+            import signal
+
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                previous_handlers[sig] = signal.signal(
+                    sig, lambda *_: self.request_stop()
                 )
-            if self.poll():
-                flushes += 1
-            else:
-                time.sleep(poll_interval)
+        try:
+            flushes = 0
+            deadline = None if max_wall_s is None else self.clock() + max_wall_s
+            while max_flushes is None or flushes < max_flushes:
+                if self._stop_requested:
+                    # Messages pulled into a partial batch go back to the
+                    # broker (nack + requeue) — leaving them unacked would
+                    # strand them forever on the in-memory broker and
+                    # until connection teardown on AMQP.
+                    for msg in self.queue:
+                        self.broker.nack(msg.delivery_tag, requeue=True)
+                    self.queue = []
+                    self._first_message_at = None
+                    self._stop_requested = False
+                    logger.info(
+                        "stop requested; exiting after %s batches", flushes
+                    )
+                    return
+                if deadline is not None and self.clock() > deadline:
+                    raise TimeoutError(
+                        f"worker made {flushes}/{max_flushes} flushes in "
+                        f"{max_wall_s}s"
+                    )
+                if self.poll():
+                    flushes += 1
+                else:
+                    time.sleep(poll_interval)
+        finally:
+            if previous_handlers:
+                import signal
+
+                for sig, handler in previous_handlers.items():
+                    signal.signal(sig, handler)
 
     # -- batch pipeline ---------------------------------------------------
     def try_process(self) -> None:
@@ -202,6 +244,10 @@ def main(max_flushes: int | None = None) -> Worker:
     worker.run(
         max_flushes=max_flushes,
         max_wall_s=None if max_flushes is None else 60.0,
+        # Production loop: SIGTERM/SIGINT finish the in-flight batch
+        # (commit + acks) before exiting; bounded test runs skip the
+        # handler install (may run off the main thread).
+        install_signal_handlers=max_flushes is None,
     )
     return worker
 

@@ -229,6 +229,53 @@ class TestCompetingConsumers:
         assert mu is not None and mu > 2100  # two wins worth of movement
 
 
+class TestGracefulShutdown:
+    def test_stop_finishes_inflight_batch_then_exits(self):
+        """request_stop mid-consume: the current batch completes (commit +
+        acks), later messages stay queued for the next worker — better
+        than the reference, which has no shutdown handling at all."""
+        broker = InMemoryBroker()
+        store = InMemoryStore()
+        cfg = ServiceConfig(batch_size=1, idle_timeout=0.0)
+        worker = Worker(broker, store, cfg, RatingConfig())
+        for i in range(3):
+            store.add_match(mk_match(f"m{i}", created_at=i))
+            broker.publish("analyze", f"m{i}".encode())
+
+        orig = worker.process
+
+        def stop_after_first(ids):
+            worker.request_stop()
+            return orig(ids)
+
+        worker.process = stop_after_first
+        # unbounded flushes; exits via the stop (deadline = hang guard)
+        worker.run(max_wall_s=30)
+        assert worker.matches_rated == 1  # in-flight batch finished...
+        assert store.matches["m0"].trueskill_quality is not None
+        assert broker.qsize("analyze") == 2  # ...the rest left for others
+
+    def test_stop_requeues_partial_batch(self):
+        """A stop while a partial batch waits for the idle timer must not
+        strand its messages unacked: they are nacked back to the queue
+        for the next worker."""
+        clock = [0.0]
+        broker = InMemoryBroker()
+        store = InMemoryStore()
+        cfg = ServiceConfig(batch_size=4, idle_timeout=100.0)
+        worker = Worker(broker, store, cfg, RatingConfig(),
+                        clock=lambda: clock[0])
+        store.add_match(mk_match("m0"))
+        broker.publish("analyze", b"m0")
+        worker.poll()  # pulls m0 into the partial batch (timer not due)
+        assert broker.qsize("analyze") == 0 and len(worker.queue) == 1
+        worker.request_stop()
+        worker.run(max_wall_s=30)
+        assert worker.matches_rated == 0
+        assert broker.qsize("analyze") == 1  # requeued, not stranded
+        assert not worker.queue
+
+
 class TestFanOut:
     def test_notify_crunch_sew_telesuck(self, rig):
         broker, store, _ = rig
