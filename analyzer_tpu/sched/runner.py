@@ -205,8 +205,19 @@ def rate_stream(
     consumed data (a batch is final once its fill count reaches the
     capacity — first-fit never reopens a full batch) rather than read
     from the C loop's watermark, whose release stores would need acquire
-    loads Python can't express. ``Thread.join`` is the one trusted
+    loads Python can't express. That loses nothing: the C loop's
+    published watermark is ``find(0)`` — the first NON-FULL batch — so
+    both watermarks equal the length of the full-batch prefix and differ
+    only by publish granularity. ``Thread.join`` is the one trusted
     synchronization point, after which the buffers are read plainly.
+
+    Occupancy caveat to the wall-time claim: batches become final only
+    by FILLING, so on a chain-bound (low-occupancy) schedule whose early
+    batches never reach capacity, no windows can be emitted until the
+    assigner finishes and the feed serializes — overlap degrades toward
+    ``rate_history``'s windowed mode (which this path never does worse
+    than). No watermark scheme can do better under first-fit: a non-full
+    batch legitimately remains open to any future fresh-player match.
 
     Deterministic: window boundaries are fixed multiples of
     ``steps_per_chunk`` and fillers are consumed in stream order, so the

@@ -72,8 +72,16 @@ def _connect(uri: str):
     if scheme == "sqlite":
         import sqlite3
 
-        # sqlite:///rel.db | sqlite:////abs.db | sqlite:// (in-memory)
-        path = (parsed.netloc or "") + (parsed.path or "")
+        # sqlite:///rel.db | sqlite:////abs.db | sqlite:// (in-memory).
+        # A netloc (sqlite://host/x) is not a filesystem path — folding it
+        # into one would silently open './host/x'; reject the unsupported
+        # host form instead.
+        if parsed.netloc:
+            raise ValueError(
+                f"sqlite URIs take no host: {uri!r} (use sqlite:///rel.db "
+                "or sqlite:////abs.db)"
+            )
+        path = parsed.path or ""
         if path.startswith("/") and not path.startswith("//"):
             path = path[1:]
         elif path.startswith("//"):

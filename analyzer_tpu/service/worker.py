@@ -132,8 +132,9 @@ class Worker:
                     )
                     return
                 if deadline is not None and self.clock() > deadline:
+                    target = "" if max_flushes is None else f"/{max_flushes}"
                     raise TimeoutError(
-                        f"worker made {flushes}/{max_flushes} flushes in "
+                        f"worker made {flushes}{target} flushes in "
                         f"{max_wall_s}s"
                     )
                 if self.poll():

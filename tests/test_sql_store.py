@@ -131,6 +131,11 @@ class TestReflection:
         with pytest.raises(RuntimeError, match="required tables missing"):
             SqlStore(f"sqlite:///{path}")
 
+    def test_sqlite_host_form_rejected(self):
+        # sqlite://host/x would otherwise silently open './host/x'
+        with pytest.raises(ValueError, match="no host"):
+            SqlStore("sqlite://somehost/some.db")
+
 
 class TestLoad:
     def test_load_dedupes_and_orders_chronologically(self, db_path):
