@@ -95,6 +95,34 @@ def _checkpoint_hook(args, sched, cursor, start_step, finished, lead=True):
     return on_chunk
 
 
+def _rate_streamed(
+    args, cfg, timer, state, stream, cursor, n_players, mesh=None, **extra
+) -> int:
+    """The fully-streamed rate path shared by cmd_rate and _rate_mesh:
+    concurrent assignment feeding the device (sched.rate_stream), stats
+    reconstructed from the runner's observables (the schedule never
+    exists as one object here)."""
+    import types
+
+    from analyzer_tpu.sched import rate_stream
+    from analyzer_tpu.utils import trace
+
+    stats: dict = {}
+    with timer.phase("rate"), trace(args.trace):
+        state, _ = rate_stream(
+            state, stream.slice(cursor, stream.n_matches), cfg,
+            stats_out=stats, mesh=mesh,
+        )
+        np.asarray(state.table[:1])  # force completion for honest timing
+    sched_view = types.SimpleNamespace(
+        n_steps=stats["n_steps"], occupancy=stats["occupancy"]
+    )
+    print(
+        _rate_stats(stream, cursor, n_players, state, sched_view, timer, **extra)
+    )
+    return 0
+
+
 def _rate_stats(stream, cursor, n_players, state, sched, timer, **extra) -> str:
     """The shared stats line of the single-device and --mesh rate paths."""
     mu = np.asarray(state.mu)[:n_players, 0]
@@ -123,7 +151,7 @@ def cmd_rate(args) -> int:
     from analyzer_tpu.config import RatingConfig
     from analyzer_tpu.core.state import PlayerState
     from analyzer_tpu.io.checkpoint import load_checkpoint, save_checkpoint
-    from analyzer_tpu.sched import pack_schedule, rate_history, rate_stream
+    from analyzer_tpu.sched import pack_schedule, rate_history
     from analyzer_tpu.utils import PhaseTimer, trace
 
     cfg = RatingConfig.from_env()
@@ -167,17 +195,7 @@ def cmd_rate(args) -> int:
         # No snapshots to coordinate: take the fully-streamed path —
         # schedule assignment runs on a worker thread and overlaps the
         # device scan (sched.rate_stream).
-        import types
-
-        stats: dict = {}
-        with timer.phase("rate"), trace(args.trace):
-            state, _ = rate_stream(state, stream, cfg, stats_out=stats)
-            np.asarray(state.table[:1])
-        sched_view = types.SimpleNamespace(
-            n_steps=stats["n_steps"], occupancy=stats["occupancy"]
-        )
-        print(_rate_stats(stream, cursor, n_players, state, sched_view, timer))
-        return 0
+        return _rate_streamed(args, cfg, timer, state, stream, cursor, n_players)
     with timer.phase("pack"):
         # Windowed: the big gather tensors materialize inside the runner's
         # prefetch loop, overlapped with the device scan.
@@ -266,13 +284,33 @@ def _rate_mesh(args, cfg, timer) -> int:
     )
     mesh = make_mesh(args.mesh or None)  # 0 = all (global) devices
     n_dev = int(mesh.devices.size)
+    if (
+        not args.checkpoint
+        and args.stop_after_steps is None
+        and not distributed
+    ):
+        # No snapshots to coordinate: the fully-streamed sharded path —
+        # worker-thread assignment + per-window routing feeding the mesh
+        # (sched.rate_stream(mesh=...)). Multi-host keeps the windowed
+        # schedule below: emission timing differs per process and the
+        # deterministic schedule is what keeps hosts in lockstep there.
+        return _rate_streamed(
+            args, cfg, timer, state, stream, cursor, n_players,
+            mesh=mesh, mesh_devices=n_dev, processes=1,
+        )
     with timer.phase("pack"):
         work = stream.slice(cursor, stream.n_matches)
         # The cost model may pick a width below the mesh size on deep
-        # chain-bound ladders; the sharded batch axis needs B % D == 0.
-        b = choose_batch_size(work, batch_multiple=math.lcm(8, n_dev))
-        b = -(-b // n_dev) * n_dev
-        sched = pack_schedule(work, pad_row=state.pad_row, batch_size=b)
+        # chain-bound ladders; the sharded batch axis needs B % D == 0
+        # and lane alignment wants B % 8 == 0 — round up to the lcm.
+        m = math.lcm(8, n_dev)
+        b = choose_batch_size(work, batch_multiple=m)
+        b = -(-b // m) * m
+        # Windowed: gather tensors AND scatter routing materialize per
+        # chunk inside the sharded feed loop (O(window) host memory).
+        sched = pack_schedule(
+            work, pad_row=state.pad_row, batch_size=b, windowed=True
+        )
     if start_step and sched.fingerprint != ck.schedule_fingerprint:
         # Same rule as the single-device path — a mid-schedule cursor is
         # only valid against the identical schedule. Note the two paths

@@ -67,7 +67,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from analyzer_tpu.config import RatingConfig
 from analyzer_tpu.core.state import MatchBatch, PlayerState
 from analyzer_tpu.core.update import rate_gathered
+from analyzer_tpu.logging_utils import get_logger
 from analyzer_tpu.sched.superstep import PackedSchedule
+
+logger = get_logger(__name__)
 
 DATA_AXIS = "data"
 
@@ -124,39 +127,55 @@ def build_routing(
 
     Vectorized over the whole schedule: one stable argsort of slot->owner
     per step groups each shard's slots contiguously; ``K`` is the max
-    per-(step, shard) count so one static shape serves the whole run."""
+    per-(step, shard) count so one static shape serves the whole run.
+
+    This is the EAGER form — it needs the whole ``[S, B, 2, T]`` gather
+    tensors and holds ``[S, D, K]`` routing in host memory at once. The
+    windowed feed (:class:`ShardedRun` / ``rate_history_sharded`` over a
+    ``WindowedSchedule``) calls :func:`_window_routing` per chunk instead
+    and never materializes either; use this only to precompute routing for
+    repeated runs over the same eager schedule (benchmarks)."""
     s_steps, b = sched.match_idx.shape
     n = b * 2 * sched.player_idx.shape[-1]
     rps = -(-n_table_rows // n_shards)
-
     idx = sched.player_idx.reshape(s_steps, n).astype(np.int64)
     valid = sched.valid_slots.reshape(s_steps, n)
-    owner = np.where(valid, _owner(idx, n_shards), n_shards)  # sentinel D = "no write"
+    sel, dst = _window_routing(idx, valid, n_shards, rps)
+    return Routing(sel=sel, dst=dst, rows_per_shard=rps, n_shards=n_shards)
+
+
+def _window_routing(
+    idx_flat: np.ndarray, valid_flat: np.ndarray, n_shards: int, rps: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """The routing core on flattened ``[W, n]`` window arrays: returns
+    (sel, dst) ``[W, D, K]`` int32 at the window's exact capacity
+    ``K = max per-(step, shard) valid-slot count`` (>= 1). Padding entries
+    hold sel 0 / dst ``rps`` (out of bounds -> dropped by the scatter)."""
+    w, n = idx_flat.shape
+    owner = np.where(valid_flat, _owner(idx_flat, n_shards), n_shards)
 
     order = np.argsort(owner, axis=1, kind="stable")
     sorted_owner = np.take_along_axis(owner, order, axis=1)
-    flat = (sorted_owner + np.arange(s_steps)[:, None] * (n_shards + 1)).ravel()
-    counts = np.bincount(flat, minlength=s_steps * (n_shards + 1)).reshape(
-        s_steps, n_shards + 1
+    flat = (sorted_owner + np.arange(w)[:, None] * (n_shards + 1)).ravel()
+    counts = np.bincount(flat, minlength=w * (n_shards + 1)).reshape(
+        w, n_shards + 1
     )[:, :n_shards]
 
     k = max(int(counts.max()) if counts.size else 0, 1)
-    start = np.cumsum(counts, axis=1) - counts  # [S, D] exclusive prefix
-    pos = start[:, :, None] + np.arange(k)[None, None, :]  # [S, D, K]
+    start = np.cumsum(counts, axis=1) - counts  # [W, D] exclusive prefix
+    pos = start[:, :, None] + np.arange(k)[None, None, :]  # [W, D, K]
     in_range = np.arange(k)[None, None, :] < counts[:, :, None]
     pos = np.minimum(pos, n - 1)
-    sel = np.take_along_axis(order, pos.reshape(s_steps, -1), axis=1).reshape(
-        s_steps, n_shards, k
+    sel = np.take_along_axis(order, pos.reshape(w, -1), axis=1).reshape(
+        w, n_shards, k
     )
-    rows = np.take_along_axis(idx, sel.reshape(s_steps, -1), axis=1).reshape(
-        s_steps, n_shards, k
+    rows = np.take_along_axis(idx_flat, sel.reshape(w, -1), axis=1).reshape(
+        w, n_shards, k
     )
     dst = _local_row(rows, n_shards)
-    return Routing(
-        sel=np.where(in_range, sel, 0).astype(np.int32),
-        dst=np.where(in_range, dst, rps).astype(np.int32),
-        rows_per_shard=rps,
-        n_shards=n_shards,
+    return (
+        np.where(in_range, sel, 0).astype(np.int32),
+        np.where(in_range, dst, rps).astype(np.int32),
     )
 
 
@@ -286,9 +305,162 @@ def sharded_step_fn(mesh: Mesh, cfg: RatingConfig, rows_per_shard: int):
     return fn
 
 
+class ShardedRun:
+    """The device-side half of the sharded re-rate, factored so ANY host
+    feed — an eager :class:`PackedSchedule`, a lazy ``WindowedSchedule``
+    window loop, or ``rate_stream``'s concurrent assignment — can drive
+    the same sharded scan one window at a time with O(window) host memory.
+
+    Holds the padded, shard-major, row-sharded table plus the compiled
+    step function; :meth:`dispatch` routes and runs one ``[W, B, ...]``
+    window. Routing capacity ``K`` is bucketed (25% headroom, multiple of
+    8) so consecutive windows reuse one compiled scan; a window whose
+    per-(step, shard) count outgrows the bucket grows it — one recompile,
+    logged — and buckets never shrink.
+    """
+
+    def __init__(
+        self,
+        state: PlayerState,
+        cfg: RatingConfig,
+        mesh: Mesh,
+        routing_capacity: int | None = None,
+    ) -> None:
+        if (
+            state.seed_cfg is not None
+            and state.seed_cfg.unknown_player_sigma != cfg.unknown_player_sigma
+        ):
+            # Same contract as rate_batch (core/update.py) — checked here
+            # once because the sharded path assembles rows itself via
+            # rate_gathered.
+            raise ValueError(
+                f"state seeds were built with UNKNOWN_PLAYER_SIGMA="
+                f"{state.seed_cfg.unknown_player_sigma}, but the sharded "
+                f"rater was called with {cfg.unknown_player_sigma}; rebuild "
+                "the state via PlayerState.create(..., cfg=cfg)"
+            )
+        self.mesh = mesh
+        self.cfg = cfg
+        self.n_dev = int(mesh.devices.size)
+        self.n_rows = state.table.shape[0]
+        self.rps = -(-self.n_rows // self.n_dev)
+        self._cap = routing_capacity
+        self._state = state
+        self._step_fn = sharded_step_fn(mesh, cfg, self.rps)
+        self._batch_sh = NamedSharding(mesh, P(None, DATA_AXIS))
+        self._route_sh = NamedSharding(mesh, P(None, DATA_AXIS, None))
+
+        # Pad the table to D * rps rows, reorder into shard-major
+        # (interleaved ownership: global row r -> shard r % D, local row
+        # r // D), and shard it. The reorder also guarantees a fresh
+        # buffer, so the donated scan never frees the CALLER's state
+        # (same guard as sched.runner).
+        pad = self.n_dev * self.rps - self.n_rows
+        width = state.table.shape[1]
+        table = state.table
+        if pad:
+            table = jnp.concatenate(
+                [table, jnp.full((pad, width), jnp.nan, table.dtype)]
+            )
+        table = _to_shard_major(table, self.n_dev, self.rps)
+        self._table = _put_global(table, NamedSharding(mesh, P(DATA_AXIS, None)))
+
+        # Undo the shard-major reorder under jit with a replicated output
+        # sharding: the result table is row-sharded across the mesh
+        # (possibly across processes on multi-host), where eager
+        # reshape/transpose/slice would raise on non-fully-addressable
+        # arrays.
+        self._unshard = jax.jit(
+            lambda t: _from_shard_major(t, self.n_dev, self.rps)[: self.n_rows],
+            out_shardings=NamedSharding(mesh, P()),
+        )
+
+    def _route_window(
+        self, pidx: np.ndarray, mask: np.ndarray, mode_id: np.ndarray,
+        afk: np.ndarray,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Per-window routing, padded to the capacity bucket."""
+        ratable = (mode_id >= 0) & ~afk
+        valid = mask & ratable[:, :, None, None]
+        w = pidx.shape[0]
+        idx = pidx.reshape(w, -1).astype(np.int64)
+        sel, dst = _window_routing(
+            idx, valid.reshape(w, -1), self.n_dev, self.rps
+        )
+        k = sel.shape[2]
+        if self._cap is None or k > self._cap:
+            new_cap = max(8, -(-int(k * 1.25) // 8) * 8)
+            if self._cap is not None:
+                logger.info(
+                    "sharded routing capacity grew %d -> %d (one recompile)",
+                    self._cap, new_cap,
+                )
+            self._cap = max(new_cap, self._cap or 0)
+        if k < self._cap:
+            pad = np.zeros(sel.shape[:2] + (self._cap - k,), np.int32)
+            sel = np.concatenate([sel, pad], axis=2)
+            dst = np.concatenate([dst, pad + self.rps], axis=2)
+        return sel, dst
+
+    def dispatch(
+        self,
+        pidx: np.ndarray,
+        mask: np.ndarray,
+        winner: np.ndarray,
+        mode_id: np.ndarray,
+        afk: np.ndarray,
+        sel: np.ndarray | None = None,
+        dst: np.ndarray | None = None,
+    ) -> None:
+        """Routes (unless precomputed sel/dst are given) and runs one
+        window. Async — returns at dispatch, so the caller's next window
+        materialization overlaps this window's device execution."""
+        if sel is None:
+            sel, dst = self._route_window(pidx, mask, mode_id, afk)
+        self._table = self._step_fn(
+            self._table,
+            _put_global(pidx, self._batch_sh),
+            _put_global(mask, self._batch_sh),
+            _put_global(winner, self._batch_sh),
+            _put_global(mode_id, self._batch_sh),
+            _put_global(afk, self._batch_sh),
+            _put_global(sel, self._route_sh),
+            _put_global(dst, self._route_sh),
+        )
+
+    def call_hook(self, on_chunk, next_step: int) -> None:
+        """Invokes ``on_chunk(snapshot, next_step)`` with a ZERO-ARG THUNK
+        producing the fully-assembled (unsharded, row-major) PlayerState.
+        Evaluating it is a cross-process collective, so a multi-host hook
+        must call it on every process or on none (make the decision a
+        pure function of ``next_step``); skipped chunks pay nothing. The
+        thunk must be consumed INSIDE the hook: the captured buffer is
+        donated to the next dispatch, so deferred evaluation would be a
+        use-after-donate — it raises loudly instead."""
+        live = [True]
+
+        def snapshot(_t=self._table, _live=live):
+            if not _live[0]:
+                raise RuntimeError(
+                    "snapshot thunk evaluated after on_chunk returned; "
+                    "the table buffer it captures is donated to the "
+                    "next chunk — consume it inside the hook"
+                )
+            return dataclasses.replace(self._state, table=self._unshard(_t))
+
+        on_chunk(snapshot, next_step)
+        live[0] = False
+
+    def finish(self) -> PlayerState:
+        """Assembles and returns the final row-major state."""
+        return dataclasses.replace(
+            self._state, table=self._unshard(self._table)
+        )
+
+
 def rate_history_sharded(
     state: PlayerState,
-    sched: PackedSchedule,
+    sched,
     cfg: RatingConfig,
     mesh: Mesh | None = None,
     steps_per_chunk: int = 1024,
@@ -296,22 +468,23 @@ def rate_history_sharded(
     stop_after: int | None = None,
     on_chunk=None,
     routing: Routing | None = None,
+    routing_capacity: int | None = None,
 ) -> PlayerState:
     """Full-history re-rate, data-parallel over the mesh. Returns final state.
 
+    ``sched`` may be an eager :class:`PackedSchedule` or a lazy
+    ``WindowedSchedule`` — with the latter, both the gather tensors AND
+    the scatter routing are built per chunk inside the feed loop (O(window)
+    host memory; the round-2 eager pack + whole-schedule routing are gone).
     ``sched.batch_size`` must be divisible by the mesh size (pack with
     ``batch_size = k * n_devices``). ``start_step``/``stop_after``/
-    ``on_chunk`` mirror ``sched.rate_history``'s checkpoint-resume
-    surface, except ``on_chunk(snapshot, next_step)`` receives a ZERO-ARG
-    THUNK producing the fully-assembled (unsharded, row-major)
-    PlayerState: evaluating it is a cross-process collective, so a
-    multi-host hook must call it on every process or on none (make the
-    decision a pure function of ``next_step``); skipped chunks pay
-    nothing. One cross-mesh gather + device sync per taken snapshot is
-    the price of a bounded crash blast radius. ``routing`` lets callers
-    reuse a precomputed :func:`build_routing` across calls (benchmarks,
-    resumed runs on the same schedule); it is validated against the mesh
-    and table shape.
+    ``on_chunk`` mirror ``sched.rate_history``'s checkpoint-resume surface;
+    the hook receives a snapshot THUNK — see :meth:`ShardedRun.call_hook`
+    for the multi-host discipline. ``routing`` lets callers reuse a
+    precomputed :func:`build_routing` across calls (benchmarks, resumed
+    runs on the same eager schedule); it is validated against the mesh and
+    table shape. ``routing_capacity`` presets the per-window routing
+    bucket (K) so a resumed run compiles the same shapes up front.
     """
     mesh = mesh or make_mesh()
     n_dev = mesh.devices.size
@@ -319,23 +492,8 @@ def rate_history_sharded(
         raise ValueError(
             f"batch_size {sched.batch_size} not divisible by mesh size {n_dev}"
         )
-    if (
-        state.seed_cfg is not None
-        and state.seed_cfg.unknown_player_sigma != cfg.unknown_player_sigma
-    ):
-        # Same contract as rate_batch (core/update.py) — checked here once
-        # because the sharded path assembles rows itself via rate_gathered.
-        raise ValueError(
-            f"state seeds were built with UNKNOWN_PLAYER_SIGMA="
-            f"{state.seed_cfg.unknown_player_sigma}, but the sharded rater "
-            f"was called with {cfg.unknown_player_sigma}; rebuild the state "
-            "via PlayerState.create(..., cfg=cfg)"
-        )
-
     n_rows = state.table.shape[0]
-    if routing is None:
-        routing = build_routing(sched, n_rows, n_dev)
-    elif (
+    if routing is not None and (
         routing.n_shards != n_dev
         or routing.rows_per_shard * n_dev < n_rows
         or routing.sel.shape[0] != sched.n_steps
@@ -349,68 +507,19 @@ def rate_history_sharded(
             f"mesh has {n_dev} devices, the table {n_rows} rows, and the "
             f"schedule {sched.n_steps} steps"
         )
-    rps = routing.rows_per_shard
-    step_fn = sharded_step_fn(mesh, cfg, rps)
 
-    # Pad the table to D * rps rows, reorder into shard-major (interleaved
-    # ownership: global row r -> shard r % D, local row r // D), and shard
-    # it. The reorder also guarantees a fresh buffer, so the donated scan
-    # never frees the CALLER's state (same guard as sched.runner).
-    pad = n_dev * rps - n_rows
-    width = state.table.shape[1]
-    table = state.table
-    if pad:
-        table = jnp.concatenate(
-            [table, jnp.full((pad, width), jnp.nan, table.dtype)]
-        )
-    table = _to_shard_major(table, n_dev, rps)
-    table = _put_global(table, NamedSharding(mesh, P(DATA_AXIS, None)))
-
-    # Undo the shard-major reorder under jit with a replicated output
-    # sharding: the result table is row-sharded across the mesh (possibly
-    # across processes on multi-host), where eager reshape/transpose/slice
-    # would raise on non-fully-addressable arrays.
-    unshard = jax.jit(
-        lambda t: _from_shard_major(t, n_dev, rps)[:n_rows],
-        out_shardings=NamedSharding(mesh, P()),
-    )
-
+    run = ShardedRun(state, cfg, mesh, routing_capacity=routing_capacity)
     n_steps = sched.n_steps if stop_after is None else min(stop_after, sched.n_steps)
-    batch_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
-    route_sharding = NamedSharding(mesh, P(None, DATA_AXIS, None))
     for start in range(start_step, n_steps, steps_per_chunk):
-        sl = slice(start, min(start + steps_per_chunk, n_steps))
-        arrays = (
-            _put_global(sched.player_idx[sl], batch_sharding),
-            _put_global(sched.slot_mask[sl], batch_sharding),
-            _put_global(sched.winner[sl], batch_sharding),
-            _put_global(sched.mode_id[sl], batch_sharding),
-            _put_global(sched.afk[sl], batch_sharding),
-            _put_global(routing.sel[sl], route_sharding),
-            _put_global(routing.dst[sl], route_sharding),
-        )
-        table = step_fn(table, *arrays)
+        stop = min(start + steps_per_chunk, n_steps)
+        pidx, mask, winner, mode_id, afk = sched.host_window(start, stop)
+        if routing is not None:
+            run.dispatch(
+                pidx, mask, winner, mode_id, afk,
+                sel=routing.sel[start:stop], dst=routing.dst[start:stop],
+            )
+        else:
+            run.dispatch(pidx, mask, winner, mode_id, afk)
         if on_chunk is not None:
-            # The shard-major table is an internal layout; snapshots get
-            # the assembled row-major state via a LAZY thunk: unshard is
-            # a cross-process collective, so the hook must either call it
-            # on every process or on none (its cadence decision is a pure
-            # function of next_step — see cli._checkpoint_hook), and
-            # skipped chunks don't pay the gather. The thunk must be
-            # consumed INSIDE the hook: the captured buffer is donated to
-            # the next chunk's step_fn, so deferred evaluation would be a
-            # use-after-donate — it raises loudly instead.
-            live = [True]
-
-            def snapshot(_t=table, _live=live):
-                if not _live[0]:
-                    raise RuntimeError(
-                        "snapshot thunk evaluated after on_chunk returned; "
-                        "the table buffer it captures is donated to the "
-                        "next chunk — consume it inside the hook"
-                    )
-                return dataclasses.replace(state, table=unshard(_t))
-
-            on_chunk(snapshot, min(start + steps_per_chunk, n_steps))
-            live[0] = False
-    return dataclasses.replace(state, table=unshard(table))
+            run.call_hook(on_chunk, stop)
+    return run.finish()

@@ -180,12 +180,22 @@ def rate_stream(
     poll_interval: float = 0.002,
     team_size: int | None = None,
     stats_out: dict | None = None,
+    mesh=None,
 ) -> tuple[PlayerState, HistoryOutputs | None]:
     """Rates a raw MatchStream with the schedule built CONCURRENTLY with
     the device scan — the fully-streamed feed. ``stats_out`` (optional
     dict) receives n_steps / batch_size / occupancy after the run — the
     schedule never exists as one object here, so these are the only
     schedule-level observables.
+
+    ``mesh`` composes this feed with the sharded-table data parallelism
+    (``parallel.mesh.ShardedRun``): every emitted window is routed per
+    chunk and dispatched to the mesh, so a pod re-rate gets the same
+    concurrent assignment + O(window) host memory as a single chip. The
+    auto batch size is rounded up to a mesh-size multiple (an explicit
+    ``batch_size`` must already be one); ``collect`` is not supported on
+    the mesh path (the sharded scan carries only the table — use
+    ``rate_history(collect=True)`` for per-match outputs).
 
     ``rate_history`` overlaps window *materialization* with the scan but
     still pays the whole first-fit assignment as a sequential prefix
@@ -245,11 +255,23 @@ def rate_stream(
         raise ValueError(
             f"stream team size {stream.team_size} exceeds team_size {team}"
         )
+    run = None
+    if mesh is not None:
+        if collect:
+            raise ValueError(
+                "collect=True is not supported with mesh= (the sharded "
+                "scan carries only the table); use rate_history"
+            )
+        from analyzer_tpu.parallel.mesh import ShardedRun
+
+        run = ShardedRun(state, cfg, mesh)
     pad_row = state.pad_row
-    state = jax.tree.map(jnp.copy, state)
+    if run is None:
+        state = jax.tree.map(jnp.copy, state)
     if n == 0:
         if stats_out is not None:
             stats_out.update(n_steps=0, batch_size=0, occupancy=0.0)
+        state = run.finish() if run is not None else state
         return state, (_gather_outputs([], np.empty(0, np.int32), 0, team)
                        if collect else None)
     if int(stream.player_idx.max()) >= pad_row:
@@ -258,7 +280,26 @@ def rate_stream(
             f"but the player table only has rows 0..{pad_row - 1}"
         )
 
-    b = batch_size or choose_batch_size(stream)
+    if run is not None:
+        import math
+
+        n_dev = int(mesh.devices.size)
+        if batch_size is None:
+            # Size with the mesh-aware multiple (like cli._rate_mesh /
+            # bench_mesh) so B stays both lane-aligned (8) and divisible
+            # by D even on non-power-of-two meshes — a plain round-up of
+            # the default choice could break 8-alignment (e.g. D=6).
+            m = math.lcm(8, n_dev)
+            b = choose_batch_size(stream, batch_multiple=m)
+            b = -(-b // m) * m  # the mean-width candidate can undershoot m
+        elif batch_size % n_dev:
+            raise ValueError(
+                f"batch_size {batch_size} not divisible by mesh size {n_dev}"
+            )
+        else:
+            b = batch_size
+    else:
+        b = batch_size or choose_batch_size(stream)
     spc = steps_per_chunk or min(8192, max(256, -(-n // b) // 8 or 1))
 
     sentinel = np.iinfo(np.int64).min
@@ -345,13 +386,16 @@ def rate_stream(
         mi = win.reshape(e1 - e0, b)
         pidx, mask = materialize_gather_window(stream, mi, pad_row, team)
         winner, mode_id, afk = materialize_scalar_window(stream, mi)
-        arrays = tuple(
-            jnp.asarray(a) for a in (pidx, mask, winner, mode_id, afk)
-        )
-        new_state, ys = _scan_chunk(state, arrays, cfg, collect)
-        state = new_state
-        if collect:
-            outs.append(jax.tree.map(np.asarray, ys))
+        if run is not None:
+            run.dispatch(pidx, mask, winner, mode_id, afk)
+        else:
+            arrays = tuple(
+                jnp.asarray(a) for a in (pidx, mask, winner, mode_id, afk)
+            )
+            new_state, ys = _scan_chunk(state, arrays, cfg, collect)
+            state = new_state
+            if collect:
+                outs.append(jax.tree.map(np.asarray, ys))
         emitted = e1
 
     while worker.is_alive():
@@ -389,6 +433,8 @@ def rate_stream(
         stats_out.update(
             n_steps=s_total, batch_size=b, occupancy=n / (s_total * b)
         )
+    if run is not None:
+        return run.finish(), None
     if not collect:
         return state, None
     flat_idx = slot_map[: s_total * b]

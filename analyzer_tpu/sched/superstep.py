@@ -204,6 +204,47 @@ class PackedSchedule(_ScheduleBase):
             self.afk[sl],
         )
 
+    def pad_to_steps(self, n_steps: int) -> "PackedSchedule":
+        """Appends inert all-padding supersteps (match_idx -1, masks False,
+        unsupported mode) so the schedule has exactly ``n_steps``. Padding
+        steps read and write nothing — they exist so a caller can BUCKET
+        step counts to a few fixed shapes and reuse one compiled scan
+        across differently-sized batches (the service loop's recompile
+        guard; the reference's fixed BATCHSIZE=500 never had this problem
+        because it never had shape-specialized compilation,
+        ``worker.py:18``)."""
+        extra = n_steps - self.n_steps
+        if extra < 0:
+            raise ValueError(
+                f"cannot pad {self.n_steps} steps down to {n_steps}"
+            )
+        if extra == 0:
+            return self
+        b = self.batch_size
+        pad_idx = np.full((extra, b), -1, np.int32)
+        pad_gather = np.full(
+            (extra, b, 2, self.team_size), self.pad_row, np.int32
+        )
+        # All-padding rows: the empty-stream branch of the scalar
+        # materializer IS the padding convention's single owner.
+        empty = MatchStream(
+            np.empty((0, 2, self.team_size), np.int32),
+            np.empty(0, np.int32), np.empty(0, np.int32), np.empty(0, bool),
+        )
+        winner, mode_id, afk = materialize_scalar_window(empty, pad_idx)
+        return PackedSchedule(
+            player_idx=np.concatenate([self.player_idx, pad_gather]),
+            slot_mask=np.concatenate(
+                [self.slot_mask, np.zeros(pad_gather.shape, bool)]
+            ),
+            winner=np.concatenate([self.winner, winner]),
+            mode_id=np.concatenate([self.mode_id, mode_id]),
+            afk=np.concatenate([self.afk, afk]),
+            match_idx=np.concatenate([self.match_idx, pad_idx]),
+            pad_row=self.pad_row,
+            stream=self.stream,
+        )
+
     def step_batch(self, s: int) -> MatchBatch:
         """Materializes superstep ``s`` as a device MatchBatch."""
         return MatchBatch(

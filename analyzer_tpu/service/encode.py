@@ -32,9 +32,17 @@ from analyzer_tpu.sched.superstep import MatchStream
 
 class EncodedBatch:
     """A batch of match objects packed for the tensor path, with the maps
-    needed to write results back."""
+    needed to write results back.
 
-    def __init__(self, matches, cfg: RatingConfig):
+    ``bucket_rows=True`` pads the player table to the next power-of-two
+    row count (floor 64): the table shape is part of every compiled
+    kernel's signature, so without bucketing each distinct
+    distinct-player count would trigger a fresh XLA compile in the
+    service loop (the worker's recompile guard, together with its pinned
+    schedule width). Ghost rows are NaN-rated, never referenced by any
+    match slot, and cost only bytes."""
+
+    def __init__(self, matches, cfg: RatingConfig, bucket_rows: bool = False):
         self.matches = list(matches)
         self.cfg = cfg
 
@@ -49,12 +57,15 @@ class EncodedBatch:
                     self.player_at.append(player)
         p = len(self.player_at)
         self.n_players = p
+        alloc = p
+        if bucket_rows:
+            alloc = max(64, 1 << max(p - 1, 0).bit_length())
 
         # State table from object attributes (NaN for SQL NULL / None).
-        table = np.full((p + 1, TABLE_WIDTH), np.nan, np.float32)
-        rr = np.full((p + 1,), np.nan, np.float32)
-        rb = np.full((p + 1,), np.nan, np.float32)
-        ti = np.zeros((p + 1,), np.int32)
+        table = np.full((alloc + 1, TABLE_WIDTH), np.nan, np.float32)
+        rr = np.full((alloc + 1,), np.nan, np.float32)
+        rb = np.full((alloc + 1,), np.nan, np.float32)
+        ti = np.zeros((alloc + 1,), np.int32)
         bad_tier: dict[int, object] = {}  # row -> out-of-table tier value
         for r, player in enumerate(self.player_at):
             for c, col in enumerate(constants.RATING_COLUMNS):

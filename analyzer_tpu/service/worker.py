@@ -53,6 +53,14 @@ class Worker:
         self.batches_failed = 0
         self._started_at = clock()
         self._stop_requested = False
+        # Pinned schedule width: auto-sizing per AMQP batch would give
+        # every distinct (steps, width) shape a fresh XLA compile — a
+        # latency spike the reference never had (its BATCHSIZE is fixed,
+        # worker.py:18). One width derived once from the batch size (a
+        # 500-match batch of mostly-distinct players packs into ~8 steps
+        # of 64), with step counts bucketed to powers of two in process().
+        w = -(-self.config.batch_size // 8)  # ~steps-of-8 heuristic width
+        self._packed_width = min(128, max(8, -(-w // 8) * 8))
 
         c = self.config
         # The reference declares queue/failed/crunch/telesuck but NOT sew
@@ -200,8 +208,17 @@ class Worker:
         logger.info("processing batch of %s matches", len(matches))
         if not matches:
             return []
-        enc = EncodedBatch(matches, self.rating_config)
-        sched = pack_schedule(enc.stream, pad_row=enc.state.pad_row)
+        # bucket_rows + pinned width + power-of-two step bucket: the three
+        # shapes in the compiled scan's signature (table rows, batch
+        # width, step count) all land on a few fixed sizes, so
+        # consecutive batches of any size reuse one compiled scan.
+        enc = EncodedBatch(matches, self.rating_config, bucket_rows=True)
+        sched = pack_schedule(
+            enc.stream, pad_row=enc.state.pad_row,
+            batch_size=self._packed_width,
+        )
+        bucket = max(4, 1 << (sched.n_steps - 1).bit_length())
+        sched = sched.pad_to_steps(bucket)
         _, outs = rate_history(enc.state, sched, self.rating_config, collect=True)
         enc.write_back(outs)
         # Transactional stores (SqlStore) flush the mutated graph in one
@@ -211,6 +228,10 @@ class Worker:
         if commit is not None:
             commit(matches)
         self.matches_rated += len(matches)
+        logger.info(
+            "batch rated: %d matches (%.1f matches/s since start)",
+            len(matches), self.matches_per_sec,
+        )
         return [m.api_id for m in matches]
 
     # -- observability ----------------------------------------------------

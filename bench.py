@@ -133,14 +133,14 @@ def main() -> None:
     del chunks
     from analyzer_tpu.sched import rate_history
 
-    e2e_times = []
     state_dev = jax.device_put(jax.tree.map(np.asarray, state0))
-    for r in range(3):  # pass 0 compiles the chunked shapes; min like `best`
-        t0 = time.perf_counter()
+
+    def run_e2e():
         e2e_state, _ = rate_history(state_dev, cfg=cfg, sched=sched)
         np.asarray(e2e_state.table[:1])
-        e2e_times.append(time.perf_counter() - t0)
-    t_e2e = min(e2e_times[1:])
+        return e2e_state
+
+    _, t_e2e = time_runs(run_e2e, 2)
     log(f"end-to-end rate_history (overlapped windowed feed): {t_e2e:.2f}s "
         f"= {t_e2e / best:.2f}x device-only time")
 
@@ -150,13 +150,12 @@ def main() -> None:
     # assignment, packing, transfers, and the scan.
     from analyzer_tpu.sched import rate_stream
 
-    stream_times = []
-    for r in range(3):
-        t0 = time.perf_counter()
+    def run_stream():
         s_state, _ = rate_stream(state_dev, stream, cfg)
         np.asarray(s_state.table[:1])
-        stream_times.append(time.perf_counter() - t0)
-    t_stream = min(stream_times[1:])
+        return s_state
+
+    _, t_stream = time_runs(run_stream, 2)
     log(f"end-to-end rate_stream (assignment overlapped too): {t_stream:.2f}s "
         f"= {t_stream / best:.2f}x device-only time")
 
@@ -202,37 +201,72 @@ def emit_metric(rate):
 
 def bench_mesh(n_mesh, stream, state0, cfg, batch, repeats, t_gen):
     """Pod-scale variant: data-parallel sharded-table runner over the
-    first BENCH_MESH real devices (parallel/mesh.py). Routing is
-    precomputed outside the timed loop; per-chunk host->device transfers
-    remain inside it (they are the pod's real feed path), so this line
-    is end-to-end-ish where the single-device metric is device-only —
-    noted on stderr rather than hidden."""
+    first BENCH_MESH real devices (parallel/mesh.py), fed the way a pod
+    run actually feeds — a WINDOWED schedule whose gather tensors and
+    scatter routing materialize per chunk inside the loop (O(window)
+    host memory), plus the fully-streamed rate_stream(mesh=...) line.
+    The headline repeats are therefore end-to-end where the
+    single-device metric is device-only — noted on stderr, not hidden.
+    Small runs (<= 2M matches) also time the eager precomputed-routing
+    control to quantify the windowed feed's overhead."""
     import math
 
     from analyzer_tpu.parallel import build_routing, make_mesh, rate_history_sharded
-    from analyzer_tpu.sched import choose_batch_size, pack_schedule
+    from analyzer_tpu.sched import choose_batch_size, pack_schedule, rate_stream
 
     mesh = make_mesh(n_mesh)  # raises if fewer devices exist
     t0 = time.perf_counter()
-    b = batch or choose_batch_size(stream, batch_multiple=math.lcm(8, n_mesh))
-    b = -(-b // n_mesh) * n_mesh
-    sched = pack_schedule(stream, pad_row=state0.pad_row, batch_size=b)
-    routing = build_routing(sched, state0.table.shape[0], n_mesh)
+    m = math.lcm(8, n_mesh)
+    b = batch or choose_batch_size(stream, batch_multiple=m)
+    b = -(-b // m) * m
+    sched = pack_schedule(
+        stream, pad_row=state0.pad_row, batch_size=b, windowed=True
+    )
     t_pack = time.perf_counter() - t0
-    log(f"generate: {t_gen:.2f}s; pack+routing (eager, B={b}): {t_pack:.2f}s "
-        f"-> {sched.n_steps} steps, occupancy {sched.occupancy:.3f}")
-    log("note: mesh repeats include per-chunk transfers (the pod feed "
-        "path); the single-device metric is device-only")
+    log(f"generate: {t_gen:.2f}s; assign+pack scalars (windowed, B={b}): "
+        f"{t_pack:.2f}s -> {sched.n_steps} steps, "
+        f"occupancy {sched.occupancy:.3f}")
+    log("note: mesh repeats include per-window routing + transfers (the "
+        "pod feed path); the single-device metric is device-only")
 
     def run():
-        final = rate_history_sharded(
-            state0, sched, cfg, mesh=mesh, routing=routing
-        )
+        final = rate_history_sharded(state0, sched, cfg, mesh=mesh)
         np.asarray(final.table[:1])
         return final
 
     state, best = time_runs(run, repeats)
     rate = sched.n_matches / best / n_mesh
+
+    # Fully-streamed: first-fit assignment on a worker thread feeding the
+    # sharded runner (the round-3 composition).
+    def run_stream():
+        s_state, _ = rate_stream(state0, stream, cfg, mesh=mesh)
+        np.asarray(s_state.table[:1])
+        return s_state
+
+    _, t_stream = time_runs(run_stream, 2)
+    log(f"end-to-end rate_stream(mesh): {t_stream:.2f}s "
+        f"= {t_stream / best:.2f}x windowed-feed time")
+
+    if stream.n_matches <= 2_000_000:
+        # Eager control: whole-schedule tensors + precomputed routing, so
+        # the repeats pay only slicing + transfers — the closest thing to
+        # a device-only mesh number. Gated by size: the eager pack is the
+        # multi-GB host materialization the windowed path exists to avoid.
+        eager = sched.materialize()
+        routing = build_routing(eager, state0.table.shape[0], n_mesh)
+
+        def run_eager():
+            final = rate_history_sharded(
+                state0, eager, cfg, mesh=mesh, routing=routing
+            )
+            np.asarray(final.table[:1])
+            return final
+
+        _, best_eager = time_runs(run_eager, repeats)
+        log(f"eager precomputed-routing control: {best_eager:.3f}s -> "
+            f"windowed feed = {best / best_eager:.2f}x eager")
+
     sanity(state, state0.n_players, extra=f" over {n_mesh} chips")
     emit_metric(rate)
 

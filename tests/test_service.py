@@ -39,6 +39,31 @@ def rig():
     return broker, store, worker
 
 
+class TestCompileChurn:
+    def test_batches_of_different_sizes_share_one_compile(self):
+        # VERDICT round-2 weak #1: auto-sized packing gave every distinct
+        # (steps, width, table-rows) shape a fresh XLA compile per AMQP
+        # batch. With the pinned width + power-of-two step/row buckets,
+        # a second batch of a different size must hit the jit cache.
+        from analyzer_tpu.sched.runner import _scan_chunk
+
+        broker = InMemoryBroker()
+        store = InMemoryStore()
+        cfg = ServiceConfig(batch_size=500, idle_timeout=0.0)
+        worker = Worker(broker, store, cfg, RatingConfig())
+        for i in range(5):
+            store.add_match(mk_match(f"a{i}", created_at=i))
+            broker.publish("analyze", f"a{i}".encode())
+        assert worker.poll()
+        size0 = _scan_chunk._cache_size()
+        for i in range(3):  # different match AND player count
+            store.add_match(mk_match(f"b{i}", created_at=10 + i))
+            broker.publish("analyze", f"b{i}".encode())
+        assert worker.poll()
+        assert worker.matches_rated == 8
+        assert _scan_chunk._cache_size() == size0  # no second compile
+
+
 class TestPipeline:
     def test_end_to_end_rating(self, rig):
         broker, store, worker = rig
