@@ -33,6 +33,37 @@ def _load_stream(path: str):
     return stream, n_players
 
 
+def _load_inputs(args, cfg, timer):
+    """The rate paths' input loader: a CSV/npz stream file (--csv) or a
+    columnar full-history DB ingest (--db, sql_store.load_stream).
+    Returns (stream, n_players, db_state, db_store, player_ids) — the
+    last three None on the file path; db_state carries the players' DB
+    rating priors, which a fresh file run does not have."""
+    if getattr(args, "db", None):
+        from analyzer_tpu.service.sql_store import SqlStore
+
+        with timer.phase("load"):
+            store = SqlStore(args.db)
+            hist = store.load_stream(cfg)
+        return (
+            hist.stream, hist.state.n_players, hist.state, store,
+            hist.player_ids,
+        )
+    with timer.phase("load"):
+        stream, n_players = _load_stream(args.csv)
+    return stream, n_players, None, None, None
+
+
+def _maybe_db_write(args, timer, db_store, state, player_ids) -> dict:
+    """Final-table write-back for --db --db-write runs; returns a stats
+    extra ({} when not writing)."""
+    if db_store is None or not getattr(args, "db_write", False):
+        return {}
+    with timer.phase("db_write"):
+        n = db_store.write_players(state, player_ids)
+    return {"players_written": n}
+
+
 def cmd_synth(args) -> int:
     from analyzer_tpu.io.csv_codec import save_stream
     from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
@@ -49,31 +80,36 @@ def cmd_synth(args) -> int:
 
 def _checkpoint_hook(args, sched, cursor, start_step, finished, lead=True):
     """The shared periodic/bounded-run snapshot closure of the
-    single-device and --mesh rate paths (None when no saves can be due).
-    Periodic saves honor --checkpoint-every; a bounded run always
-    snapshots at its stop boundary; the finished branch's final save is
-    never duplicated.
+    single-device and --mesh rate paths. Returns ``(on_chunk, close)``
+    — on_chunk is None when no saves can be due; close drains the async
+    writer (call it in a finally). Periodic saves honor
+    --checkpoint-every; a bounded run always snapshots at its stop
+    boundary; the finished branch's final save is never duplicated.
+
+    Snapshots are ASYNC (io.checkpoint.CheckpointWriter): the hook pays
+    only the device fetch; the ~100 MB serialize+rename at north-star
+    scale runs on a writer thread instead of stalling the scan (the
+    reference pays durability synchronously per 500-match commit,
+    worker.py:194 — bounded blast radius without the per-batch stall).
 
     Multi-host discipline: the hook must run on EVERY process — the mesh
     runner hands the state as a lazy thunk whose evaluation is a
     cross-process collective (the unshard gather), and the cadence
     decision is a pure function of ``next_step``, so all processes make
-    the same call and the SPMD program never diverges. Only the actual
-    file write is gated to the lead process. The thunk is evaluated
-    strictly AFTER the cadence decision, so skipped chunks never pay the
-    cross-mesh gather."""
-    from analyzer_tpu.io.checkpoint import save_checkpoint
+    the same call and the SPMD program never diverges. Only the lead
+    process has a writer. The thunk is evaluated strictly AFTER the
+    cadence decision, so skipped chunks never pay the cross-mesh gather."""
+    from analyzer_tpu.io.checkpoint import CheckpointWriter
 
-    if not args.checkpoint:
-        return None
-    if not args.checkpoint_every and finished:
-        return None
+    if not args.checkpoint or (not args.checkpoint_every and finished):
+        return None, lambda: None
     every = args.checkpoint_every or sched.n_steps + 1
     fingerprint = sched.fingerprint
     effective_stop = (
         sched.n_steps if finished else min(args.stop_after_steps, sched.n_steps)
     )
     last_saved = start_step
+    writer = CheckpointWriter(args.checkpoint) if lead else None
 
     def on_chunk(st, next_step):
         nonlocal last_saved
@@ -86,22 +122,24 @@ def _checkpoint_hook(args, sched, cursor, start_step, finished, lead=True):
         last_saved = next_step
         if callable(st):  # mesh path: collective snapshot, all processes
             st = st()
-        if lead:
-            save_checkpoint(
-                args.checkpoint, st, cursor=cursor,
+        if writer is not None:
+            writer.save(
+                st, cursor=cursor,
                 step_cursor=next_step, schedule_fingerprint=fingerprint,
             )
 
-    return on_chunk
+    return on_chunk, (writer.close if writer is not None else lambda: None)
 
 
 def _rate_streamed(
-    args, cfg, timer, state, stream, cursor, n_players, mesh=None, **extra
+    args, cfg, timer, state, stream, cursor, n_players,
+    mesh=None, finalize=None, **extra,
 ) -> int:
     """The fully-streamed rate path shared by cmd_rate and _rate_mesh:
     concurrent assignment feeding the device (sched.rate_stream), stats
     reconstructed from the runner's observables (the schedule never
-    exists as one object here)."""
+    exists as one object here). ``finalize(state) -> dict`` runs after
+    the rate (DB write-back) and its stats merge into the output line."""
     import types
 
     from analyzer_tpu.sched import rate_stream
@@ -114,8 +152,13 @@ def _rate_streamed(
             stats_out=stats, mesh=mesh,
         )
         np.asarray(state.table[:1])  # force completion for honest timing
+    if finalize is not None:
+        extra.update(finalize(state))
     sched_view = types.SimpleNamespace(
         n_steps=stats["n_steps"], occupancy=stats["occupancy"]
+    )
+    extra.setdefault(
+        "choose_batch_size_s", round(stats["choose_batch_size_s"], 3)
     )
     print(
         _rate_stats(stream, cursor, n_players, state, sched_view, timer, **extra)
@@ -173,11 +216,18 @@ def cmd_rate(args) -> int:
     if args.mesh is not None and args.mesh < 0:
         print("error: --mesh must be >= 0 (0 = all devices)", file=sys.stderr)
         return 2
+    if (args.csv is None) == (args.db is None):
+        print("error: exactly one of --csv / --db is required", file=sys.stderr)
+        return 2
+    if args.db_write and not args.db:
+        print("error: --db-write requires --db", file=sys.stderr)
+        return 2
     timer = PhaseTimer()
     if args.mesh is not None:
         return _rate_mesh(args, cfg, timer)
-    with timer.phase("load"):
-        stream, n_players = _load_stream(args.csv)
+    stream, n_players, db_state, db_store, player_ids = _load_inputs(
+        args, cfg, timer
+    )
     cursor, start_step = 0, 0
     ck = None
     if args.resume:
@@ -189,13 +239,20 @@ def cmd_rate(args) -> int:
             + (f", superstep {start_step}" if start_step else ""),
             file=sys.stderr,
         )
+    elif db_state is not None:
+        state = db_state  # DB rating priors, seeds baked by load_stream
     else:
         state = PlayerState.create(n_players, cfg=cfg)
     if not args.checkpoint and args.stop_after_steps is None:
         # No snapshots to coordinate: take the fully-streamed path —
         # schedule assignment runs on a worker thread and overlaps the
         # device scan (sched.rate_stream).
-        return _rate_streamed(args, cfg, timer, state, stream, cursor, n_players)
+        return _rate_streamed(
+            args, cfg, timer, state, stream, cursor, n_players,
+            finalize=lambda st: _maybe_db_write(
+                args, timer, db_store, st, player_ids
+            ),
+        )
     with timer.phase("pack"):
         # Windowed: the big gather tensors materialize inside the runner's
         # prefetch loop, overlapped with the device scan.
@@ -218,22 +275,29 @@ def cmd_rate(args) -> int:
             )
             return 2
     finished = args.stop_after_steps is None or args.stop_after_steps >= sched.n_steps
-    on_chunk = _checkpoint_hook(args, sched, cursor, start_step, finished)
-    with timer.phase("rate"), trace(args.trace):
-        state, _ = rate_history(
-            state, sched, cfg,
-            start_step=start_step,
-            stop_after=args.stop_after_steps,
-            steps_per_chunk=(
-                min(8192, args.checkpoint_every) if args.checkpoint_every else None
-            ),
-            on_chunk=on_chunk,
-        )
-        np.asarray(state.table[:1])  # force completion for honest timing
+    on_chunk, ck_close = _checkpoint_hook(args, sched, cursor, start_step, finished)
+    try:
+        with timer.phase("rate"), trace(args.trace):
+            state, _ = rate_history(
+                state, sched, cfg,
+                start_step=start_step,
+                stop_after=args.stop_after_steps,
+                steps_per_chunk=(
+                    min(8192, args.checkpoint_every) if args.checkpoint_every else None
+                ),
+                on_chunk=on_chunk,
+            )
+            np.asarray(state.table[:1])  # force completion for honest timing
+    finally:
+        ck_close()  # drain async snapshot writes (raises on write error)
     if args.checkpoint and finished:
         with timer.phase("checkpoint"):
             save_checkpoint(args.checkpoint, state, cursor=stream.n_matches)
-    print(_rate_stats(stream, cursor, n_players, state, sched, timer))
+    extra = (
+        _maybe_db_write(args, timer, db_store, state, player_ids)
+        if finished else {}
+    )
+    print(_rate_stats(stream, cursor, n_players, state, sched, timer, **extra))
     return 0
 
 
@@ -264,14 +328,17 @@ def _rate_mesh(args, cfg, timer) -> int:
 
     distributed = initialize_distributed()
     lead = not distributed or jax.process_index() == 0
-    with timer.phase("load"):
-        stream, n_players = _load_stream(args.csv)
+    stream, n_players, db_state, db_store, player_ids = _load_inputs(
+        args, cfg, timer
+    )
     cursor, start_step = 0, 0
     ck = None
     if args.resume:
         with timer.phase("restore"):
             ck = load_checkpoint(args.checkpoint)
         state, cursor, start_step = ck.state, ck.cursor, ck.step_cursor
+    elif db_state is not None:
+        state = db_state
     else:
         state = PlayerState.create(n_players, cfg=cfg)
     # Every process must hold identical inputs before any is fed into the
@@ -297,6 +364,9 @@ def _rate_mesh(args, cfg, timer) -> int:
         return _rate_streamed(
             args, cfg, timer, state, stream, cursor, n_players,
             mesh=mesh, mesh_devices=n_dev, processes=1,
+            finalize=lambda st: _maybe_db_write(
+                args, timer, db_store, st, player_ids
+            ),
         )
     with timer.phase("pack"):
         work = stream.slice(cursor, stream.n_matches)
@@ -325,25 +395,35 @@ def _rate_mesh(args, cfg, timer) -> int:
         )
         return 2
     finished = args.stop_after_steps is None or args.stop_after_steps >= sched.n_steps
-    on_chunk = _checkpoint_hook(args, sched, cursor, start_step, finished, lead)
-    with timer.phase("rate"), trace(args.trace):
-        state = rate_history_sharded(
-            state, sched, cfg, mesh=mesh,
-            start_step=start_step, stop_after=args.stop_after_steps,
-            on_chunk=on_chunk,
-            steps_per_chunk=(
-                min(1024, args.checkpoint_every) if args.checkpoint_every else 1024
-            ),
-        )
-        np.asarray(state.table[:1])
+    on_chunk, ck_close = _checkpoint_hook(
+        args, sched, cursor, start_step, finished, lead
+    )
+    try:
+        with timer.phase("rate"), trace(args.trace):
+            state = rate_history_sharded(
+                state, sched, cfg, mesh=mesh,
+                start_step=start_step, stop_after=args.stop_after_steps,
+                on_chunk=on_chunk,
+                steps_per_chunk=(
+                    min(1024, args.checkpoint_every) if args.checkpoint_every else 1024
+                ),
+            )
+            np.asarray(state.table[:1])
+    finally:
+        ck_close()  # drain async snapshot writes (raises on write error)
     if args.checkpoint and lead and finished:
         with timer.phase("checkpoint"):
             save_checkpoint(args.checkpoint, state, cursor=stream.n_matches)
+    extra = (
+        _maybe_db_write(args, timer, db_store, state, player_ids)
+        if finished and lead else {}
+    )
     if lead:
         print(
             _rate_stats(
                 stream, cursor, n_players, state, sched, timer,
                 mesh_devices=n_dev, processes=jax.process_count(),
+                **extra,
             )
         )
     return 0
@@ -500,7 +580,18 @@ def main(argv=None) -> int:
     s.set_defaults(fn=cmd_synth)
 
     s = sub.add_parser("rate", help="TrueSkill full-history re-rate of a stream")
-    s.add_argument("--csv", required=True, help="match stream, .csv or .npz")
+    s.add_argument("--csv", help="match stream, .csv or .npz")
+    s.add_argument(
+        "--db", metavar="URI",
+        help="full-history columnar ingest straight from a database "
+        "(sqlite:///... or mysql://...; the reference's actual data "
+        "source, worker.py:176-191) — player rating priors come from "
+        "the player table",
+    )
+    s.add_argument(
+        "--db-write", action="store_true",
+        help="with --db: bulk-write the final player ratings back",
+    )
     s.add_argument("--checkpoint", help="state snapshot path (.npz)")
     s.add_argument("--resume", action="store_true", help="resume from --checkpoint")
     s.add_argument(

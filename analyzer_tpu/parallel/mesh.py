@@ -37,9 +37,16 @@ replicated compute] + t_psum(D) [~330 KB ring all-reduce, ~5-15 us on ICI]
 + 370 us * K/N / D [sharded scatter, K/N ~ occupancy * (1 + imbalance)].
 Single chip ~405 us -> D=8 predicts ~90-100 us, i.e. ~4-4.5x throughput —
 a real speedup where round 1 had ~1.1x, with per-chip HBM for the table
-also divided by D. The residual floor is the replicated candidate gather;
-host-compacted gather routing + reduce_scatter could shard that too and is
-the next lever if profiling demands it.
+also divided by D. MEASURED single-chip constant (round 3, BASELINE.md
+"Measured (round 3)"): the sharded runner at D=1 costs ~1.7x the plain
+runner on the real chip (1.29 s vs 0.76 s per 500k with precomputed
+routing; the D=1 psum/all_gather are pure copies, so this is the
+replicated-gather + routing-transfer overhead the model attributes to
+t_psum + feed). Breakeven vs one plain chip is therefore ~2 real chips,
+and the D=8 prediction stands as a model until real multi-chip hardware
+exists to measure on. The residual floor is the replicated candidate
+gather; host-compacted gather routing + reduce_scatter could shard that
+too and is the next lever if profiling demands it.
 
 Correctness invariants (tested bit-identical vs the single-device runner on
 1/2/4/8 virtual CPU devices, tests/test_parallel.py):

@@ -17,6 +17,7 @@ from analyzer_tpu.sched.superstep import (
     assign_batches,
     assign_supersteps,
     choose_batch_size,
+    choose_batch_size_streamed,
     pack_schedule,
 )
 from analyzer_tpu.sched.runner import HistoryOutputs, rate_history, rate_stream
@@ -28,6 +29,7 @@ __all__ = [
     "assign_batches",
     "assign_supersteps",
     "choose_batch_size",
+    "choose_batch_size_streamed",
     "pack_schedule",
     "HistoryOutputs",
     "rate_history",

@@ -243,7 +243,7 @@ def rate_stream(
 
     from analyzer_tpu.sched.superstep import (
         assign_batches,
-        choose_batch_size,
+        choose_batch_size_streamed,
         materialize_gather_window,
         materialize_scalar_window,
     )
@@ -270,7 +270,9 @@ def rate_stream(
         state = jax.tree.map(jnp.copy, state)
     if n == 0:
         if stats_out is not None:
-            stats_out.update(n_steps=0, batch_size=0, occupancy=0.0)
+            stats_out.update(
+                n_steps=0, batch_size=0, occupancy=0.0, choose_batch_size_s=0.0
+            )
         state = run.finish() if run is not None else state
         return state, (_gather_outputs([], np.empty(0, np.int32), 0, team)
                        if collect else None)
@@ -280,6 +282,7 @@ def rate_stream(
             f"but the player table only has rows 0..{pad_row - 1}"
         )
 
+    t_choose = _time.perf_counter()
     if run is not None:
         import math
 
@@ -290,7 +293,7 @@ def rate_stream(
             # by D even on non-power-of-two meshes — a plain round-up of
             # the default choice could break 8-alignment (e.g. D=6).
             m = math.lcm(8, n_dev)
-            b = choose_batch_size(stream, batch_multiple=m)
+            b = choose_batch_size_streamed(stream, batch_multiple=m)
             b = -(-b // m) * m  # the mean-width candidate can undershoot m
         elif batch_size % n_dev:
             raise ValueError(
@@ -299,7 +302,8 @@ def rate_stream(
         else:
             b = batch_size
     else:
-        b = batch_size or choose_batch_size(stream)
+        b = batch_size or choose_batch_size_streamed(stream)
+    t_choose = _time.perf_counter() - t_choose
     spc = steps_per_chunk or min(8192, max(256, -(-n // b) // 8 or 1))
 
     sentinel = np.iinfo(np.int64).min
@@ -431,7 +435,8 @@ def rate_stream(
 
     if stats_out is not None:
         stats_out.update(
-            n_steps=s_total, batch_size=b, occupancy=n / (s_total * b)
+            n_steps=s_total, batch_size=b, occupancy=n / (s_total * b),
+            choose_batch_size_s=t_choose,
         )
     if run is not None:
         return run.finish(), None

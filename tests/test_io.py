@@ -198,6 +198,63 @@ class TestCheckpoint:
         assert ck.schedule_fingerprint == "ab" * 20
 
 
+class TestAsyncWriter:
+    def test_latest_wins_coalescing_and_drain(self, tmp_path, monkeypatch):
+        # Snapshots queued faster than the (artificially slow) writer
+        # drains must coalesce — only the newest matters for resume — and
+        # close() must leave the LAST snapshot on disk.
+        import time
+
+        from analyzer_tpu.io import checkpoint as ck_mod
+        from analyzer_tpu.io.checkpoint import CheckpointWriter
+
+        written = []
+        real = ck_mod.save_checkpoint
+
+        def slow_save(path, state, **kw):
+            time.sleep(0.03)
+            written.append(kw["step_cursor"])
+            real(path, state, **kw)
+
+        monkeypatch.setattr(ck_mod, "save_checkpoint", slow_save)
+        path = str(tmp_path / "ck.npz")
+        state = PlayerState.create(6)
+        w = CheckpointWriter(path)
+        for step in range(1, 21):
+            w.save(state, cursor=0, step_cursor=step)
+        w.close()
+        assert written[-1] == 20  # the newest snapshot always lands
+        assert len(written) < 20  # older unwritten snapshots coalesced
+        assert load_checkpoint(path).step_cursor == 20
+
+    def test_crash_mid_write_preserves_previous_snapshot(self, tmp_path):
+        # A kill during an async write leaves at most a .tmp file; the
+        # previous snapshot (atomic rename) must still load.
+        path = str(tmp_path / "ck.npz")
+        state = PlayerState.create(6)
+        save_checkpoint(path, state, cursor=5, step_cursor=9)
+        with open(path + ".tmp", "wb") as f:
+            f.write(b"partial garbage from a killed writer")
+        ck = load_checkpoint(path)
+        assert (ck.cursor, ck.step_cursor) == (5, 9)
+        # and a later writer save replaces it cleanly despite the debris
+        from analyzer_tpu.io.checkpoint import CheckpointWriter
+
+        with CheckpointWriter(path) as w:
+            w.save(state, cursor=6, step_cursor=11)
+        assert load_checkpoint(path).step_cursor == 11
+
+    def test_write_error_surfaces_on_close(self, tmp_path):
+        from analyzer_tpu.io.checkpoint import CheckpointWriter
+
+        bad = str(tmp_path / "no_such_dir" / "ck.npz")
+        state = PlayerState.create(3)
+        w = CheckpointWriter(bad)
+        w.save(state)
+        with pytest.raises(OSError):
+            w.close()
+
+
 class TestPeriodicCheckpoint:
     """Kill-and-resume: a run interrupted at any chunk boundary, resumed
     from its snapshot, must end bit-identical to an uninterrupted run —

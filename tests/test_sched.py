@@ -463,6 +463,22 @@ class TestAutoBatchSize:
         )
         assert choose_batch_size(stream) <= 8
 
+    def test_streamed_chooser_prefix_semantics(self):
+        # Small streams (prefix >= n) are exact; an explicit prefix sizes
+        # from the slice only and still honors the batch multiple — the
+        # launch-latency fix for rate_stream (VERDICT round-2 #3).
+        from analyzer_tpu.sched.superstep import (
+            choose_batch_size,
+            choose_batch_size_streamed,
+        )
+
+        players = synthetic_players(2000, seed=5)
+        stream = synthetic_stream(8000, players, seed=5)
+        assert choose_batch_size_streamed(stream) == choose_batch_size(stream)
+        b = choose_batch_size_streamed(stream, prefix=1000, batch_multiple=24)
+        assert b == choose_batch_size(stream.slice(0, 1000), batch_multiple=24)
+        assert b >= 1
+
     def test_activity_cap_bounds_top_player(self):
         players = synthetic_players(2000, seed=9)
         capped = synthetic_stream(

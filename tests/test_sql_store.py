@@ -137,6 +137,144 @@ class TestReflection:
             SqlStore("sqlite://somehost/some.db")
 
 
+class TestColumnarIngest:
+    """load_stream: the full-history DB -> tensor fast lane must agree
+    with the object path (load_batch -> EncodedBatch -> write_back ->
+    commit) on the same data."""
+
+    def test_stream_shape_and_chronology(self, db_path):
+        store = SqlStore(f"sqlite:///{db_path}")
+        hist = store.load_stream(RatingConfig())
+        # created_at was inserted DESCENDING: m2 is earliest
+        assert hist.match_ids == ["m2", "m1", "m0"]
+        assert hist.stream.n_matches == 3
+        assert (hist.stream.mode_id >= 0).all()  # all ranked
+        assert not hist.stream.afk.any()
+        assert hist.state.n_players == 6
+        # team 0 always wins (roster winner = 1 - t)
+        assert (hist.stream.winner == 0).all()
+        # 6 players, 2 teams x 3 slots, no padding in a 3v3
+        assert (hist.stream.player_idx >= 0).sum() == 3 * 6
+
+    def test_matches_object_path_end_to_end(self, tmp_path):
+        import numpy as np
+
+        from analyzer_tpu.core.state import MU_LO, SIGMA_LO
+        from analyzer_tpu.core.constants import RATING_COLUMNS
+        from analyzer_tpu.sched import rate_history, pack_schedule
+
+        a = str(tmp_path / "obj.db")
+        b = str(tmp_path / "col.db")
+        for p in (a, b):
+            seed_db(p, n_matches=5, afk_match=2)
+
+        # object path: worker rates + commits into A
+        broker, store_a, worker = make_worker(a, batch_size=8)
+        for i in range(5):
+            broker.publish("analyze", f"m{i}".encode())
+        assert worker.poll()
+
+        # columnar path: ingest B, rate, write back
+        store_b = SqlStore(f"sqlite:///{b}")
+        hist = store_b.load_stream(RatingConfig())
+        sched = pack_schedule(hist.stream, pad_row=hist.state.pad_row)
+        final, _ = rate_history(hist.state, sched, RatingConfig())
+        n = store_b.write_players(final, hist.player_ids)
+        assert n == 6
+
+        cols = [
+            c for base in RATING_COLUMNS for c in (f"{base}_mu", f"{base}_sigma")
+        ]
+        present = [c for c in cols if c in store_b.columns["player"]]
+        sql = (
+            f"SELECT api_id, {', '.join(present)} FROM player ORDER BY api_id"
+        )
+        rows_a = sqlite3.connect(a).execute(sql).fetchall()
+        rows_b = sqlite3.connect(b).execute(sql).fetchall()
+        assert len(rows_a) == len(rows_b) == 6
+        for ra, rb in zip(rows_a, rows_b):
+            assert ra[0] == rb[0]
+            for va, vb in zip(ra[1:], rb[1:]):
+                if va is None or vb is None:
+                    assert va == vb, (ra[0], va, vb)
+                else:  # both paths write float32 values
+                    assert np.float32(va) == np.float32(vb), (ra[0], va, vb)
+
+        # and the in-table state agrees with what the object path wrote
+        tbl = np.asarray(final.table)
+        for r, pid in enumerate(hist.player_ids):
+            mu = sqlite3.connect(a).execute(
+                "SELECT trueskill_mu FROM player WHERE api_id=?", (pid,)
+            ).fetchone()[0]
+            got = tbl[r, MU_LO]
+            assert np.float32(mu) == np.float32(got)
+        assert SIGMA_LO  # imported symbols used above
+
+    def test_malformed_matches_marked_non_ratable(self, tmp_path):
+        path = str(tmp_path / "mal.db")
+        seed_db(path, n_matches=2)
+        conn = sqlite3.connect(path)
+        # m9: only one roster -> roster-count gate
+        conn.execute(
+            "INSERT INTO match (api_id, game_mode, created_at) VALUES "
+            "('m9', 'ranked', 2000)"
+        )
+        conn.execute(
+            "INSERT INTO roster (api_id, match_api_id, winner) VALUES "
+            "('m9-r0', 'm9', 1)"
+        )
+        # m8: two winners -> tie gate
+        conn.execute(
+            "INSERT INTO match (api_id, game_mode, created_at) VALUES "
+            "('m8', 'ranked', 2001)"
+        )
+        for t in range(2):
+            conn.execute(
+                "INSERT INTO roster (api_id, match_api_id, winner) VALUES "
+                f"('m8-r{t}', 'm8', 1)"
+            )
+        conn.commit()
+        conn.close()
+        store = SqlStore(f"sqlite:///{path}")
+        hist = store.load_stream(RatingConfig())
+        afk = dict(zip(hist.match_ids, hist.stream.afk))
+        assert afk["m9"] and afk["m8"]
+        assert not afk["m0"] and not afk["m1"]
+        assert hist.stream.ratable.sum() == 2
+
+    def test_three_roster_match_does_not_corrupt_neighbor(self, tmp_path):
+        # Regression (review finding): a malformed match with a THIRD
+        # roster must not collide its slot-numbering key with the next
+        # match's team 0 — the well-formed neighbor stays ratable with
+        # correct slots.
+        import numpy as np
+
+        path = str(tmp_path / "tri.db")
+        seed_db(path, n_matches=3)
+        conn = sqlite3.connect(path)
+        # give m2 (the chronologically FIRST match) a third roster with
+        # three participants of its own
+        conn.execute(
+            "INSERT INTO roster (api_id, match_api_id, winner) VALUES "
+            "('m2-r2', 'm2', 0)"
+        )
+        for s in range(3):
+            conn.execute(
+                "INSERT INTO participant (api_id, match_api_id, "
+                "roster_api_id, player_api_id, skill_tier, went_afk) "
+                f"VALUES ('m2-x{s}', 'm2', 'm2-r2', 'p{s}', 15, 0)"
+            )
+        conn.commit()
+        conn.close()
+        store = SqlStore(f"sqlite:///{path}")
+        hist = store.load_stream(RatingConfig())
+        afk = dict(zip(hist.match_ids, hist.stream.afk))
+        assert afk["m2"]  # 3 rosters -> non-ratable
+        assert not afk["m1"] and not afk["m0"]  # neighbors untouched
+        i1 = hist.match_ids.index("m1")
+        assert (hist.stream.player_idx[i1] >= 0).sum() == 6  # full 3v3
+
+
 class TestLoad:
     def test_load_dedupes_and_orders_chronologically(self, db_path):
         store = SqlStore(f"sqlite:///{db_path}")
