@@ -222,6 +222,15 @@ def cmd_rate(args) -> int:
     if args.db_write and not args.db:
         print("error: --db-write requires --db", file=sys.stderr)
         return 2
+    if args.db_write and args.stop_after_steps is not None:
+        # A bounded run never reaches the write-back; silently skipping
+        # it would let a user believe partial ratings were persisted.
+        print(
+            "error: --db-write requires a finished run "
+            "(drop --stop-after-steps, or resume to completion and "
+            "write then)", file=sys.stderr,
+        )
+        return 2
     timer = PhaseTimer()
     if args.mesh is not None:
         return _rate_mesh(args, cfg, timer)

@@ -30,6 +30,34 @@ from analyzer_tpu.core.state import (
 from analyzer_tpu.sched.superstep import MatchStream
 
 
+class PoisonError(Exception):
+    """Base for encode failures attributable to SPECIFIC matches.
+
+    ``api_ids`` names the offending match(es), so the worker can
+    dead-letter exactly those messages and rate the rest — one corrupt
+    record costs one message, not the whole 500-message batch. This
+    dominates both the reference's whole-batch policy
+    (``worker.py:110-120``) and round 2's strict divergence (which
+    dead-lettered all 500). Unattributable failures (store errors,
+    bugs) still fail the whole batch.
+    """
+
+    def __init__(self, api_ids, message):
+        super().__init__(message)
+        self.api_ids = tuple(api_ids)
+
+
+class PoisonMatchError(PoisonError, ValueError):
+    """A structurally malformed match (winner flags, team size)."""
+
+
+class PoisonTierError(PoisonError, KeyError):
+    """The reference's out-of-table skill-tier KeyError
+    (``rater.py:60``), attributed to every ratable match that would
+    consult the bad seed — a KeyError subclass so the reference's
+    exception-type contract holds (tests/test_rater_parity.py)."""
+
+
 class EncodedBatch:
     """A batch of match objects packed for the tensor path, with the maps
     needed to write results back.
@@ -106,12 +134,17 @@ class EncodedBatch:
             seed_cfg=cfg,
         )
 
-        # Match tensors.
+        # Match tensors. Structural problems are COLLECTED across the
+        # whole batch and raised as ONE PoisonMatchError naming every
+        # offender — a worker isolating them then retries once, not once
+        # per bad match (which would re-load and re-encode the remaining
+        # batch per incident, quadratic in the worst case).
         n = len(self.matches)
         idx = np.full((n, 2, MAX_TEAM_SIZE), -1, np.int32)
         winner = np.zeros((n,), np.int32)
         mode = np.full((n,), constants.UNSUPPORTED_MODE_ID, np.int32)
         afk = np.zeros((n,), bool)
+        poison: dict[str, str] = {}  # api_id -> reason
         # slot -> participant object, for the per-participant write-back
         self.slot_part: list[list[list[object]]] = []
         for i, m in enumerate(self.matches):
@@ -122,26 +155,40 @@ class EncodedBatch:
             if not bad:
                 wins = [bool(r.winner) for r in rosters]
                 if wins[0] == wins[1]:
-                    raise ValueError(
-                        f"match {m.api_id}: rosters must have exactly one "
-                        f"winner, got winner flags {wins}"
+                    poison[m.api_id] = (
+                        f"rosters must have exactly one winner, got winner "
+                        f"flags {wins}"
                     )
+                    self.slot_part.append(parts_grid)
+                    continue  # tensors stay inert; the raise below gates use
                 winner[i] = 0 if wins[0] else 1
+                oversize = False
                 for t, roster in enumerate(rosters):
                     plist = list(roster.participants)
                     if len(plist) > MAX_TEAM_SIZE:
-                        raise ValueError(
-                            f"match {m.api_id}: team of {len(plist)} exceeds "
-                            f"max team size {MAX_TEAM_SIZE}"
+                        poison[m.api_id] = (
+                            f"team of {len(plist)} exceeds max team size "
+                            f"{MAX_TEAM_SIZE}"
                         )
+                        oversize = True
+                        break
                     for s, part in enumerate(plist):
                         idx[i, t, s] = self.row_of[part.player[0].api_id]
                     parts_grid[t] = plist
+                if oversize:
+                    idx[i] = -1
+                    self.slot_part.append([[], []])
+                    continue
             anyafk = bad or any(
                 p.went_afk == 1 for p in getattr(m, "participants", [])
             )
             afk[i] = anyafk
             self.slot_part.append(parts_grid)
+        if poison:
+            raise PoisonMatchError(
+                tuple(poison),
+                "; ".join(f"match {k}: {v}" for k, v in poison.items()),
+            )
 
         self.stream = MatchStream(
             player_idx=idx, winner=winner, mode_id=mode, afk=afk
@@ -157,6 +204,8 @@ class EncodedBatch:
             ratable = (mode >= 0) & ~afk
             used = np.unique(idx[ratable])
             used = used[used >= 0]
+            hit_any = np.zeros(n, bool)
+            reasons: list[str] = []
             for r in used:
                 r = int(r)
                 if r not in bad_tier:
@@ -166,12 +215,23 @@ class EncodedBatch:
                     np.isnan(rb[r]) or rb[r] == 0
                 )
                 if no_shared and no_points:
-                    raise KeyError(
+                    # Every ratable match with this player consults the
+                    # same bad seed — isolating fewer would just fail
+                    # again on the next retry; all offenders are
+                    # collected into ONE raise for the same reason the
+                    # structural pass above collects.
+                    hit_any |= ratable & (idx == r).any(axis=(1, 2))
+                    reasons.append(
                         f"player {self.player_at[r].api_id}: skill_tier "
                         f"{bad_tier[r]} outside [{constants.MIN_SKILL_TIER}, "
                         f"{constants.MAX_SKILL_TIER}] and the seed would be "
                         "consulted (no shared rating, no rank points)"
                     )
+            if reasons:
+                raise PoisonTierError(
+                    tuple(self.matches[i].api_id for i in np.flatnonzero(hit_any)),
+                    "; ".join(reasons),
+                )
 
     def write_back(self, outs) -> None:
         """Applies HistoryOutputs (stream order) to the object graph with

@@ -356,7 +356,6 @@ class SqlStore:
             for a in range(lo, hi + 1, chunk_rows)
         ]
         cols = [*str_cols, *int_cols, *float_cols]
-        jobs = [(ri, c) for ri in range(len(ranges)) for c in cols]
 
         # One extra connection for the scans (bytes text factory without
         # disturbing the main connection); :memory: databases fall back
@@ -371,51 +370,52 @@ class SqlStore:
             conn = self.conn
         prev_factory = conn.text_factory
         conn.text_factory = bytes
+        by_col: dict[str, list[np.ndarray]] = {c: [] for c in cols}
         try:
             c = conn.cursor()
-            bufs = []
-            for ri, col in jobs:
-                # 'nan' for float columns: numpy's float parser turns it
-                # back into NaN, so SQL NULL round-trips without a
-                # sparse query.
-                fill = (
-                    "''" if col in str_cols
-                    else "0" if col in int_cols else "'nan'"
-                )
-                c.execute(
-                    f"SELECT group_concat(COALESCE({q(col)}, {fill}), "
-                    f"x'0a') FROM {q(table)} WHERE rowid BETWEEN ? AND ?",
-                    ranges[ri],
-                )
-                bufs.append(c.fetchone()[0])
+            for ri, _ in enumerate(ranges):
+                sizes = set()
+                for col in cols:
+                    # 'nan' for float columns: numpy's float parser turns
+                    # it back into NaN, so SQL NULL round-trips without a
+                    # sparse query.
+                    fill = (
+                        "''" if col in str_cols
+                        else "0" if col in int_cols else "'nan'"
+                    )
+                    c.execute(
+                        f"SELECT group_concat(COALESCE({q(col)}, {fill}), "
+                        f"x'0a') FROM {q(table)} WHERE rowid BETWEEN ? AND ?",
+                        ranges[ri],
+                    )
+                    buf = c.fetchone()[0]
+                    if buf is None:
+                        sizes.add(0)
+                        continue
+                    # Parse IMMEDIATELY so the raw text buffer frees per
+                    # column — peak memory is one column's text plus the
+                    # arrays, not every buffer at once.
+                    raw = buf.split(b"\n")
+                    del buf
+                    sizes.add(len(raw))
+                    dt = (
+                        None if col in str_cols
+                        else np.int64 if col in int_cols else np.float64
+                    )
+                    by_col[col].append(
+                        np.array(raw) if dt is None else np.array(raw, dt)
+                    )
+                if len(sizes) > 1:  # COALESCE guarantees alignment; fail loudly
+                    raise RuntimeError(
+                        f"bulk scan of {table}: misaligned column lengths "
+                        f"{sizes}"
+                    )
             c.close()
         finally:
             if conn is not self.conn:
                 conn.close()
             else:
                 conn.text_factory = prev_factory
-
-        by_col: dict[str, list[np.ndarray]] = {c: [] for c in cols}
-        for ri in range(len(ranges)):
-            sizes = set()
-            for ci, col in enumerate(cols):
-                buf = bufs[ri * len(cols) + ci]
-                if buf is None:
-                    sizes.add(0)
-                    continue
-                raw = buf.split(b"\n")
-                sizes.add(len(raw))
-                dt = (
-                    None if col in str_cols
-                    else np.int64 if col in int_cols else np.float64
-                )
-                by_col[col].append(
-                    np.array(raw) if dt is None else np.array(raw, dt)
-                )
-            if len(sizes) > 1:  # COALESCE guarantees alignment; fail loudly
-                raise RuntimeError(
-                    f"bulk scan of {table}: misaligned column lengths {sizes}"
-                )
         if not any(by_col[c] for c in cols):
             return empty
         return {c: np.concatenate(by_col[c]) for c in cols}

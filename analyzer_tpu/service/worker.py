@@ -157,26 +157,64 @@ class Worker:
                     signal.signal(sig, handler)
 
     # -- batch pipeline ---------------------------------------------------
-    def try_process(self) -> None:
-        """The reference's ``try_process`` (``worker.py:103-166``)."""
-        batch = self.queue
-        self.queue = []
-        self._first_message_at = None
-        try:
-            rated_ids = self.process([m.body.decode() for m in batch])
-        except Exception as err:  # noqa: BLE001 — policy: any error dead-letters
-            logger.error("batch failed: %s", err)
-            self.batches_failed += 1
+    def _dead_letter(self, messages) -> None:
+        """Republish to the failed queue + nack without requeue — the
+        reference's failure policy (``worker.py:110-120``), applied here
+        to whatever subset the caller determined."""
+        rollback = getattr(self.store, "rollback", None)
+        if rollback is not None:
             # Close out any read transaction load_batch's SELECTs opened
             # (the reference's rollback-then-close, worker.py:195-199);
             # without this a MySQL connection would pin a stale snapshot
             # and the next load_batch would miss newly ingested matches.
-            rollback = getattr(self.store, "rollback", None)
-            if rollback is not None:
-                rollback()
-            for msg in batch:
-                self.broker.publish(self.config.failed_queue, msg.body, msg.headers)
-                self.broker.nack(msg.delivery_tag, requeue=False)
+            rollback()
+        for msg in messages:
+            self.broker.publish(self.config.failed_queue, msg.body, msg.headers)
+            self.broker.nack(msg.delivery_tag, requeue=False)
+
+    def try_process(self) -> None:
+        """The reference's ``try_process`` (``worker.py:103-166``), with
+        POISON-PILL ISOLATION on top: a failure that names its offending
+        match(es) (service.encode.PoisonError) dead-letters exactly
+        those messages and retries the rest, so one corrupt record costs
+        one message instead of the whole 500 (the reference dead-letters
+        everything, ``worker.py:110-120``). Unattributable errors keep
+        the whole-batch policy."""
+        from analyzer_tpu.service.encode import PoisonError
+
+        batch = self.queue
+        self.queue = []
+        self._first_message_at = None
+        for _ in range(len(batch) + 1):  # each pass removes >= 1 message
+            try:
+                self.process([m.body.decode() for m in batch])
+                break
+            except PoisonError as err:
+                bad_ids = set(err.api_ids)
+                bad = [m for m in batch if m.body.decode() in bad_ids]
+                if not bad:  # can't attribute after all: whole-batch policy
+                    logger.error("batch failed: %s", err)
+                    self.batches_failed += 1
+                    self._dead_letter(batch)
+                    return
+                logger.error(
+                    "poison match(es) %s: %s; dead-lettering %d message(s), "
+                    "retrying the other %d",
+                    sorted(bad_ids), err, len(bad), len(batch) - len(bad),
+                )
+                self._dead_letter(bad)
+                keep = {id(m) for m in bad}
+                batch = [m for m in batch if id(m) not in keep]
+                if not batch:
+                    return
+            except Exception as err:  # noqa: BLE001 — policy: any error dead-letters
+                logger.error("batch failed: %s", err)
+                self.batches_failed += 1
+                self._dead_letter(batch)
+                return
+        else:  # loop exhausted without success — defensive, unreachable
+            self.batches_failed += 1
+            self._dead_letter(batch)
             return
 
         logger.info("acking batch")
@@ -253,7 +291,9 @@ def main(max_flushes: int | None = None) -> Worker:
     config = ServiceConfig.from_env()
     from analyzer_tpu.service.broker import make_pika_broker
 
-    broker = make_pika_broker(config.rabbitmq_uri)
+    # prefetch_count=BATCHSIZE bounds in-flight messages exactly like the
+    # reference (worker.py:91).
+    broker = make_pika_broker(config.rabbitmq_uri, prefetch=config.batch_size)
     if config.database_uri:
         from analyzer_tpu.service.sql_store import SqlStore
 

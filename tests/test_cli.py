@@ -59,6 +59,9 @@ class TestCli:
         assert main(["rate"]) == 2
         assert main(["rate", "--csv", "x", "--db", "sqlite:///y"]) == 2
         assert main(["rate", "--csv", "x", "--db-write"]) == 2
+        # a bounded run never reaches the write-back — refuse loudly
+        assert main(["rate", "--db", "sqlite:///y", "--db-write",
+                     "--stop-after-steps", "3"]) == 2
         capsys.readouterr()
 
     def test_train_both_heads(self, tmp_path, capsys):

@@ -2,12 +2,16 @@
 
 Round-1 review: ``make_pika_broker`` was the one L3 surface with zero
 verification — pika isn't installed here, so the adapter was dead code.
-A faithful in-memory stub of the pika 0.10 blocking API (URLParameters,
-BlockingConnection, channel with queue_declare/basic_publish/basic_get/
-basic_ack/basic_nack, BasicProperties) is injected via sys.modules and the
-adapter's full 6-method Broker protocol runs against it, including the
-delivery-tag and header mapping. The no-pika construction error path is
-pinned from the cmd_worker entry point.
+A faithful in-memory stub of the pika blocking API is injected via
+sys.modules and the adapter's full 6-method Broker protocol runs against
+it. Round 3 upgraded both sides to the reference's actual consumption
+model (``basic_qos(prefetch_count)`` + ``basic_consume`` push flow,
+``worker.py:91-92``): the stub now models a SERVER (queues shared across
+connections, per-channel unacked maps, prefetch-bounded delivery on
+``process_data_events``) and can drop all connections — requeueing
+unacked deliveries — to exercise the adapter's reconnect path. The
+no-pika construction error path is pinned from the cmd_worker entry
+point.
 """
 
 import sys
@@ -19,6 +23,46 @@ import pytest
 
 def make_stub_pika():
     pika = types.ModuleType("pika")
+    exc = types.ModuleType("pika.exceptions")
+
+    class AMQPError(Exception):
+        pass
+
+    class AMQPConnectionError(AMQPError):
+        pass
+
+    class ConnectionClosed(AMQPConnectionError):
+        pass
+
+    exc.AMQPError = AMQPError
+    exc.AMQPConnectionError = AMQPConnectionError
+    exc.ConnectionClosed = ConnectionClosed
+    pika.exceptions = exc
+
+    class _Server:
+        """Broker-side state shared by every connection of this stub."""
+
+        def __init__(self):
+            self.queues: dict[str, deque] = {}
+            self.connections: list = []
+
+        def drop_all(self):
+            """Kills every live connection; unacked deliveries requeue at
+            the FRONT, preserving order (AMQP redelivery semantics)."""
+            for conn in self.connections:
+                ch = conn._channel
+                for tag in sorted(ch._unacked, reverse=True):
+                    queue, headers, body = ch._unacked[tag]
+                    self.queues.setdefault(queue, deque()).appendleft(
+                        (headers, body)
+                    )
+                ch._unacked.clear()
+                ch._open = False
+                conn._open = False
+            self.connections = []
+
+    server = _Server()
+    pika._server = server
 
     class URLParameters:
         def __init__(self, uri):
@@ -33,46 +77,90 @@ def make_stub_pika():
             self.delivery_tag = tag
 
     class _Channel:
-        def __init__(self):
+        def __init__(self, server):
+            self._server = server
+            self._open = True
             self.declared = []
-            self.queues = {}
             self.topic_published = []
             self.acked = []
             self.nacked = []
             self._tag = 0
+            self._prefetch = 0
+            self._consumers: list[tuple[str, object]] = []
+            self._unacked: dict[int, tuple] = {}
+
+        def _check(self):
+            if not self._open:
+                raise ConnectionClosed("stub connection dropped")
 
         def queue_declare(self, queue, durable=False):
+            self._check()
             self.declared.append((queue, durable))
-            self.queues.setdefault(queue, deque())
+            self._server.queues.setdefault(queue, deque())
+
+        def basic_qos(self, prefetch_count=0):
+            self._check()
+            self._prefetch = prefetch_count
+
+        def basic_consume(self, queue=None, on_message_callback=None):
+            self._check()
+            self._consumers.append((queue, on_message_callback))
 
         def basic_publish(self, exchange, routing_key, body, properties=None):
+            self._check()
             if exchange:  # topic publish
                 self.topic_published.append((exchange, routing_key, body))
                 return
             headers = getattr(properties, "headers", None)
-            self.queues.setdefault(routing_key, deque()).append((headers, body))
+            self._server.queues.setdefault(routing_key, deque()).append(
+                (headers, body)
+            )
 
-        def basic_get(self, queue):
-            q = self.queues.get(queue)
-            if not q:
-                return None, None, None
-            headers, body = q.popleft()
-            self._tag += 1
-            return _Method(self._tag), BasicProperties(headers), body
+        def _pump(self):
+            self._check()
+            for queue, cb in self._consumers:
+                q = self._server.queues.get(queue)
+                while q and (
+                    self._prefetch == 0 or len(self._unacked) < self._prefetch
+                ):
+                    headers, body = q.popleft()
+                    self._tag += 1
+                    self._unacked[self._tag] = (queue, headers, body)
+                    cb(self, _Method(self._tag), BasicProperties(headers), body)
 
         def basic_ack(self, tag):
+            self._check()
+            self._unacked.pop(tag, None)
             self.acked.append(tag)
 
         def basic_nack(self, tag, requeue=False):
+            self._check()
+            entry = self._unacked.pop(tag, None)
+            if entry is not None and requeue:
+                queue, headers, body = entry
+                self._server.queues[queue].appendleft((headers, body))
             self.nacked.append((tag, requeue))
 
     class BlockingConnection:
         def __init__(self, params):
             self.params = params
-            self._channel = _Channel()
+            self._open = True
+            self._channel = _Channel(server)
+            server.connections.append(self)
 
         def channel(self):
             return self._channel
+
+        def process_data_events(self, time_limit=0):
+            if not self._open:
+                raise ConnectionClosed("stub connection dropped")
+            self._channel._pump()
+
+        def close(self):
+            self._open = False
+            self._channel._open = False
+            if self in server.connections:
+                server.connections.remove(self)
 
     pika.URLParameters = URLParameters
     pika.BasicProperties = BasicProperties
@@ -148,6 +236,66 @@ class TestPikaAdapter:
         assert store.matches["m0"].trueskill_quality is not None
 
 
+class TestPushConsume:
+    """The round-3 adapter contract: prefetch bounds in-flight messages
+    (reference worker.py:91) and a dropped connection reconnects with
+    redeclare + re-qos + re-subscribe, relying on broker redelivery."""
+
+    def test_prefetch_bounds_in_flight(self, stub_pika):
+        from analyzer_tpu.service.broker import make_pika_broker
+
+        broker = make_pika_broker("amqp://localhost", prefetch=2)
+        broker.declare_queue("q")
+        for i in range(5):
+            broker.publish("q", f"{i}".encode())
+        got = broker.get("q", 10)
+        assert [m.body for m in got] == [b"0", b"1"]  # qos bound, not 5
+        assert broker.get("q", 10) == []  # still 2 unacked -> no pushes
+        for m in got:
+            broker.ack(m.delivery_tag)
+        got2 = broker.get("q", 10)
+        assert [m.body for m in got2] == [b"2", b"3"]
+
+    def test_dropped_connection_reconnects_and_redelivers(self, stub_pika):
+        from analyzer_tpu.service.broker import make_pika_broker
+
+        broker = make_pika_broker("amqp://localhost", prefetch=10)
+        broker.declare_queue("q")
+        for i in range(3):
+            broker.publish("q", f"{i}".encode())
+        got = broker.get("q", 10)
+        assert len(got) == 3
+        broker.ack(got[0].delivery_tag)
+        stale = [m.delivery_tag for m in got[1:]]
+        old_conn = broker._conn
+        stub_pika._server.drop_all()
+
+        got2 = broker.get("q", 10)  # reconnects, broker redelivers unacked
+        assert broker._conn is not old_conn
+        assert [m.body for m in got2] == [b"1", b"2"]
+        assert ("q", True) in broker._ch.declared  # durable redeclare
+        assert broker._ch._prefetch == 10  # qos re-applied
+
+        # stale (dead-channel) tags settle as silent no-ops — never an
+        # ack of a different message on the new channel
+        for t in stale:
+            broker.ack(t)
+        assert broker._ch.acked == []
+        for m in got2:
+            broker.ack(m.delivery_tag)
+        assert len(broker._ch.acked) == 2
+        assert broker.get("q", 10) == []  # nothing lost, nothing duplicated
+
+    def test_publish_survives_drop(self, stub_pika):
+        from analyzer_tpu.service.broker import make_pika_broker
+
+        broker = make_pika_broker("amqp://localhost")
+        broker.declare_queue("q")
+        stub_pika._server.drop_all()
+        broker.publish("q", b"after-drop")  # reconnect inside publish
+        assert [m.body for m in broker.get("q", 10)] == [b"after-drop"]
+
+
 class TestMainEntryPoint:
     def test_main_wires_pika_and_sql_store(self, stub_pika, tmp_path, monkeypatch):
         """The reference's __main__ path end-to-end: env config -> pika
@@ -169,8 +317,8 @@ class TestMainEntryPoint:
 
         orig = broker_mod.make_pika_broker
 
-        def seeded(uri):
-            b = orig(uri)
+        def seeded(uri, **kw):
+            b = orig(uri, **kw)
             b.publish("analyze", b"m0")
             return b
 

@@ -409,14 +409,15 @@ class TestEndToEnd:
         assert out[0].headers == {"match_api_id": "m1"}
 
     def test_poison_batch_leaves_db_untouched(self, tmp_path):
-        """Tier-30 player with no rating/points -> encode KeyError -> whole
-        batch dead-lettered, nothing committed (worker.py:110-120,195-197)."""
+        """Tier-30 player with no rating/points -> encode KeyError -> the
+        poisoned match is ISOLATED and dead-lettered (round-3 poison-pill;
+        a whole batch died here through round 2), nothing committed."""
         path = str(tmp_path / "poison.db")
         seed_db(path, n_matches=1, tier=30)
         broker, store, worker = make_worker(path)
         broker.publish("analyze", b"m0")
         worker.poll()
-        assert worker.batches_failed == 1
+        assert worker.batches_failed == 0  # isolation, not batch failure
         assert len(broker.queues[worker.config.failed_queue]) == 1
         db = sqlite3.connect(path)
         assert db.execute(
