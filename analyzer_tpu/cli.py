@@ -66,15 +66,28 @@ def _maybe_db_write(args, timer, db_store, state, player_ids) -> dict:
 
 def cmd_synth(args) -> int:
     from analyzer_tpu.io.csv_codec import save_stream
-    from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
+    from analyzer_tpu.io.synthetic import (
+        synthetic_players,
+        synthetic_stream,
+        synthetic_telemetry,
+    )
 
     players = synthetic_players(args.players, seed=args.seed)
     stream = synthetic_stream(
         args.matches, players, seed=args.seed,
         activity_concentration=args.concentration,
     )
-    save_stream(args.out, stream)
-    print(f"wrote {stream.n_matches} matches / {args.players} players to {args.out}")
+    telemetry = None
+    if args.telemetry:
+        if not args.out.endswith(".npz"):
+            print("error: --telemetry requires an .npz output", file=sys.stderr)
+            return 2
+        telemetry = synthetic_telemetry(stream, players, seed=args.seed)
+    save_stream(args.out, stream, telemetry=telemetry)
+    print(
+        f"wrote {stream.n_matches} matches / {args.players} players to "
+        f"{args.out}" + (" (+telemetry)" if telemetry is not None else "")
+    )
     return 0
 
 
@@ -180,6 +193,35 @@ def _rate_stats(stream, cursor, n_players, state, sched, timer, **extra) -> str:
         "phases": {k: round(v, 3) for k, v in timer.report().items()},
     }
     return json.dumps(stats)
+
+
+def _auc(p: np.ndarray, y: np.ndarray) -> float | None:
+    """ROC AUC via the Mann-Whitney U statistic, tie-averaged ranks."""
+    pos = y == 1.0
+    n1, n0 = int(pos.sum()), int((~pos).sum())
+    if n1 == 0 or n0 == 0:
+        return None
+    order = np.argsort(p, kind="mergesort")
+    sp = p[order]
+    first = np.r_[True, sp[1:] != sp[:-1]]
+    grp = np.cumsum(first) - 1
+    counts = np.bincount(grp)
+    starts = np.cumsum(counts) - counts
+    avg = starts + (counts - 1) / 2.0 + 1.0
+    ranks = np.empty(p.size)
+    ranks[order] = avg[grp]
+    return float((ranks[pos].sum() - n1 * (n1 + 1) / 2.0) / (n1 * n0))
+
+
+def _ece(p: np.ndarray, y: np.ndarray, bins: int = 10) -> float:
+    """Expected calibration error over equal-width probability bins."""
+    idx = np.clip((p * bins).astype(int), 0, bins - 1)
+    err = 0.0
+    for b in range(bins):
+        sel = idx == b
+        if sel.any():
+            err += abs(p[sel].mean() - y[sel].mean()) * sel.mean()
+    return float(err)
 
 
 def _half_credit_accuracy(p: np.ndarray, team0_won: np.ndarray) -> float:
@@ -495,26 +537,51 @@ def cmd_train(args) -> int:
     with timer.phase("features"):
         sched = pack_schedule(stream, pad_row=state.pad_row, windowed=True)
         feats, ratable, _ = history_features(state, sched, cfg)
+        if args.telemetry:
+            # Config 4's full-telemetry head: POST-GAME stats, so this
+            # trains an analysis model (outcome from game stats), not a
+            # forecast — models/features.py documents the distinction.
+            from analyzer_tpu.io.csv_codec import load_telemetry
+            from analyzer_tpu.models.features import telemetry_features
+
+            tel = load_telemetry(args.csv)
+            if tel is None:
+                print(
+                    "error: --telemetry needs an .npz stream with a "
+                    "telemetry block (synth --telemetry)", file=sys.stderr,
+                )
+                return 2
+            feats = np.concatenate(
+                [feats, telemetry_features(tel, stream.player_idx)], axis=1
+            )
     y = (stream.winner == 0).astype(np.float32)
     rows = np.flatnonzero(ratable)  # stream order
     if rows.size < 10:
         print("error: too few ratable matches to train on", file=sys.stderr)
         return 2
+    mesh = None
+    if args.mesh is not None:
+        from analyzer_tpu.parallel import make_mesh
+
+        mesh = make_mesh(args.mesh or None)
     cut = max(1, int(rows.size * (1.0 - args.eval_frac)))
     tr, ev = rows[:cut], rows[cut:]
     with timer.phase("train"):
         if args.model == "logistic":
             model, nll = train_logistic(
-                feats[tr], y[tr], epochs=args.epochs, seed=args.seed
+                feats[tr], y[tr], epochs=args.epochs, seed=args.seed,
+                mesh=mesh,
             )
         else:
             model, nll = train_mlp(
                 feats[tr], y[tr], hidden=args.hidden,
-                epochs=args.epochs, seed=args.seed,
+                epochs=args.epochs, seed=args.seed, mesh=mesh,
             )
     p = np.asarray(model.predict(feats[ev])) if ev.size else np.empty(0)
     if ev.size:
         acc = _half_credit_accuracy(p, y[ev])
+        auc = _auc(p, y[ev])
+        ece = _ece(p, y[ev])
         eps = 1e-7
         logloss = float(
             -np.mean(
@@ -522,7 +589,7 @@ def cmd_train(args) -> int:
             )
         )
     else:
-        acc = logloss = None
+        acc = logloss = auc = ece = None
     if args.out:
         np.savez(
             args.out,
@@ -539,6 +606,8 @@ def cmd_train(args) -> int:
                 "train_nll": round(float(nll), 4),
                 "eval_accuracy": round(acc, 4) if acc is not None else None,
                 "eval_logloss": round(logloss, 4) if logloss is not None else None,
+                "eval_auc": round(auc, 4) if auc is not None else None,
+                "eval_ece": round(ece, 4) if ece is not None else None,
                 "phases": {k: round(v, 3) for k, v in timer.report().items()},
             }
         )
@@ -586,6 +655,11 @@ def main(argv=None) -> int:
     s.add_argument("--seed", type=int, default=0)
     s.add_argument("--concentration", type=float, default=0.8)
     s.add_argument("--out", required=True, help=".csv (native parser) or .npz (binary)")
+    s.add_argument(
+        "--telemetry", action="store_true",
+        help="also generate post-game telemetry (K/D/A, gold, cs) for the "
+        "config-4 analysis head (.npz only)",
+    )
     s.set_defaults(fn=cmd_synth)
 
     s = sub.add_parser("rate", help="TrueSkill full-history re-rate of a stream")
@@ -635,6 +709,16 @@ def main(argv=None) -> int:
                    help="chronological tail fraction held out for eval")
     s.add_argument("--seed", type=int, default=0)
     s.add_argument("--out", help="npz output for the trained weights")
+    s.add_argument(
+        "--telemetry", action="store_true",
+        help="append post-game telemetry features (analysis head, "
+        "BASELINE config 4; needs an .npz stream from synth --telemetry)",
+    )
+    s.add_argument(
+        "--mesh", type=int, metavar="N",
+        help="data-parallel training: shard the minibatch axis over N "
+        "devices (0 = all)",
+    )
     s.set_defaults(fn=cmd_train)
 
     s = sub.add_parser("elo", help="Elo re-rate of a CSV + accuracy")

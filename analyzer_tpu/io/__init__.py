@@ -10,7 +10,11 @@ for bulk interchange, and explicit state snapshots with match + superstep
 cursors and a schedule fingerprint.
 """
 
-from analyzer_tpu.io.synthetic import synthetic_stream, synthetic_players
+from analyzer_tpu.io.synthetic import (
+    synthetic_players,
+    synthetic_stream,
+    synthetic_telemetry,
+)
 from analyzer_tpu.io.csv_codec import (
     load_stream,
     load_stream_csv,
@@ -24,6 +28,7 @@ from analyzer_tpu.io.checkpoint import load_checkpoint, save_checkpoint
 __all__ = [
     "synthetic_stream",
     "synthetic_players",
+    "synthetic_telemetry",
     "load_stream",
     "load_stream_csv",
     "load_stream_npz",

@@ -39,17 +39,32 @@ def save_stream_csv(path: str, stream: MatchStream) -> None:
             w.writerow([i, mode, int(stream.winner[i]), int(stream.afk[i])] + teams)
 
 
-def save_stream_npz(path: str, stream: MatchStream) -> None:
+def save_stream_npz(
+    path: str, stream: MatchStream, telemetry: np.ndarray | None = None
+) -> None:
     """Binary stream format — the bulk-interchange fast path. A 10M-match
     history is ~3 min each way as CSV text; as npz it is seconds. Same
-    chronological-order contract as the CSV."""
-    np.savez(
-        path,
+    chronological-order contract as the CSV. ``telemetry`` optionally
+    rides along (``[N, 2, T, 5]`` post-game stats, io/synthetic.py) for
+    the config-4 analysis head — npz only, the CSV schema has no column
+    for it."""
+    arrays = dict(
         player_idx=stream.player_idx,
         winner=stream.winner,
         mode_id=stream.mode_id,
         afk=stream.afk,
     )
+    if telemetry is not None:
+        from analyzer_tpu.io.synthetic import TELEMETRY_STATS
+
+        want = stream.player_idx.shape + (len(TELEMETRY_STATS),)
+        if telemetry.ndim != 4 or telemetry.shape != want:
+            raise ValueError(
+                f"telemetry shape {telemetry.shape} does not match the "
+                f"stream's {want} ([N, 2, T, {len(TELEMETRY_STATS)}])"
+            )
+        arrays["telemetry"] = telemetry
+    np.savez(path, **arrays)
 
 
 def load_stream_npz(path: str) -> MatchStream:
@@ -62,10 +77,23 @@ def load_stream_npz(path: str) -> MatchStream:
         )
 
 
-def save_stream(path: str, stream: MatchStream) -> None:
+def load_telemetry(path: str) -> np.ndarray | None:
+    """The telemetry block of an ``.npz`` stream, or None (absent /
+    CSV stream)."""
+    if not path.endswith(".npz"):
+        return None
+    with np.load(path) as z:
+        return z["telemetry"] if "telemetry" in z else None
+
+
+def save_stream(
+    path: str, stream: MatchStream, telemetry: np.ndarray | None = None
+) -> None:
     """Extension-dispatched save: ``.npz`` binary, anything else CSV."""
     if path.endswith(".npz"):
-        save_stream_npz(path, stream)
+        save_stream_npz(path, stream, telemetry)
+    elif telemetry is not None:
+        raise ValueError("telemetry requires the .npz stream format")
     else:
         save_stream_csv(path, stream)
 

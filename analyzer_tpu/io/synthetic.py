@@ -209,3 +209,42 @@ def synthetic_stream(
     winner = (rng.random(n) >= p_win).astype(np.int32)  # 0 if team0 wins
 
     return MatchStream(player_idx=player_idx, winner=winner, mode_id=mode_id, afk=afk)
+
+
+TELEMETRY_STATS = ("kills", "deaths", "assists", "gold", "cs")
+
+
+def synthetic_telemetry(
+    stream: MatchStream, players: SyntheticPlayers, seed: int = 0
+) -> np.ndarray:
+    """Per-participant POST-GAME telemetry ``[N, 2, T, 5]`` float32
+    (kills, deaths, assists, gold, creep score), zero at padded slots.
+
+    BASELINE config 4's "MLP match-outcome predictor on full telemetry
+    (items, gold, KDA)" consumes these. The reference's data model keeps
+    them in ``participant_stats`` (``worker.py:75-78``) — wired into the
+    ORM, never loaded by the rating path — so the telemetry head is an
+    ANALYSIS model over finished matches, not a forecast (the leak-free
+    forecasting features are ``models.features.match_features``).
+
+    Signal structure: winners farm more gold/CS and trade kills for
+    deaths; a player's latent skill shifts their individual output within
+    the team; everything is noisy enough that the head must actually
+    learn the aggregation.
+    """
+    rng = np.random.default_rng(seed + 7)
+    n, _, t = stream.player_idx.shape
+    mask = stream.player_idx >= 0
+    skill = players.latent_skill[np.clip(stream.player_idx, 0, None)]
+    z = ((skill - 1500.0) / 400.0).astype(np.float64)  # ~N(0,1)
+    won = (np.arange(2)[None, :] == stream.winner[:, None]).astype(np.float64)
+    w = won[:, :, None]  # [N,2,1]
+
+    kills = rng.poisson(np.exp(0.25 * z + 0.7 * w - 0.1))
+    deaths = rng.poisson(np.exp(-0.15 * z - 0.6 * w + 0.9))
+    assists = rng.poisson(np.exp(0.15 * z + 0.5 * w + 0.4))
+    gold = np.clip(rng.normal(8000 + 2500 * w + 800 * z, 1500), 0, None)
+    cs = np.clip(rng.normal(120 + 25 * w + 15 * z, 30), 0, None)
+
+    out = np.stack([kills, deaths, assists, gold, cs], axis=-1).astype(np.float32)
+    return out * mask[..., None].astype(np.float32)

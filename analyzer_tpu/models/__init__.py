@@ -9,7 +9,13 @@ SGD/Adam steps that scan over minibatches on device.
 """
 
 from analyzer_tpu.models.elo import EloConfig, elo_history, elo_rate_batch
-from analyzer_tpu.models.features import N_FEATURES, history_features, match_features
+from analyzer_tpu.models.features import (
+    N_FEATURES,
+    N_TELEMETRY_FEATURES,
+    history_features,
+    match_features,
+    telemetry_features,
+)
 from analyzer_tpu.models.logistic import LogisticModel, train_logistic
 from analyzer_tpu.models.mlp import MLPModel, init_mlp, train_mlp
 
@@ -20,6 +26,8 @@ __all__ = [
     "match_features",
     "history_features",
     "N_FEATURES",
+    "N_TELEMETRY_FEATURES",
+    "telemetry_features",
     "LogisticModel",
     "train_logistic",
     "MLPModel",

@@ -67,6 +67,26 @@ def match_features(
     )
 
 
+N_TELEMETRY_FEATURES = 10
+
+
+def telemetry_features(telemetry, player_idx) -> "np.ndarray":
+    """``[N, 10]`` from POST-GAME telemetry ``[N, 2, T, 5]`` (kills,
+    deaths, assists, gold, cs — io/synthetic.py TELEMETRY_STATS): per
+    stat, the bounded team ratio ``(t0 - t1) / (t0 + t1 + 1)`` and the
+    log1p match total (scale). These describe a FINISHED match — the
+    telemetry head (BASELINE config 4) analyzes outcomes from game
+    stats; it does not forecast. Forecasting features are
+    :func:`match_features` (pre-match state only)."""
+    import numpy as np
+
+    mask = (player_idx >= 0).astype(np.float32)[..., None]
+    team = (np.asarray(telemetry, np.float32) * mask).sum(axis=2)  # [N,2,5]
+    total = team.sum(axis=1)  # [N,5]
+    diff = (team[:, 0] - team[:, 1]) / (total + 1.0)
+    return np.concatenate([diff, np.log1p(total)], axis=1).astype(np.float32)
+
+
 def history_features(state, sched, cfg: RatingConfig, steps_per_chunk: int = 8192):
     """Leak-free training data for the win-prob heads: one scan over the
     packed schedule that computes each match's features from the PRE-match

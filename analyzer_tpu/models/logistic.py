@@ -47,10 +47,13 @@ def train_logistic(
     batch_size: int = 4096,
     lr: float = 0.05,
     seed: int = 0,
+    mesh=None,
 ) -> tuple[LogisticModel, float]:
-    """Trains on ``[N, F]`` features; returns (model, final mean NLL)."""
+    """Trains on ``[N, F]`` features; returns (model, final mean NLL).
+    ``mesh`` shards the minibatch axis (models.training)."""
     f = features.shape[1]
     model = LogisticModel(w=jnp.zeros((f,), jnp.float32), b=jnp.zeros((), jnp.float32))
     return train_minibatch(
-        model, _nll, features, team0_won, epochs, batch_size, lr, seed
+        model, _nll, features, team0_won, epochs, batch_size, lr, seed,
+        mesh=mesh,
     )

@@ -73,9 +73,12 @@ def train_mlp(
     batch_size: int = 4096,
     lr: float = 1e-3,
     seed: int = 0,
+    mesh=None,
 ) -> tuple[MLPModel, float]:
-    """Trains on ``[N, F]`` features; returns (model, final mean NLL)."""
+    """Trains on ``[N, F]`` features; returns (model, final mean NLL).
+    ``mesh`` shards the minibatch axis (models.training)."""
     model = init_mlp(features.shape[1], hidden, seed)
     return train_minibatch(
-        model, _nll, features, team0_won, epochs, batch_size, lr, seed
+        model, _nll, features, team0_won, epochs, batch_size, lr, seed,
+        mesh=mesh,
     )

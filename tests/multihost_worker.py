@@ -92,6 +92,21 @@ def main() -> int:
     if not np.array_equal(got, want, equal_nan=True):
         print(f"proc {process_id}: MISMATCH", file=sys.stderr)
         return 1
+
+    # Round-3 production path: the WINDOWED sharded feed — per-chunk
+    # gather tensors AND per-chunk scatter routing, built independently
+    # on each host from the identical deterministic schedule. Must stay
+    # in SPMD lockstep and produce the same bits.
+    wsched = pack_schedule(
+        stream, pad_row=state.pad_row, batch_size=16, windowed=True
+    )
+    sharded_w = rate_history_sharded(
+        state, wsched, cfg, mesh=mesh, steps_per_chunk=7
+    )
+    got_w = np.asarray(sharded_w.table)[: state.n_players]
+    if not np.array_equal(got_w, want, equal_nan=True):
+        print(f"proc {process_id}: WINDOWED MISMATCH", file=sys.stderr)
+        return 1
     print(f"proc {process_id}: bit-identical over 2-process mesh", flush=True)
     return 0
 

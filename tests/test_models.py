@@ -132,3 +132,71 @@ class TestFeaturesAndHeads:
         p = np.asarray(model.predict(feats[ratable]))
         acc = ((p > 0.5) == (y[ratable] > 0.5)).mean()
         assert acc > 0.6, acc
+
+
+class TestTelemetryHead:
+    """BASELINE config 4's "full telemetry" analysis head: post-game
+    K/D/A, gold, cs features must carry much more signal about the
+    outcome than the pre-match rating features alone."""
+
+    def test_telemetry_features_shape_and_masking(self, history):
+        from analyzer_tpu.io.synthetic import synthetic_telemetry
+        from analyzer_tpu.models import N_TELEMETRY_FEATURES, telemetry_features
+
+        players, stream, state, sched = history
+        tel = synthetic_telemetry(stream, players, seed=21)
+        assert tel.shape == stream.player_idx.shape + (5,)
+        # padded slots contribute nothing
+        assert (tel[stream.player_idx < 0] == 0).all()
+        f = telemetry_features(tel, stream.player_idx)
+        assert f.shape == (stream.n_matches, N_TELEMETRY_FEATURES)
+        assert np.isfinite(f).all()
+
+    def test_telemetry_mlp_beats_rating_only(self, history):
+        from analyzer_tpu.io.synthetic import synthetic_telemetry
+        from analyzer_tpu.models import telemetry_features
+
+        players, stream, state, sched = history
+        feats, ratable, _ = history_features(state, sched, CFG)
+        tel = synthetic_telemetry(stream, players, seed=21)
+        tfeats = np.concatenate(
+            [feats, telemetry_features(tel, stream.player_idx)], axis=1
+        )
+        y = (stream.winner == 0).astype(np.float32)
+        _, nll_rating = train_mlp(
+            feats[ratable], y[ratable], epochs=40, batch_size=512, hidden=32
+        )
+        model, nll_tel = train_mlp(
+            tfeats[ratable], y[ratable], epochs=40, batch_size=512, hidden=32
+        )
+        assert nll_tel < nll_rating - 0.05, (nll_tel, nll_rating)
+        p = np.asarray(model.predict(tfeats[ratable]))
+        acc = ((p > 0.5) == (y[ratable] > 0.5)).mean()
+        assert acc > 0.8, acc  # post-game stats nearly decide the match
+
+
+class TestMeshTraining:
+    def test_mesh_training_matches_single_device(self, history):
+        # Data-parallel minibatch sharding: GSPMD inserts the gradient
+        # all-reduce; the result must match single-device training up to
+        # f32 reduction order.
+        import jax
+
+        if len(jax.devices()) < 8:
+            pytest.skip("need 8 devices")
+        from analyzer_tpu.parallel import make_mesh
+
+        players, stream, state, sched = history
+        feats, ratable, _ = history_features(state, sched, CFG)
+        y = (stream.winner == 0).astype(np.float32)
+        single, nll_s = train_logistic(
+            feats[ratable], y[ratable], epochs=30, batch_size=512
+        )
+        meshed, nll_m = train_logistic(
+            feats[ratable], y[ratable], epochs=30, batch_size=512,
+            mesh=make_mesh(8),
+        )
+        assert nll_m == pytest.approx(nll_s, rel=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(meshed.w), np.asarray(single.w), rtol=1e-4, atol=1e-5
+        )
