@@ -211,14 +211,19 @@ def synthetic_stream(
     return MatchStream(player_idx=player_idx, winner=winner, mode_id=mode_id, afk=afk)
 
 
-TELEMETRY_STATS = ("kills", "deaths", "assists", "gold", "cs")
+TELEMETRY_STATS = ("kills", "deaths", "assists", "gold", "cs", "item_build")
+N_ITEM_BUILDS = 8  # categorical: which of 8 canonical item builds was bought
 
 
 def synthetic_telemetry(
     stream: MatchStream, players: SyntheticPlayers, seed: int = 0
 ) -> np.ndarray:
-    """Per-participant POST-GAME telemetry ``[N, 2, T, 5]`` float32
-    (kills, deaths, assists, gold, creep score), zero at padded slots.
+    """Per-participant POST-GAME telemetry ``[N, 2, T, 6]`` float32
+    (kills, deaths, assists, gold, creep score, item build id), zero at
+    padded slots. ``item_build`` is categorical in ``[0, N_ITEM_BUILDS)``
+    — the "items" of BASELINE config 4, standing in for the reference's
+    ``participant_items`` purchase record; builds carry a mild winrate
+    bias so the head can learn meta strength from the draft histogram.
 
     BASELINE config 4's "MLP match-outcome predictor on full telemetry
     (items, gold, KDA)" consumes these. The reference's data model keeps
@@ -245,6 +250,15 @@ def synthetic_telemetry(
     assists = rng.poisson(np.exp(0.15 * z + 0.5 * w + 0.4))
     gold = np.clip(rng.normal(8000 + 2500 * w + 800 * z, 1500), 0, None)
     cs = np.clip(rng.normal(120 + 25 * w + 15 * z, 30), 0, None)
+    # Item builds: winners lean toward the stronger half of the meta
+    # (builds 0..3), losers toward the weaker — a soft preference, so
+    # the histogram is informative but not decisive.
+    strong = rng.integers(0, N_ITEM_BUILDS // 2, size=(n, 2, t))
+    weak = rng.integers(N_ITEM_BUILDS // 2, N_ITEM_BUILDS, size=(n, 2, t))
+    prefer_strong = rng.random((n, 2, t)) < (0.35 + 0.3 * w)
+    item_build = np.where(prefer_strong, strong, weak)
 
-    out = np.stack([kills, deaths, assists, gold, cs], axis=-1).astype(np.float32)
+    out = np.stack(
+        [kills, deaths, assists, gold, cs, item_build], axis=-1
+    ).astype(np.float32)
     return out * mask[..., None].astype(np.float32)

@@ -67,24 +67,57 @@ def match_features(
     )
 
 
-N_TELEMETRY_FEATURES = 10
+def _n_telemetry_features():
+    from analyzer_tpu.io.synthetic import N_ITEM_BUILDS, TELEMETRY_STATS
+
+    return 2 * (len(TELEMETRY_STATS) - 1) + N_ITEM_BUILDS
+
+
+N_TELEMETRY_FEATURES = _n_telemetry_features()  # derived from the schema
 
 
 def telemetry_features(telemetry, player_idx) -> "np.ndarray":
-    """``[N, 10]`` from POST-GAME telemetry ``[N, 2, T, 5]`` (kills,
-    deaths, assists, gold, cs — io/synthetic.py TELEMETRY_STATS): per
-    stat, the bounded team ratio ``(t0 - t1) / (t0 + t1 + 1)`` and the
-    log1p match total (scale). These describe a FINISHED match — the
-    telemetry head (BASELINE config 4) analyzes outcomes from game
-    stats; it does not forecast. Forecasting features are
+    """``[N, 18]`` from POST-GAME telemetry ``[N, 2, T, 6]`` (kills,
+    deaths, assists, gold, cs, item_build — io/synthetic.py
+    TELEMETRY_STATS): per numeric stat, the bounded team ratio
+    ``(t0 - t1) / (t0 + t1 + 1)`` and the log1p match total (scale);
+    plus the per-build team HISTOGRAM difference over the categorical
+    item channel (the "items" of config 4), team-size normalized. These
+    describe a FINISHED match — the telemetry head analyzes outcomes
+    from game stats; it does not forecast. Forecasting features are
     :func:`match_features` (pre-match state only)."""
     import numpy as np
 
-    mask = (player_idx >= 0).astype(np.float32)[..., None]
-    team = (np.asarray(telemetry, np.float32) * mask).sum(axis=2)  # [N,2,5]
+    from analyzer_tpu.io.synthetic import N_ITEM_BUILDS, TELEMETRY_STATS
+
+    tele = np.asarray(telemetry, np.float32)
+    if tele.ndim != 4 or tele.shape[-1] != len(TELEMETRY_STATS):
+        # A stat-width mismatch (e.g. an npz from an older schema) would
+        # silently misread the categorical channel as a stat — reject.
+        raise ValueError(
+            f"telemetry must be [N, 2, T, {len(TELEMETRY_STATS)}] "
+            f"({', '.join(TELEMETRY_STATS)}), got shape {tele.shape}"
+        )
+    maskb = player_idx >= 0
+    mask = maskb.astype(np.float32)[..., None]
+    stats = tele[..., :-1]
+    team = (stats * mask).sum(axis=2)  # [N,2,5]
     total = team.sum(axis=1)  # [N,5]
     diff = (team[:, 0] - team[:, 1]) / (total + 1.0)
-    return np.concatenate([diff, np.log1p(total)], axis=1).astype(np.float32)
+
+    n, _, t = player_idx.shape
+    build = np.clip(tele[..., -1].astype(np.int64), 0, N_ITEM_BUILDS - 1)
+    rows = np.repeat(np.arange(n * 2), t).reshape(n, 2, t)
+    key = (rows * N_ITEM_BUILDS + build)[maskb]
+    hist = np.bincount(key, minlength=n * 2 * N_ITEM_BUILDS).reshape(
+        n, 2, N_ITEM_BUILDS
+    )
+    n_team = np.maximum(maskb.sum(axis=2), 1)[:, :, None]  # [N,2,1]
+    hdiff = hist[:, 0] / n_team[:, 0] - hist[:, 1] / n_team[:, 1]
+
+    return np.concatenate(
+        [diff, np.log1p(total), hdiff], axis=1
+    ).astype(np.float32)
 
 
 def history_features(state, sched, cfg: RatingConfig, steps_per_chunk: int = 8192):

@@ -58,9 +58,11 @@ def train_minibatch(
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        xb = jax.device_put(xb, NamedSharding(mesh, P(None, "data", None)))
-        yb = jax.device_put(yb, NamedSharding(mesh, P(None, "data")))
-        mb = jax.device_put(mb, NamedSharding(mesh, P(None, "data")))
+        from analyzer_tpu.parallel.mesh import DATA_AXIS
+
+        xb = jax.device_put(xb, NamedSharding(mesh, P(None, DATA_AXIS, None)))
+        yb = jax.device_put(yb, NamedSharding(mesh, P(None, DATA_AXIS)))
+        mb = jax.device_put(mb, NamedSharding(mesh, P(None, DATA_AXIS)))
         model = jax.device_put(model, NamedSharding(mesh, P()))
 
     opt = optax.adam(lr)

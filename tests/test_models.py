@@ -140,15 +140,17 @@ class TestTelemetryHead:
     outcome than the pre-match rating features alone."""
 
     def test_telemetry_features_shape_and_masking(self, history):
-        from analyzer_tpu.io.synthetic import synthetic_telemetry
+        from analyzer_tpu.io.synthetic import TELEMETRY_STATS, synthetic_telemetry
         from analyzer_tpu.models import N_TELEMETRY_FEATURES, telemetry_features
+        from analyzer_tpu.models.features import _n_telemetry_features
 
         players, stream, state, sched = history
         tel = synthetic_telemetry(stream, players, seed=21)
-        assert tel.shape == stream.player_idx.shape + (5,)
+        assert tel.shape == stream.player_idx.shape + (len(TELEMETRY_STATS),)
         # padded slots contribute nothing
         assert (tel[stream.player_idx < 0] == 0).all()
         f = telemetry_features(tel, stream.player_idx)
+        assert N_TELEMETRY_FEATURES == _n_telemetry_features()
         assert f.shape == (stream.n_matches, N_TELEMETRY_FEATURES)
         assert np.isfinite(f).all()
 
