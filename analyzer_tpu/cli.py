@@ -551,9 +551,12 @@ def cmd_train(args) -> int:
                     "telemetry block (synth --telemetry)", file=sys.stderr,
                 )
                 return 2
-            feats = np.concatenate(
-                [feats, telemetry_features(tel, stream.player_idx)], axis=1
-            )
+            try:
+                tfeat = telemetry_features(tel, stream.player_idx)
+            except ValueError as err:  # e.g. an older-schema npz
+                print(f"error: {err}", file=sys.stderr)
+                return 2
+            feats = np.concatenate([feats, tfeat], axis=1)
     y = (stream.winner == 0).astype(np.float32)
     rows = np.flatnonzero(ratable)  # stream order
     if rows.size < 10:
@@ -577,8 +580,19 @@ def cmd_train(args) -> int:
                 feats[tr], y[tr], hidden=args.hidden,
                 epochs=args.epochs, seed=args.seed, mesh=mesh,
             )
-    p = np.asarray(model.predict(feats[ev])) if ev.size else np.empty(0)
+    # Temperature-scale on a HELD-OUT slice (the chronological tail of
+    # the train split): fixes the head's raw over/under-confidence
+    # (log-loss, ECE) without touching its ranking (accuracy/AUC are
+    # invariant under a positive temperature). Fitting on the fitted
+    # rows themselves would underestimate miscalibration exactly when
+    # the head overfits — train logits are conditioned on train labels.
+    from analyzer_tpu.models.calibration import apply_temperature, fit_temperature
+
+    cal_cut = int(tr.size * 0.8)
+    cal = tr[cal_cut:] if tr.size - cal_cut >= 50 else tr
+    temperature = fit_temperature(np.asarray(model.logits(feats[cal])), y[cal])
     if ev.size:
+        p = apply_temperature(np.asarray(model.logits(feats[ev])), temperature)
         acc = _half_credit_accuracy(p, y[ev])
         auc = _auc(p, y[ev])
         ece = _ece(p, y[ev])
@@ -591,9 +605,12 @@ def cmd_train(args) -> int:
     else:
         acc = logloss = auc = ece = None
     if args.out:
+        # temperature rides along so artifact consumers reproduce the
+        # reported (calibrated) probabilities, not the raw head.
         np.savez(
             args.out,
             model=args.model,
+            temperature=temperature,
             **{k: np.asarray(v) for k, v in vars(model).items()},
         )
     print(
@@ -608,6 +625,7 @@ def cmd_train(args) -> int:
                 "eval_logloss": round(logloss, 4) if logloss is not None else None,
                 "eval_auc": round(auc, 4) if auc is not None else None,
                 "eval_ece": round(ece, 4) if ece is not None else None,
+                "temperature": round(temperature, 3),
                 "phases": {k: round(v, 3) for k, v in timer.report().items()},
             }
         )

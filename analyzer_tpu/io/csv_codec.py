@@ -45,7 +45,7 @@ def save_stream_npz(
     """Binary stream format — the bulk-interchange fast path. A 10M-match
     history is ~3 min each way as CSV text; as npz it is seconds. Same
     chronological-order contract as the CSV. ``telemetry`` optionally
-    rides along (``[N, 2, T, 5]`` post-game stats, io/synthetic.py) for
+    rides along (``[N, 2, T, 6]`` post-game stats, io/synthetic.py) for
     the config-4 analysis head — npz only, the CSV schema has no column
     for it."""
     arrays = dict(

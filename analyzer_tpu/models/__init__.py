@@ -16,6 +16,7 @@ from analyzer_tpu.models.features import (
     match_features,
     telemetry_features,
 )
+from analyzer_tpu.models.calibration import apply_temperature, fit_temperature
 from analyzer_tpu.models.logistic import LogisticModel, train_logistic
 from analyzer_tpu.models.mlp import MLPModel, init_mlp, train_mlp
 
@@ -28,6 +29,8 @@ __all__ = [
     "N_FEATURES",
     "N_TELEMETRY_FEATURES",
     "telemetry_features",
+    "apply_temperature",
+    "fit_temperature",
     "LogisticModel",
     "train_logistic",
     "MLPModel",

@@ -67,13 +67,10 @@ def match_features(
     )
 
 
-def _n_telemetry_features():
-    from analyzer_tpu.io.synthetic import N_ITEM_BUILDS, TELEMETRY_STATS
+from analyzer_tpu.io.synthetic import N_ITEM_BUILDS, TELEMETRY_STATS  # noqa: E402
 
-    return 2 * (len(TELEMETRY_STATS) - 1) + N_ITEM_BUILDS
-
-
-N_TELEMETRY_FEATURES = _n_telemetry_features()  # derived from the schema
+# Per numeric stat a ratio + a log-total, plus the item-build histogram.
+N_TELEMETRY_FEATURES = 2 * (len(TELEMETRY_STATS) - 1) + N_ITEM_BUILDS
 
 
 def telemetry_features(telemetry, player_idx) -> "np.ndarray":
@@ -87,8 +84,6 @@ def telemetry_features(telemetry, player_idx) -> "np.ndarray":
     from game stats; it does not forecast. Forecasting features are
     :func:`match_features` (pre-match state only)."""
     import numpy as np
-
-    from analyzer_tpu.io.synthetic import N_ITEM_BUILDS, TELEMETRY_STATS
 
     tele = np.asarray(telemetry, np.float32)
     if tele.ndim != 4 or tele.shape[-1] != len(TELEMETRY_STATS):

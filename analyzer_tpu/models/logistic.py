@@ -29,9 +29,12 @@ class LogisticModel:
     w: jnp.ndarray  # [F]
     b: jnp.ndarray  # []
 
+    def logits(self, x: jnp.ndarray) -> jnp.ndarray:
+        return x @ self.w + self.b
+
     def predict(self, x: jnp.ndarray) -> jnp.ndarray:
         """P(team 0 wins), ``[B]`` for ``x [B, F]``."""
-        return jax.nn.sigmoid(x @ self.w + self.b)
+        return jax.nn.sigmoid(self.logits(x))
 
 
 def _nll(model: LogisticModel, x, y, mask):

@@ -142,7 +142,6 @@ class TestTelemetryHead:
     def test_telemetry_features_shape_and_masking(self, history):
         from analyzer_tpu.io.synthetic import TELEMETRY_STATS, synthetic_telemetry
         from analyzer_tpu.models import N_TELEMETRY_FEATURES, telemetry_features
-        from analyzer_tpu.models.features import _n_telemetry_features
 
         players, stream, state, sched = history
         tel = synthetic_telemetry(stream, players, seed=21)
@@ -150,7 +149,7 @@ class TestTelemetryHead:
         # padded slots contribute nothing
         assert (tel[stream.player_idx < 0] == 0).all()
         f = telemetry_features(tel, stream.player_idx)
-        assert N_TELEMETRY_FEATURES == _n_telemetry_features()
+        assert N_TELEMETRY_FEATURES == 18  # 5 ratios + 5 totals + 8 builds
         assert f.shape == (stream.n_matches, N_TELEMETRY_FEATURES)
         assert np.isfinite(f).all()
 
@@ -202,3 +201,37 @@ class TestMeshTraining:
         np.testing.assert_allclose(
             np.asarray(meshed.w), np.asarray(single.w), rtol=1e-4, atol=1e-5
         )
+
+
+class TestCalibration:
+    def test_temperature_fixes_overconfidence(self):
+        from analyzer_tpu.models import apply_temperature, fit_temperature
+
+        rng = np.random.default_rng(3)
+        n = 20000
+        z_true = rng.normal(0, 1.2, n)  # true log-odds
+        y = (rng.random(n) < 1 / (1 + np.exp(-z_true))).astype(np.float32)
+        logits = 4.0 * z_true  # overconfident head: logits scaled 4x
+        t = fit_temperature(logits, y)
+        assert 3.0 < t < 5.5, t  # recovers the inflation factor
+
+        def ece(p):
+            idx = np.clip((p * 10).astype(int), 0, 9)
+            return sum(
+                abs(p[idx == b].mean() - y[idx == b].mean()) * (idx == b).mean()
+                for b in range(10) if (idx == b).any()
+            )
+
+        raw = 1 / (1 + np.exp(-logits))
+        cal = apply_temperature(logits, t)
+        assert ece(cal) < ece(raw) / 3  # calibration error collapses
+        # ranking untouched
+        assert ((cal > 0.5) == (raw > 0.5)).all()
+
+    def test_identity_when_already_calibrated(self):
+        from analyzer_tpu.models import fit_temperature
+
+        rng = np.random.default_rng(4)
+        z = rng.normal(0, 1.5, 30000)
+        y = (rng.random(30000) < 1 / (1 + np.exp(-z))).astype(np.float32)
+        assert fit_temperature(z, y) == pytest.approx(1.0, abs=0.15)
