@@ -569,27 +569,32 @@ def cmd_train(args) -> int:
         mesh = make_mesh(args.mesh or None)
     cut = max(1, int(rows.size * (1.0 - args.eval_frac)))
     tr, ev = rows[:cut], rows[cut:]
+    # Reserve the chronological tail of the train split for temperature
+    # calibration — rows the model never fits, or the overfit case would
+    # hide exactly the miscalibration being corrected. Too-small splits
+    # fall back to fitting on (and calibrating from) everything.
+    cal_cut = int(tr.size * 0.8)
+    if tr.size - cal_cut >= 50:
+        fit, cal = tr[:cal_cut], tr[cal_cut:]
+    else:
+        fit, cal = tr, tr
     with timer.phase("train"):
         if args.model == "logistic":
             model, nll = train_logistic(
-                feats[tr], y[tr], epochs=args.epochs, seed=args.seed,
+                feats[fit], y[fit], epochs=args.epochs, seed=args.seed,
                 mesh=mesh,
             )
         else:
             model, nll = train_mlp(
-                feats[tr], y[tr], hidden=args.hidden,
+                feats[fit], y[fit], hidden=args.hidden,
                 epochs=args.epochs, seed=args.seed, mesh=mesh,
             )
-    # Temperature-scale on a HELD-OUT slice (the chronological tail of
-    # the train split): fixes the head's raw over/under-confidence
-    # (log-loss, ECE) without touching its ranking (accuracy/AUC are
-    # invariant under a positive temperature). Fitting on the fitted
-    # rows themselves would underestimate miscalibration exactly when
-    # the head overfits — train logits are conditioned on train labels.
+    # Temperature-scale on the calibration slice (held out from the fit
+    # above): fixes the head's raw over/under-confidence (log-loss, ECE)
+    # without touching its ranking (accuracy/AUC are invariant under a
+    # positive temperature).
     from analyzer_tpu.models.calibration import apply_temperature, fit_temperature
 
-    cal_cut = int(tr.size * 0.8)
-    cal = tr[cal_cut:] if tr.size - cal_cut >= 50 else tr
     temperature = fit_temperature(np.asarray(model.logits(feats[cal])), y[cal])
     if ev.size:
         p = apply_temperature(np.asarray(model.logits(feats[ev])), temperature)
@@ -618,7 +623,8 @@ def cmd_train(args) -> int:
             {
                 "model": args.model,
                 "matches": stream.n_matches,
-                "trained_on": int(tr.size),
+                "trained_on": int(fit.size),
+                "calibrated_on": int(cal.size) if cal is not fit else 0,
                 "eval_on": int(ev.size),
                 "train_nll": round(float(nll), 4),
                 "eval_accuracy": round(acc, 4) if acc is not None else None,

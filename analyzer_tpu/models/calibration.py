@@ -4,9 +4,10 @@ A single scalar T rescales the head's logits (``sigmoid(z / T)``) to
 minimize NLL — the standard one-parameter calibration that fixes the
 over/under-confidence an under-trained or over-trained head exhibits
 without touching its ranking (accuracy and AUC are invariant under a
-positive temperature; log-loss and calibration error improve). The CLI
-fits T on the TRAINING split and reports it alongside the eval metrics;
-one degree of freedom cannot meaningfully overfit there.
+positive temperature; log-loss and calibration error improve). Fit T on
+rows the head did NOT train on (the CLI reserves the chronological tail
+of its train split): an overfit head's logits on its own training rows
+look calibrated precisely when its eval logits are not.
 """
 
 from __future__ import annotations

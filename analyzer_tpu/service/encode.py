@@ -30,6 +30,15 @@ from analyzer_tpu.core.state import (
 from analyzer_tpu.sched.superstep import MatchStream
 
 
+def row_bucket(n_players: int) -> int:
+    """Power-of-two player-row bucket (floor 64) — the SINGLE owner of
+    the service path's state-table sizing. ``EncodedBatch`` and
+    ``Worker.warmup`` must agree on this, or warmup compiles shapes
+    production never hits and the first real batch pays the XLA stall
+    warmup exists to prevent."""
+    return max(64, 1 << max(n_players - 1, 0).bit_length())
+
+
 class PoisonError(Exception):
     """Base for encode failures attributable to SPECIFIC matches.
 
@@ -85,9 +94,7 @@ class EncodedBatch:
                     self.player_at.append(player)
         p = len(self.player_at)
         self.n_players = p
-        alloc = p
-        if bucket_rows:
-            alloc = max(64, 1 << max(p - 1, 0).bit_length())
+        alloc = row_bucket(p) if bucket_rows else p
 
         # State table from object attributes (NaN for SQL NULL / None).
         table = np.full((alloc + 1, TABLE_WIDTH), np.nan, np.float32)

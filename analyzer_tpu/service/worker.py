@@ -156,7 +156,61 @@ class Worker:
                 for sig, handler in previous_handlers.items():
                     signal.signal(sig, handler)
 
+    # -- warmup -----------------------------------------------------------
+    def warmup(self) -> None:
+        """Pre-compiles the rating scan for the shapes production batches
+        hit, so the FIRST message doesn't pay XLA compilation (seconds —
+        the reference's pure-Python loop had no compile step to hide;
+        here it's real first-request latency). Thanks to the pinned
+        width + power-of-two bucketing, a handful of shapes covers
+        steady state: a full batch of distinct-player 5v5s and 3v3s
+        (the largest row buckets a saturated queue produces) and the
+        tiny idle-flush shape. Deeper-chained batches (higher step
+        buckets) still compile on first sight — rarer and cheaper."""
+        import numpy as np
+
+        from analyzer_tpu.core.state import PlayerState
+        from analyzer_tpu.sched.superstep import MatchStream
+
+        from analyzer_tpu.service.encode import row_bucket
+
+        t0 = self.clock()
+        for n_matches, team in (
+            (self.config.batch_size, 5),
+            (self.config.batch_size, 3),
+            (1, 3),
+        ):
+            p = n_matches * 2 * team
+            alloc = row_bucket(p)  # the same rule EncodedBatch applies
+            state = PlayerState.create(alloc, cfg=self.rating_config)
+            idx = np.full((n_matches, 2, 5), -1, np.int32)
+            idx[:, :, :team] = np.arange(p, dtype=np.int32).reshape(
+                n_matches, 2, team
+            )
+            stream = MatchStream(
+                player_idx=idx,
+                winner=np.zeros(n_matches, np.int32),
+                mode_id=np.ones(n_matches, np.int32),  # ranked
+                afk=np.zeros(n_matches, bool),
+            )
+            sched = self._bucketed_schedule(stream, alloc)
+            rate_history(state, sched, self.rating_config, collect=True)
+        logger.info(
+            "warmup compiled %d batch shapes in %.1fs",
+            3, self.clock() - t0,
+        )
+
     # -- batch pipeline ---------------------------------------------------
+    def _bucketed_schedule(self, stream, pad_row: int):
+        """Pinned width + power-of-two step bucket — the ONE place the
+        service schedule shapes are derived, shared by ``process`` and
+        ``warmup`` so the warmed shapes are exactly production's."""
+        sched = pack_schedule(
+            stream, pad_row=pad_row, batch_size=self._packed_width
+        )
+        bucket = max(4, 1 << (sched.n_steps - 1).bit_length())
+        return sched.pad_to_steps(bucket)
+
     def _dead_letter(self, messages) -> None:
         """Republish to the failed queue + nack without requeue — the
         reference's failure policy (``worker.py:110-120``), applied here
@@ -251,12 +305,7 @@ class Worker:
         # width, step count) all land on a few fixed sizes, so
         # consecutive batches of any size reuse one compiled scan.
         enc = EncodedBatch(matches, self.rating_config, bucket_rows=True)
-        sched = pack_schedule(
-            enc.stream, pad_row=enc.state.pad_row,
-            batch_size=self._packed_width,
-        )
-        bucket = max(4, 1 << (sched.n_steps - 1).bit_length())
-        sched = sched.pad_to_steps(bucket)
+        sched = self._bucketed_schedule(enc.stream, enc.state.pad_row)
         _, outs = rate_history(enc.state, sched, self.rating_config, collect=True)
         enc.write_back(outs)
         # Transactional stores (SqlStore) flush the mutated graph in one
@@ -303,6 +352,7 @@ def main(max_flushes: int | None = None) -> Worker:
 
         store = InMemoryStore()
     worker = Worker(broker, store, config)
+    worker.warmup()  # compile before consuming: no first-batch stall
     worker.run(
         max_flushes=max_flushes,
         max_wall_s=None if max_flushes is None else 60.0,

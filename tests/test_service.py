@@ -64,6 +64,29 @@ class TestCompileChurn:
         assert _scan_chunk._cache_size() == size0  # no second compile
 
 
+class TestWarmup:
+    def test_warmup_precompiles_full_batch_shape(self):
+        # After warmup, a full batch of fresh 3v3 matches must hit the
+        # jit cache — zero compilation on the first real message.
+        from analyzer_tpu.sched.runner import _scan_chunk
+
+        broker = InMemoryBroker()
+        store = InMemoryStore()
+        cfg = ServiceConfig(batch_size=8, idle_timeout=0.0)
+        worker = Worker(broker, store, cfg, RatingConfig())
+        worker.warmup()
+        size0 = _scan_chunk._cache_size()
+        for i in range(8):  # full batch, distinct players -> 1-step bucket
+            players = [
+                fake_player(skill_tier=15, api_id=f"w{i}p{j}") for j in range(6)
+            ]
+            store.add_match(mk_match(f"w{i}", created_at=i, players=players))
+            broker.publish("analyze", f"w{i}".encode())
+        assert worker.poll()
+        assert worker.matches_rated == 8
+        assert _scan_chunk._cache_size() == size0  # warm: no new compile
+
+
 class TestPipeline:
     def test_end_to_end_rating(self, rig):
         broker, store, worker = rig
