@@ -172,18 +172,20 @@ class Worker:
         from analyzer_tpu.core.state import PlayerState
         from analyzer_tpu.sched.superstep import MatchStream
 
+        from analyzer_tpu.core.state import MAX_TEAM_SIZE
         from analyzer_tpu.service.encode import row_bucket
 
         t0 = self.clock()
-        for n_matches, team in (
-            (self.config.batch_size, 5),
-            (self.config.batch_size, 3),
-            (1, 3),
-        ):
+        shapes = (
+            (self.config.batch_size, MAX_TEAM_SIZE),
+            (self.config.batch_size, min(3, MAX_TEAM_SIZE)),
+            (1, min(3, MAX_TEAM_SIZE)),
+        )
+        for n_matches, team in shapes:
             p = n_matches * 2 * team
             alloc = row_bucket(p)  # the same rule EncodedBatch applies
             state = PlayerState.create(alloc, cfg=self.rating_config)
-            idx = np.full((n_matches, 2, 5), -1, np.int32)
+            idx = np.full((n_matches, 2, MAX_TEAM_SIZE), -1, np.int32)
             idx[:, :, :team] = np.arange(p, dtype=np.int32).reshape(
                 n_matches, 2, team
             )
@@ -197,7 +199,7 @@ class Worker:
             rate_history(state, sched, self.rating_config, collect=True)
         logger.info(
             "warmup compiled %d batch shapes in %.1fs",
-            3, self.clock() - t0,
+            len(shapes), self.clock() - t0,
         )
 
     # -- batch pipeline ---------------------------------------------------
