@@ -44,9 +44,15 @@ routing; the D=1 psum/all_gather are pure copies, so this is the
 replicated-gather + routing-transfer overhead the model attributes to
 t_psum + feed). Breakeven vs one plain chip is therefore ~2 real chips,
 and the D=8 prediction stands as a model until real multi-chip hardware
-exists to measure on. The residual floor is the replicated candidate
-gather; host-compacted gather routing + reduce_scatter could shard that
-too and is the next lever if profiling demands it.
+exists to measure on. The round-3 ablation
+(experiments/sharded_overhead.py) measured the sharded step's DEVICE
+work as free at D=1 — psum assembly and compacted scatter both compile
+to the plain path's cost, and the measured ~1.7x single-chip e2e
+constant is feed logistics (per-chunk H2D + setup + unshard), not
+compute. The replicated candidate gather's real cost (the psum as an
+actual ICI collective) appears only at D>1; sharding it via
+host-compacted gather routing + reduce_scatter remains the lever to
+evaluate once multi-chip hardware exists.
 
 Correctness invariants (tested bit-identical vs the single-device runner on
 1/2/4/8 virtual CPU devices, tests/test_parallel.py):

@@ -1,0 +1,174 @@
+"""Sharded-runner single-chip overhead breakdown (round-3 follow-up).
+
+BASELINE.md "Measured (round 3)" records the sharded runner at D=1
+costing ~1.7x the plain runner per 500k matches. That constant sets the
+pod breakeven (~2 real chips) — but WHERE does it go? Two candidate
+sinks, measured here by ablation at D=1 (the psum/all_gather compile to
+copies, so every difference is real compute/layout work, not ICI):
+
+  plain    — production single-device scan (sched.runner._scan_chunk):
+             whole-row gather -> rate_gathered -> full-batch row scatter
+  sharded  — the mesh step (parallel.mesh.sharded_step_fn): batch
+             all_gather + psum-assembled priors (where/clip/psum/reshape)
+             + routing-compacted scatter via sel/dst
+  nopsum   — sharded minus the assembly: priors read by DIRECT whole-row
+             gather (valid only at D=1 where the shard owns every row),
+             routing-compacted scatter kept. The sharded-vs-nopsum gap is
+             the ASSEMBLY cost (the candidate-gather + masking + psum
+             machinery); the nopsum-vs-plain gap is the ROUTING SCATTER +
+             extra xs-transfer cost.
+
+Whichever gap dominates names the next lever: a big assembly gap backs
+the docstring's "shard the candidate gather via host-compacted routing +
+reduce_scatter" plan; a big routing gap says the compacted scatter needs
+work (e.g. fusing sel into the gather) before more sharding helps.
+
+MEASURED (v5e via tunnel, 500k/166k, three corrected runs): plain
+0.56-0.57 s, nopsum 0.92-1.08x plain, sharded 0.95-1.07x plain — ALL
+THREE EQUAL within the tunnel's ~8% run-to-run noise. At D=1 XLA
+compiles the psum/where assembly and the compacted scatter down to the
+plain path's cost; the sharded step's device work is FREE. (A first
+buggy harness showed "+0.17 s assembly cost" — it was paying a D2H of
+the state inside ONLY the plain variant's timed region; review caught
+it.) Consequence: the ~1.7x end-to-end eager-control constant in
+BASELINE.md is entirely FEED LOGISTICS — per-chunk H2D inside the timed
+loop (the plain headline preloads all chunks once), ShardedRun setup
+(pad/reorder/put), and the final unshard — much of which a real TPU
+host (local PCIe, no tunnel) would not pay. The D=1 ablation cannot see
+the one cost that appears only at D>1: the psum as a real ICI
+collective; that needs multi-chip hardware.
+
+Usage: ``python experiments/sharded_overhead.py`` (expects the TPU;
+fetch-timed, min-of-5 — same-session comparisons only, the tunnel drifts
+between sessions).
+"""
+
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from analyzer_tpu.config import RatingConfig  # noqa: E402
+from analyzer_tpu.core.state import MatchBatch, PlayerState
+from analyzer_tpu.core.update import rate_gathered
+from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
+from analyzer_tpu.parallel.mesh import build_routing, make_mesh, sharded_step_fn
+from analyzer_tpu.sched import pack_schedule
+from analyzer_tpu.sched.runner import _scan_chunk
+
+N_MATCHES = 500_000
+N_PLAYERS = N_MATCHES // 3
+REPEATS = 5
+
+
+def fetch_time(fn, repeats=REPEATS):
+    fn()  # warmup/compile
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def nopsum_step_fn(cfg):
+    """The sharded step with the psum assembly ablated: direct whole-row
+    gather (D=1 only — the single shard owns every row), compacted
+    scatter kept."""
+
+    @jax.jit
+    def run(table, pidx, mask, winner, mode, afk, sel, dst):
+        def step(tbl, xs):
+            lp, lm, lw, lmo, la, s_, d_ = xs
+            batch = MatchBatch(
+                player_idx=lp, slot_mask=lm, winner=lw, mode_id=lmo, afk=la
+            )
+            rows = tbl[batch.player_idx.reshape(-1)].reshape(
+                batch.player_idx.shape + (tbl.shape[-1],)
+            )
+            out = rate_gathered(rows, batch, cfg)
+            new_flat = out.new_rows.reshape(-1, tbl.shape[-1])
+            tbl = tbl.at[d_[0]].set(new_flat[s_[0]], mode="drop")
+            return tbl, None
+
+        table, _ = jax.lax.scan(
+            step, table, (pidx, mask, winner, mode, afk, sel, dst)
+        )
+        return table
+
+    return run
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind}), "
+          f"{N_MATCHES} matches / {N_PLAYERS} players, D=1 ablation")
+    cfg = RatingConfig()
+    players = synthetic_players(N_PLAYERS, seed=42)
+    stream = synthetic_stream(
+        N_MATCHES, players, seed=42, activity_concentration=0.8,
+        max_activity_share=1e-4,
+    )
+    state = PlayerState.create(
+        N_PLAYERS,
+        rank_points_ranked=players.rank_points_ranked,
+        rank_points_blitz=players.rank_points_blitz,
+        skill_tier=players.skill_tier,
+    )
+    sched = pack_schedule(stream, pad_row=state.pad_row)
+    routing = build_routing(sched, state.table.shape[0], 1)
+    print(f"schedule: {sched.n_steps} steps x B={sched.batch_size}, "
+          f"occupancy {sched.occupancy:.3f}, routing K={routing.capacity}")
+
+    # Preload everything (transfers excluded — this isolates device work).
+    # The per-repeat device_put of the donated carry is unavoidable (the
+    # scan consumes its buffer), but the HOST copies are hoisted so no
+    # variant pays D2H inside the timed region.
+    arrays = sched.device_arrays(0, sched.n_steps)
+    sel = jnp.asarray(routing.sel)
+    dst = jnp.asarray(routing.dst)
+    table0 = np.asarray(state.table)
+    host_state = jax.tree.map(np.asarray, state)
+
+    def run_plain():
+        st = jax.device_put(host_state)
+        st, _ = _scan_chunk(st, arrays, cfg, False)
+        np.asarray(st.table[:1])
+
+    mesh = make_mesh(1)
+    step_sh = sharded_step_fn(mesh, cfg, state.table.shape[0])
+
+    def run_sharded():
+        tbl = jax.device_put(table0)
+        tbl = step_sh(tbl, *arrays, sel, dst)
+        np.asarray(tbl[:1])
+
+    step_np = nopsum_step_fn(cfg)
+
+    def run_nopsum():
+        tbl = jax.device_put(table0)
+        tbl = step_np(tbl, *arrays, sel, dst)
+        np.asarray(tbl[:1])
+
+    t_plain = fetch_time(run_plain)
+    t_nopsum = fetch_time(run_nopsum)
+    t_sharded = fetch_time(run_sharded)
+    print(f"plain (production single-device):  {t_plain:.3f} s")
+    print(f"nopsum (direct gather + routing):  {t_nopsum:.3f} s "
+          f"= {t_nopsum / t_plain:.2f}x plain")
+    print(f"sharded (psum assembly + routing): {t_sharded:.3f} s "
+          f"= {t_sharded / t_plain:.2f}x plain")
+    print(
+        f"-> assembly cost {t_sharded - t_nopsum:+.3f} s, "
+        f"routing-scatter/xs cost {t_nopsum - t_plain:+.3f} s "
+        "(same-session comparison; tunnel drifts between sessions)"
+    )
+
+
+if __name__ == "__main__":
+    main()
