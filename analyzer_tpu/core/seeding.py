@@ -60,3 +60,41 @@ def trueskill_seed(
     sigma = jnp.where(has_points, sigma_points, sigma_tier)
     mu = jnp.where(has_points, rank_points + sigma_points, tier_points + sigma_tier)
     return mu, sigma
+
+
+_host_jit = None
+
+
+def trueskill_seed_host(
+    rank_points_ranked, rank_points_blitz, skill_tier, cfg: RatingConfig
+) -> tuple:
+    """Seeding for host-side ingest paths, pinned to the CPU backend.
+    Numpy in, numpy out.
+
+    :func:`trueskill_seed` called outside jit runs op-by-op on the
+    *default* backend — against a remote TPU that is ~20 tiny kernel
+    compiles (measured ~12 s through the dev tunnel) just to bake seed
+    columns that are about to land back in a host-resident table. Every
+    op here (add/compare/select/gather) is bit-identical between the CPU
+    and TPU backends, so pinning to CPU costs no parity and makes ingest
+    pay milliseconds instead.
+    """
+    import numpy as np
+
+    import jax
+
+    global _host_jit
+    if _host_jit is None:
+        _host_jit = jax.jit(trueskill_seed, static_argnums=3)
+    # local_devices, not devices: under jax.distributed the global list
+    # leads with process 0's devices, and pinning another process's
+    # device turns this into a cross-process computation (measured as a
+    # Gloo handshake deadline in the 2-process cluster test).
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        mu, sigma = _host_jit(
+            jnp.asarray(np.asarray(rank_points_ranked)),
+            jnp.asarray(np.asarray(rank_points_blitz)),
+            jnp.asarray(np.asarray(skill_tier)),
+            cfg,
+        )
+        return np.asarray(mu), np.asarray(sigma)

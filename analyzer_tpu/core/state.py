@@ -124,34 +124,36 @@ class PlayerState:
         to 1 point, the reference's floor — ``rater.py:15-16``).
         """
         from analyzer_tpu.config import RatingConfig
-        from analyzer_tpu.core.seeding import trueskill_seed
+        from analyzer_tpu.core.seeding import trueskill_seed_host
 
         cfg = cfg or RatingConfig()
         p1 = n_players + 1
+        np_dtype = np.dtype(dtype)
 
         def _feat(x, fill):
             out = np.full((p1,), fill, dtype=np.float64)
             if x is not None:
                 out[:n_players] = np.asarray(x, dtype=np.float64)
-            return out
+            return out.astype(np_dtype)
 
         tiers = np.zeros((p1,), dtype=np.int32)
         if skill_tier is not None:
             tiers[:n_players] = np.asarray(skill_tier, dtype=np.int32)
 
-        rr = jnp.asarray(_feat(rank_points_ranked, np.nan), dtype)
-        rb = jnp.asarray(_feat(rank_points_blitz, np.nan), dtype)
-        ti = jnp.asarray(tiers)
-        seed_mu, seed_sigma = trueskill_seed(rr, rb, ti, cfg)
+        rr_np = _feat(rank_points_ranked, np.nan)
+        rb_np = _feat(rank_points_blitz, np.nan)
+        # Seeds bake on the CPU backend: op-by-op remote-TPU dispatch is
+        # pure fixed overhead for a host-resident table (seeding.py).
+        seed_mu, seed_sigma = trueskill_seed_host(rr_np, rb_np, tiers, cfg)
 
-        table = jnp.full((p1, TABLE_WIDTH), jnp.nan, dtype=dtype)
-        table = table.at[:, COL_SEED_MU].set(seed_mu)
-        table = table.at[:, COL_SEED_SIGMA].set(seed_sigma)
+        table = np.full((p1, TABLE_WIDTH), np.nan, dtype=np_dtype)
+        table[:, COL_SEED_MU] = seed_mu
+        table[:, COL_SEED_SIGMA] = seed_sigma
         return cls(
-            table=table,
-            rank_points_ranked=rr,
-            rank_points_blitz=rb,
-            skill_tier=ti,
+            table=jnp.asarray(table),
+            rank_points_ranked=jnp.asarray(rr_np),
+            rank_points_blitz=jnp.asarray(rb_np),
+            skill_tier=jnp.asarray(tiers),
             seed_cfg=cfg,
         )
 

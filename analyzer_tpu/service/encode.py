@@ -17,7 +17,7 @@ import jax.numpy as jnp
 
 from analyzer_tpu.config import RatingConfig
 from analyzer_tpu.core import constants
-from analyzer_tpu.core.seeding import trueskill_seed
+from analyzer_tpu.core.seeding import trueskill_seed_host
 from analyzer_tpu.core.state import (
     COL_SEED_MU,
     COL_SEED_SIGMA,
@@ -128,11 +128,9 @@ class EncodedBatch:
                     )
                 else:
                     ti[r] = int(tier)
-        seed_mu, seed_sigma = trueskill_seed(
-            jnp.asarray(rr), jnp.asarray(rb), jnp.asarray(ti), cfg
-        )
-        table[:, COL_SEED_MU] = np.asarray(seed_mu)
-        table[:, COL_SEED_SIGMA] = np.asarray(seed_sigma)
+        seed_mu, seed_sigma = trueskill_seed_host(rr, rb, ti, cfg)
+        table[:, COL_SEED_MU] = seed_mu
+        table[:, COL_SEED_SIGMA] = seed_sigma
         self.state = PlayerState(
             table=jnp.asarray(table),
             rank_points_ranked=jnp.asarray(rr),

@@ -137,6 +137,7 @@ class SqlStore:
         self.chunk_size = max(int(chunk_size), 1)
         (self.conn, self._paramstyle, self._dialect,
          self._sqlite_path) = _connect(uri)
+        self._native_sql: bool | None = None  # False once proven unbuildable
         self.columns = self._reflect()
         missing = [t for t in REQUIRED_TABLES if t not in self.columns]
         if missing:
@@ -458,11 +459,51 @@ class SqlStore:
             )
         return out
 
+    def _native_scan(self, sql: str, cols: list) -> "dict | None":
+        """[sqlite fastest path] Arbitrary-query columnar scan through the
+        C sqlite reader (``fastsql.cc``): one b-tree walk per pass with no
+        per-row Python and no text round-trip for numeric columns —
+        measured ~4x faster than the group_concat scan on the 1M-match
+        fixture. Opens the database read-only BY PATH, so it sees
+        committed data only (the same visibility as ``_sqlite_bulk``'s
+        second connection). Returns None when the native layer is
+        unavailable or the scan fails (callers fall back to the python
+        scans); in-memory databases never take this path.
+        """
+        if self._sqlite_path is None or self._native_sql is False:
+            return None
+        try:
+            from analyzer_tpu.service import _native_sql
+        except ImportError as e:
+            self._native_sql = False  # no g++ / unloadable .so: stop trying
+            logger.warning("native sqlite scanner unavailable (%s); "
+                           "using python bulk scans", e)
+            return None
+        try:
+            return _native_sql.scan_query(self._sqlite_path, sql, cols)
+        except RuntimeError as e:  # db changed mid-scan, odd page, ...
+            logger.warning("native sqlite scan failed (%s); "
+                           "falling back to python scan for: %s", e, sql)
+            return None
+
     def _bulk(
         self, table: str, str_cols: tuple, int_cols: tuple = (),
         float_cols: tuple = (),
     ) -> dict:
         if self._dialect == "sqlite":
+            q = self._q
+            cols = (
+                [(c, "str") for c in str_cols]
+                + [(c, "int") for c in int_cols]
+                + [(c, "float") for c in float_cols]
+            )
+            native = self._native_scan(
+                f"SELECT {', '.join(q(c) for c, _ in cols)} FROM {q(table)} "
+                f"ORDER BY rowid ASC",
+                cols,
+            )
+            if native is not None:
+                return native
             return self._sqlite_bulk(table, str_cols, int_cols, float_cols)
         return self._generic_bulk(table, str_cols, int_cols, float_cols)
 
@@ -504,7 +545,7 @@ class SqlStore:
 
         from analyzer_tpu.config import RatingConfig
         from analyzer_tpu.core import constants
-        from analyzer_tpu.core.seeding import trueskill_seed
+        from analyzer_tpu.core.seeding import trueskill_seed_host
         from analyzer_tpu.core.state import (
             COL_SEED_MU, COL_SEED_SIGMA, MAX_TEAM_SIZE, MU_LO, SIGMA_LO,
             TABLE_WIDTH, PlayerState,
@@ -520,6 +561,43 @@ class SqlStore:
 
         def _decode(x):
             return x.decode() if isinstance(x, bytes) else x
+
+        def _decode_list(arr) -> list:
+            """Vectorized id-array -> list[str] (np.char.decode runs the
+            utf-8 decode in a C loop; the per-element comprehension cost
+            0.7 s at 1.3M ids)."""
+            if arr.dtype.kind == "S":
+                return np.char.decode(arr, "utf-8").tolist()
+            return [_decode(x) for x in arr]
+
+        native_join = None
+        if sqlite and self._native_sql is not False:
+            try:
+                from analyzer_tpu.service import _native_sql
+
+                native_join = _native_sql.lookup
+            except ImportError as e:
+                # Latch like _native_scan does: a failed build would
+                # otherwise re-spawn g++ for every fresh store.
+                self._native_sql = False
+                logger.warning("native sqlite scanner unavailable (%s); "
+                               "using numpy joins", e)
+
+        def _join(ids, needles):
+            """needle -> position in ``ids``; ok=False for misses. Native
+            hash join when available (S-dtype ids), else the numpy
+            argsort+searchsorted path — identical semantics, including
+            smallest-index resolution of duplicate ids."""
+            if (
+                native_join is not None
+                and ids.dtype.kind == "S"
+                and needles.dtype.kind == "S"
+            ):
+                got = native_join(ids, needles)
+                ok = got >= 0
+                return np.where(ok, got, 0), ok
+            sorted_ids, order = _index(ids)
+            return _lookup(sorted_ids, order, needles)
 
         def _index(ids):
             """Sorted view of an id array for searchsorted lookups."""
@@ -537,11 +615,27 @@ class SqlStore:
             got = order[pos]
             return got, sorted_ids[pos] == needles
 
-        def _cumcount(keys):
+        def _cumcount(keys, minlength=None):
             """Occurrence index of each element within its key group,
-            preserving arrival order (stable)."""
+            preserving arrival order (stable). ``minlength`` bounds the
+            key values and routes through the native single-pass counter
+            when available — unless the bound is degenerate (a malformed
+            match with hundreds of rosters inflates the slot stride, and
+            with it the dense counter) or the allocation fails; the numpy
+            path's cost is independent of the key range."""
             if keys.size == 0:
                 return np.zeros(0, np.int64)
+            if (
+                native_join is not None
+                and minlength is not None
+                and minlength <= 16 * keys.size
+            ):
+                try:
+                    return _native_sql.cumcount(keys, minlength)
+                except RuntimeError as e:
+                    logger.warning(
+                        "native cumcount failed (%s); using numpy path", e
+                    )
             order = np.argsort(keys, kind="stable")
             sk = keys[order]
             first = np.r_[True, sk[1:] != sk[:-1]]
@@ -553,29 +647,44 @@ class SqlStore:
             return out
 
         # -- matches: the one type-aware sort the database owns ----------
-        # The bytes factory window is scoped to THIS fetch (try/finally):
-        # leaking it past an exception would leave every later
-        # load_batch/asset_urls on this store returning bytes ids.
         tie = "rowid" if sqlite else q("api_id")
-        if sqlite:
-            prev_factory = self.conn.text_factory
-            self.conn.text_factory = bytes
-        try:
-            cur.execute(
-                f"SELECT {q('api_id')}, {q('game_mode')} FROM {q('match')} "
-                f"ORDER BY {q('created_at')} ASC, {tie} ASC"
-            )
-            m_rows = cur.fetchall()
-        finally:
-            if sqlite:
-                self.conn.text_factory = prev_factory
-        n = len(m_rows)
-        nil = b"" if sqlite else ""
-        m_ids = np.array([r[0] for r in m_rows]) if n else np.empty(0, "S1")
-        modes = (
-            np.array([r[1] or nil for r in m_rows]) if n else np.empty(0, "S1")
+        match_sql = (
+            f"SELECT {q('api_id')}, {q('game_mode')} FROM {q('match')} "
+            f"ORDER BY {q('created_at')} ASC, {tie} ASC"
         )
-        del m_rows
+        native = (
+            self._native_scan(
+                match_sql, [("api_id", "str"), ("game_mode", "str")]
+            ) if sqlite else None
+        )
+        if native is not None:
+            m_ids = native["api_id"]
+            modes = native["game_mode"]
+            n = int(m_ids.size)
+        else:
+            # The bytes factory window is scoped to THIS fetch
+            # (try/finally): leaking it past an exception would leave
+            # every later load_batch/asset_urls on this store returning
+            # bytes ids.
+            if sqlite:
+                prev_factory = self.conn.text_factory
+                self.conn.text_factory = bytes
+            try:
+                cur.execute(match_sql)
+                m_rows = cur.fetchall()
+            finally:
+                if sqlite:
+                    self.conn.text_factory = prev_factory
+            n = len(m_rows)
+            nil = b"" if sqlite else ""
+            m_ids = (
+                np.array([r[0] for r in m_rows]) if n else np.empty(0, "S1")
+            )
+            modes = (
+                np.array([r[1] or nil for r in m_rows])
+                if n else np.empty(0, "S1")
+            )
+            del m_rows
         mode_id = np.full(n, constants.UNSUPPORTED_MODE_ID, np.int32)
         for name, mid in constants.MODE_TO_ID.items():
             key = name.encode() if sqlite else name
@@ -593,14 +702,11 @@ class SqlStore:
         p_ids = pl["api_id"]
         p = int(p_ids.size)
 
-        m_sorted, m_order = _index(m_ids)
-        p_sorted, p_order = _index(p_ids)
-
         # -- rosters -----------------------------------------------------
         ro = self._bulk(
             "roster", ("api_id", "match_api_id"), ("winner",)
         )
-        r_mid, r_ok = _lookup(m_sorted, m_order, ro["match_api_id"])
+        r_mid, r_ok = _join(m_ids, ro["match_api_id"])
         if not r_ok.all():
             logger.warning(
                 "load_stream: dropped %d rosters with missing matches",
@@ -610,7 +716,7 @@ class SqlStore:
         r_mid = r_mid[r_ok]
         r_win = ro["winner"][r_ok]
         del ro
-        team = _cumcount(r_mid)  # arrival order within the match
+        team = _cumcount(r_mid, minlength=n)  # arrival order within match
         roster_count = np.bincount(r_mid, minlength=n)
         bad = roster_count != 2  # rater.py:91-93 validity gate
 
@@ -626,9 +732,8 @@ class SqlStore:
         pa = self._bulk(
             "participant", ("roster_api_id", "player_api_id"), ("went_afk",)
         )
-        r_sorted, r_order = _index(r_ids)
-        pr, ok_r = _lookup(r_sorted, r_order, pa["roster_api_id"])
-        prow, ok_p = _lookup(p_sorted, p_order, pa["player_api_id"])
+        pr, ok_r = _join(r_ids, pa["roster_api_id"])
+        prow, ok_p = _join(p_ids, pa["player_api_id"])
         ok = ok_r & ok_p
         if not ok.all():
             logger.warning(
@@ -646,7 +751,7 @@ class SqlStore:
         # next match's team-0 key and corrupt a well-formed neighbor's
         # slot numbering.
         stride = int(team_p.max()) + 1 if team_p.size else 1
-        slot = _cumcount(midx_p * stride + team_p)
+        slot = _cumcount(midx_p * stride + team_p, minlength=n * stride)
 
         player_idx = np.full((n, 2, MAX_TEAM_SIZE), -1, np.int32)
         fits = (team_p < 2) & (slot < MAX_TEAM_SIZE)
@@ -689,11 +794,9 @@ class SqlStore:
                 if col in pl:
                     table[:p, lo_ + c] = pl[col].astype(np.float32)
         del pl
-        seed_mu, seed_sigma = trueskill_seed(
-            jnp.asarray(rrk), jnp.asarray(rbl), jnp.asarray(tier), cfg
-        )
-        table[:, COL_SEED_MU] = np.asarray(seed_mu)
-        table[:, COL_SEED_SIGMA] = np.asarray(seed_sigma)
+        seed_mu, seed_sigma = trueskill_seed_host(rrk, rbl, tier, cfg)
+        table[:, COL_SEED_MU] = seed_mu
+        table[:, COL_SEED_SIGMA] = seed_sigma
         state = PlayerState(
             table=jnp.asarray(table),
             rank_points_ranked=jnp.asarray(rrk),
@@ -706,8 +809,8 @@ class SqlStore:
         self.conn.rollback()  # release the read snapshot (see asset_urls)
         return ColumnarHistory(
             stream=stream, state=state,
-            match_ids=[_decode(x) for x in m_ids],
-            player_ids=[_decode(x) for x in p_ids],
+            match_ids=_decode_list(m_ids),
+            player_ids=_decode_list(p_ids),
         )
 
     def write_players(self, state, player_ids: list) -> int:

@@ -1,0 +1,217 @@
+"""Columnar DB ingest benchmark: native C scanner vs python bulk scans.
+
+Builds a synthetic full-history sqlite database (the reference's actual
+data source shape — match/roster/participant/player rows keyed by TEXT
+api_ids, ``worker.py:176-191``) and times ``SqlStore.load_stream`` both
+ways:
+
+  * native: ``fastsql.cc`` — one sqlite3 C-API walk per pass, values
+    memcpy'd into numpy buffers (no per-row Python, no text round-trip)
+  * python: ``_sqlite_bulk`` — one group_concat aggregate per (chunk,
+    column) + numpy text parse (round 3's 28.5 s / 35k matches/s at 1M)
+
+Usage:
+    python experiments/db_ingest.py --matches 1000000 [--db /tmp/hist.db]
+
+The fixture builds once (~2 min at 1M — executemany of ~10M rows) and is
+reused on reruns. Results land in BASELINE.md's round-3 table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sqlite3
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from analyzer_tpu.core import constants
+from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
+from analyzer_tpu.service import SqlStore
+
+SCHEMA = """
+CREATE TABLE match (
+    api_id TEXT PRIMARY KEY, game_mode TEXT, created_at INTEGER,
+    trueskill_quality REAL
+);
+CREATE TABLE asset (id INTEGER PRIMARY KEY, match_api_id TEXT, url TEXT);
+CREATE TABLE roster (
+    api_id TEXT PRIMARY KEY, match_api_id TEXT, winner INTEGER
+);
+CREATE TABLE participant (
+    api_id TEXT PRIMARY KEY, match_api_id TEXT, roster_api_id TEXT,
+    player_api_id TEXT, skill_tier INTEGER, went_afk INTEGER,
+    trueskill_mu REAL, trueskill_sigma REAL, trueskill_delta REAL
+);
+CREATE TABLE participant_stats (
+    api_id TEXT PRIMARY KEY, participant_api_id TEXT, kills INTEGER
+);
+CREATE TABLE participant_items (
+    api_id TEXT PRIMARY KEY, participant_api_id TEXT, any_afk INTEGER,
+    trueskill_casual_mu REAL, trueskill_casual_sigma REAL,
+    trueskill_ranked_mu REAL, trueskill_ranked_sigma REAL,
+    trueskill_blitz_mu REAL, trueskill_blitz_sigma REAL,
+    trueskill_br_mu REAL, trueskill_br_sigma REAL
+);
+CREATE TABLE player (
+    api_id TEXT PRIMARY KEY, skill_tier INTEGER,
+    rank_points_ranked REAL, rank_points_blitz REAL,
+    trueskill_mu REAL, trueskill_sigma REAL,
+    trueskill_casual_mu REAL, trueskill_casual_sigma REAL,
+    trueskill_ranked_mu REAL, trueskill_ranked_sigma REAL,
+    trueskill_blitz_mu REAL, trueskill_blitz_sigma REAL,
+    trueskill_br_mu REAL, trueskill_br_sigma REAL,
+    trueskill_5v5_casual_mu REAL, trueskill_5v5_casual_sigma REAL,
+    trueskill_5v5_ranked_mu REAL, trueskill_5v5_ranked_sigma REAL
+);
+"""
+
+
+def build_db(path: str, n_matches: int, n_players: int, seed: int) -> None:
+    players = synthetic_players(n_players, seed=seed)
+    stream = synthetic_stream(
+        n_matches, players, seed=seed, max_activity_share=1e-4
+    )
+    conn = sqlite3.connect(path)
+    conn.executescript(SCHEMA)
+    conn.execute("PRAGMA journal_mode=OFF")
+    conn.execute("PRAGMA synchronous=OFF")
+
+    def null_if_nan(x: float):
+        return None if np.isnan(x) else float(x)
+
+    conn.executemany(
+        "INSERT INTO player (api_id, skill_tier, rank_points_ranked,"
+        " rank_points_blitz) VALUES (?, ?, ?, ?)",
+        (
+            (f"p{i:08d}", int(players.skill_tier[i]),
+             null_if_nan(players.rank_points_ranked[i]),
+             null_if_nan(players.rank_points_blitz[i]))
+            for i in range(n_players)
+        ),
+    )
+    mode_names = {
+        i: name for name, i in constants.MODE_TO_ID.items()
+    }
+
+    def match_rows():
+        for m in range(n_matches):
+            mid = int(stream.mode_id[m])
+            name = mode_names.get(mid, "aral")  # unsupported mode name
+            yield (f"m{m:09d}", name, 1_000_000 + m)
+
+    def roster_rows():
+        for m in range(n_matches):
+            for t in range(2):
+                yield (f"m{m:09d}r{t}", f"m{m:09d}",
+                       1 if int(stream.winner[m]) == t else 0)
+
+    def participant_rows():
+        idx = stream.player_idx
+        afk = stream.afk
+        for m in range(n_matches):
+            first = True
+            for t in range(2):
+                for s in range(idx.shape[2]):
+                    p = int(idx[m, t, s])
+                    if p < 0:
+                        continue
+                    yield (
+                        f"m{m:09d}t{t}s{s}", f"m{m:09d}", f"m{m:09d}r{t}",
+                        f"p{p:08d}", int(players.skill_tier[p]),
+                        1 if (afk[m] and first) else 0,
+                    )
+                    first = False
+
+    conn.executemany(
+        "INSERT INTO match (api_id, game_mode, created_at) VALUES (?, ?, ?)",
+        match_rows(),
+    )
+    conn.executemany(
+        "INSERT INTO roster (api_id, match_api_id, winner) VALUES (?, ?, ?)",
+        roster_rows(),
+    )
+    conn.executemany(
+        "INSERT INTO participant (api_id, match_api_id, roster_api_id,"
+        " player_api_id, skill_tier, went_afk) VALUES (?, ?, ?, ?, ?, ?)",
+        participant_rows(),
+    )
+    conn.commit()
+    conn.close()
+
+
+def time_ingest(path: str, native: bool) -> tuple[float, object]:
+    store = SqlStore(f"sqlite:///{path}")
+    if not native:
+        store._native_sql = False
+    t0 = time.perf_counter()
+    hist = store.load_stream()
+    dt = time.perf_counter() - t0
+    return dt, hist
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--matches", type=int, default=1_000_000)
+    ap.add_argument("--players", type=int, default=None)
+    ap.add_argument("--db", default=None)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument(
+        "--skip-python", action="store_true",
+        help="time only the native path (the python scan is ~4x slower)",
+    )
+    args = ap.parse_args()
+    n_players = args.players or max(args.matches // 3, 12)
+    path = args.db or f"/tmp/db_ingest_{args.matches}_{n_players}.db"
+
+    if not os.path.exists(path):
+        print(f"building fixture {path} ...", flush=True)
+        t0 = time.perf_counter()
+        build_db(path, args.matches, n_players, args.seed)
+        print(f"  built in {time.perf_counter() - t0:.1f} s "
+              f"({os.path.getsize(path) / 1e6:.0f} MB)")
+    else:
+        print(f"reusing fixture {path} "
+              f"({os.path.getsize(path) / 1e6:.0f} MB)")
+
+    # Warm the one-time costs both paths share (the CPU-jitted seed bake
+    # at the fixture's exact [P+1] shape, jax backend init) so the first
+    # timed run isn't charged for them.
+    from analyzer_tpu.config import RatingConfig
+    from analyzer_tpu.core.seeding import trueskill_seed_host
+
+    z = np.zeros(n_players + 1, np.float32)
+    trueskill_seed_host(z, z, np.zeros(n_players + 1, np.int32),
+                        RatingConfig())
+
+    dt_n, hist_n = time_ingest(path, native=True)
+    rate_n = args.matches / dt_n
+    print(f"native ingest: {dt_n:.2f} s  ({rate_n / 1e3:.0f}k matches/s)")
+
+    if not args.skip_python:
+        dt_p, hist_p = time_ingest(path, native=False)
+        print(f"python ingest: {dt_p:.2f} s  "
+              f"({args.matches / dt_p / 1e3:.0f}k matches/s)  "
+              f"-> native is {dt_p / dt_n:.2f}x faster")
+        same = (
+            (hist_n.stream.player_idx == hist_p.stream.player_idx).all()
+            and (hist_n.stream.winner == hist_p.stream.winner).all()
+            and (hist_n.stream.mode_id == hist_p.stream.mode_id).all()
+            and (hist_n.stream.afk == hist_p.stream.afk).all()
+            and np.array_equal(
+                np.asarray(hist_n.state.table), np.asarray(hist_p.state.table),
+                equal_nan=True,
+            )
+        )
+        print(f"parity native == python: {same}")
+        if not same:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

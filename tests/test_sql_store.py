@@ -275,6 +275,151 @@ class TestColumnarIngest:
         assert (hist.stream.player_idx[i1] >= 0).sum() == 6  # full 3v3
 
 
+class TestNativeScan:
+    """fastsql.cc: the C columnar scanner must agree byte-for-byte with
+    the python bulk scans it replaces, and every failure mode must fall
+    back to them instead of breaking ingest."""
+
+    def _native(self):
+        return pytest.importorskip(
+            "analyzer_tpu.service._native_sql",
+            reason="native sqlite scanner not buildable here",
+        )
+
+    def test_bulk_parity_with_nulls_and_unicode(self, tmp_path):
+        import numpy as np
+
+        self._native()
+        path = str(tmp_path / "nulls.db")
+        conn = sqlite3.connect(path)
+        conn.executescript(SCHEMA)
+        rows = [
+            ("p-ascii", 15, 1700.5, None),
+            ("p-ünicode-世界", None, None, 0.0),
+            ("p-" + "x" * 200, -1, 0.0, 2500.25),
+            ("", 29, None, None),  # empty-string id
+        ]
+        conn.executemany(
+            "INSERT INTO player (api_id, skill_tier, rank_points_ranked,"
+            " rank_points_blitz) VALUES (?, ?, ?, ?)", rows,
+        )
+        conn.commit()
+        conn.close()
+        store = SqlStore(f"sqlite:///{path}")
+        sc, ic, fc = (
+            ("api_id",), ("skill_tier",),
+            ("rank_points_ranked", "rank_points_blitz"),
+        )
+        nat = store._bulk("player", sc, ic, fc)
+        py = store._sqlite_bulk("player", sc, ic, fc)
+        assert nat["api_id"].dtype.kind == "S"
+        assert (nat["api_id"] == py["api_id"]).all()
+        # NULL conventions: int NULL -> 0, float NULL -> NaN
+        assert np.array_equal(nat["skill_tier"], py["skill_tier"])
+        for c in fc:
+            assert np.array_equal(nat[c], py[c], equal_nan=True)
+
+    def test_load_stream_parity_native_vs_python(self, tmp_path):
+        import numpy as np
+
+        self._native()
+        path = str(tmp_path / "par.db")
+        seed_db(path, n_matches=5, afk_match=2)
+        a = SqlStore(f"sqlite:///{path}").load_stream(RatingConfig())
+        forced = SqlStore(f"sqlite:///{path}")
+        forced._native_sql = False  # permanent python fallback
+        b = forced.load_stream(RatingConfig())
+        assert a.match_ids == b.match_ids
+        assert a.player_ids == b.player_ids
+        assert (a.stream.player_idx == b.stream.player_idx).all()
+        assert (a.stream.winner == b.stream.winner).all()
+        assert (a.stream.mode_id == b.stream.mode_id).all()
+        assert (a.stream.afk == b.stream.afk).all()
+        assert np.array_equal(
+            np.asarray(a.state.table), np.asarray(b.state.table),
+            equal_nan=True,
+        )
+
+    def test_memory_db_never_takes_native_path(self, db_path):
+        store = SqlStore(f"sqlite:///{db_path}")
+        store._sqlite_path = None  # what an in-memory store carries
+        assert store._native_scan("SELECT 1", [("x", "int")]) is None
+
+    def test_scan_failure_falls_back_to_python(self, db_path, monkeypatch):
+        native = self._native()
+
+        def boom(path, sql, cols):
+            raise RuntimeError("simulated mid-scan failure")
+
+        monkeypatch.setattr(native, "scan_query", boom)
+        store = SqlStore(f"sqlite:///{db_path}")
+        hist = store.load_stream(RatingConfig())  # python path engages
+        assert hist.stream.n_matches == 3
+
+    def test_lookup_matches_numpy_join(self):
+        import numpy as np
+
+        native = self._native()
+        rng = np.random.default_rng(7)
+        keys = np.array(
+            [f"k{i:05d}" for i in rng.integers(0, 5000, 4000)], "S8"
+        )  # ~duplicates included: smallest index must win
+        needles = np.array(
+            [f"k{i:05d}" for i in rng.integers(0, 6000, 10000)], "S12"
+        )  # wider dtype + guaranteed misses
+        got = native.lookup(keys, needles)
+        # reference: numpy stable argsort + searchsorted-left
+        order = np.argsort(keys, kind="stable")
+        sk = keys[order]
+        pos = np.minimum(np.searchsorted(sk, needles.astype("S8")),
+                         sk.size - 1)
+        ok = sk[pos] == needles.astype("S8")
+        want = np.where(ok, order[pos], -1)
+        # searchsorted-left lands on the first duplicate in sorted order,
+        # which by stability is the smallest original index
+        assert np.array_equal(got, want)
+
+    def test_cumcount_matches_numpy(self):
+        import numpy as np
+
+        native = self._native()
+        rng = np.random.default_rng(3)
+        keys = rng.integers(0, 50, 2000).astype(np.int64)
+        got = native.cumcount(keys, 50)
+        # reference: stable argsort + segmented arange (the fallback)
+        order = np.argsort(keys, kind="stable")
+        sk = keys[order]
+        first = np.r_[True, sk[1:] != sk[:-1]]
+        start = np.maximum.accumulate(np.where(first, np.arange(sk.size), 0))
+        want = np.empty(sk.size, np.int64)
+        want[order] = np.arange(sk.size) - start
+        assert np.array_equal(got, want)
+
+    def test_scan_query_rejects_bad_sql(self, db_path):
+        native = self._native()
+        with pytest.raises(RuntimeError):
+            native.scan_query(db_path, "SELECT FROM nope", [("x", "int")])
+
+    def test_scan_query_empty_table(self, tmp_path):
+        native = self._native()
+        path = str(tmp_path / "empty.db")
+        conn = sqlite3.connect(path)
+        conn.executescript(SCHEMA)
+        conn.commit()
+        conn.close()
+        out = native.scan_query(
+            path,
+            'SELECT "api_id", "skill_tier", "rank_points_ranked" '
+            'FROM "player"',
+            [("api_id", "str"), ("skill_tier", "int"),
+             ("rank_points_ranked", "float")],
+        )
+        assert out["api_id"].size == 0
+        assert out["api_id"].dtype.kind == "S"
+        assert out["skill_tier"].dtype == "int64"
+        assert out["rank_points_ranked"].dtype == "float64"
+
+
 class TestLoad:
     def test_load_dedupes_and_orders_chronologically(self, db_path):
         store = SqlStore(f"sqlite:///{db_path}")
