@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from analyzer_tpu.sched.superstep import PackedSchedule
+from analyzer_tpu.sched.superstep import PackedSchedule, expand_step
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,19 +78,26 @@ def elo_history(
     Returns (ratings [P], expected0 [N] in stream order) — the latter is the
     model's win prediction for every match, made from pre-match ratings.
     """
-    pad_row = n_players  # schedules pack against the player-table pad row
+    pad_row = n_players  # the elo table's own parking row for padding writes
+    if sched.pad_row < n_players:
+        # expand_step derives slot_mask from sched.pad_row; a schedule
+        # packed against a SMALLER table would alias a real player's row.
+        # (A larger sched.pad_row is fine: masks derive from it, writes
+        # park at the elo table's own pad row.)
+        raise ValueError(
+            f"schedule packed with pad_row={sched.pad_row} < "
+            f"n_players={n_players}"
+        )
 
     @partial(jax.jit, donate_argnums=(0,))
     def run_chunk(table, arrays):
-        pidx, mask, win, mode, afk = arrays
-
         def step(tb, xs):
-            p, m, w, mo, a = xs
+            p, m, w, mo, a = expand_step(xs, sched.pad_row)
             ratable = (mo >= 0) & ~a
             tb, exp0 = elo_rate_batch(tb, p, m, w, ratable, pad_row, cfg)
             return tb, exp0
 
-        return jax.lax.scan(step, table, (pidx, mask, win, mode, afk))
+        return jax.lax.scan(step, table, arrays)
 
     table = create_elo_table(n_players, cfg)
     exps = []

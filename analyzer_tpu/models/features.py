@@ -131,11 +131,12 @@ def history_features(state, sched, cfg: RatingConfig, steps_per_chunk: int = 819
 
     from analyzer_tpu.core.state import MatchBatch
     from analyzer_tpu.core.update import rate_and_apply
+    from analyzer_tpu.sched.superstep import expand_step
 
-    @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
-    def run_chunk(st, arrays, cfg):
+    @partial(jax.jit, static_argnames=("cfg", "pad_row"), donate_argnums=(0,))
+    def run_chunk(st, arrays, cfg, pad_row):
         def step(s, xs):
-            pidx, mask, win, mode, afk = xs
+            pidx, mask, win, mode, afk = expand_step(xs, pad_row)
             batch = MatchBatch(
                 player_idx=pidx, slot_mask=mask, winner=win, mode_id=mode, afk=afk
             )
@@ -149,7 +150,9 @@ def history_features(state, sched, cfg: RatingConfig, steps_per_chunk: int = 819
     chunks = []
     for start in range(0, sched.n_steps, steps_per_chunk):
         stop = min(start + steps_per_chunk, sched.n_steps)
-        state, feats = run_chunk(state, sched.device_arrays(start, stop), cfg)
+        state, feats = run_chunk(
+            state, sched.device_arrays(start, stop), cfg, sched.pad_row
+        )
         chunks.append(np.asarray(feats))
 
     flat = np.concatenate(chunks, axis=0).reshape(-1, N_FEATURES)

@@ -25,7 +25,11 @@ import numpy as np
 from analyzer_tpu.config import RatingConfig
 from analyzer_tpu.core.state import MatchBatch, PlayerState
 from analyzer_tpu.core.update import rate_and_apply
-from analyzer_tpu.sched.superstep import PackedSchedule
+from analyzer_tpu.sched.superstep import (
+    PackedSchedule,
+    compact_device_window,
+    expand_step,
+)
 
 
 @dataclasses.dataclass
@@ -49,12 +53,18 @@ class HistoryOutputs:
     updated: np.ndarray  # [N]
 
 
-@partial(jax.jit, static_argnames=("cfg", "collect"), donate_argnums=(0,))
-def _scan_chunk(state: PlayerState, arrays, cfg: RatingConfig, collect: bool):
-    """Scans rate_and_apply over a [S', B, ...] slab of supersteps."""
+@partial(
+    jax.jit, static_argnames=("cfg", "collect", "pad_row"), donate_argnums=(0,)
+)
+def _scan_chunk(
+    state: PlayerState, arrays, cfg: RatingConfig, collect: bool, pad_row: int
+):
+    """Scans rate_and_apply over a compact [S', B, ...] slab of supersteps
+    (``compact_device_window`` layout: slot_mask derived on device,
+    int8 scalars widened here — ``pad_row`` is static like the shapes)."""
 
     def step(st, xs):
-        pidx, mask, winner, mode, afk = xs
+        pidx, mask, winner, mode, afk = expand_step(xs, pad_row)
         batch = MatchBatch(
             player_idx=pidx, slot_mask=mask, winner=winner, mode_id=mode, afk=afk
         )
@@ -112,7 +122,9 @@ def rate_history(
         else None
     )
     for i, start in enumerate(starts):
-        state, ys = _scan_chunk(state, arrays, cfg, collect)  # async dispatch
+        state, ys = _scan_chunk(
+            state, arrays, cfg, collect, sched.pad_row
+        )  # async dispatch
         arrays = None  # let the consumed slab free as soon as the scan is done
         if i + 1 < len(starts):  # stage k+1's slab while k executes
             arrays = sched.device_arrays(
@@ -393,10 +405,8 @@ def rate_stream(
         if run is not None:
             run.dispatch(pidx, mask, winner, mode_id, afk)
         else:
-            arrays = tuple(
-                jnp.asarray(a) for a in (pidx, mask, winner, mode_id, afk)
-            )
-            new_state, ys = _scan_chunk(state, arrays, cfg, collect)
+            arrays = compact_device_window(pidx, winner, mode_id, afk)
+            new_state, ys = _scan_chunk(state, arrays, cfg, collect, pad_row)
             state = new_state
             if collect:
                 outs.append(jax.tree.map(np.asarray, ys))

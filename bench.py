@@ -119,7 +119,7 @@ def main() -> None:
     def run():
         state = jax.device_put(jax.tree.map(np.asarray, state0))
         for arrays in chunks:
-            state, _ = _scan_chunk(state, arrays, cfg, False)
+            state, _ = _scan_chunk(state, arrays, cfg, False, sched.pad_row)
         # Fetch a value: on the tunneled dev chip block_until_ready can
         # return at enqueue; a host fetch must wait for real completion.
         np.asarray(state.table[:1])

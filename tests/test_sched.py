@@ -310,6 +310,40 @@ class TestPacking:
             np.asarray(fe.table), np.asarray(fl.table)
         )
 
+    def test_compact_slab_roundtrip(self):
+        """device_arrays drops slot_mask from the H2D slab (derived on
+        device as player_idx != pad_row) and narrows winner/mode_id to
+        int8; expand_step must reproduce the host 5-tuple EXACTLY — for
+        eager and windowed schedules, padded steps, 3v3-in-5v5 padding,
+        and unsupported/AFK matches."""
+        from analyzer_tpu.sched.superstep import expand_step
+
+        stream, state = small_stream(n_matches=200, n_players=30, seed=5)
+        for windowed in (False, True):
+            sched = pack_schedule(
+                stream, pad_row=state.pad_row, batch_size=8,
+                windowed=windowed,
+            )
+            if not windowed:  # cover all-padding (inert) steps too
+                sched = sched.pad_to_steps(sched.n_steps + 3)
+            stop = min(6, sched.n_steps)
+            compact = sched.device_arrays(0, stop)
+            assert compact[1].dtype == np.int8  # winner
+            assert compact[2].dtype == np.int8  # mode_id
+            host = sched.host_window(0, stop)
+            for s in range(stop):
+                xs = tuple(np.asarray(a[s]) for a in compact)
+                pidx, mask, win, mode, afk = (
+                    np.asarray(x) for x in expand_step(
+                        tuple(map(np.asarray, xs)), sched.pad_row
+                    )
+                )
+                np.testing.assert_array_equal(pidx, host[0][s])
+                np.testing.assert_array_equal(mask, host[1][s])
+                np.testing.assert_array_equal(win, host[2][s])
+                np.testing.assert_array_equal(mode, host[3][s])
+                np.testing.assert_array_equal(afk, host[4][s])
+
     def test_windowed_pads_narrow_stream_to_team_size(self):
         # 3-wide stream packed at team_size=5: windows must pad the team
         # axis with inert pad_row slots exactly like the eager packer.
