@@ -206,6 +206,27 @@ class PackedSchedule(_ScheduleBase):
             self.afk[sl],
         )
 
+    def device_arrays(self, start: int = 0, stop: int | None = None):
+        if stop is None:
+            stop = self.n_steps
+        if self.stream is None:
+            # Hand-built schedule (the fingerprint's 'materialized-v1'
+            # branch): it did not come from the materializer that
+            # guarantees the compact-slab invariant the device relies on
+            # (slot_mask == player_idx != pad_row). A schedule violating
+            # it would be rated silently wrong — fail loudly instead.
+            sl = slice(start, stop)
+            if not (
+                self.slot_mask[sl] == (self.player_idx[sl] != self.pad_row)
+            ).all():
+                raise ValueError(
+                    "hand-built schedule violates the compact-slab "
+                    "invariant: slot_mask must equal "
+                    "(player_idx != pad_row) — point padding slots at "
+                    f"pad_row={self.pad_row}"
+                )
+        return super().device_arrays(start, stop)
+
     def pad_to_steps(self, n_steps: int) -> "PackedSchedule":
         """Appends inert all-padding supersteps (match_idx -1, masks False,
         unsupported mode) so the schedule has exactly ``n_steps``. Padding

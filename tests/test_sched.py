@@ -344,6 +344,24 @@ class TestPacking:
                 np.testing.assert_array_equal(mode, host[3][s])
                 np.testing.assert_array_equal(afk, host[4][s])
 
+    def test_hand_built_schedule_invariant_guarded(self):
+        """A hand-built PackedSchedule whose slot_mask disagrees with the
+        player_idx != pad_row invariant must fail loudly at device_arrays
+        (the compact slab derives the mask on device) instead of rating a
+        masked-off player."""
+        import dataclasses as dc
+
+        stream, state = small_stream(n_matches=8)
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=4)
+        bad_mask = sched.slot_mask.copy()
+        bad_mask[0, 0, 0, 0] = not bad_mask[0, 0, 0, 0]
+        bad = dc.replace(sched, slot_mask=bad_mask, stream=None)
+        with pytest.raises(ValueError, match="compact-slab invariant"):
+            bad.device_arrays(0, 1)
+        # a consistent hand-built schedule passes
+        ok = dc.replace(sched, stream=None)
+        ok.device_arrays(0, 1)
+
     def test_windowed_pads_narrow_stream_to_team_size(self):
         # 3-wide stream packed at team_size=5: windows must pad the team
         # axis with inert pad_row slots exactly like the eager packer.

@@ -420,6 +420,43 @@ class TestNativeScan:
         assert out["rank_points_ranked"].dtype == "float64"
 
 
+class TestWritePlayers:
+    def test_nan_columns_write_null_and_unrated_skip(self, db_path):
+        import types
+
+        import numpy as np
+
+        from analyzer_tpu.core.state import MU_LO, SIGMA_LO, TABLE_WIDTH
+
+        store = SqlStore(f"sqlite:///{db_path}")
+        hist = store.load_stream(RatingConfig())
+        p = len(hist.player_ids)
+        tbl = np.full((p + 1, TABLE_WIDTH), np.nan, np.float32)
+        # player 0: shared + ranked rated, everything else NaN -> NULL
+        tbl[0, MU_LO] = 1800.0
+        tbl[0, SIGMA_LO] = 120.0
+        tbl[0, MU_LO + 2] = 1900.0  # trueskill_ranked
+        tbl[0, SIGMA_LO + 2] = 130.0
+        # player 1: untouched (shared mu NaN) -> row must NOT update
+        n = store.write_players(
+            types.SimpleNamespace(table=tbl), hist.player_ids
+        )
+        assert n == 1
+        conn = sqlite3.connect(db_path)
+        mu, smu, rmu, cmu = conn.execute(
+            "SELECT trueskill_mu, trueskill_sigma, trueskill_ranked_mu,"
+            " trueskill_casual_mu FROM player WHERE api_id = ?",
+            (hist.player_ids[0],),
+        ).fetchone()
+        assert (mu, smu, rmu) == (1800.0, 120.0, 1900.0)
+        assert cmu is None  # NaN -> NULL
+        other = conn.execute(
+            "SELECT trueskill_mu FROM player WHERE api_id = ?",
+            (hist.player_ids[1],),
+        ).fetchone()[0]
+        assert other is None  # unrated player untouched
+
+
 class TestLoad:
     def test_load_dedupes_and_orders_chronologically(self, db_path):
         store = SqlStore(f"sqlite:///{db_path}")
