@@ -189,6 +189,24 @@ class EncodedBatch:
             )
             afk[i] = anyafk
             self.slot_part.append(parts_grid)
+            # write_back needs participant_items[0] for every participant
+            # of a supported-mode match (gate path: any_afk on
+            # m.participants; rated path: mode mu/sigma on the slotted
+            # ones — rater.py:96-106,163-169). The reference IndexErrors
+            # here and dead-letters the whole batch; naming the match now
+            # lets the worker isolate it instead.
+            if m.api_id not in poison and mode[i] != constants.UNSUPPORTED_MODE_ID:
+                for part in (
+                    list(getattr(m, "participants", []))
+                    + [p for t in parts_grid for p in t]
+                ):
+                    if not getattr(part, "participant_items", None):
+                        poison[m.api_id] = (
+                            f"participant {part.api_id!r} has no "
+                            "participant_items row (write-back target, "
+                            "rater.py:104,169)"
+                        )
+                        break
         if poison:
             raise PoisonMatchError(
                 tuple(poison),

@@ -70,8 +70,27 @@ CREATE TABLE player (
 );
 """
 
+# FK indexes: any real deployment has them; without them every selectin
+# IN-list load in the service path is a full table scan (measured 81
+# scans per 500-match batch). Created AFTER the bulk inserts — live
+# indexes would be maintained row-by-row through ~10M executemany rows.
+INDEXES = """
+CREATE INDEX idx_roster_match ON roster(match_api_id);
+CREATE INDEX idx_part_match ON participant(match_api_id);
+CREATE INDEX idx_part_roster ON participant(roster_api_id);
+CREATE INDEX idx_items_part ON participant_items(participant_api_id);
+CREATE INDEX idx_asset_match ON asset(match_api_id);
+"""
 
-def build_db(path: str, n_matches: int, n_players: int, seed: int) -> None:
+
+def build_db(
+    path: str, n_matches: int, n_players: int, seed: int,
+    items: bool = False,
+) -> None:
+    """``items=True`` adds one participant_items row per participant —
+    required by the SERVICE path's write-back (``rater.py:104,169``);
+    the columnar ingest (`load_stream`) never reads them, so the ingest
+    benchmark skips them to keep the fixture build fast."""
     players = synthetic_players(n_players, seed=seed)
     stream = synthetic_stream(
         n_matches, players, seed=seed, max_activity_share=1e-4
@@ -140,6 +159,27 @@ def build_db(path: str, n_matches: int, n_players: int, seed: int) -> None:
         " player_api_id, skill_tier, went_afk) VALUES (?, ?, ?, ?, ?, ?)",
         participant_rows(),
     )
+    if items:
+        # Ids regenerate from the same deterministic scheme as
+        # participant_rows — no reading the table back (a second
+        # connection can't read while this one's bulk transaction is
+        # open, and fetchall would hold ~7.3M str objects at once).
+        def items_rows():
+            idx = stream.player_idx
+            for m in range(n_matches):
+                for t in range(2):
+                    for s in range(idx.shape[2]):
+                        if int(idx[m, t, s]) < 0:
+                            continue
+                        pid = f"m{m:09d}t{t}s{s}"
+                        yield (f"{pid}-items", pid)
+
+        conn.executemany(
+            "INSERT INTO participant_items (api_id, participant_api_id)"
+            " VALUES (?, ?)",
+            items_rows(),
+        )
+    conn.executescript(INDEXES)
     conn.commit()
     conn.close()
 

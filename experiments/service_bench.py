@@ -1,0 +1,143 @@
+"""Service-loop steady-state throughput: the reference's operating shape.
+
+The columnar lane (`cli rate --db`) is for full-history re-rates; the
+SERVICE lane is the reference's actual job — AMQP batches of 500 match
+ids, load the object graph, encode, rate on device, write back, commit,
+ack (``worker.py:95-199``). This measures that loop end to end with the
+in-memory broker and either store:
+
+  * mem    — InMemoryStore object graphs (isolates worker+encode+device)
+  * sqlite — SqlStore against a real file-backed DB (adds the per-batch
+             selectin loads and the transactional UPDATE commits)
+
+The reference's ceiling on the same loop is its numerics alone:
+<= ~1.4k matches/s/core (BASELINE.md) before any ORM/broker cost.
+
+Usage:
+    python experiments/service_bench.py --matches 50000 [--store sqlite]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from analyzer_tpu.config import RatingConfig, ServiceConfig
+from analyzer_tpu.service import InMemoryBroker, InMemoryStore, Worker
+
+BATCH = 500  # the reference's BATCHSIZE (worker.py:18)
+
+
+def build_mem_store(n_matches: int, n_players: int, seed: int):
+    """Persistent fake-player population + n 3v3 ranked matches over it.
+    Players are SHARED objects: the worker's write-back makes each
+    match's posterior the next one's prior, like the reference's DB."""
+    from tests.fakes import (
+        fake_items, fake_match, fake_participant, fake_player, fake_roster,
+    )
+
+    rng = np.random.default_rng(seed)
+    players = []
+    for i in range(n_players):
+        players.append(fake_player(skill_tier=int(rng.integers(1, 29))))
+        players[-1].api_id = f"p{i}"
+    store = InMemoryStore()
+    ids = []
+    # distinct 6-player draws, vectorized with dup-redraw (io/synthetic.py)
+    draws = rng.integers(0, n_players, (n_matches, 6))
+    need = np.arange(n_matches)
+    for _ in range(64):
+        rows = np.sort(draws[need], axis=1)
+        dup = (rows[:, 1:] == rows[:, :-1]).any(axis=1)
+        need = need[dup]
+        if need.size == 0:
+            break
+        draws[need] = rng.integers(0, n_players, (need.size, 6))
+    winners = rng.integers(0, 2, n_matches)
+    for m in range(n_matches):
+        rosters = []
+        for t in range(2):
+            parts = [
+                fake_participant(player=players[draws[m, t * 3 + s]],
+                                 items=fake_items(),
+                                 skill_tier=players[draws[m, t * 3 + s]].skill_tier)
+                for s in range(3)
+            ]
+            rosters.append(fake_roster(winner=int(winners[m] == t), participants=parts))
+        mid = f"m{m:08d}"
+        store.add_match(fake_match("ranked", rosters, api_id=mid))
+        ids.append(mid)
+    return store, ids
+
+
+def build_sqlite_store(path: str, n_matches: int, n_players: int, seed: int):
+    """The PRISTINE fixture caches at ``path``; each run copies it to a
+    scratch file — the worker's write-back mutates the database, so
+    rerunning against the original would silently benchmark pre-rated
+    players (and drift further every rerun)."""
+    import shutil
+
+    from analyzer_tpu.service import SqlStore
+    from experiments.db_ingest import build_db
+
+    if not os.path.exists(path):
+        build_db(path, n_matches, n_players, seed, items=True)
+    scratch = path + ".run"
+    shutil.copy(path, scratch)
+    store = SqlStore(f"sqlite:///{scratch}")
+    cur = store.conn.cursor()
+    cur.execute('SELECT "api_id" FROM "match" ORDER BY "created_at" ASC')
+    ids = [r[0] for r in cur.fetchall()]
+    cur.close()
+    return store, ids
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--matches", type=int, default=50_000)
+    ap.add_argument("--players", type=int, default=None)
+    ap.add_argument("--store", choices=("mem", "sqlite"), default="mem")
+    ap.add_argument("--seed", type=int, default=42)
+    args = ap.parse_args()
+    n_players = args.players or max(args.matches // 3, 12)
+
+    t0 = time.perf_counter()
+    if args.store == "mem":
+        store, ids = build_mem_store(args.matches, n_players, args.seed)
+    else:
+        store, ids = build_sqlite_store(
+            f"/tmp/service_bench_{args.matches}_{n_players}.db",
+            args.matches, n_players, args.seed,
+        )
+    print(f"fixture ({args.store}): {len(ids)} matches / {n_players} "
+          f"players in {time.perf_counter() - t0:.1f} s", flush=True)
+
+    broker = InMemoryBroker()
+    cfg = ServiceConfig(batch_size=BATCH, idle_timeout=0.0)
+    worker = Worker(broker, store, cfg, RatingConfig())
+    worker.warmup()
+
+    for mid in ids:
+        broker.publish(cfg.queue, mid.encode()
+                       if isinstance(mid, str) else mid)
+
+    t0 = time.perf_counter()
+    batches = 0
+    while worker.poll():
+        batches += 1
+    dt = time.perf_counter() - t0
+    failed = len(broker.queues.get(cfg.queue + "_failed", []))
+    print(f"service loop: {len(ids)} matches in {dt:.2f} s = "
+          f"{len(ids) / dt / 1e3:.1f}k matches/s "
+          f"({batches} batches of {BATCH}, {failed} dead-lettered)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

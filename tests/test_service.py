@@ -282,6 +282,25 @@ class TestPoisonIsolation:
         assert broker.qsize("analyze_failed") == 2
         assert loads == [4, 2]  # one poison pass + one clean pass
 
+    def test_missing_items_row_isolates_one_match(self, rig):
+        # The reference IndexErrors at participant_items[0] (rater.py:104)
+        # and dead-letters the whole batch; encode names the match so one
+        # missing write-back row costs one message.
+        broker, store, worker = rig
+        for i in range(2):
+            store.add_match(mk_match(f"m{i}", created_at=i))
+        noitems = mk_match("noitems", created_at=1)
+        noitems.rosters[0].participants[0].participant_items = []
+        store.add_match(noitems)
+        for mid in ("m0", "noitems", "m1"):
+            broker.publish("analyze", mid.encode())
+        assert worker.poll()
+        assert worker.matches_rated == 2
+        assert broker.qsize("analyze_failed") == 1
+        assert broker.queues["analyze_failed"][0].body == b"noitems"
+        assert noitems.trueskill_quality is None  # untouched
+        assert worker.batches_failed == 0
+
     def test_unattributable_error_still_fails_whole_batch(self, rig):
         broker, store, worker = rig
         store.add_match(mk_match("m0", created_at=0))
