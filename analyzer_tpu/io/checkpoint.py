@@ -42,6 +42,7 @@ import numpy as np
 
 from analyzer_tpu.config import RatingConfig
 from analyzer_tpu.core.state import PlayerState
+from analyzer_tpu.utils.host import fetch_tree
 
 _FIELDS = ("table", "rank_points_ranked", "rank_points_blitz", "skill_tier")
 _CFG_FIELDS = tuple(f.name for f in dataclasses.fields(RatingConfig))
@@ -68,7 +69,8 @@ def save_checkpoint(
     schedule_fingerprint: str | None = None,
 ) -> None:
     """Writes state + cursors atomically (tmp file + rename)."""
-    arrays = {f: np.asarray(getattr(state, f)) for f in _FIELDS}
+    # fetch_tree pipelines the D2H fetches (one link RTT, not four).
+    arrays = fetch_tree({f: getattr(state, f) for f in _FIELDS})
     arrays["cursor"] = np.int64(cursor)
     arrays["step_cursor"] = np.int64(step_cursor)
     if schedule_fingerprint is not None:
@@ -127,8 +129,11 @@ class CheckpointWriter:
         failing disk must not be discovered only at close()."""
         if self._err is not None:
             raise self._err
+        # fetch_tree pipelines the per-field D2H round trips; this runs
+        # on the scan thread, and the whole point of the async writer is
+        # a short stall there.
         host = dataclasses.replace(
-            state, **{f: np.asarray(getattr(state, f)) for f in _FIELDS}
+            state, **fetch_tree({f: getattr(state, f) for f in _FIELDS})
         )
         with self._lock:
             self._pending = (host, cursor, step_cursor, schedule_fingerprint)

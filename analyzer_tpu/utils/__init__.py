@@ -2,6 +2,7 @@
 profiler traces) that the reference lacks entirely (SURVEY.md section 5.1:
 no profiler hooks, no timing, no metrics — only debug logs)."""
 
+from analyzer_tpu.utils.host import fetch_tree
 from analyzer_tpu.utils.profiling import PhaseTimer, Counters, trace
 
-__all__ = ["PhaseTimer", "Counters", "trace"]
+__all__ = ["PhaseTimer", "Counters", "trace", "fetch_tree"]

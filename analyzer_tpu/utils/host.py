@@ -1,0 +1,21 @@
+"""Host-side device-transfer helpers shared by the runners, the
+checkpoint writer, and anything else that pulls device state back."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def fetch_tree(tree):
+    """D2H fetch of a pytree with every leaf's host copy started FIRST
+    (``copy_to_host_async``), so N leaves cost ~one link round trip
+    instead of N sequential ones. On the tunneled dev chip a blocking
+    ``np.asarray`` pays ~100 ms of latency PER ARRAY; the service loop
+    fetched a 9-leaf output tree per 500-match batch, which made the
+    sequential version the dominant per-batch cost (measured ~0.9 s of
+    1.4 s). Non-jax leaves (numpy, scalars) pass through unchanged."""
+    for x in jax.tree.leaves(tree):
+        if hasattr(x, "copy_to_host_async"):
+            x.copy_to_host_async()
+    return jax.tree.map(np.asarray, tree)

@@ -112,7 +112,7 @@ def main() -> int:
         store, ids = build_mem_store(args.matches, n_players, args.seed)
     else:
         store, ids = build_sqlite_store(
-            f"/tmp/service_bench_{args.matches}_{n_players}.db",
+            f"/tmp/service_bench_{args.matches}_{n_players}_{args.seed}.db",
             args.matches, n_players, args.seed,
         )
     print(f"fixture ({args.store}): {len(ids)} matches / {n_players} "
@@ -132,7 +132,7 @@ def main() -> int:
     while worker.poll():
         batches += 1
     dt = time.perf_counter() - t0
-    failed = len(broker.queues.get(cfg.queue + "_failed", []))
+    failed = broker.qsize(cfg.failed_queue)
     print(f"service loop: {len(ids)} matches in {dt:.2f} s = "
           f"{len(ids) / dt / 1e3:.1f}k matches/s "
           f"({batches} batches of {BATCH}, {failed} dead-lettered)")

@@ -1,0 +1,126 @@
+"""Property tests for the native (C) host components against their
+numpy/python reference implementations.
+
+The C sqlite scanner + joins (service/fastsql.cc) replaced measured-hot
+numpy paths; these drive them with adversarial inputs (duplicate keys,
+shared prefixes, width mismatches, NULLs, empty strings, unicode) that
+the fixture-based tests undersample. Examples are capped to keep the
+suite fast — the generators bias toward collisions on purpose.
+"""
+
+import sqlite3
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+native = pytest.importorskip(
+    "analyzer_tpu.service._native_sql",
+    reason="native sqlite scanner not buildable here",
+)
+
+# Small alphabet + short lengths = many duplicates and shared prefixes.
+_ids = st.lists(
+    st.text(alphabet="abAB0é", min_size=0, max_size=6), max_size=60
+)
+
+
+def _np_join(keys: np.ndarray, needles: np.ndarray) -> np.ndarray:
+    """The numpy reference: stable argsort + searchsorted-left."""
+    out = np.full(needles.size, -1, np.int64)
+    if keys.size == 0 or needles.size == 0:
+        return out
+    w = max(keys.dtype.itemsize, needles.dtype.itemsize)
+    k = keys.astype(f"S{w}")
+    m = needles.astype(f"S{w}")
+    order = np.argsort(k, kind="stable")
+    sk = k[order]
+    pos = np.minimum(np.searchsorted(sk, m), sk.size - 1)
+    ok = sk[pos] == m
+    return np.where(ok, order[pos], -1)
+
+
+class TestLookupProperties:
+    @settings(max_examples=60, deadline=None)
+    @given(keys=_ids, needles=_ids)
+    def test_matches_numpy_join(self, keys, needles):
+        ka = np.array([s.encode() for s in keys]) if keys else np.zeros(0, "S1")
+        na = (
+            np.array([s.encode() for s in needles])
+            if needles else np.zeros(0, "S1")
+        )
+        got = native.lookup(ka, na)
+        want = _np_join(ka, na)
+        assert np.array_equal(got, want)
+
+    @settings(max_examples=30, deadline=None)
+    @given(
+        data=st.lists(st.integers(0, 30), min_size=1, max_size=80),
+        kw=st.integers(2, 4),
+        nw=st.integers(2, 4),
+    )
+    def test_width_mismatch_is_padding_blind(self, data, kw, nw):
+        # The same logical ids at different S widths must join identically
+        # (numpy S-compare ignores trailing NULs; so must the C join).
+        ids = [f"k{i}" for i in data]
+        ka = np.array(ids, f"S{kw}")
+        na = np.array(ids, f"S{nw}")
+        got = native.lookup(ka, na)
+        want = _np_join(ka, na)
+        assert np.array_equal(got, want)
+
+
+class TestCumcountProperties:
+    @settings(max_examples=60, deadline=None)
+    @given(st.lists(st.integers(0, 12), max_size=120))
+    def test_matches_numpy(self, keys):
+        ka = np.array(keys, np.int64)
+        got = native.cumcount(ka, 13)
+        order = np.argsort(ka, kind="stable")
+        sk = ka[order]
+        first = np.r_[True, sk[1:] != sk[:-1]] if sk.size else np.zeros(0, bool)
+        start = np.maximum.accumulate(
+            np.where(first, np.arange(sk.size), 0)
+        ) if sk.size else np.zeros(0, np.int64)
+        want = np.empty(sk.size, np.int64)
+        want[order] = np.arange(sk.size) - start
+        assert np.array_equal(got, want)
+
+
+class TestScanProperties:
+    @settings(max_examples=25, deadline=None)
+    @given(
+        rows=st.lists(
+            st.tuples(
+                st.one_of(st.none(), st.text(max_size=12)),
+                st.one_of(st.none(), st.integers(-2**40, 2**40)),
+                st.one_of(
+                    st.none(),
+                    st.floats(allow_nan=False, allow_infinity=False,
+                              width=32),
+                ),
+            ),
+            max_size=40,
+        )
+    )
+    def test_roundtrip_vs_python_bulk(self, rows, tmp_path_factory):
+        path = str(tmp_path_factory.mktemp("scan") / "t.db")
+        conn = sqlite3.connect(path)
+        conn.execute("CREATE TABLE t (s TEXT, i INTEGER, f REAL)")
+        conn.executemany("INSERT INTO t VALUES (?, ?, ?)", rows)
+        conn.commit()
+        conn.close()
+        out = native.scan_query(
+            path, 'SELECT "s", "i", "f" FROM "t" ORDER BY rowid ASC',
+            [("s", "str"), ("i", "int"), ("f", "float")],
+        )
+        want_s = np.array(
+            [(r[0] or "").encode() for r in rows]
+        ) if rows else np.zeros(0, "S1")
+        want_i = np.array([r[1] or 0 for r in rows], np.int64)
+        want_f = np.array(
+            [np.nan if r[2] is None else r[2] for r in rows], np.float64
+        )
+        assert np.array_equal(out["s"], want_s.astype(out["s"].dtype))
+        assert np.array_equal(out["i"], want_i)
+        assert np.array_equal(out["f"], want_f, equal_nan=True)
