@@ -253,32 +253,43 @@ def _put_global(arr, sharding: NamedSharding):
 _step_fn_cache: dict = {}
 
 
-def sharded_step_fn(mesh: Mesh, cfg: RatingConfig, rows_per_shard: int):
+def sharded_step_fn(
+    mesh: Mesh, cfg: RatingConfig, rows_per_shard: int, pad_row: int
+):
     """Builds (and memoizes — jit cache can't see through fresh closures)
     the jitted, shard_map'd chunk runner over the sharded table.
 
-    Returns ``run(table, pidx, mask, winner, mode, afk, sel, dst) -> table``
+    Returns ``run(table, pidx, winner, mode, afk, sel, dst) -> table``
     scanning over the leading superstep axis; ``table`` is row-sharded over
     ``data`` and donated, the batch axis is sharded, ``sel``/``dst`` carry
-    one ``[K]`` block per shard.
+    one ``[K]`` block per shard. The feed is COMPACT, mirroring the
+    single-device runner (sched.superstep.compact_device_window): no
+    slot_mask (derived here as ``player_idx != pad_row`` — the invariant
+    every schedule producer guarantees) and int8 winner/mode_id, widened
+    on device. The mask all_gather it replaces was ~15% of the window
+    transfer, on the path BASELINE.md's D=1 ablation pinned as pure feed
+    logistics.
     """
-    key = (tuple(d.id for d in mesh.devices.flat), cfg, rows_per_shard)
+    key = (
+        tuple(d.id for d in mesh.devices.flat), cfg, rows_per_shard, pad_row,
+    )
     cached = _step_fn_cache.get(key)
     if cached is not None:
         return cached
 
-    def scan_chunk(table, pidx, mask, winner, mode, afk, sel, dst):
+    def scan_chunk(table, pidx, winner, mode, afk, sel, dst):
         me = jax.lax.axis_index(DATA_AXIS)
         n_shards = jax.lax.axis_size(DATA_AXIS)
 
         def step(tbl, xs):
-            lp, lm, lw, lmo, la, s_, d_ = xs  # local [B/D, ...] + [1, K]
+            lp, lw, lmo, la, s_, d_ = xs  # local [B/D, ...] + [1, K]
             gather = lambda x: jax.lax.all_gather(x, DATA_AXIS, axis=0, tiled=True)
+            gp = gather(lp)
             batch = MatchBatch(
-                player_idx=gather(lp),
-                slot_mask=gather(lm),
-                winner=gather(lw),
-                mode_id=gather(lmo),
+                player_idx=gp,
+                slot_mask=gp != pad_row,
+                winner=gather(lw).astype(jnp.int32),
+                mode_id=gather(lmo).astype(jnp.int32),
                 afk=gather(la),
             )
             # Prior assembly: candidates from this shard, zeros elsewhere;
@@ -303,7 +314,7 @@ def sharded_step_fn(mesh: Mesh, cfg: RatingConfig, rows_per_shard: int):
             return tbl, None
 
         table, _ = jax.lax.scan(
-            step, table, (pidx, mask, winner, mode, afk, sel, dst)
+            step, table, (pidx, winner, mode, afk, sel, dst)
         )
         return table
 
@@ -317,7 +328,7 @@ def sharded_step_fn(mesh: Mesh, cfg: RatingConfig, rows_per_shard: int):
     shmapped = jax.shard_map(
         scan_chunk,
         mesh=mesh,
-        in_specs=(tspec, bspec, bspec, bspec, bspec, bspec, rspec, rspec),
+        in_specs=(tspec, bspec, bspec, bspec, bspec, rspec, rspec),
         out_specs=tspec,
         check_vma=False,
     )
@@ -367,7 +378,9 @@ class ShardedRun:
         self.rps = -(-self.n_rows // self.n_dev)
         self._cap = routing_capacity
         self._state = state
-        self._step_fn = sharded_step_fn(mesh, cfg, self.rps)
+        self._step_fn = sharded_step_fn(
+            mesh, cfg, self.rps, state.pad_row
+        )
         self._batch_sh = NamedSharding(mesh, P(None, DATA_AXIS))
         self._route_sh = NamedSharding(mesh, P(None, DATA_AXIS, None))
 
@@ -435,15 +448,17 @@ class ShardedRun:
     ) -> None:
         """Routes (unless precomputed sel/dst are given) and runs one
         window. Async — returns at dispatch, so the caller's next window
-        materialization overlaps this window's device execution."""
+        materialization overlaps this window's device execution.
+        ``mask`` is consumed host-side (routing) only — the device
+        derives it from ``pidx != pad_row``, and winner/mode cross the
+        link as int8 (the step fn widens them)."""
         if sel is None:
             sel, dst = self._route_window(pidx, mask, mode_id, afk)
         self._table = self._step_fn(
             self._table,
             _put_global(pidx, self._batch_sh),
-            _put_global(mask, self._batch_sh),
-            _put_global(winner, self._batch_sh),
-            _put_global(mode_id, self._batch_sh),
+            _put_global(winner.astype(np.int8), self._batch_sh),
+            _put_global(mode_id.astype(np.int8), self._batch_sh),
             _put_global(afk, self._batch_sh),
             _put_global(sel, self._route_sh),
             _put_global(dst, self._route_sh),
@@ -514,6 +529,27 @@ def rate_history_sharded(
             f"batch_size {sched.batch_size} not divisible by mesh size {n_dev}"
         )
     n_rows = state.table.shape[0]
+    # The sharded step derives slot_mask on device as player_idx !=
+    # state.pad_row (the compact feed). A schedule packed against a
+    # DIFFERENT pad row would mark its padding slots as real players —
+    # phantom pad-row teammates silently corrupting the update. Fail
+    # loudly instead, like the single-device runner's hand-built-schedule
+    # guard (superstep.PackedSchedule.device_arrays).
+    if sched.pad_row != state.pad_row:
+        raise ValueError(
+            f"schedule packed with pad_row={sched.pad_row} but the state "
+            f"table's pad row is {state.pad_row}; repack the schedule with "
+            "pad_row=state.pad_row"
+        )
+    if getattr(sched, "stream", None) is None and hasattr(sched, "slot_mask"):
+        # Hand-built eager schedule: did not come from the materializer
+        # that guarantees the mask invariant — verify before deriving.
+        if not (sched.slot_mask == (sched.player_idx != sched.pad_row)).all():
+            raise ValueError(
+                "hand-built schedule violates the compact-feed invariant: "
+                "slot_mask must equal (player_idx != pad_row) — point "
+                f"padding slots at pad_row={sched.pad_row}"
+            )
     if routing is not None and (
         routing.n_shards != n_dev
         or routing.rows_per_shard * n_dev < n_rows

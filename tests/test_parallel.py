@@ -52,6 +52,27 @@ class TestShardedHistory:
             np.asarray(sharded.table)[:p], np.asarray(base.table)[:p]
         )
 
+    def test_pad_row_mismatch_rejected(self):
+        # The compact sharded feed derives slot_mask from state.pad_row;
+        # a schedule packed against a different pad row would rate
+        # phantom pad-row teammates — must fail loudly, not silently.
+        state, _ = setup()
+        players = synthetic_players(60, seed=11)
+        stream = synthetic_stream(200, players, seed=11)
+        bigger = pack_schedule(stream, pad_row=state.pad_row + 8, batch_size=32)
+        with pytest.raises(ValueError, match="pad_row"):
+            rate_history_sharded(state, bigger, CFG, mesh=make_mesh(1))
+
+    def test_hand_built_mask_violation_rejected(self):
+        import dataclasses as dc
+
+        state, sched = setup()
+        bad_mask = sched.slot_mask.copy()
+        bad_mask[0, 0, 0, 0] = not bad_mask[0, 0, 0, 0]
+        bad = dc.replace(sched, slot_mask=bad_mask, stream=None)
+        with pytest.raises(ValueError, match="compact-feed invariant"):
+            rate_history_sharded(state, bad, CFG, mesh=make_mesh(1))
+
     def test_routing_covers_every_ratable_slot(self):
         # Every written slot (sched.valid_slots) appears in exactly one
         # shard's sel/dst lists, at its owner shard (interleaved: global
