@@ -541,15 +541,9 @@ def rate_history_sharded(
             f"table's pad row is {state.pad_row}; repack the schedule with "
             "pad_row=state.pad_row"
         )
-    if getattr(sched, "stream", None) is None and hasattr(sched, "slot_mask"):
-        # Hand-built eager schedule: did not come from the materializer
-        # that guarantees the mask invariant — verify before deriving.
-        if not (sched.slot_mask == (sched.player_idx != sched.pad_row)).all():
-            raise ValueError(
-                "hand-built schedule violates the compact-feed invariant: "
-                "slot_mask must equal (player_idx != pad_row) — point "
-                f"padding slots at pad_row={sched.pad_row}"
-            )
+    check = getattr(sched, "check_compact_invariant", None)
+    if check is not None:  # hand-built eager schedules verify; see there
+        check()
     if routing is not None and (
         routing.n_shards != n_dev
         or routing.rows_per_shard * n_dev < n_rows

@@ -206,25 +206,33 @@ class PackedSchedule(_ScheduleBase):
             self.afk[sl],
         )
 
+    def check_compact_invariant(
+        self, start: int = 0, stop: int | None = None
+    ) -> None:
+        """Verifies ``slot_mask == (player_idx != pad_row)`` for a
+        HAND-BUILT schedule (``stream is None`` — the fingerprint's
+        'materialized-v1' branch). Materializer-produced schedules hold
+        the invariant by construction; a hand-built one that violates it
+        would be rated silently wrong by every compact-feed consumer
+        (the single-device slab AND the sharded mesh feed, both of which
+        derive the mask on device) — fail loudly instead."""
+        if self.stream is not None:
+            return
+        sl = slice(start, self.n_steps if stop is None else stop)
+        if not (
+            self.slot_mask[sl] == (self.player_idx[sl] != self.pad_row)
+        ).all():
+            raise ValueError(
+                "hand-built schedule violates the compact-feed "
+                "invariant: slot_mask must equal "
+                "(player_idx != pad_row) — point padding slots at "
+                f"pad_row={self.pad_row}"
+            )
+
     def device_arrays(self, start: int = 0, stop: int | None = None):
         if stop is None:
             stop = self.n_steps
-        if self.stream is None:
-            # Hand-built schedule (the fingerprint's 'materialized-v1'
-            # branch): it did not come from the materializer that
-            # guarantees the compact-slab invariant the device relies on
-            # (slot_mask == player_idx != pad_row). A schedule violating
-            # it would be rated silently wrong — fail loudly instead.
-            sl = slice(start, stop)
-            if not (
-                self.slot_mask[sl] == (self.player_idx[sl] != self.pad_row)
-            ).all():
-                raise ValueError(
-                    "hand-built schedule violates the compact-slab "
-                    "invariant: slot_mask must equal "
-                    "(player_idx != pad_row) — point padding slots at "
-                    f"pad_row={self.pad_row}"
-                )
+        self.check_compact_invariant(start, stop)
         return super().device_arrays(start, stop)
 
     def pad_to_steps(self, n_steps: int) -> "PackedSchedule":

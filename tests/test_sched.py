@@ -356,7 +356,7 @@ class TestPacking:
         bad_mask = sched.slot_mask.copy()
         bad_mask[0, 0, 0, 0] = not bad_mask[0, 0, 0, 0]
         bad = dc.replace(sched, slot_mask=bad_mask, stream=None)
-        with pytest.raises(ValueError, match="compact-slab invariant"):
+        with pytest.raises(ValueError, match="compact-feed invariant"):
             bad.device_arrays(0, 1)
         # a consistent hand-built schedule passes
         ok = dc.replace(sched, stream=None)
