@@ -54,6 +54,19 @@ def _load_inputs(args, cfg, timer):
     return stream, n_players, None, None, None
 
 
+def _require_one_source(args) -> bool:
+    """Validates that EXACTLY one of --csv / --db names a source,
+    normalizing empty strings to missing (``--db ""`` must not slip
+    past the xor and crash in the loader). Shared by rate/elo/train."""
+    args.csv = getattr(args, "csv", None) or None
+    args.db = getattr(args, "db", None) or None
+    if (args.csv is None) == (args.db is None):
+        print("error: exactly one of --csv / --db is required",
+              file=sys.stderr)
+        return False
+    return True
+
+
 def _maybe_db_write(args, timer, db_store, state, player_ids) -> dict:
     """Final-table write-back for --db --db-write runs; returns a stats
     extra ({} when not writing)."""
@@ -258,8 +271,7 @@ def cmd_rate(args) -> int:
     if args.mesh is not None and args.mesh < 0:
         print("error: --mesh must be >= 0 (0 = all devices)", file=sys.stderr)
         return 2
-    if (args.csv is None) == (args.db is None):
-        print("error: exactly one of --csv / --db is required", file=sys.stderr)
+    if not _require_one_source(args):
         return 2
     if args.db_write and not args.db:
         print("error: --db-write requires --db", file=sys.stderr)
@@ -481,14 +493,23 @@ def _rate_mesh(args, cfg, timer) -> int:
 
 
 def cmd_elo(args) -> int:
+    from analyzer_tpu.config import RatingConfig
     from analyzer_tpu.models import elo_history
     from analyzer_tpu.sched import pack_schedule
+    from analyzer_tpu.utils import PhaseTimer
 
-    stream, n_players = _load_stream(args.csv)
+    if not _require_one_source(args):
+        return 2
+    timer = PhaseTimer()
+    stream, n_players, _, _, _ = _load_inputs(
+        args, RatingConfig.from_env(), timer
+    )
     # Windowed: elo_history consumes device_arrays/match_idx only, so the
     # gather tensors materialize lazily here too.
-    sched = pack_schedule(stream, pad_row=n_players, windowed=True)
-    ratings, expected = elo_history(sched, n_players)
+    with timer.phase("pack"):
+        sched = pack_schedule(stream, pad_row=n_players, windowed=True)
+    with timer.phase("rate"):
+        ratings, expected = elo_history(sched, n_players)
     ratable = stream.ratable
     if ratable.any():
         acc = _half_credit_accuracy(
@@ -505,6 +526,9 @@ def cmd_elo(args) -> int:
                 "players": n_players,
                 "mean_rating": round(float(ratings.mean()), 2),
                 "prediction_accuracy": round(acc, 4) if acc is not None else None,
+                "phases": {
+                    k: round(v, 3) for k, v in timer.report().items()
+                },
             }
         )
     )
@@ -529,10 +553,23 @@ def cmd_train(args) -> int:
     if not (0.0 <= args.eval_frac < 1.0):
         print("error: --eval-frac must be in [0, 1)", file=sys.stderr)
         return 2
+    if not _require_one_source(args):
+        return 2
+    if args.telemetry and args.db:
+        print(
+            "error: --telemetry needs an .npz stream (databases carry no "
+            "telemetry block); use --csv", file=sys.stderr,
+        )
+        return 2
     cfg = RatingConfig.from_env()
     timer = PhaseTimer()
-    with timer.phase("load"):
-        stream, n_players = _load_stream(args.csv)
+    stream, n_players, _, _, _ = _load_inputs(args, cfg, timer)
+    # ALWAYS cold-start, even on the DB lane: a production database's
+    # stored ratings are usually the END state of rating this very
+    # history (e.g. after `rate --db --db-write`), so seeding features
+    # from them would leak every match's own outcome into its
+    # "pre-match" features and inflate the chronological holdout. The
+    # one scan below re-derives honest pre-match state either way.
     state = PlayerState.create(n_players, cfg=cfg)
     with timer.phase("features"):
         sched = pack_schedule(stream, pad_row=state.pad_row, windowed=True)
@@ -725,7 +762,12 @@ def main(argv=None) -> int:
         help="win-probability heads (logistic/MLP) on leak-free rating "
         "features, chronological holdout eval",
     )
-    s.add_argument("--csv", required=True, help="match stream, .csv or .npz")
+    s.add_argument("--csv", help="match stream, .csv or .npz")
+    s.add_argument(
+        "--db", metavar="URI",
+        help="train on a full history ingested straight from a database "
+        "(columnar load_stream; features start from the DB rating priors)",
+    )
     s.add_argument("--model", choices=("logistic", "mlp"), default="logistic")
     s.add_argument("--epochs", type=int, default=30)
     s.add_argument("--hidden", type=int, default=64, help="MLP width")
@@ -745,8 +787,12 @@ def main(argv=None) -> int:
     )
     s.set_defaults(fn=cmd_train)
 
-    s = sub.add_parser("elo", help="Elo re-rate of a CSV + accuracy")
-    s.add_argument("--csv", required=True)
+    s = sub.add_parser("elo", help="Elo re-rate of a stream + accuracy")
+    s.add_argument("--csv", help="match stream, .csv or .npz")
+    s.add_argument(
+        "--db", metavar="URI",
+        help="Elo re-rate a full history straight from a database",
+    )
     s.add_argument("--out", help="npz output for ratings/predictions")
     s.set_defaults(fn=cmd_elo)
 

@@ -33,6 +33,30 @@ class TestCli:
         assert elo["matches"] == 200
         assert elo["prediction_accuracy"] is not None
 
+    def test_elo_and_train_from_db(self, tmp_path, capsys):
+        # The model heads accept the DB lane too: Elo and the logistic
+        # head run on a columnar-ingested history (train seeds features
+        # from the stored rating priors).
+        from tests.test_sql_store import seed_db
+
+        path = str(tmp_path / "heads.db")
+        seed_db(path, n_matches=12)  # >= 10 ratable rows to train on
+        line = run(capsys, "elo", "--db", f"sqlite:///{path}")
+        elo = json.loads(line)
+        assert elo["matches"] == 12
+        assert elo["players"] == 6
+        line = run(capsys, "train", "--db", f"sqlite:///{path}",
+                   "--model", "logistic", "--epochs", "2",
+                   "--eval-frac", "0.0")
+        stats = json.loads(line)
+        assert stats["model"] == "logistic"
+        # telemetry needs an npz stream; DBs carry none
+        assert main(["train", "--db", f"sqlite:///{path}",
+                     "--telemetry"]) == 2
+        assert main(["train", "--csv", "x.csv", "--db", "y"]) == 2
+        assert main(["elo"]) == 2
+        assert main(["elo", "--db", ""]) == 2  # empty source != a source
+
     def test_rate_db_checkpoint_resume_matches_oneshot(self, tmp_path, capsys):
         # The production full-history story end to end: DB ingest with
         # periodic snapshots, kill at a step bound, resume to completion,
