@@ -96,7 +96,14 @@ def cmd_synth(args) -> int:
             print("error: --telemetry requires an .npz output", file=sys.stderr)
             return 2
         telemetry = synthetic_telemetry(stream, players, seed=args.seed)
-    save_stream(args.out, stream, telemetry=telemetry)
+    if args.out.endswith(".db"):
+        # Reference-schema sqlite: exercises the whole DB lane (service
+        # worker, rate/elo/train --db) without production data.
+        from analyzer_tpu.io.dbgen import write_history_db
+
+        write_history_db(args.out, stream, players)
+    else:
+        save_stream(args.out, stream, telemetry=telemetry)
     print(
         f"wrote {stream.n_matches} matches / {args.players} players to "
         f"{args.out}" + (" (+telemetry)" if telemetry is not None else "")
@@ -715,7 +722,11 @@ def main(argv=None) -> int:
     s.add_argument("--players", type=int, default=300)
     s.add_argument("--seed", type=int, default=0)
     s.add_argument("--concentration", type=float, default=0.8)
-    s.add_argument("--out", required=True, help=".csv (native parser) or .npz (binary)")
+    s.add_argument(
+        "--out", required=True,
+        help=".csv (native parser), .npz (binary), or .db "
+        "(reference-schema sqlite for the --db lanes)",
+    )
     s.add_argument(
         "--telemetry", action="store_true",
         help="also generate post-game telemetry (K/D/A, gold, cs) for the "
@@ -766,7 +777,9 @@ def main(argv=None) -> int:
     s.add_argument(
         "--db", metavar="URI",
         help="train on a full history ingested straight from a database "
-        "(columnar load_stream; features start from the DB rating priors)",
+        "(columnar load_stream; features COLD-START even if the DB holds "
+        "ratings — stored ratings are usually this history's own end "
+        "state, and seeding from them would leak outcomes into the eval)",
     )
     s.add_argument("--model", choices=("logistic", "mlp"), default="logistic")
     s.add_argument("--epochs", type=int, default=30)

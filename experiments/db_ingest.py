@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import argparse
 import os
-import sqlite3
 import sys
 import time
 
@@ -29,159 +28,24 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from analyzer_tpu.core import constants
+from analyzer_tpu.io.dbgen import write_history_db
 from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
 from analyzer_tpu.service import SqlStore
-
-SCHEMA = """
-CREATE TABLE match (
-    api_id TEXT PRIMARY KEY, game_mode TEXT, created_at INTEGER,
-    trueskill_quality REAL
-);
-CREATE TABLE asset (id INTEGER PRIMARY KEY, match_api_id TEXT, url TEXT);
-CREATE TABLE roster (
-    api_id TEXT PRIMARY KEY, match_api_id TEXT, winner INTEGER
-);
-CREATE TABLE participant (
-    api_id TEXT PRIMARY KEY, match_api_id TEXT, roster_api_id TEXT,
-    player_api_id TEXT, skill_tier INTEGER, went_afk INTEGER,
-    trueskill_mu REAL, trueskill_sigma REAL, trueskill_delta REAL
-);
-CREATE TABLE participant_stats (
-    api_id TEXT PRIMARY KEY, participant_api_id TEXT, kills INTEGER
-);
-CREATE TABLE participant_items (
-    api_id TEXT PRIMARY KEY, participant_api_id TEXT, any_afk INTEGER,
-    trueskill_casual_mu REAL, trueskill_casual_sigma REAL,
-    trueskill_ranked_mu REAL, trueskill_ranked_sigma REAL,
-    trueskill_blitz_mu REAL, trueskill_blitz_sigma REAL,
-    trueskill_br_mu REAL, trueskill_br_sigma REAL
-);
-CREATE TABLE player (
-    api_id TEXT PRIMARY KEY, skill_tier INTEGER,
-    rank_points_ranked REAL, rank_points_blitz REAL,
-    trueskill_mu REAL, trueskill_sigma REAL,
-    trueskill_casual_mu REAL, trueskill_casual_sigma REAL,
-    trueskill_ranked_mu REAL, trueskill_ranked_sigma REAL,
-    trueskill_blitz_mu REAL, trueskill_blitz_sigma REAL,
-    trueskill_br_mu REAL, trueskill_br_sigma REAL,
-    trueskill_5v5_casual_mu REAL, trueskill_5v5_casual_sigma REAL,
-    trueskill_5v5_ranked_mu REAL, trueskill_5v5_ranked_sigma REAL
-);
-"""
-
-# FK indexes: any real deployment has them; without them every selectin
-# IN-list load in the service path is a full table scan (measured 81
-# scans per 500-match batch). Created AFTER the bulk inserts — live
-# indexes would be maintained row-by-row through ~10M executemany rows.
-INDEXES = """
-CREATE INDEX idx_roster_match ON roster(match_api_id);
-CREATE INDEX idx_part_match ON participant(match_api_id);
-CREATE INDEX idx_part_roster ON participant(roster_api_id);
-CREATE INDEX idx_items_part ON participant_items(participant_api_id);
-CREATE INDEX idx_asset_match ON asset(match_api_id);
-"""
-
 
 def build_db(
     path: str, n_matches: int, n_players: int, seed: int,
     items: bool = False,
 ) -> None:
-    """``items=True`` adds one participant_items row per participant —
-    required by the SERVICE path's write-back (``rater.py:104,169``);
-    the columnar ingest (`load_stream`) never reads them, so the ingest
-    benchmark skips them to keep the fixture build fast."""
+    """Synthetic full-history fixture via io.dbgen (the package's
+    reference-schema sqlite writer). ``items=True`` adds the
+    participant_items rows the SERVICE path's write-back needs
+    (``rater.py:104,169``); the columnar ingest never reads them, so the
+    ingest benchmark skips them to keep the fixture build fast."""
     players = synthetic_players(n_players, seed=seed)
     stream = synthetic_stream(
         n_matches, players, seed=seed, max_activity_share=1e-4
     )
-    conn = sqlite3.connect(path)
-    conn.executescript(SCHEMA)
-    conn.execute("PRAGMA journal_mode=OFF")
-    conn.execute("PRAGMA synchronous=OFF")
-
-    def null_if_nan(x: float):
-        return None if np.isnan(x) else float(x)
-
-    conn.executemany(
-        "INSERT INTO player (api_id, skill_tier, rank_points_ranked,"
-        " rank_points_blitz) VALUES (?, ?, ?, ?)",
-        (
-            (f"p{i:08d}", int(players.skill_tier[i]),
-             null_if_nan(players.rank_points_ranked[i]),
-             null_if_nan(players.rank_points_blitz[i]))
-            for i in range(n_players)
-        ),
-    )
-    mode_names = {
-        i: name for name, i in constants.MODE_TO_ID.items()
-    }
-
-    def match_rows():
-        for m in range(n_matches):
-            mid = int(stream.mode_id[m])
-            name = mode_names.get(mid, "aral")  # unsupported mode name
-            yield (f"m{m:09d}", name, 1_000_000 + m)
-
-    def roster_rows():
-        for m in range(n_matches):
-            for t in range(2):
-                yield (f"m{m:09d}r{t}", f"m{m:09d}",
-                       1 if int(stream.winner[m]) == t else 0)
-
-    def participant_rows():
-        idx = stream.player_idx
-        afk = stream.afk
-        for m in range(n_matches):
-            first = True
-            for t in range(2):
-                for s in range(idx.shape[2]):
-                    p = int(idx[m, t, s])
-                    if p < 0:
-                        continue
-                    yield (
-                        f"m{m:09d}t{t}s{s}", f"m{m:09d}", f"m{m:09d}r{t}",
-                        f"p{p:08d}", int(players.skill_tier[p]),
-                        1 if (afk[m] and first) else 0,
-                    )
-                    first = False
-
-    conn.executemany(
-        "INSERT INTO match (api_id, game_mode, created_at) VALUES (?, ?, ?)",
-        match_rows(),
-    )
-    conn.executemany(
-        "INSERT INTO roster (api_id, match_api_id, winner) VALUES (?, ?, ?)",
-        roster_rows(),
-    )
-    conn.executemany(
-        "INSERT INTO participant (api_id, match_api_id, roster_api_id,"
-        " player_api_id, skill_tier, went_afk) VALUES (?, ?, ?, ?, ?, ?)",
-        participant_rows(),
-    )
-    if items:
-        # Ids regenerate from the same deterministic scheme as
-        # participant_rows — no reading the table back (a second
-        # connection can't read while this one's bulk transaction is
-        # open, and fetchall would hold ~7.3M str objects at once).
-        def items_rows():
-            idx = stream.player_idx
-            for m in range(n_matches):
-                for t in range(2):
-                    for s in range(idx.shape[2]):
-                        if int(idx[m, t, s]) < 0:
-                            continue
-                        pid = f"m{m:09d}t{t}s{s}"
-                        yield (f"{pid}-items", pid)
-
-        conn.executemany(
-            "INSERT INTO participant_items (api_id, participant_api_id)"
-            " VALUES (?, ?)",
-            items_rows(),
-        )
-    conn.executescript(INDEXES)
-    conn.commit()
-    conn.close()
+    write_history_db(path, stream, players, items=items)
 
 
 def time_ingest(path: str, native: bool) -> tuple[float, object]:

@@ -33,6 +33,33 @@ class TestCli:
         assert elo["matches"] == 200
         assert elo["prediction_accuracy"] is not None
 
+    def test_synth_db_roundtrips_stream_exactly(self, tmp_path, capsys):
+        # synth --out h.db writes the reference sqlite schema; columnar
+        # ingest must recover the IDENTICAL stream (order, teams, modes,
+        # afk) — and the whole lane runs: rate --db-write + elo --db.
+        import numpy as np
+
+        from analyzer_tpu.config import RatingConfig
+        from analyzer_tpu.io.csv_codec import load_stream_npz
+        from analyzer_tpu.service import SqlStore
+
+        db = str(tmp_path / "h.db")
+        npz = str(tmp_path / "h.npz")
+        for out in (db, npz):
+            run(capsys, "synth", "--matches", "60", "--players", "30",
+                "--seed", "3", "--out", out)
+        want = load_stream_npz(npz)
+        hist = SqlStore(f"sqlite:///{db}").load_stream(RatingConfig())
+        got = hist.stream
+        np.testing.assert_array_equal(got.player_idx, want.player_idx)
+        np.testing.assert_array_equal(got.winner, want.winner)
+        np.testing.assert_array_equal(got.mode_id, want.mode_id)
+        np.testing.assert_array_equal(got.afk, want.afk)
+        stats = json.loads(
+            run(capsys, "rate", "--db", f"sqlite:///{db}", "--db-write")
+        )
+        assert stats["matches"] == 60 and stats["players_written"] > 0
+
     def test_elo_and_train_from_db(self, tmp_path, capsys):
         # The model heads accept the DB lane too: Elo and the logistic
         # head run on a columnar-ingested history (train seeds features
