@@ -707,6 +707,20 @@ def cmd_bench(args) -> int:
 
 
 def cmd_worker(args) -> int:
+    if args.requeue_failed:
+        # Dead-letter redrive: move <QUEUE>_failed back onto the main
+        # queue and exit — run after fixing whatever poisoned them.
+        from analyzer_tpu.config import ServiceConfig
+        from analyzer_tpu.service.broker import make_pika_broker
+        from analyzer_tpu.service.worker import requeue_failed
+
+        config = ServiceConfig.from_env()
+        broker = make_pika_broker(
+            config.rabbitmq_uri, prefetch=config.batch_size
+        )
+        n = requeue_failed(broker, config)
+        print(json.dumps({"requeued": n, "queue": config.queue}))
+        return 0
     from analyzer_tpu.service.worker import main as worker_main
 
     worker_main()
@@ -813,6 +827,11 @@ def main(argv=None) -> int:
     s.set_defaults(fn=cmd_bench)
 
     s = sub.add_parser("worker", help="broker-consuming service loop")
+    s.add_argument(
+        "--requeue-failed", action="store_true",
+        help="redrive <QUEUE>_failed back onto the main queue and exit "
+        "(run after fixing what dead-lettered them)",
+    )
     s.set_defaults(fn=cmd_worker)
 
     args = p.parse_args(argv)

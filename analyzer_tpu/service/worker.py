@@ -330,6 +330,53 @@ class Worker:
         return self.matches_rated / dt if dt > 0 else 0.0
 
 
+def requeue_failed(
+    broker, config: "ServiceConfig",
+    empty_polls: int = 5, poll_interval: float = 0.2,
+    sleep=time.sleep,
+) -> int:
+    """Redrives every dead-lettered message from ``<QUEUE>_failed`` back
+    onto the main queue, headers intact. Returns the count.
+
+    The operational complement to the failure policy: after fixing the
+    cause (schema, upstream data, a poison record), the reference's
+    operators had to shovel `analyze_failed` back by hand with broker
+    tooling; here it is one command (`cli worker --requeue-failed`).
+
+    Broker realities this respects:
+      * both queues are declared first — subscribing to a missing queue
+        404s a real channel, and publishing to a missing main queue
+        would silently DROP the redriven messages;
+      * a push-consumer broker (the pika adapter) returns empty from its
+        first non-blocking polls while the server's deliveries are in
+        flight, so the drain only stops after ``empty_polls`` CONSECUTIVE
+        empty polls ``poll_interval`` apart;
+      * delivery is at-least-once: each message re-publishes BEFORE its
+        ack, so a crash or connection blip mid-drain can duplicate up to
+        one prefetch window, never lose — and rating is idempotent per
+        match (a re-rate writes the same posteriors)."""
+    broker.declare_queue(config.queue)
+    broker.declare_queue(config.failed_queue)
+    moved = 0
+    empties = 0
+    while empties < empty_polls:
+        batch = broker.get(config.failed_queue, 100)
+        if not batch:
+            empties += 1
+            sleep(poll_interval)
+            continue
+        empties = 0
+        for msg in batch:
+            broker.publish(config.queue, msg.body, msg.headers)
+            broker.ack(msg.delivery_tag)
+            moved += 1
+    logger.info(
+        "requeued %d dead-lettered message(s) %s -> %s",
+        moved, config.failed_queue, config.queue,
+    )
+    return moved
+
+
 def main(max_flushes: int | None = None) -> Worker:
     """``python -m analyzer_tpu.service.worker`` — the reference's
     ``python3 worker.py`` entry point (``worker.py:219-221``), requiring a

@@ -212,6 +212,29 @@ class TestPikaAdapter:
         assert len(broker.get("q", 10)) == 2
         assert broker.get("q", 10) == []
 
+    def test_requeue_failed_drains_via_push_consumer(self, stub_pika):
+        # The redrive tool against the PUSH-consumer adapter (the
+        # production path): it must declare both queues, survive the
+        # empty first polls of an async consumer, and move every
+        # dead-letter with headers intact.
+        from analyzer_tpu.config import ServiceConfig
+        from analyzer_tpu.service.broker import make_pika_broker
+        from analyzer_tpu.service.worker import requeue_failed
+
+        broker = make_pika_broker("amqp://localhost")
+        cfg = ServiceConfig(batch_size=4)
+        broker.declare_queue(cfg.failed_queue)
+        for i in range(6):
+            broker.publish(
+                cfg.failed_queue, f"m{i}".encode(), {"notify": f"u{i}"}
+            )
+        n = requeue_failed(broker, cfg, sleep=lambda s: None)
+        assert n == 6
+        got = broker.get(cfg.queue, 10)
+        assert [m.body for m in got] == [f"m{i}".encode() for i in range(6)]
+        assert got[0].headers == {"notify": "u0"}
+        assert broker.get(cfg.failed_queue, 10) == []
+
     def test_worker_runs_against_stubbed_pika(self, stub_pika):
         """The full Worker loop over the adapter: publish ids, poll once,
         batch rated and acked through the stub channel."""

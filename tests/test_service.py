@@ -301,6 +301,32 @@ class TestPoisonIsolation:
         assert noitems.trueskill_quality is None  # untouched
         assert worker.batches_failed == 0
 
+    def test_requeue_failed_redrives_dead_letters(self, rig):
+        # The operational complement: after the poison cause is fixed,
+        # one command moves <QUEUE>_failed back and the worker rates
+        # what previously dead-lettered (headers intact).
+        from analyzer_tpu.service.worker import requeue_failed
+
+        broker, store, worker = rig
+        store.add_match(mk_match("fine", created_at=0))
+        poison = mk_match("bad", created_at=1)
+        poison.rosters[1].winner = True  # two winners -> dead-letter
+        store.add_match(poison)
+        broker.publish("analyze", b"fine", {"notify": "web.player.x"})
+        broker.publish("analyze", b"bad", {"notify": "web.player.y"})
+        assert worker.poll()
+        assert broker.qsize("analyze_failed") == 1
+
+        poison.rosters[1].winner = False  # operator fixes the data
+        n = requeue_failed(broker, worker.config, sleep=lambda s: None)
+        assert n == 1
+        assert broker.qsize("analyze_failed") == 0
+        assert worker.poll()
+        assert worker.matches_rated == 2
+        assert poison.trueskill_quality is not None  # rated this time
+        # the redriven message kept its headers (notify fan-out fired)
+        assert any(rk == "web.player.y" for _, rk, _ in broker.topics)
+
     def test_unattributable_error_still_fails_whole_batch(self, rig):
         broker, store, worker = rig
         store.add_match(mk_match("m0", created_at=0))
