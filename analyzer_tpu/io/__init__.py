@@ -24,8 +24,10 @@ from analyzer_tpu.io.csv_codec import (
     save_stream_npz,
 )
 from analyzer_tpu.io.checkpoint import load_checkpoint, save_checkpoint
+from analyzer_tpu.io.dbgen import write_history_db
 
 __all__ = [
+    "write_history_db",
     "synthetic_stream",
     "synthetic_players",
     "synthetic_telemetry",

@@ -340,3 +340,24 @@ class TestPeriodicCheckpoint:
         np.testing.assert_array_equal(
             np.asarray(final.table), np.asarray(state.table)
         )
+
+
+class TestFetchTree:
+    def test_pipelined_fetch_equals_sequential(self):
+        # fetch_tree (utils.host): same values as per-leaf np.asarray,
+        # numpy/scalar leaves pass through, nested structure preserved.
+        import jax.numpy as jnp
+
+        from analyzer_tpu.utils import fetch_tree
+
+        tree = {
+            "a": jnp.arange(12).reshape(3, 4),
+            "b": [jnp.ones(5), np.full(3, 7.0)],
+            "c": 2.5,
+        }
+        out = fetch_tree(tree)
+        np.testing.assert_array_equal(out["a"], np.arange(12).reshape(3, 4))
+        np.testing.assert_array_equal(out["b"][0], np.ones(5))
+        np.testing.assert_array_equal(out["b"][1], np.full(3, 7.0))
+        assert out["c"] == 2.5
+        assert isinstance(out["a"], np.ndarray)
