@@ -190,9 +190,13 @@ def _gather_outputs(
     packed = np.zeros((n, full.shape[1]), full.dtype)
     packed[dest] = full[sel]
     del full  # the concat copy (~1.3 GB at 10M matches) dies here
-    # The field blocks below are VIEWS into `packed` (a contiguous
-    # last-axis split) — the one packed buffer stays alive behind the
-    # returned HistoryOutputs instead of being copied out field by field.
+    # The field blocks below are VIEWS into `packed`: a column slice is
+    # strided but its LAST axis stays contiguous, and splitting that
+    # trailing axis (n, 2T) -> (n, 2, T) is stride-expressible, so
+    # numpy's reshape returns a view, not a copy (pinned by
+    # tests/test_sched.py::test_gather_outputs_blocks_are_views). The one
+    # packed buffer stays alive behind the returned HistoryOutputs
+    # instead of being copied out field by field.
 
     def block(i):
         return packed[:, 3 + i * t2: 3 + (i + 1) * t2].reshape(n, 2, team)

@@ -103,7 +103,10 @@ def scan_query(path: str, sql: str, cols: list[tuple[str, str]]) -> dict:
 def cumcount(keys: np.ndarray, minlength: int) -> np.ndarray:
     """Arrival-order occurrence index within each key group (the numpy
     version needs a stable argsort + segmented arange). ``keys`` must be
-    int64 in ``[0, minlength)`` — the caller guarantees the bound."""
+    int64 in ``[0, minlength)`` — the C loop now enforces the bound per
+    element (rc=-2) instead of trusting the caller, so a future caller
+    that violates it raises here (and sql_store falls back to the numpy
+    path) rather than silently corrupting heap memory."""
     keys = np.ascontiguousarray(keys, np.int64)
     out = np.empty(keys.size, np.int64)
     if keys.size == 0:
@@ -112,6 +115,10 @@ def cumcount(keys: np.ndarray, minlength: int) -> np.ndarray:
         keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), keys.size,
         int(minlength), out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
+    if rc == -2:
+        raise RuntimeError(
+            "native cumcount: key outside [0, minlength) — caller bug"
+        )
     if rc != 0:
         raise RuntimeError("native cumcount: counter allocation failed")
     return out

@@ -253,9 +253,12 @@ inline u64 fnv1a(const char *p, i64 len) {
 
 // Occurrence index of each element within its key group, in arrival
 // order: out[i] = #{j < i : keys[j] == keys[i]}. Keys must lie in
-// [0, minlen). The numpy fallback needs a stable argsort + segmented
-// arange (~1.2 s at 9M rows); this is one pass over a dense counter
-// array. Returns 0, or -1 when the counter allocation fails.
+// [0, minlen) — enforced per element (an out-of-range key returns -2
+// instead of corrupting the heap; the contract lived only in a Python
+// docstring before). The numpy fallback needs a stable argsort +
+// segmented arange (~1.2 s at 9M rows); this is one pass over a dense
+// counter array. Returns 0, -1 when the counter allocation fails, or
+// -2 on a key outside [0, minlen).
 extern "C" int32_t sq_cumcount(const i64 *keys, i64 n, i64 minlen,
                                i64 *out) {
   std::vector<i64> cnt;
@@ -265,6 +268,7 @@ extern "C" int32_t sq_cumcount(const i64 *keys, i64 n, i64 minlen,
     return -1;
   }
   for (i64 i = 0; i < n; ++i) {
+    if ((u64)keys[i] >= (u64)minlen) return -2;
     out[i] = cnt[(size_t)keys[i]]++;
   }
   return 0;

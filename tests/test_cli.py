@@ -62,8 +62,9 @@ class TestCli:
 
     def test_elo_and_train_from_db(self, tmp_path, capsys):
         # The model heads accept the DB lane too: Elo and the logistic
-        # head run on a columnar-ingested history (train seeds features
-        # from the stored rating priors).
+        # head run on a columnar-ingested history (the DB lane COLD-STARTS
+        # features — stored ratings are deliberately ignored so the
+        # chronological holdout stays leak-free; see cli.cmd_train).
         from tests.test_sql_store import seed_db
 
         path = str(tmp_path / "heads.db")

@@ -86,6 +86,17 @@ class TestCumcountProperties:
         want[order] = np.arange(sk.size) - start
         assert np.array_equal(got, want)
 
+    def test_out_of_range_key_raises(self):
+        # The C loop enforces the [0, minlength) contract per element
+        # (rc=-2) instead of silently corrupting heap memory — the
+        # round-3 advisor finding. Both directions must raise.
+        import pytest
+
+        with pytest.raises(RuntimeError, match="outside"):
+            native.cumcount(np.array([0, 5], np.int64), 5)
+        with pytest.raises(RuntimeError, match="outside"):
+            native.cumcount(np.array([-1], np.int64), 5)
+
 
 class TestScanProperties:
     @settings(max_examples=25, deadline=None)

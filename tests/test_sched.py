@@ -603,3 +603,29 @@ class TestRunnerOracle:
         np.testing.assert_allclose(
             np.asarray(final_a.mu)[:p], np.asarray(final_b.mu)[:p], rtol=1e-6, equal_nan=True
         )
+
+
+def test_gather_outputs_blocks_are_views():
+    # Pins the memory claim in runner._gather_outputs: every HistoryOutputs
+    # field is a VIEW into the one packed buffer (a column slice keeps its
+    # trailing axis contiguous, and splitting that axis is
+    # stride-expressible, so reshape returns a view). Regression guard for
+    # the round-3 advisor exchange — if numpy ever copies here, the memory
+    # story in the comment becomes wrong and this fails.
+    stream, state = small_stream(n_matches=40, n_players=20)
+    sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=8)
+    _, outs = rate_history(state, sched, CFG, collect=True)
+
+    def root(a):
+        while a.base is not None:
+            a = a.base
+        return a
+
+    # Disjoint column views share no BYTES (np.shares_memory would be
+    # False between them) — the claim is that they are views of the SAME
+    # underlying packed allocation, i.e. every field's base chain ends at
+    # one root buffer rather than at a per-field copy.
+    want = root(outs.quality)  # packed[:, 0] — certainly a view
+    assert want.size >= outs.quality.size * 3  # the root IS the packed buffer
+    for name in ("shared_mu", "shared_sigma", "delta", "mode_mu", "mode_sigma"):
+        assert root(getattr(outs, name)) is want, name
