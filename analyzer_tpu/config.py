@@ -85,6 +85,12 @@ class ServiceConfig:
     telesuck_queue: str = "telesuck"
     do_sew_match: bool = False
     sew_queue: str = "sew"
+    # Not reference vars: the pipelined consume loop (service/pipeline.py).
+    # Default False for direct construction (tests get the sequential,
+    # reference-shaped loop); from_env defaults ON — production workers
+    # want the overlap, and PIPELINE=false restores the sequential loop.
+    pipeline: bool = False
+    pipeline_lag: int = 6
 
     @classmethod
     def from_env(cls, env: Mapping[str, str] | None = None) -> "ServiceConfig":
@@ -102,6 +108,8 @@ class ServiceConfig:
             telesuck_queue=e.get("TELESUCK_QUEUE") or "telesuck",
             do_sew_match=e.get("DOSEWMATCH") == "true",
             sew_queue=e.get("SEW_QUEUE") or "sew",
+            pipeline=(e.get("PIPELINE") or "true") == "true",
+            pipeline_lag=int(e.get("PIPELINE_LAG") or 6),
         )
 
     @property

@@ -1,0 +1,425 @@
+"""Pipelined service loop: overlap host work with the device round trip.
+
+The sequential worker (``Worker.process``) is the reference's shape —
+load, encode, rate, write back, commit, one batch at a time
+(``/root/reference/worker.py:95-199``). On this rig the device round trip
+(the packed-outputs D2H fetch crossing the tunnel, ~100-150 ms) dominates
+each 500-match batch, and the sequential loop spends it idle. This engine
+keeps the per-batch failure policy while hiding the fetch behind the NEXT
+batch's host work:
+
+  * **Device-side prior chaining** breaks the fetch -> encode dependency.
+    Batch N+1's priors normally come from the store, which doesn't have
+    batch N's posteriors until N's outputs are fetched and committed.
+    Instead, N+1 is encoded from a (stale-by-<=lag) store snapshot and its
+    player table is PATCHED ON DEVICE from the final device-resident
+    tables of the in-flight batches: one jitted row scatter per in-flight
+    batch (``_chain_patch``), keyed by player-id overlap computed on the
+    host from the encoders' ``row_of`` maps. The posterior never visits
+    the host on the critical path.
+  * **A small fetch pool** issues each batch's packed-outputs fetch right
+    at dispatch, so consecutive fetches' tunnel RTTs overlap instead of
+    serializing in the writer.
+  * **An ordered writer thread** applies ``write_back`` + ``commit``
+    strictly in batch order (players are shared across batches — the
+    last-write-wins order must match the sequential loop) on its OWN
+    store handle (``SqlStore.clone``; sqlite connections are bound to
+    their creating thread).
+  * **Main-thread harvest**: acks, notify/crunch/sew/telesuck fan-out,
+    dead-lettering and failure fallback all stay on the consumer thread —
+    the broker (pika especially) is not thread-safe.
+
+Correctness argument (the induction ``tests/test_pipeline.py`` pins):
+
+  With commit lag ``L``, a batch's store load happens only after batch
+  ``N-L`` committed (the submit gate), so its snapshot is missing at most
+  the writes of batches ``N-L+1..N`` — exactly the ones patched, in
+  order, from their device-resident final tables. Patching from an
+  already-committed batch is idempotent (the snapshot and the device
+  table agree), so no per-batch commit bookkeeping is needed on the
+  chaining side. Final ratings are bit-identical to the sequential loop.
+
+Failure policy (``worker.py:110-120`` semantics preserved):
+
+  The writer processes batches in order; the FIRST failure poisons the
+  stream. The failed batch surfaces to the worker's normal failure
+  handler (dead-letter + nack after rollback); every later in-flight
+  batch is ABORTED — its device results are discarded (they chained off
+  uncommitted state the sequential loop would never have seen) and its
+  messages are reprocessed from scratch through the sequential path
+  against the rolled-back store. A failed batch therefore never acks
+  later batches, and an aborted batch never commits tainted state.
+
+Semantic caveats vs the strictly sequential loop (documented, tested
+where cheap):
+
+  * The reference's out-of-table skill-tier KeyError consults "has a
+    shared rating yet?" (``rater.py:57-60``); under chaining that check
+    runs against the stale snapshot. A PoisonError raised during a
+    pipelined encode is therefore retried ONCE from fully-drained
+    committed state before the worker's poison isolation path engages.
+  * Static seed features (rank_points/skill_tier) are read at load time;
+    a concurrent external writer changing them can land one batch later
+    than in the sequential loop — the reference has the same race across
+    its competing consumers (SURVEY.md section 3.2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import deque
+from concurrent.futures import Future
+from functools import partial
+
+import jax
+import numpy as np
+
+from analyzer_tpu.core.state import MU_LO, SIGMA_HI
+from analyzer_tpu.logging_utils import get_logger
+from analyzer_tpu.sched.runner import _gather_outputs, _scan_chunk
+from analyzer_tpu.utils.host import fetch_tree
+
+logger = get_logger(__name__)
+
+
+class PipelineFallback(Exception):
+    """Submit could not take the batch; the worker must harvest (to apply
+    the pending failure policy) and run the batch sequentially."""
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _chain_patch(dst_table, src_table, dst_idx):
+    """Copies the 14 rating columns of every ``src_table`` row to
+    ``dst_table[dst_idx[r]]``. Rows with no destination point at the dst
+    padding row (writes park there, like every masked scatter in the
+    framework). Seed columns are NOT copied — seeds derive from static
+    features the worker never writes, and the destination batch's are
+    fresher."""
+    vals = src_table[:, MU_LO:SIGMA_HI]
+    return dst_table.at[dst_idx, MU_LO:SIGMA_HI].set(vals)
+
+
+def chain_dst_index(src_row_of: dict, src_rows: int, dst_row_of: dict,
+                    dst_pad_row: int) -> np.ndarray:
+    """Host half of the patch: src row -> dst row (or dst pad row)."""
+    dst = np.full(src_rows, dst_pad_row, np.int32)
+    for pid, r in src_row_of.items():
+        d = dst_row_of.get(pid)
+        if d is not None:
+            dst[r] = d
+    return dst
+
+
+class _LazyFetch:
+    """Future-shaped handle that materializes the packed outputs on the
+    CALLING (writer) thread. The D2H transfer was issued at dispatch via
+    ``copy_to_host_async`` — ``result()`` mostly just wraps the already-
+    arrived bytes into stream-ordered HistoryOutputs."""
+
+    def __init__(self, ys, flat_idx, n, team):
+        self._args = (ys, flat_idx, n, team)
+
+    def result(self):
+        ys, flat_idx, n, team = self._args
+        return _gather_outputs([fetch_tree(ys)], flat_idx, n, team)
+
+
+class _EmptyBatch:
+    """Stand-in EncodedBatch for a batch whose ids loaded no matches —
+    the reference's query returns no rows and the messages fall straight
+    through to the ack loop (``worker.py:122-129``)."""
+
+    matches: list = []
+
+    def write_back(self, outs) -> None:  # pragma: no cover — trivial
+        pass
+
+
+@dataclasses.dataclass
+class _Job:
+    seq: int
+    msgs: list
+    enc: object  # EncodedBatch (or _EmptyBatch)
+    fetch: Future  # -> HistoryOutputs (or None for _EmptyBatch)
+    status: str = "inflight"  # -> ok | failed | aborted
+    error: BaseException | None = None
+
+
+class _Writer(threading.Thread):
+    """Applies write_back + commit strictly in submit order on its own
+    store handle. The first failure poisons the stream: every later job
+    is aborted untouched (the worker reprocesses its messages)."""
+
+    def __init__(self, store_factory) -> None:
+        super().__init__(daemon=True, name="analyzer-pipeline-writer")
+        # The store handle is created ON this thread (run()): sqlite
+        # connections may only be used by their creating thread.
+        self._store_factory = store_factory
+        self.store = None
+        self.jobs: deque[_Job] = deque()
+        self.done: deque[_Job] = deque()
+        self.cv = threading.Condition()
+        self.left_seq = -1  # highest seq that has LEFT the writer
+        self.poisoned = False
+        self._active = False
+        self._stop = False
+
+    def submit(self, job: _Job) -> None:
+        with self.cv:
+            self.jobs.append(job)
+            self.cv.notify_all()
+
+    def stop(self) -> None:
+        with self.cv:
+            self._stop = True
+            self.cv.notify_all()
+
+    def wait_left(self, seq: int) -> bool:
+        """Blocks until every job with ``seq' <= seq`` has left the
+        writer (ok OR aborted). Returns False when the stream is
+        poisoned — the caller must go through harvest."""
+        with self.cv:
+            while self.left_seq < seq and not self.poisoned:
+                self.cv.wait()
+            return not self.poisoned
+
+    def wait_idle(self) -> None:
+        """Blocks until the queue is empty and nothing is mid-flight.
+        Used by harvest after a failure: every queued job drains to
+        ``done`` as aborted before the reset. A dead writer (store
+        factory failure) can't drain — its stranded jobs are aborted
+        here so the worker reprocesses their messages."""
+        with self.cv:
+            while self.jobs or self._active:
+                if not self.is_alive():
+                    while self.jobs:
+                        job = self.jobs.popleft()
+                        job.status = "aborted"
+                        self.done.append(job)
+                    self._active = False
+                    break
+                self.cv.wait(0.1)
+
+    def run(self) -> None:
+        try:
+            self.store = self._store_factory()
+        except Exception:
+            # A dead writer must not hang every gate wait: poison the
+            # stream so submit falls back to the sequential loop.
+            logger.exception("pipeline writer store unavailable")
+            with self.cv:
+                self.poisoned = True
+                self.cv.notify_all()
+            return
+        while True:
+            with self.cv:
+                while not self.jobs and not self._stop:
+                    self.cv.wait()
+                if not self.jobs:
+                    return  # stop requested, queue drained
+                job = self.jobs.popleft()
+                self._active = True
+                poisoned = self.poisoned
+            if poisoned:
+                job.status = "aborted"
+            else:
+                try:
+                    outs = job.fetch.result()
+                    if outs is not None:
+                        job.enc.write_back(outs)
+                    commit = getattr(self.store, "commit", None)
+                    if commit is not None and job.enc.matches:
+                        commit(job.enc.matches)
+                    job.status = "ok"
+                except BaseException as err:  # noqa: BLE001 — policy boundary
+                    job.status = "failed"
+                    job.error = err
+                    rollback = getattr(self.store, "rollback", None)
+                    if rollback is not None:
+                        try:
+                            rollback()
+                        except Exception:  # pragma: no cover — best effort
+                            logger.exception("writer rollback failed")
+            with self.cv:
+                self.done.append(job)
+                self._active = False
+                if job.status == "failed":
+                    self.poisoned = True
+                else:
+                    self.left_seq = job.seq
+                self.cv.notify_all()
+
+
+class PipelineEngine:
+    """Drives the pipelined batch flow for a :class:`Worker`.
+
+    The worker owns the broker and the failure policy; the engine owns
+    dispatch ordering, the chaining state, the fetch pool and the writer.
+    ``lag`` = max batches in flight past the last known commit (2 keeps
+    two fetch RTTs overlapped; 1 degrades toward the sequential loop).
+    """
+
+    def __init__(self, worker, lag: int = 2):
+        self.worker = worker
+        self.lag = max(1, int(lag))
+        store = worker.store
+        clone = getattr(store, "clone", None)
+        if clone is not None:
+            clone().close()  # eager validation on the consumer thread:
+            # an uncloneable store (in-memory sqlite) raises HERE, where
+            # the worker can fall back to the sequential loop — not
+            # asynchronously on the writer.
+            factory = clone
+        else:
+            factory = lambda: store  # noqa: E731 — shared-object stores
+        self.writer = _Writer(factory)
+        self.writer.start()
+        # Chaining sources: (row_of, n_rows, final_table) of the last
+        # `lag` dispatched batches, newest last.
+        self.chain: deque = deque(maxlen=self.lag)
+        self.seq = 0
+
+    # -- submission -------------------------------------------------------
+    def submit(self, msgs: list) -> None:
+        """Dispatches one message batch into the pipeline.
+
+        Raises :class:`PipelineFallback` when the pipeline is poisoned
+        (harvest must apply the failure policy first), or lets a
+        PoisonError propagate after the drained retry (the worker's
+        isolation path takes over)."""
+        from analyzer_tpu.service.encode import EncodedBatch, PoisonError
+
+        w = self.worker
+        # Gate: the store snapshot below must include every commit up to
+        # seq - lag, so at most `lag` uncommitted batches need chaining.
+        if not self.writer.wait_left(self.seq - self.lag):
+            raise PipelineFallback("pipeline poisoned; harvest first")
+        ids = [m.body.decode() for m in msgs]
+        matches = self._load_fresh(ids)
+        logger.info("processing batch of %s matches (pipelined)", len(matches))
+        if not matches:
+            self._enqueue(msgs, _EmptyBatch(), _done_future(None))
+            return
+        try:
+            enc = EncodedBatch(matches, w.rating_config, bucket_rows=True)
+        except PoisonError:
+            # The stale snapshot can mis-decide the reference's
+            # seed-consulted KeyError gate (module docstring); retry once
+            # from fully committed state before isolating.
+            self.drain()
+            matches = self._load_fresh(ids)
+            enc = EncodedBatch(matches, w.rating_config, bucket_rows=True)
+        sched = w._bucketed_schedule(enc.stream, enc.state.pad_row)
+
+        state = enc.state
+        for row_of, rows, table in self.chain:
+            dst = chain_dst_index(row_of, rows, enc.row_of, enc.state.pad_row)
+            state = dataclasses.replace(
+                state, table=_chain_patch(state.table, table, dst)
+            )
+        arrays = sched.device_arrays(0, sched.n_steps)
+        final, ys = _scan_chunk(state, arrays, w.rating_config, True,
+                                sched.pad_row)
+        flat_idx = sched.match_idx.reshape(-1)
+        n, team = sched.n_matches, sched.team_size
+        try:
+            # Start the D2H stream NOW (enqueued behind the scan): by the
+            # time the writer needs the outputs, the transfer has been in
+            # flight for ~lag batch periods instead of starting cold —
+            # measured on the tunneled v5e, this is what actually
+            # pipelines the per-batch RTT. The writer then materializes
+            # the already-streamed bytes; a fetch THREAD POOL measured
+            # strictly worse here (3 threads x np.asarray contending on
+            # the tunnel + GIL ping-pong with encode/write_back).
+            ys.copy_to_host_async()
+        except AttributeError:  # pragma: no cover — older jax arrays
+            pass
+        fetch = _LazyFetch(ys, flat_idx, n, team)
+        self.chain.append((enc.row_of, int(final.table.shape[0]), final.table))
+        self._enqueue(msgs, enc, fetch)
+
+    def _load_fresh(self, ids: list) -> list:
+        """``load_batch`` + read-snapshot release. The consumer connection
+        never commits in pipelined mode (the writer's clone does), so on
+        MySQL a REPEATABLE READ snapshot pinned at the first SELECT would
+        make every later load stale beyond the chain's ``lag`` window —
+        the gate invariant requires each load to see commits up to
+        ``seq - lag``. Rolling back after the objects are materialized
+        forces the NEXT load to open a fresh snapshot (the same move
+        ``asset_urls`` / ``_dead_letter`` make; no-op on sqlite)."""
+        matches = self.worker.store.load_batch(ids)
+        rollback = getattr(self.worker.store, "rollback", None)
+        if rollback is not None:
+            rollback()
+        return matches
+
+    def _enqueue(self, msgs: list, enc, fetch: Future) -> None:
+        self.writer.submit(_Job(seq=self.seq, msgs=msgs, enc=enc, fetch=fetch))
+        self.seq += 1
+
+    # -- completion -------------------------------------------------------
+    def harvest(self) -> None:
+        """Applies completed jobs in order ON THE CONSUMER THREAD: acks +
+        fan-out for successes, the worker's failure policy for the first
+        failure, sequential reprocessing for aborted followers."""
+        w = self.worker
+        if not self.writer.is_alive() and self.writer.poisoned:
+            self.writer.wait_idle()  # recover jobs stranded by a dead writer
+        jobs = self._pop_done()
+        if any(j.status == "failed" for j in jobs):
+            # Every not-yet-processed job drains to `done` as aborted
+            # before the reset — the poison flag must outlive them.
+            self.writer.wait_idle()
+            jobs += self._pop_done()
+        reprocess: list[_Job] = []
+        for job in jobs:
+            if job.status == "ok":
+                w.matches_rated += len(job.enc.matches)
+                w._ack_batch(job.msgs)
+            elif job.status == "failed":
+                logger.error("pipelined batch failed: %s", job.error)
+                w.batches_failed += 1
+                w._dead_letter(job.msgs)
+                # Chain state is tainted; the writer queue is empty
+                # (wait_idle above), so the stream can restart cleanly.
+                self.chain.clear()
+                with self.writer.cv:
+                    self.writer.poisoned = False
+                    self.writer.left_seq = self.seq - 1
+                    self.writer.cv.notify_all()
+            else:  # aborted — chained off the failed batch; redo fresh
+                reprocess.append(job)
+        for job in sorted(reprocess, key=lambda j: j.seq):
+            w._process_batch_sequential(job.msgs)
+
+    def _pop_done(self) -> list:
+        with self.writer.cv:
+            jobs = sorted(self.writer.done, key=lambda j: j.seq)
+            self.writer.done.clear()
+        return jobs
+
+    def drain(self) -> None:
+        """Blocks until every submitted batch has left the writer, then
+        harvests. Afterwards the store reflects every submitted batch (or
+        its failure policy has been applied)."""
+        self.writer.wait_left(self.seq - 1)  # False on poison: fall through
+        self.writer.wait_idle()
+        self.harvest()
+
+    @property
+    def idle(self) -> bool:
+        with self.writer.cv:
+            return (not self.writer.jobs and not self.writer.done
+                    and not self.writer._active)
+
+    def close(self) -> None:
+        self.drain()
+        self.writer.stop()
+        self.writer.join(timeout=10)
+
+
+def _done_future(value) -> Future:
+    f: Future = Future()
+    f.set_result(value)
+    return f

@@ -88,6 +88,11 @@ def _connect(uri: str):
         elif path.startswith("//"):
             path = path[1:]
         conn = sqlite3.connect(path or ":memory:")
+        # The pipelined worker reads on the consumer thread while its
+        # writer thread commits on a clone()d connection; without a busy
+        # timeout a reader colliding with a commit raises SQLITE_BUSY
+        # instead of briefly waiting.
+        conn.execute("PRAGMA busy_timeout = 30000")
         return conn, "qmark", "sqlite", (path or None)
     if scheme == "mysql":
         last: Exception | None = None
@@ -158,6 +163,23 @@ class SqlStore:
             ]
             for table in ("player", "participant_items")
         }
+
+    def clone(self) -> "SqlStore":
+        """A second store handle on its OWN connection — the pipelined
+        worker's writer thread commits through a clone while the consumer
+        thread keeps loading (sqlite connections are bound to the thread
+        that may use them; MySQL connections are not thread-safe either).
+        In-memory sqlite cannot be cloned (a new connection sees a
+        different empty database) nor shared across threads
+        (``check_same_thread``) — raises RuntimeError so the worker falls
+        back to the sequential loop instead of failing batches."""
+        if self._dialect == "sqlite" and self._sqlite_path is None:
+            raise RuntimeError(
+                "in-memory sqlite store cannot be used by the pipelined "
+                "worker (no second connection can see it); use a "
+                "file-backed database or PIPELINE=false"
+            )
+        return SqlStore(self.uri, chunk_size=self.chunk_size)
 
     # -- reflection -------------------------------------------------------
     def _reflect(self) -> dict[str, list[str]]:

@@ -104,6 +104,10 @@ def main() -> int:
     ap.add_argument("--players", type=int, default=None)
     ap.add_argument("--store", choices=("mem", "sqlite"), default="mem")
     ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument(
+        "--no-pipeline", action="store_true",
+        help="sequential reference-shaped loop (the round-3 baseline)",
+    )
     args = ap.parse_args()
     n_players = args.players or max(args.matches // 3, 12)
 
@@ -120,7 +124,9 @@ def main() -> int:
 
     broker = InMemoryBroker()
     cfg = ServiceConfig(batch_size=BATCH, idle_timeout=0.0)
-    worker = Worker(broker, store, cfg, RatingConfig())
+    worker = Worker(
+        broker, store, cfg, RatingConfig(), pipeline=not args.no_pipeline
+    )
     worker.warmup()
 
     for mid in ids:
@@ -131,6 +137,7 @@ def main() -> int:
     batches = 0
     while worker.poll():
         batches += 1
+    worker.drain()  # pipelined mode: include the in-flight tail's commits
     dt = time.perf_counter() - t0
     failed = broker.qsize(cfg.failed_queue)
     print(f"service loop: {len(ids)} matches in {dt:.2f} s = "
