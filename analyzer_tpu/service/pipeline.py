@@ -163,7 +163,7 @@ class _Writer(threading.Thread):
         self.left_seq = -1  # highest seq that has LEFT the writer
         self.poisoned = False
         self._active = False
-        self._stop = False
+        self._stop_requested = False
 
     def submit(self, job: _Job) -> None:
         with self.cv:
@@ -172,7 +172,7 @@ class _Writer(threading.Thread):
 
     def stop(self) -> None:
         with self.cv:
-            self._stop = True
+            self._stop_requested = True
             self.cv.notify_all()
 
     def wait_left(self, seq: int) -> bool:
@@ -214,7 +214,7 @@ class _Writer(threading.Thread):
             return
         while True:
             with self.cv:
-                while not self.jobs and not self._stop:
+                while not self.jobs and not self._stop_requested:
                     self.cv.wait()
                 if not self.jobs:
                     return  # stop requested, queue drained
@@ -366,6 +366,16 @@ class PipelineEngine:
         w = self.worker
         if not self.writer.is_alive() and self.writer.poisoned:
             self.writer.wait_idle()  # recover jobs stranded by a dead writer
+            # A dead writer never produces a `failed` job to reset the
+            # poison, so without this every later flush would pay
+            # PipelineFallback + sequential reprocessing forever.
+            self.chain.clear()
+            w.pipeline_enabled = False
+            w._engine = None
+            logger.warning(
+                "pipeline writer died; worker degraded to the sequential "
+                "loop"
+            )
         jobs = self._pop_done()
         if any(j.status == "failed" for j in jobs):
             # Every not-yet-processed job drains to `done` as aborted
@@ -402,10 +412,17 @@ class PipelineEngine:
     def drain(self) -> None:
         """Blocks until every submitted batch has left the writer, then
         harvests. Afterwards the store reflects every submitted batch (or
-        its failure policy has been applied)."""
+        its failure policy has been applied).
+
+        The chain MUST clear here: callers commit through the store after
+        a drain (sequential fallback, poison isolation), and a commit the
+        chain never saw breaks patch idempotence — a later submit would
+        overwrite those fresher rows with the chain's older device
+        tables. Post-drain, a fresh load sees everything anyway."""
         self.writer.wait_left(self.seq - 1)  # False on poison: fall through
         self.writer.wait_idle()
         self.harvest()
+        self.chain.clear()
 
     @property
     def idle(self) -> bool:

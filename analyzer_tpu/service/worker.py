@@ -309,6 +309,14 @@ class Worker:
         if self._engine is not None:
             self._engine.drain()
 
+    def close(self) -> None:
+        """Releases the pipelined engine (writer thread + its cloned
+        store connection) after draining. A Worker is reusable after
+        close — the next pipelined flush builds a fresh engine."""
+        if self._engine is not None:
+            self._engine.close()
+            self._engine = None
+
     def _try_process_pipelined(self, batch) -> None:
         from analyzer_tpu.service.pipeline import PipelineFallback
 
@@ -517,14 +525,17 @@ def main(max_flushes: int | None = None) -> Worker:
         store = InMemoryStore()
     worker = Worker(broker, store, config)
     worker.warmup()  # compile before consuming: no first-batch stall
-    worker.run(
-        max_flushes=max_flushes,
-        max_wall_s=None if max_flushes is None else 60.0,
-        # Production loop: SIGTERM/SIGINT finish the in-flight batch
-        # (commit + acks) before exiting; bounded test runs skip the
-        # handler install (may run off the main thread).
-        install_signal_handlers=max_flushes is None,
-    )
+    try:
+        worker.run(
+            max_flushes=max_flushes,
+            max_wall_s=None if max_flushes is None else 60.0,
+            # Production loop: SIGTERM/SIGINT finish the in-flight batch
+            # (commit + acks) before exiting; bounded test runs skip the
+            # handler install (may run off the main thread).
+            install_signal_handlers=max_flushes is None,
+        )
+    finally:
+        worker.close()  # writer thread + cloned store connection
     return worker
 
 

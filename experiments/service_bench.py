@@ -139,6 +139,7 @@ def main() -> int:
         batches += 1
     worker.drain()  # pipelined mode: include the in-flight tail's commits
     dt = time.perf_counter() - t0
+    worker.close()
     failed = broker.qsize(cfg.failed_queue)
     print(f"service loop: {len(ids)} matches in {dt:.2f} s = "
           f"{len(ids) / dt / 1e3:.1f}k matches/s "

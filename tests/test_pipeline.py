@@ -60,6 +60,7 @@ def consume_all(worker, broker, cfg, ids):
     while worker.poll():
         pass
     worker.drain()
+    worker.close()  # release the writer thread per test
 
 
 def player_snapshot(store):
@@ -211,33 +212,48 @@ class TestFailureDuringOverlap:
 
     def test_poison_match_isolated_under_pipeline(self, tmp_path):
         """A structurally corrupt match inside an overlapped batch still
-        costs exactly one message (the poison-isolation contract), with
-        the rest of its batch rated."""
-        path = str(tmp_path / "poison.db")
+        costs exactly one message (the poison-isolation contract), the
+        rest of its batch is rated, and — the round-4 review's
+        regression — batches AFTER the sequential fallback must not be
+        patched from a stale chain: the final database must equal the
+        sequential loop's value for value (every player is shared across
+        every batch here, so one stale patch would show)."""
         n = 12
-        seed_db(path, n_matches=n)
-        conn = sqlite3.connect(path)
-        # Corrupt m5: drop its participant_items rows (write-back target)
-        conn.execute(
-            "DELETE FROM participant_items WHERE participant_api_id LIKE"
-            " 'm5-%'"
-        )
-        conn.commit()
-        conn.close()
-        broker = InMemoryBroker()
-        store = SqlStore(f"sqlite:///{path}")
-        cfg = ServiceConfig(batch_size=4, idle_timeout=0.0)
-        w = Worker(broker, store, cfg, RatingConfig(), pipeline=True)
-        consume_all(w, broker, cfg, [f"m{i}" for i in range(n)])
-        failed = [
-            m.body.decode() for m in broker.queues[cfg.failed_queue]
-        ]
-        assert failed == ["m5"]
-        assert not broker._unacked
-        conn = sqlite3.connect(path)
-        rated = conn.execute(
-            "SELECT COUNT(*) FROM participant WHERE trueskill_mu IS NOT"
-            " NULL"
-        ).fetchone()[0]
-        conn.close()
-        assert rated == (n - 1) * 6
+
+        def run(pipeline):
+            path = str(tmp_path / f"poison_{pipeline}.db")
+            seed_db(path, n_matches=n)
+            conn = sqlite3.connect(path)
+            # Corrupt m5: drop its participant_items (write-back target)
+            conn.execute(
+                "DELETE FROM participant_items WHERE participant_api_id"
+                " LIKE 'm5-%'"
+            )
+            conn.commit()
+            conn.close()
+            broker = InMemoryBroker()
+            store = SqlStore(f"sqlite:///{path}")
+            cfg = ServiceConfig(batch_size=4, idle_timeout=0.0)
+            w = Worker(broker, store, cfg, RatingConfig(), pipeline=pipeline)
+            consume_all(w, broker, cfg, [f"m{i}" for i in range(n)])
+            failed = [
+                m.body.decode() for m in broker.queues[cfg.failed_queue]
+            ]
+            assert failed == ["m5"]
+            assert not broker._unacked
+            conn = sqlite3.connect(path)
+            rated = conn.execute(
+                "SELECT COUNT(*) FROM participant WHERE trueskill_mu IS"
+                " NOT NULL"
+            ).fetchone()[0]
+            players = conn.execute(
+                "SELECT * FROM player ORDER BY api_id"
+            ).fetchall()
+            parts = conn.execute(
+                "SELECT * FROM participant ORDER BY api_id"
+            ).fetchall()
+            conn.close()
+            assert rated == (n - 1) * 6
+            return players, parts
+
+        assert run(True) == run(False)
