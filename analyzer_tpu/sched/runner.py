@@ -141,6 +141,7 @@ def rate_history(
         if starts
         else None
     )
+    pending = None  # chunk k-1's outputs: fetched AFTER dispatching k
     for i, start in enumerate(starts):
         state, ys = _scan_chunk(
             state, arrays, cfg, collect, sched.pad_row
@@ -151,11 +152,24 @@ def rate_history(
                 starts[i + 1], min(starts[i + 1] + steps_per_chunk, n_steps)
             )
         if collect:
-            outs.append(fetch_tree(ys))
+            # One-chunk-deep fetch pipelining: start k's D2H stream now
+            # and materialize k-1's (whose transfer has been in flight a
+            # whole chunk) — without this every chunk pays a cold ~100 ms
+            # tunnel round trip SERIALLY, which the service path's fixed
+            # 8-step chunks turned into ceil(steps/8) RTTs per deep batch.
+            try:
+                ys.copy_to_host_async()
+            except AttributeError:  # pragma: no cover — older jax arrays
+                pass
+            if pending is not None:
+                outs.append(fetch_tree(pending))
+            pending = ys
         if on_chunk is not None:
             on_chunk(state, min(start + steps_per_chunk, n_steps))
     if not collect:
         return state, None
+    if pending is not None:
+        outs.append(fetch_tree(pending))
 
     flat_idx = sched.match_idx[start_step:n_steps].reshape(-1)
     return state, _gather_outputs(

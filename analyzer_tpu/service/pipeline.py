@@ -88,6 +88,17 @@ class PipelineFallback(Exception):
     the pending failure policy) and run the batch sequentially."""
 
 
+@partial(jax.jit, static_argnames=("rows",))
+def _canonical_rows(table, rows: int):
+    """Zero-pads a final batch table to the worker's MAX row bucket.
+    Chain sources are canonicalized ONCE per batch so ``_chain_patch``
+    compiles per destination rung only — without this, mixed-size
+    batch successions (a full batch after an idle flush) would compile
+    every (dst_rows, src_rows) PAIR in the ladder (64 shapes at
+    BATCHSIZE=500 instead of 2x8, unwarmable in practice)."""
+    return jax.numpy.pad(table, ((0, rows - table.shape[0]), (0, 0)))
+
+
 @partial(jax.jit, donate_argnums=(0,))
 def _chain_patch(dst_table, src_table, dst_idx):
     """Copies the 14 rating columns of every ``src_table`` row to
@@ -117,12 +128,14 @@ class _LazyFetch:
     ``copy_to_host_async`` — ``result()`` mostly just wraps the already-
     arrived bytes into stream-ordered HistoryOutputs."""
 
-    def __init__(self, ys, flat_idx, n, team):
-        self._args = (ys, flat_idx, n, team)
+    def __init__(self, ys_chunks, flat_idx, n, team):
+        self._args = (ys_chunks, flat_idx, n, team)
 
     def result(self):
-        ys, flat_idx, n, team = self._args
-        return _gather_outputs([fetch_tree(ys)], flat_idx, n, team)
+        ys_chunks, flat_idx, n, team = self._args
+        return _gather_outputs(
+            [fetch_tree(ys) for ys in ys_chunks], flat_idx, n, team
+        )
 
 
 class _EmptyBatch:
@@ -276,9 +289,13 @@ class PipelineEngine:
         self.writer = _Writer(factory)
         self.writer.start()
         # Chaining sources: (row_of, n_rows, final_table) of the last
-        # `lag` dispatched batches, newest last.
+        # `lag` dispatched batches, newest last; tables canonicalized to
+        # the max row bucket (see _canonical_rows).
         self.chain: deque = deque(maxlen=self.lag)
         self.seq = 0
+        # One owner for the compile-shape knobs: the worker (warmup and
+        # schedule bucketing read the same attributes).
+        self._canon_rows = worker._canon_rows
 
     # -- submission -------------------------------------------------------
     def submit(self, msgs: list) -> None:
@@ -318,25 +335,42 @@ class PipelineEngine:
             state = dataclasses.replace(
                 state, table=_chain_patch(state.table, table, dst)
             )
-        arrays = sched.device_arrays(0, sched.n_steps)
-        final, ys = _scan_chunk(state, arrays, w.rating_config, True,
-                                sched.pad_row)
+        # Chunked dispatch at the fixed service step shape (the schedule
+        # is padded to a SERVICE_STEP_CHUNK multiple): any chain depth
+        # reuses the one warmed compile per row bucket.
+        chunk = w._step_chunk
+        ys_chunks = []
+        for s0 in range(0, sched.n_steps, chunk):
+            arrays = sched.device_arrays(s0, s0 + chunk)
+            state, ys = _scan_chunk(state, arrays, w.rating_config, True,
+                                    sched.pad_row)
+            try:
+                # Start the D2H stream NOW (enqueued behind the scan): by
+                # the time the writer needs the outputs, the transfer has
+                # been in flight for ~lag batch periods instead of
+                # starting cold — measured on the tunneled v5e, this is
+                # what actually pipelines the per-batch RTT. The writer
+                # then materializes the already-streamed bytes; a fetch
+                # THREAD POOL measured strictly worse here (3 threads x
+                # np.asarray contending on the tunnel + GIL ping-pong
+                # with encode/write_back).
+                ys.copy_to_host_async()
+            except AttributeError:  # pragma: no cover — older jax arrays
+                pass
+            ys_chunks.append(ys)
+        final = state
         flat_idx = sched.match_idx.reshape(-1)
-        n, team = sched.n_matches, sched.team_size
-        try:
-            # Start the D2H stream NOW (enqueued behind the scan): by the
-            # time the writer needs the outputs, the transfer has been in
-            # flight for ~lag batch periods instead of starting cold —
-            # measured on the tunneled v5e, this is what actually
-            # pipelines the per-batch RTT. The writer then materializes
-            # the already-streamed bytes; a fetch THREAD POOL measured
-            # strictly worse here (3 threads x np.asarray contending on
-            # the tunnel + GIL ping-pong with encode/write_back).
-            ys.copy_to_host_async()
-        except AttributeError:  # pragma: no cover — older jax arrays
-            pass
-        fetch = _LazyFetch(ys, flat_idx, n, team)
-        self.chain.append((enc.row_of, int(final.table.shape[0]), final.table))
+        fetch = _LazyFetch(
+            ys_chunks, flat_idx, sched.n_matches, sched.team_size
+        )
+        rows = int(final.table.shape[0])
+        if rows <= self._canon_rows:
+            self.chain.append(
+                (enc.row_of, self._canon_rows,
+                 _canonical_rows(final.table, self._canon_rows))
+            )
+        else:  # defensive: an over-bucket batch chains raw (lazy compile)
+            self.chain.append((enc.row_of, rows, final.table))
         self._enqueue(msgs, enc, fetch)
 
     def _load_fresh(self, ids: list) -> list:

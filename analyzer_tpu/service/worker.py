@@ -37,6 +37,15 @@ from analyzer_tpu.service.encode import EncodedBatch
 
 logger = get_logger(__name__)
 
+# The service scan's step dimension is FIXED: schedules pad to a multiple
+# of this and the scan runs in chunks of exactly this many supersteps.
+# With the step shape constant, the compile ladder collapses from
+# (row-bucket x step-bucket) — 64 combos a warmup could never cover — to
+# the row-bucket ladder alone (8 shapes, all warmed). An adversarially
+# chained 500-message batch (steps ~ 500) just runs more chunks of the
+# one compiled shape instead of compiling a 512-step scan on first sight.
+SERVICE_STEP_CHUNK = 8
+
 
 class Worker:
     def __init__(
@@ -74,6 +83,16 @@ class Worker:
         # of 64), with step counts bucketed to powers of two in process().
         w = -(-self.config.batch_size // 8)  # ~steps-of-8 heuristic width
         self._packed_width = min(128, max(8, -(-w // 8) * 8))
+        # The SINGLE owners of the service compile-shape knobs — schedule
+        # bucketing, warmup, and the pipelined engine all read these, so
+        # overriding one on a worker keeps every consumer in lockstep.
+        self._step_chunk = SERVICE_STEP_CHUNK
+        from analyzer_tpu.core.state import MAX_TEAM_SIZE
+        from analyzer_tpu.service.encode import row_bucket
+
+        self._canon_rows = (
+            row_bucket(self.config.batch_size * 2 * MAX_TEAM_SIZE) + 1
+        )
 
         c = self.config
         # The reference declares queue/failed/crunch/telesuck but NOT sew
@@ -184,36 +203,45 @@ class Worker:
 
     # -- warmup -----------------------------------------------------------
     def warmup(self) -> None:
-        """Pre-compiles the rating scan for the shapes production batches
-        hit, so the FIRST message doesn't pay XLA compilation (seconds —
-        the reference's pure-Python loop had no compile step to hide;
-        here it's real first-request latency). Thanks to the pinned
-        width + power-of-two bucketing, a handful of shapes covers
-        steady state: a full batch of distinct-player 5v5s and 3v3s
-        (the largest row buckets a saturated queue produces) and the
-        tiny idle-flush shape. Deeper-chained batches (higher step
-        buckets) still compile on first sight — rarer and cheaper."""
+        """Pre-compiles the rating scan for EVERY shape production
+        batches can hit, so no message ever pays XLA compilation (the
+        reference's pure-Python loop had no compile step to hide; here
+        it's real first-request latency).
+
+        The shape space is small by construction: the schedule width is
+        pinned, the scan's step dimension is fixed at
+        ``SERVICE_STEP_CHUNK`` (any chain depth = more chunks of the one
+        shape), and the team axis is always ``MAX_TEAM_SIZE`` — so the
+        only free dimension is the player-row bucket, a power-of-two
+        ladder from 64 up to ``row_bucket(batch_size * 2 * 5)`` (8
+        values at the reference's BATCHSIZE=500). The whole ladder is
+        compiled here, including the pipelined engine's chaining scatter
+        on each ladder rung's square pair (consecutive batches share a
+        bucket in steady state; a mixed-size pair — a full batch right
+        after an idle flush — is a rare, sub-second one-off compile).
+        ``tests/test_service.py::TestCompileChurn`` asserts an
+        adversarially chained batch after warmup compiles NOTHING."""
         import numpy as np
 
-        from analyzer_tpu.core.state import PlayerState
+        from analyzer_tpu.core.state import MAX_TEAM_SIZE, PlayerState
         from analyzer_tpu.sched.superstep import MatchStream
 
-        from analyzer_tpu.core.state import MAX_TEAM_SIZE
-        from analyzer_tpu.service.encode import row_bucket
-
         t0 = self.clock()
-        shapes = (
-            (self.config.batch_size, MAX_TEAM_SIZE),
-            (self.config.batch_size, min(3, MAX_TEAM_SIZE)),
-            (1, min(3, MAX_TEAM_SIZE)),
-        )
-        for n_matches, team in shapes:
-            p = n_matches * 2 * team
-            alloc = row_bucket(p)  # the same rule EncodedBatch applies
+        max_alloc = self._canon_rows - 1  # one owner: the constructor
+        ladder = []
+        alloc = 64  # row_bucket's floor
+        while alloc <= max_alloc:
+            ladder.append(alloc)
+            alloc *= 2
+        for alloc in ladder:
+            # A matches-worth of distinct players filling this bucket
+            # (any occupancy compiles the same (rows, chunk) shape).
+            p = min(alloc, self.config.batch_size * 2 * MAX_TEAM_SIZE)
+            n_matches = max(1, p // (2 * MAX_TEAM_SIZE))
+            p = n_matches * 2 * MAX_TEAM_SIZE
             state = PlayerState.create(alloc, cfg=self.rating_config)
-            idx = np.full((n_matches, 2, MAX_TEAM_SIZE), -1, np.int32)
-            idx[:, :, :team] = np.arange(p, dtype=np.int32).reshape(
-                n_matches, 2, team
+            idx = np.arange(p, dtype=np.int32).reshape(
+                n_matches, 2, MAX_TEAM_SIZE
             )
             stream = MatchStream(
                 player_idx=idx,
@@ -222,38 +250,49 @@ class Worker:
                 afk=np.zeros(n_matches, bool),
             )
             sched = self._bucketed_schedule(stream, alloc)
-            rate_history(state, sched, self.rating_config, collect=True)
+            rate_history(
+                state, sched, self.rating_config, collect=True,
+                steps_per_chunk=self._step_chunk,
+            )
         if self.pipeline_enabled:
-            # The pipelined engine's chaining scatter compiles per
-            # (dst_rows, src_rows) pair; consecutive production batches
-            # share a row bucket, so warming the square pairs covers
-            # steady state (mixed pairs are rare one-off compiles).
             import jax.numpy as jnp
 
             from analyzer_tpu.core.state import TABLE_WIDTH
-            from analyzer_tpu.service.pipeline import _chain_patch
+            from analyzer_tpu.service.pipeline import (
+                _canonical_rows, _chain_patch,
+            )
 
-            for n_matches, team in shapes:
-                alloc = row_bucket(n_matches * 2 * team)
+            canon = self._canon_rows
+            src = jnp.zeros((canon, TABLE_WIDTH), jnp.float32)
+            idx = jnp.zeros((canon,), jnp.int32)
+            for alloc in ladder:
+                # Every batch's final table canonicalizes once (per-rung
+                # compile) and every destination rung patches from the
+                # canonical shape — the full pair grid needs 2 compiles
+                # per rung, not rung^2.
+                _canonical_rows(
+                    jnp.zeros((alloc + 1, TABLE_WIDTH), jnp.float32), canon
+                ).block_until_ready()
                 dst = jnp.zeros((alloc + 1, TABLE_WIDTH), jnp.float32)
-                src = jnp.zeros((alloc + 1, TABLE_WIDTH), jnp.float32)
-                idx = jnp.zeros((alloc + 1,), jnp.int32)
                 _chain_patch(dst, src, idx).block_until_ready()
         logger.info(
-            "warmup compiled %d batch shapes in %.1fs",
-            len(shapes), self.clock() - t0,
+            "warmup compiled the %d-rung row ladder in %.1fs",
+            len(ladder), self.clock() - t0,
         )
 
     # -- batch pipeline ---------------------------------------------------
     def _bucketed_schedule(self, stream, pad_row: int):
-        """Pinned width + power-of-two step bucket — the ONE place the
-        service schedule shapes are derived, shared by ``process`` and
-        ``warmup`` so the warmed shapes are exactly production's."""
+        """Pinned width + fixed step-chunk multiple — the ONE place the
+        service schedule shapes are derived, shared by ``process``,
+        ``warmup`` and the pipelined engine so the warmed shapes are
+        exactly production's. The scan consumes the schedule in chunks of
+        ``SERVICE_STEP_CHUNK`` steps, so ANY chain depth reuses the one
+        compiled (rows, chunk) shape."""
         sched = pack_schedule(
             stream, pad_row=pad_row, batch_size=self._packed_width
         )
-        bucket = max(4, 1 << (sched.n_steps - 1).bit_length())
-        return sched.pad_to_steps(bucket)
+        c = self._step_chunk
+        return sched.pad_to_steps(-(-sched.n_steps // c) * c)
 
     def _dead_letter(self, messages) -> None:
         """Republish to the failed queue + nack without requeue — the
@@ -431,7 +470,10 @@ class Worker:
         # consecutive batches of any size reuse one compiled scan.
         enc = EncodedBatch(matches, self.rating_config, bucket_rows=True)
         sched = self._bucketed_schedule(enc.stream, enc.state.pad_row)
-        _, outs = rate_history(enc.state, sched, self.rating_config, collect=True)
+        _, outs = rate_history(
+            enc.state, sched, self.rating_config, collect=True,
+            steps_per_chunk=self._step_chunk,
+        )
         enc.write_back(outs)
         # Transactional stores (SqlStore) flush the mutated graph in one
         # commit, rolling back internally on error (worker.py:194-199);

@@ -63,6 +63,67 @@ class TestCompileChurn:
         assert worker.matches_rated == 8
         assert _scan_chunk._cache_size() == size0  # no second compile
 
+    @pytest.mark.parametrize("pipeline", [False, True])
+    def test_warmed_ladder_covers_adversarial_chains(self, pipeline, caplog):
+        # VERDICT round-3 item 4: warmup must cover the WHOLE shape
+        # ladder, not just 3 shapes — after warmup(), a full batch, an
+        # adversarially CHAINED batch (every match shares one player, so
+        # the schedule is as deep as the batch), and a tiny idle flush
+        # must all trigger ZERO XLA compiles. The step dimension is
+        # fixed by SERVICE_STEP_CHUNK, so depth only adds chunks of the
+        # one compiled shape; the row ladder is warmed rung by rung; the
+        # pipelined chain-patch goes through the canonical source shape.
+        # (Asserted on jax's compile log, not pjit _cache_size — the
+        # fast-path call cache adds entries keyed on input provenance
+        # even on a 100% executable-cache hit.)
+        import logging
+
+        import jax
+
+        broker = InMemoryBroker()
+        store = InMemoryStore()
+        cfg = ServiceConfig(batch_size=32, idle_timeout=0.0)
+        worker = Worker(
+            broker, store, cfg, RatingConfig(), pipeline=pipeline
+        )
+        worker.warmup()
+
+        jax.config.update("jax_log_compiles", True)
+        try:
+            with caplog.at_level(logging.WARNING, logger="jax"):
+                # (a) full batch of distinct players (widest row bucket)
+                for i in range(32):
+                    store.add_match(mk_match(f"w{i}", created_at=i))
+                    broker.publish("analyze", f"w{i}".encode())
+                assert worker.poll()
+                # (b) adversarial chain: one shared player -> 32 steps
+                shared = fake_player(skill_tier=15, api_id="chained")
+                for i in range(32):
+                    fresh = [
+                        fake_player(skill_tier=15, api_id=f"c{i}-p{j}")
+                        for j in range(5)
+                    ]
+                    store.add_match(
+                        mk_match(f"c{i}", created_at=100 + i,
+                                 players=[shared] + fresh)
+                    )
+                    broker.publish("analyze", f"c{i}".encode())
+                assert worker.poll()
+                # (c) tiny idle flush (smallest row bucket)
+                store.add_match(mk_match("tiny", created_at=500))
+                broker.publish("analyze", b"tiny")
+                assert worker.poll()
+                worker.drain()
+        finally:
+            jax.config.update("jax_log_compiles", False)
+            worker.close()
+        assert worker.matches_rated == 65
+        compiles = [
+            r.getMessage() for r in caplog.records
+            if "Compiling" in r.getMessage()
+        ]
+        assert compiles == [], compiles
+
 
 class TestWarmup:
     def test_warmup_precompiles_full_batch_shape(self):
