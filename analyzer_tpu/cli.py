@@ -640,19 +640,31 @@ def cmd_train(args) -> int:
     from analyzer_tpu.models.calibration import apply_temperature, fit_temperature
 
     temperature = fit_temperature(np.asarray(model.logits(feats[cal])), y[cal])
+    def _metrics(p, yy):
+        eps = 1e-7
+        auc = _auc(p, yy)  # None on a single-class eval slice
+        return {
+            "accuracy": round(_half_credit_accuracy(p, yy), 4),
+            "logloss": round(float(-np.mean(
+                yy * np.log(p + eps) + (1 - yy) * np.log(1 - p + eps)
+            )), 4),
+            "auc": round(auc, 4) if auc is not None else None,
+            "ece": round(_ece(p, yy), 4),
+        }
+
     if ev.size:
         p = apply_temperature(np.asarray(model.logits(feats[ev])), temperature)
-        acc = _half_credit_accuracy(p, y[ev])
-        auc = _auc(p, y[ev])
-        ece = _ece(p, y[ev])
-        eps = 1e-7
-        logloss = float(
-            -np.mean(
-                y[ev] * np.log(p + eps) + (1 - y[ev]) * np.log(1 - p + eps)
-            )
-        )
+        m = _metrics(p, y[ev])
+        acc, logloss = m["accuracy"], m["logloss"]
+        auc, ece = m["auc"], m["ece"]
+        # The trivial rating-only baseline every head must beat to earn
+        # its keep: the closed-form TrueSkill win probability computed
+        # from the same pre-match state (feature column 2,
+        # models/features.py) with NO learned parameters. Reported on
+        # the same eval split so BASELINE.md rows carry the comparison.
+        baseline = _metrics(feats[ev, 2].astype(np.float64), y[ev])
     else:
-        acc = logloss = auc = ece = None
+        acc = logloss = auc = ece = baseline = None
     if args.out:
         # temperature rides along so artifact consumers reproduce the
         # reported (calibrated) probabilities, not the raw head.
@@ -671,10 +683,11 @@ def cmd_train(args) -> int:
                 "calibrated_on": int(cal.size) if cal is not fit else 0,
                 "eval_on": int(ev.size),
                 "train_nll": round(float(nll), 4),
-                "eval_accuracy": round(acc, 4) if acc is not None else None,
-                "eval_logloss": round(logloss, 4) if logloss is not None else None,
-                "eval_auc": round(auc, 4) if auc is not None else None,
-                "eval_ece": round(ece, 4) if ece is not None else None,
+                "eval_accuracy": acc,
+                "eval_logloss": logloss,
+                "eval_auc": auc,
+                "eval_ece": ece,
+                "baseline_rating_only": baseline,
                 "temperature": round(temperature, 3),
                 "phases": {k: round(v, 3) for k, v in timer.report().items()},
             }
