@@ -2,7 +2,17 @@
 
 Prints ONE JSON line:
   {"metric": "matches_per_sec_per_chip", "value": N, "unit": "matches/s",
-   "vs_baseline": N}
+   "vs_baseline": N, "capture": {...}}
+
+``capture`` makes the measurement self-describing on the shared-tunnel
+dev chip (whose latency drifts 1.5-4x between minutes): matmul link
+probes on BOTH sides of the timed window, every repeat's wall time, the
+>3x-stall drop count, spread and min/median of the survivors, and a
+``degraded`` flag (both probes > 160 ms, or the trailing repeats never
+converged — _tail_stable). Repeats EXTEND adaptively (up to 3x) while
+the tail hasn't converged, so min-of-N gets a chance to span a quiet
+window; if it never does the artifact says so instead of silently
+underreporting the chip (the round-3 -> round-2 artifact regression).
 
 ``vs_baseline`` is measured throughput / the north-star target rate from
 BASELINE.json (~10M matches in <5 min on a v5e-8 = 33.3k matches/s pod
@@ -125,7 +135,9 @@ def main() -> None:
         np.asarray(state.table[:1])
         return state
 
-    state, best = time_runs(run, repeats)
+    probe_ms = probe_tunnel()
+    log(f"tunnel probe: {probe_ms:.0f} ms (quiet reference ~90-120)")
+    state, best, times, stable = time_runs(run, repeats, max_extra=2 * repeats)
     rate = sched.n_matches / best
 
     # End-to-end feed+compute: the windowed schedule materializes gather
@@ -143,7 +155,7 @@ def main() -> None:
         np.asarray(e2e_state.table[:1])
         return e2e_state
 
-    _, t_e2e = time_runs(run_e2e, 2)
+    _, t_e2e, _, _ = time_runs(run_e2e, 2)
     log(f"end-to-end rate_history (overlapped windowed feed): {t_e2e:.2f}s "
         f"= {t_e2e / best:.2f}x device-only time")
 
@@ -158,29 +170,110 @@ def main() -> None:
         np.asarray(s_state.table[:1])
         return s_state
 
-    _, t_stream = time_runs(run_stream, 2)
+    _, t_stream, _, _ = time_runs(run_stream, 2)
     log(f"end-to-end rate_stream (assignment overlapped too): {t_stream:.2f}s "
         f"= {t_stream / best:.2f}x device-only time")
 
     sanity(state, state0.n_players)
 
-    emit_metric(rate)
+    probe_after = probe_tunnel()
+    log(f"tunnel probe after: {probe_after:.0f} ms")
+    emit_metric(rate, capture_stats(times, (probe_ms, probe_after), stable))
 
 
-def time_runs(run, repeats):
-    """Warmup (compile) + fetch-timed repeats; returns (last_state, best).
-    Shared by the single-device and mesh benchmark paths so the
-    measurement protocol cannot drift between them."""
+def probe_tunnel() -> float:
+    """Minimum of three 2048^2 bf16 matmul fetches, in ms. On a quiet
+    tunnel this costs ~90-120 ms (memory: tunnel-bench-protocol); much
+    more means the link is degraded and the capture should say so."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda a: a @ a)
+    x = jnp.ones((2048, 2048), jnp.bfloat16)
+    np.asarray(f(x)[0, 0])  # compile + first-transfer warmth
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(f(x)[0, 0])
+        best = min(best, time.perf_counter() - t0)
+    return best * 1e3
+
+
+# One owner for "how much repeat disagreement is acceptable": the
+# adaptive-extension stop in time_runs and the artifact's degraded flag
+# must agree, or the log and the JSON contradict each other.
+SPREAD_LIMIT = 1.25
+
+
+def _tail_stable(times: list, repeats: int) -> bool:
+    """The capture CONVERGED: the trailing ``repeats`` samples (stalls
+    dropped) agree within SPREAD_LIMIT *and* reach within 10% of the
+    global best — i.e. the run ended in a quiet window that reproduces
+    the reported min. Judged on the TAIL, not all samples: one early
+    1.5-4x drift sample (common on this tunnel, below the 3x stall
+    cutoff) would otherwise pin the all-sample spread forever and force
+    every capture to burn the full extension."""
+    lo = min(times)
+    tail = [t for t in times[-repeats:] if t <= 3 * lo]
+    if not tail:
+        return False
+    return (max(tail) / min(tail) <= SPREAD_LIMIT
+            and min(tail) <= 1.1 * lo)
+
+
+def capture_stats(times: list, probes_ms: tuple, stable: bool) -> dict:
+    """Self-describing capture quality: repeats with >3x-the-min samples
+    dropped as tunnel stalls (the BASELINE.md A/B protocol, promoted into
+    the artifact), spread and min/median of the survivors, link probes
+    from BOTH sides of the timed window, and a DEGRADED flag when the
+    link or the capture was visibly unstable — so a BENCH_rNN.json that
+    underreports carries its own explanation (the round-3 verdict's weak
+    #1: r03 recorded 24% below r02 with nothing in the artifact marking
+    the capture as bad)."""
+    lo = min(times)
+    clean = [t for t in times if t <= 3 * lo]
+    spread = max(clean) / lo
+    med = sorted(clean)[len(clean) // 2]
+    return {
+        "probe_ms_before": round(probes_ms[0], 1),
+        "probe_ms_after": round(probes_ms[1], 1),
+        "repeats_s": [round(t, 3) for t in times],
+        "stalls_dropped": len(times) - len(clean),
+        "spread": round(spread, 3),
+        "min_over_median": round(lo / med, 3),
+        # The link was bad on BOTH sides of the window, or the repeats
+        # never converged (the same verdict time_runs stopped on).
+        "degraded": bool(min(probes_ms) > 160 or not stable),
+    }
+
+
+def time_runs(run, repeats, max_extra: int = 0):
+    """Warmup (compile) + fetch-timed repeats; returns (last_state, best,
+    times, stable). Shared by the single-device and mesh benchmark paths
+    so the measurement protocol cannot drift between them. ``max_extra``
+    allows ADAPTIVE extension: while the trailing ``repeats`` samples
+    have not converged (_tail_stable), keep sampling — min-of-N only
+    reproduces the quiet-tunnel number if N spans a quiet window."""
     t0 = time.perf_counter()
     state = run()
     log(f"warmup (incl. compile): {time.perf_counter() - t0:.2f}s")
     times = []
-    for r in range(repeats):
+    r = 0
+    while True:
         t0 = time.perf_counter()
         state = run()
         times.append(time.perf_counter() - t0)
         log(f"repeat {r}: {times[-1]:.3f}s")
-    return state, min(times)
+        r += 1
+        if r >= repeats:
+            stable = _tail_stable(times, repeats)
+            if stable or r >= repeats + max_extra:
+                if not stable and max_extra:
+                    log(f"capture did not converge after {r} repeats — "
+                        "the artifact will carry degraded: true")
+                break
+            log("capture not converged; extending repeats")
+    return state, min(times), times, _tail_stable(times, repeats)
 
 
 def sanity(state, n_players, extra=""):
@@ -193,13 +286,19 @@ def sanity(state, n_players, extra=""):
     assert np.isfinite(mu[rated, 0]).all()
 
 
-def emit_metric(rate):
-    print(json.dumps({
+def emit_metric(rate, capture: dict | None = None):
+    line = {
         "metric": "matches_per_sec_per_chip",
         "value": round(rate, 1),
         "unit": "matches/s",
         "vs_baseline": round(rate / BASELINE_MATCHES_PER_SEC_PER_CHIP, 3),
-    }))
+    }
+    if capture is not None:
+        # Self-describing capture quality (see capture_stats): a degraded
+        # tunnel window is marked IN the artifact instead of silently
+        # underreporting the chip.
+        line["capture"] = capture
+    print(json.dumps(line))
 
 
 def bench_mesh(n_mesh, stream, state0, cfg, batch, repeats, t_gen):
@@ -237,7 +336,9 @@ def bench_mesh(n_mesh, stream, state0, cfg, batch, repeats, t_gen):
         np.asarray(final.table[:1])
         return final
 
-    state, best = time_runs(run, repeats)
+    probe_ms = probe_tunnel()
+    log(f"tunnel probe: {probe_ms:.0f} ms (quiet reference ~90-120)")
+    state, best, times, stable = time_runs(run, repeats, max_extra=2 * repeats)
     rate = sched.n_matches / best / n_mesh
 
     # Fully-streamed: first-fit assignment on a worker thread feeding the
@@ -247,7 +348,7 @@ def bench_mesh(n_mesh, stream, state0, cfg, batch, repeats, t_gen):
         np.asarray(s_state.table[:1])
         return s_state
 
-    _, t_stream = time_runs(run_stream, 2)
+    _, t_stream, _, _ = time_runs(run_stream, 2)
     log(f"end-to-end rate_stream(mesh): {t_stream:.2f}s "
         f"= {t_stream / best:.2f}x windowed-feed time")
 
@@ -266,12 +367,14 @@ def bench_mesh(n_mesh, stream, state0, cfg, batch, repeats, t_gen):
             np.asarray(final.table[:1])
             return final
 
-        _, best_eager = time_runs(run_eager, repeats)
+        _, best_eager, _, _ = time_runs(run_eager, repeats)
         log(f"eager precomputed-routing control: {best_eager:.3f}s -> "
             f"windowed feed = {best / best_eager:.2f}x eager")
 
     sanity(state, state0.n_players, extra=f" over {n_mesh} chips")
-    emit_metric(rate)
+    probe_after = probe_tunnel()
+    log(f"tunnel probe after: {probe_after:.0f} ms")
+    emit_metric(rate, capture_stats(times, (probe_ms, probe_after), stable))
 
 
 if __name__ == "__main__":
