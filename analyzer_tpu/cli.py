@@ -89,6 +89,7 @@ def cmd_synth(args) -> int:
     stream = synthetic_stream(
         args.matches, players, seed=args.seed,
         activity_concentration=args.concentration,
+        max_activity_share=args.max_share or None,
     )
     telemetry = None
     if args.telemetry:
@@ -749,6 +750,12 @@ def main(argv=None) -> int:
     s.add_argument("--players", type=int, default=300)
     s.add_argument("--seed", type=int, default=0)
     s.add_argument("--concentration", type=float, default=0.8)
+    s.add_argument(
+        "--max-share", type=float, default=0.0, metavar="FRAC",
+        help="cap any player's expected share of match slots (bench.py "
+        "uses 1e-4: a physically plausible ladder; 0 = uncapped Zipf, "
+        "whose top grinder chains the whole schedule — io/synthetic.py)",
+    )
     s.add_argument(
         "--out", required=True,
         help=".csv (native parser), .npz (binary), or .db "
