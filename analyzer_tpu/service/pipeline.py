@@ -17,9 +17,11 @@ batch's host work:
     batch (``_chain_patch``), keyed by player-id overlap computed on the
     host from the encoders' ``row_of`` maps. The posterior never visits
     the host on the critical path.
-  * **A small fetch pool** issues each batch's packed-outputs fetch right
-    at dispatch, so consecutive fetches' tunnel RTTs overlap instead of
-    serializing in the writer.
+  * **Async D2H at dispatch**: each batch's packed-outputs transfer is
+    issued (``copy_to_host_async``) the moment its scan is enqueued, so
+    by the time the ordered writer materializes it the bytes have been
+    streaming for ~lag batch periods. (A fetch THREAD POOL measured
+    strictly worse: tunnel + GIL contention with encode/write_back.)
   * **An ordered writer thread** applies ``write_back`` + ``commit``
     strictly in batch order (players are shared across batches — the
     last-write-wins order must match the sequential loop) on its OWN
