@@ -12,6 +12,8 @@ matches (``rater.py:83-106``).
 
 from __future__ import annotations
 
+from types import SimpleNamespace
+
 import numpy as np
 import jax.numpy as jnp
 
@@ -28,6 +30,16 @@ from analyzer_tpu.core.state import (
     PlayerState,
 )
 from analyzer_tpu.sched.superstep import MatchStream
+
+
+# Hoisted (col index, "<col>_mu", "<col>_sigma") triples: the encode loop
+# reads 14 rating attributes per player, and building the attribute names
+# with f-strings inside the loop cost ~40k string formats per 500-match
+# batch on the consumer thread.
+_RATING_ATTRS = tuple(
+    (c, f"{col}_mu", f"{col}_sigma")
+    for c, col in enumerate(constants.RATING_COLUMNS)
+)
 
 
 def row_bucket(n_players: int) -> int:
@@ -103,11 +115,23 @@ class EncodedBatch:
         ti = np.zeros((alloc + 1,), np.int32)
         bad_tier: dict[int, object] = {}  # row -> out-of-table tier value
         for r, player in enumerate(self.player_at):
-            for c, col in enumerate(constants.RATING_COLUMNS):
-                mu = getattr(player, f"{col}_mu", None)
+            # __dict__.get is ~2x getattr, but it is only CORRECT where
+            # the instance dict is the whole truth — exactly
+            # SimpleNamespace (SqlStore's loaded graphs). Any other type
+            # may serve attributes through properties, class defaults,
+            # __getattr__ or ORM descriptors (which a bare __dict__ probe
+            # would silently read as None = unrated), so everything else
+            # keeps the duck-typed getattr path.
+            if type(player) is SimpleNamespace:
+                get = player.__dict__.get
+            else:
+                def get(name, _p=player):
+                    return getattr(_p, name, None)
+            for c, mu_col, sg_col in _RATING_ATTRS:
+                mu = get(mu_col)
                 if mu is not None:
                     table[r, MU_LO + c] = float(mu)
-                    table[r, SIGMA_LO + c] = float(getattr(player, f"{col}_sigma"))
+                    table[r, SIGMA_LO + c] = float(getattr(player, sg_col))
             if player.rank_points_ranked is not None:
                 rr[r] = float(player.rank_points_ranked)
             if player.rank_points_blitz is not None:
