@@ -123,15 +123,22 @@ class EncodedBatch:
             # would silently read as None = unrated), so everything else
             # keeps the duck-typed getattr path.
             if type(player) is SimpleNamespace:
-                get = player.__dict__.get
+                d = player.__dict__
+                get, get_req = d.get, d.__getitem__
             else:
                 def get(name, _p=player):
                     return getattr(_p, name, None)
+
+                def get_req(name, _p=player):
+                    return getattr(_p, name)
             for c, mu_col, sg_col in _RATING_ATTRS:
                 mu = get(mu_col)
                 if mu is not None:
                     table[r, MU_LO + c] = float(mu)
-                    table[r, SIGMA_LO + c] = float(getattr(player, sg_col))
+                    # get_req raises on a missing sigma (KeyError /
+                    # AttributeError by path) — a mu without its sigma is
+                    # malformed data, same contract as before.
+                    table[r, SIGMA_LO + c] = float(get_req(sg_col))
             if player.rank_points_ranked is not None:
                 rr[r] = float(player.rank_points_ranked)
             if player.rank_points_blitz is not None:

@@ -258,6 +258,49 @@ class TestPikaAdapter:
         assert len(broker._ch.acked) == 3
         assert store.matches["m0"].trueskill_quality is not None
 
+    def test_pipelined_worker_runs_against_stubbed_pika(self, stub_pika):
+        """The PIPELINED loop over the push-consume adapter: multiple
+        overlapped batches, broker interaction strictly on the consumer
+        thread, acks land after drain, results equal the sequential
+        run's — the production combination (main() default) that no
+        other test exercised."""
+        from analyzer_tpu.config import RatingConfig, ServiceConfig
+        from analyzer_tpu.service import InMemoryStore, Worker
+        from analyzer_tpu.service.broker import make_pika_broker
+        from tests.test_service import mk_match
+        from tests.fakes import fake_player
+
+        def run(pipeline):
+            broker = make_pika_broker("amqp://localhost", prefetch=16)
+            store = InMemoryStore()
+            pool = [
+                fake_player(skill_tier=15, api_id=f"sp{j}") for j in range(9)
+            ]
+            for i in range(12):  # shared pool -> batches chain on players
+                store.add_match(
+                    mk_match(f"m{i}", created_at=i,
+                             players=pool[i % 4: i % 4 + 6])
+                )
+            worker = Worker(
+                broker, store,
+                ServiceConfig(batch_size=4, idle_timeout=0.0),
+                RatingConfig(), pipeline=pipeline,
+            )
+            for i in range(12):
+                broker.publish("analyze", f"m{i}".encode())
+            while worker.poll():
+                pass
+            worker.drain()
+            worker.close()
+            assert worker.matches_rated == 12
+            assert len(broker._ch.acked) == 12
+            return {
+                pid: (p.trueskill_mu, p.trueskill_sigma)
+                for pid, p in store.players.items()
+            }
+
+        assert run(True) == run(False)
+
 
 class TestPushConsume:
     """The round-3 adapter contract: prefetch bounds in-flight messages

@@ -548,3 +548,26 @@ class TestFanOut:
         t[0] = 1.5
         assert worker.poll()  # idle flush
         assert worker.matches_rated == 1
+
+
+class TestPipelineConfig:
+    def test_env_default_on_direct_default_off(self):
+        # from_env (production main()) defaults the pipelined loop ON;
+        # direct construction (tests, embedders) stays sequential unless
+        # asked — the split documented in config.py.
+        assert ServiceConfig().pipeline is False
+        assert ServiceConfig.from_env({}).pipeline is True
+        assert ServiceConfig.from_env({"PIPELINE": "false"}).pipeline is False
+        assert ServiceConfig.from_env({"PIPELINE_LAG": "3"}).pipeline_lag == 3
+        assert ServiceConfig.from_env({}).pipeline_lag == 6
+
+    def test_worker_follows_config(self):
+        broker = InMemoryBroker()
+        w = Worker(broker, InMemoryStore(),
+                   ServiceConfig(batch_size=2, idle_timeout=0.0,
+                                 pipeline=True))
+        assert w.pipeline_enabled is True
+        w2 = Worker(broker, InMemoryStore(),
+                    ServiceConfig(batch_size=2, idle_timeout=0.0,
+                                  pipeline=True), pipeline=False)
+        assert w2.pipeline_enabled is False  # explicit arg wins
