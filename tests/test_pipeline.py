@@ -54,11 +54,17 @@ def build_mem_store(n_matches: int, n_players: int, seed: int = 0):
     return store, ids
 
 
-def consume_all(worker, broker, cfg, ids):
+def consume_all(worker, broker, cfg, ids, max_polls=None):
+    """Publish + consume to completion. ``max_polls`` (default 3x the
+    message count) bounds the loop so a broken flush condition fails the
+    test instead of hanging it; partial idle flushes legitimately need
+    more polls than batches."""
     for mid in ids:
         broker.publish(cfg.queue, mid.encode())
-    while worker.poll():
-        pass
+    limit = max_polls or max(3 * len(ids), 10)
+    for _ in range(limit):
+        if not worker.poll() and broker.qsize(cfg.queue) == 0:
+            break
     worker.drain()
     worker.close()  # release the writer thread per test
 
