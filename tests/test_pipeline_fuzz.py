@@ -13,8 +13,8 @@ batch that contains a chosen match id), not on commit ordinals — poison
 isolation legitimately splits batches differently between the modes, so
 ordinal-keyed faults would diverge by construction.
 
-Provenance: 106 seeds checked divergence-free offline in round 4 — the
-6 committed here, 60 more of this shape, and 40 stress variants (MULTIPLE
+Provenance: 166 seeds checked divergence-free offline in round 4 — the
+6 committed here, 120 more of this shape, and 40 stress variants (MULTIPLE
 content-keyed failures per run, duplicate message deliveries, batch sizes
 down to 1).
 """
