@@ -729,6 +729,10 @@ def cmd_worker(args) -> int:
         from analyzer_tpu.service.worker import requeue_failed
 
         config = ServiceConfig.from_env()
+        # Deliberately NOT config.prefetch_count: the redrive acks each
+        # message right after republish (no deferred-ack window to
+        # cover), and the prefetch bound is also the worst-case
+        # duplicate window on a mid-drain crash — keep it one batch.
         broker = make_pika_broker(
             config.rabbitmq_uri, prefetch=config.batch_size
         )

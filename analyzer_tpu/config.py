@@ -19,6 +19,15 @@ def _env(env: Mapping[str, str] | None) -> Mapping[str, str]:
     return os.environ if env is None else env
 
 
+# Clamp range for the pipelined loop's commit lag (in-flight batches past
+# the last known commit). The floor keeps at least one full round trip
+# overlapped; the ceiling bounds the failure blast radius (an aborted
+# stream reprocesses up to `lag` batches sequentially) and the broker's
+# unacked-delivery headroom (`ServiceConfig.prefetch_count`).
+PIPELINE_MIN_LAG = 2
+PIPELINE_MAX_LAG = 12
+
+
 @dataclasses.dataclass(frozen=True)
 class RatingConfig:
     """TrueSkill environment hyperparameters.
@@ -89,8 +98,13 @@ class ServiceConfig:
     # Default False for direct construction (tests get the sequential,
     # reference-shaped loop); from_env defaults ON — production workers
     # want the overlap, and PIPELINE=false restores the sequential loop.
+    # ``pipeline_lag=None`` means auto-tune: the worker measures the
+    # dispatch->fetch round trip and its per-batch host time at warmup
+    # and sets lag ~ ceil(RTT / host_time) + 1, clamped to
+    # [PIPELINE_MIN_LAG, PIPELINE_MAX_LAG] (service/pipeline.py:
+    # choose_pipeline_lag). Set PIPELINE_LAG to pin a fixed depth.
     pipeline: bool = False
-    pipeline_lag: int = 6
+    pipeline_lag: int | None = None
 
     @classmethod
     def from_env(cls, env: Mapping[str, str] | None = None) -> "ServiceConfig":
@@ -109,9 +123,36 @@ class ServiceConfig:
             do_sew_match=e.get("DOSEWMATCH") == "true",
             sew_queue=e.get("SEW_QUEUE") or "sew",
             pipeline=(e.get("PIPELINE") or "true") == "true",
-            pipeline_lag=int(e.get("PIPELINE_LAG") or 6),
+            pipeline_lag=(
+                int(e["PIPELINE_LAG"]) if e.get("PIPELINE_LAG") else None
+            ),
         )
 
     @property
     def failed_queue(self) -> str:
         return self.queue + "_failed"
+
+    @property
+    def prefetch_count(self) -> int:
+        """AMQP prefetch bound for the broker connection.
+
+        The reference pins ``prefetch_count=BATCHSIZE`` (``worker.py:91``)
+        — right for the sequential loop, where at most one batch is ever
+        unacked. The pipelined loop defers each batch's acks until its
+        commit is harvested, so up to ``lag + 1`` batches are legitimately
+        unacked at once; with only one batch-size of headroom the broker
+        would withhold batch N+1's deliveries until batch N fully acked,
+        serializing the pipeline back to the sequential loop. Auto-tuned
+        lag sizes for the clamp ceiling (the measured lag is unknown at
+        connect time; over-provisioned prefetch costs only broker-side
+        buffering)."""
+        if not self.pipeline:
+            return self.batch_size
+        # max(1, ...) mirrors PipelineEngine's own clamp: PIPELINE_LAG=0
+        # still runs the engine at lag 1 (two batches legitimately
+        # unacked), so prefetch must cover two.
+        lag = (
+            PIPELINE_MAX_LAG if self.pipeline_lag is None
+            else max(1, self.pipeline_lag)
+        )
+        return self.batch_size * (lag + 1)

@@ -1,0 +1,95 @@
+"""Duck-typed fake ORM object factories.
+
+The reference's tests hand-build plain classes mirroring only the
+attributes ``rate_match`` touches (``worker_test.py:6-63``) — no DB, no
+broker, no mocks. These factories keep that strategy (SURVEY.md section 4
+calls it the single most important design fact to preserve) but cover the
+full 7-column rating schema, including the 5v5 pairs the reference's
+fixtures omit.
+
+They live in the package (not under ``tests/``) because production code
+uses them too: the worker's warmup cost probe encodes a synthetic
+batch-size object graph to measure per-batch host time
+(``service/worker.py``). One definition keeps the probe and the parity
+tests from drifting when the encoded attribute set changes.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+from analyzer_tpu.core.constants import RATING_COLUMNS
+
+
+def fake_player(skill_tier=None, rank_points_ranked=None, rank_points_blitz=None,
+                **ratings):
+    attrs = {"api_id": "", "skill_tier": skill_tier,
+             "rank_points_ranked": rank_points_ranked,
+             "rank_points_blitz": rank_points_blitz}
+    for col in RATING_COLUMNS:
+        attrs[f"{col}_mu"] = None
+        attrs[f"{col}_sigma"] = None
+    attrs.update(ratings)
+    return SimpleNamespace(**attrs)
+
+
+def fake_items(**ratings):
+    attrs = {"api_id": "", "any_afk": False}
+    for col in RATING_COLUMNS[1:]:
+        attrs[f"{col}_mu"] = None
+        attrs[f"{col}_sigma"] = None
+    attrs.update(ratings)
+    return SimpleNamespace(**attrs)
+
+
+def fake_participant(player=None, items=None, skill_tier=0, went_afk=False):
+    return SimpleNamespace(
+        api_id="",
+        skill_tier=skill_tier,
+        went_afk=went_afk,
+        trueskill_mu=None,
+        trueskill_sigma=None,
+        trueskill_delta=None,
+        participant_items=[items if items is not None else fake_items()],
+        player=[player if player is not None else fake_player()],
+    )
+
+
+def fake_roster(winner, participants):
+    return SimpleNamespace(api_id="", winner=winner, participants=participants)
+
+
+def fake_match(game_mode, rosters, api_id=""):
+    return SimpleNamespace(
+        api_id=api_id,
+        game_mode=game_mode,
+        rosters=rosters,
+        participants=[p for r in rosters for p in r.participants],
+        trueskill_quality=None,
+        created_at=0,
+    )
+
+
+def synthetic_batch(n: int, team_size: int = 3, game_mode: str = "ranked",
+                    id_prefix: str = "warm") -> list:
+    """``n`` well-formed two-team matches of fresh tier-15 players, every
+    player distinct — the worker's warmup probe input (never touches a
+    store)."""
+    matches = []
+    for m in range(n):
+        rosters = []
+        for t in range(2):
+            parts = [
+                fake_participant(
+                    player=fake_player(skill_tier=15),
+                    skill_tier=15,
+                )
+                for _ in range(team_size)
+            ]
+            for s, part in enumerate(parts):
+                part.player[0].api_id = f"{id_prefix}_{m}_{t}_{s}"
+            rosters.append(fake_roster(winner=int(t == 0), participants=parts))
+        match = fake_match(game_mode, rosters, api_id=f"{id_prefix}_m{m}")
+        match.created_at = m
+        matches.append(match)
+    return matches

@@ -88,6 +88,10 @@ class InMemoryBroker:
             self.queues[queue].appendleft(msg)
         self._unacked.clear()
 
+    def set_prefetch(self, prefetch: int) -> None:
+        """No delivery bound to adjust in memory; recorded for tests."""
+        self.prefetch = int(prefetch)
+
     def qsize(self, queue: str) -> int:
         return len(self.queues.get(queue, ()))
 
@@ -250,6 +254,21 @@ def make_pika_broker(uri: str, prefetch: int = 0):
                 # channel — the same numeric tag would settle a
                 # different message there.
                 self._reconnect(e)
+
+        def set_prefetch(self, prefetch: int) -> None:
+            """Re-bounds the per-consumer QoS window on the live channel
+            (and across reconnects). Used by a worker whose pipelined
+            mode permanently degrades: the wide in-flight window sized
+            for deferred acks would otherwise keep hogging deliveries a
+            sequential consumer can't keep up with, starving healthy
+            competing consumers on the same queue."""
+            self._prefetch = int(prefetch or 0)
+            if self._prefetch:
+                self._retry(
+                    lambda: self._ch.basic_qos(
+                        prefetch_count=self._prefetch
+                    )
+                )
 
         def ack(self, delivery_tag: int) -> None:
             self._settle(delivery_tag, self._ch.basic_ack)

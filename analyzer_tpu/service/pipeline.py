@@ -84,6 +84,33 @@ from analyzer_tpu.utils.host import fetch_tree
 
 logger = get_logger(__name__)
 
+# Fallback commit lag when nothing was measured (engine constructed
+# without a warmup probe and without an explicit PIPELINE_LAG): the
+# round-4 A/B winner on the tunneled dev rig (~100-200 ms RTT vs ~45 ms
+# host work -> choose_pipeline_lag lands on 6 there too).
+DEFAULT_LAG = 6
+
+
+def choose_pipeline_lag(rtt_s: float, host_s: float) -> int:
+    """Commit lag from measured costs: enough in-flight batches that the
+    dispatch->fetch round trip hides entirely behind host work.
+
+    Steady state, one batch period ~= max(host_s, device_s): the fetch
+    issued at batch N's dispatch must complete before the writer needs it,
+    i.e. within ``lag`` batch periods — ``lag >= rtt / host`` — plus one
+    period of slack for jitter (the tunnel's RTT spread is the dominant
+    variance on this rig). Clamped: the floor keeps one full RTT
+    overlapped even when host work dominates (a real TPU host at ~1 ms
+    dispatch wants the floor, not the tunnel's 6); the ceiling bounds the
+    failure blast radius and the unacked-message window
+    (``ServiceConfig.prefetch_count``)."""
+    from analyzer_tpu.config import PIPELINE_MAX_LAG, PIPELINE_MIN_LAG
+
+    if host_s <= 0:
+        return PIPELINE_MAX_LAG
+    lag = -(-rtt_s // host_s) + 1  # ceil + jitter slack
+    return int(min(PIPELINE_MAX_LAG, max(PIPELINE_MIN_LAG, lag)))
+
 
 class PipelineFallback(Exception):
     """Submit could not take the batch; the worker must harvest (to apply
@@ -271,12 +298,27 @@ class PipelineEngine:
 
     The worker owns the broker and the failure policy; the engine owns
     dispatch ordering, the chaining state, the fetch pool and the writer.
-    ``lag`` = max batches in flight past the last known commit (2 keeps
-    two fetch RTTs overlapped; 1 degrades toward the sequential loop).
+    ``lag`` = max batches in flight past the last known commit. ``None``
+    resolves from the worker's warmup-measured dispatch->fetch RTT and
+    per-batch host time (:func:`choose_pipeline_lag`), else
+    :data:`DEFAULT_LAG`; production passes ``ServiceConfig.pipeline_lag``
+    (default None = auto, ``PIPELINE_LAG`` pins it). 1 degrades toward
+    the sequential loop.
     """
 
-    def __init__(self, worker, lag: int = 2):
+    def __init__(self, worker, lag: int | None = None):
         self.worker = worker
+        if lag is None:
+            rtt = getattr(worker, "measured_rtt_s", None)
+            host = getattr(worker, "measured_host_s", None)
+            if rtt is not None and host is not None:
+                lag = choose_pipeline_lag(rtt, host)
+                logger.info(
+                    "pipeline lag auto-tuned to %d (rtt %.0f ms, host "
+                    "%.0f ms/batch)", lag, rtt * 1e3, host * 1e3,
+                )
+            else:
+                lag = DEFAULT_LAG
         self.lag = max(1, int(lag))
         store = worker.store
         clone = getattr(store, "clone", None)
@@ -406,12 +448,7 @@ class PipelineEngine:
             # poison, so without this every later flush would pay
             # PipelineFallback + sequential reprocessing forever.
             self.chain.clear()
-            w.pipeline_enabled = False
-            w._engine = None
-            logger.warning(
-                "pipeline writer died; worker degraded to the sequential "
-                "loop"
-            )
+            w._disable_pipeline("pipeline writer died")
         jobs = self._pop_done()
         if any(j.status == "failed" for j in jobs):
             # Every not-yet-processed job drains to `done` as aborted

@@ -171,10 +171,13 @@ class SqlStore:
         that may use them; MySQL connections are not thread-safe either).
         In-memory sqlite cannot be cloned (a new connection sees a
         different empty database) nor shared across threads
-        (``check_same_thread``) — raises RuntimeError so the worker falls
-        back to the sequential loop instead of failing batches."""
+        (``check_same_thread``) — raises UncloneableStoreError so the
+        worker permanently falls back to the sequential loop instead of
+        failing batches (transient failures retry instead)."""
+        from analyzer_tpu.service.store import UncloneableStoreError
+
         if self._dialect == "sqlite" and self._sqlite_path is None:
-            raise RuntimeError(
+            raise UncloneableStoreError(
                 "in-memory sqlite store cannot be used by the pipelined "
                 "worker (no second connection can see it); use a "
                 "file-backed database or PIPELINE=false"

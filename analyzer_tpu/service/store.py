@@ -14,6 +14,14 @@ from __future__ import annotations
 from typing import Iterable
 
 
+class UncloneableStoreError(RuntimeError):
+    """The store can never provide a second connection (e.g. in-memory
+    sqlite — a new connection sees a different empty database). Raised by
+    ``clone()``; the worker treats it as a PERMANENT refusal and disables
+    pipelined mode for its lifetime, unlike transient construction
+    failures (DB blips), which retry with backoff."""
+
+
 class InMemoryStore:
     def __init__(self) -> None:
         self.matches: dict[str, object] = {}

@@ -75,6 +75,18 @@ class Worker:
             self.config.pipeline if pipeline is None else pipeline
         )
         self._engine = None
+        self._pipeline_requested = self.pipeline_enabled
+        # Transient engine-construction failures retry with backoff
+        # instead of permanently degrading the worker (ADVICE r4): a
+        # brief DB blip at the clone probe must not halve throughput
+        # until restart.
+        self._engine_retry_at: float | None = None
+        self._engine_backoff = 5.0
+        self.pipeline_engine_failures = 0
+        # Filled by warmup's probe when lag is auto (config.pipeline_lag
+        # None); PipelineEngine reads them through choose_pipeline_lag.
+        self.measured_rtt_s: float | None = None
+        self.measured_host_s: float | None = None
         # Pinned schedule width: auto-sizing per AMQP batch would give
         # every distinct (steps, width) shape a fresh XLA compile — a
         # latency spike the reference never had (its BATCHSIZE is fixed,
@@ -279,6 +291,65 @@ class Worker:
             "warmup compiled the %d-rung row ladder in %.1fs",
             len(ladder), self.clock() - t0,
         )
+        if self.pipeline_enabled and self.config.pipeline_lag is None:
+            try:
+                self._measure_pipeline_costs()
+            except Exception:  # noqa: BLE001 — optimization-only probe:
+                # a transient device error here must not kill startup;
+                # the engine falls back to DEFAULT_LAG.
+                logger.exception(
+                    "pipeline cost probe failed; lag falls back to the "
+                    "default"
+                )
+
+    def _measure_pipeline_costs(self) -> None:
+        """Feeds ``choose_pipeline_lag``: the dispatch->fetch round trip
+        of one production-sized packed-outputs chunk (the latency the
+        pipeline must hide; min of 3 after a compile rep) and the
+        per-batch host cost of encode + schedule + write_back on a
+        synthetic batch-size object graph (the work that hides it).
+        Store load/commit costs add to the host side in production,
+        which only LOWERS the ideal lag — an over-estimate costs broker
+        headroom and failure blast radius, not throughput, so the probe
+        deliberately errs high."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from analyzer_tpu.core.state import MAX_TEAM_SIZE
+
+        # One scan chunk's collect output: [chunk, width, 3 + 10T] f32 —
+        # a full 500-match batch of mostly-distinct players packs into
+        # about one such chunk (~108 KB at the defaults).
+        shape = (self._step_chunk, self._packed_width, 3 + 10 * MAX_TEAM_SIZE)
+        base = jnp.zeros(shape, jnp.float32)
+        base.block_until_ready()
+        rtt: float | None = None
+        for i in range(4):
+            t0 = self.clock()
+            np.asarray(base + jnp.float32(i))  # fresh array: no host cache
+            dt = self.clock() - t0
+            if i > 0:  # rep 0 pays the add's compile
+                rtt = dt if rtt is None else min(rtt, dt)
+        from analyzer_tpu.fixtures import synthetic_batch
+
+        matches = synthetic_batch(self.config.batch_size)
+        t0 = self.clock()
+        enc = EncodedBatch(matches, self.rating_config, bucket_rows=True)
+        sched = self._bucketed_schedule(enc.stream, enc.state.pad_row)
+        host = self.clock() - t0
+        _, outs = rate_history(
+            enc.state, sched, self.rating_config, collect=True,
+            steps_per_chunk=self._step_chunk,
+        )
+        t0 = self.clock()
+        enc.write_back(outs)
+        host += self.clock() - t0
+        self.measured_rtt_s = rtt
+        self.measured_host_s = host
+        logger.info(
+            "pipeline cost probe: rtt %.0f ms, host %.0f ms/batch",
+            (rtt or 0.0) * 1e3, host * 1e3,
+        )
 
     # -- batch pipeline ---------------------------------------------------
     def _bucketed_schedule(self, stream, pad_row: int):
@@ -323,24 +394,62 @@ class Worker:
             self._process_batch_sequential(batch)
 
     def _ensure_engine(self):
-        if self._engine is None:
-            from analyzer_tpu.service.pipeline import PipelineEngine
+        """Returns the pipelined engine, constructing it on first use, or
+        ``None`` when unavailable (caller runs the sequential loop). A
+        PERMANENT refusal (RuntimeError from the store's eager clone
+        probe — e.g. in-memory sqlite, ``sql_store.py:176``) disables
+        pipelined mode for the worker's lifetime; anything else (a
+        transient DB outage hitting the probe's connect) keeps pipelined
+        mode requested and retries construction after a backoff, so a
+        brief blip costs seconds of sequential throughput, not the rest
+        of the process. ``pipeline_degraded`` surfaces the state."""
+        if self._engine is not None:
+            return self._engine
+        if not self.pipeline_enabled:
+            return None
+        now = self.clock()
+        if self._engine_retry_at is not None and now < self._engine_retry_at:
+            return None
+        from analyzer_tpu.service.pipeline import PipelineEngine
+        from analyzer_tpu.service.store import UncloneableStoreError
 
-            try:
-                self._engine = PipelineEngine(
-                    self, lag=self.config.pipeline_lag
-                )
-            except Exception as err:  # noqa: BLE001 — uncloneable store,
-                # transient DB outage in the eager clone probe, ... —
-                # permanently degrade to the sequential loop (safe, and
-                # the sequential path owns the batch's failure policy).
-                logger.warning(
-                    "pipelined mode unavailable (%s); using the "
-                    "sequential loop", err
-                )
-                self.pipeline_enabled = False
-                raise
+        try:
+            self._engine = PipelineEngine(self, lag=self.config.pipeline_lag)
+        except UncloneableStoreError as err:
+            self._disable_pipeline(f"store refuses a second connection: {err}")
+            return None
+        except Exception as err:  # noqa: BLE001 — transient: retry later
+            self.pipeline_engine_failures += 1
+            self._engine_retry_at = now + self._engine_backoff
+            logger.warning(
+                "pipeline engine construction failed (%s); sequential "
+                "loop for ~%.0f s, then retrying", err, self._engine_backoff,
+            )
+            self._engine_backoff = min(self._engine_backoff * 2, 300.0)
+            return None
+        self._engine_retry_at = None
+        self._engine_backoff = 5.0
         return self._engine
+
+    def _disable_pipeline(self, reason: str) -> None:
+        """Permanently degrades the worker to the sequential loop (store
+        can never clone; writer died). Narrows the broker's QoS window
+        back to the reference's one-batch bound when the broker supports
+        it — the pipelined prefetch (lag+1 batches) would otherwise keep
+        hogging deliveries a sequential consumer can't keep up with,
+        starving healthy competing consumers on the same queue."""
+        self.pipeline_enabled = False
+        self._engine = None
+        logger.warning(
+            "pipelined mode disabled (%s); using the sequential loop",
+            reason,
+        )
+        set_prefetch = getattr(self.broker, "set_prefetch", None)
+        if set_prefetch is not None:
+            try:
+                set_prefetch(self.config.batch_size)
+            except Exception:  # noqa: BLE001 — QoS narrowing is best-effort
+                logger.exception("could not narrow broker prefetch")
 
     def drain(self) -> None:
         """Blocks until every in-flight pipelined batch has committed (or
@@ -359,12 +468,9 @@ class Worker:
     def _try_process_pipelined(self, batch) -> None:
         from analyzer_tpu.service.pipeline import PipelineFallback
 
-        try:
-            engine = self._ensure_engine()
-        except Exception:  # noqa: BLE001 — any engine-construction failure
-            # (uncloneable store, transient DB outage in the eager clone
-            # probe, ...) degrades to the sequential loop rather than
-            # killing the consume loop with the batch unacked.
+        engine = self._ensure_engine()
+        if engine is None:  # unavailable (permanent or inside the retry
+            # window): the sequential loop owns the batch's failure policy.
             self._process_batch_sequential(batch)
             return
         engine.harvest()  # apply whatever completed since the last flush
@@ -494,6 +600,19 @@ class Worker:
         dt = self.clock() - self._started_at
         return self.matches_rated / dt if dt > 0 else 0.0
 
+    @property
+    def pipeline_degraded(self) -> bool:
+        """True while a pipeline-configured worker is routing batches
+        through the sequential loop — a permanent clone refusal flipped
+        ``pipeline_enabled`` off, or a transient engine-construction
+        failure is inside its retry window. False before the first flush
+        (the engine is built lazily) and in sequential-by-config
+        workers. A metrics surface for the state ADVICE r4 flagged as
+        one-log-line-and-silent."""
+        return self._pipeline_requested and (
+            not self.pipeline_enabled or self._engine_retry_at is not None
+        )
+
 
 def requeue_failed(
     broker, config: "ServiceConfig",
@@ -554,9 +673,15 @@ def main(max_flushes: int | None = None) -> Worker:
     config = ServiceConfig.from_env()
     from analyzer_tpu.service.broker import make_pika_broker
 
-    # prefetch_count=BATCHSIZE bounds in-flight messages exactly like the
-    # reference (worker.py:91).
-    broker = make_pika_broker(config.rabbitmq_uri, prefetch=config.batch_size)
+    # Sequential mode: prefetch_count=BATCHSIZE bounds in-flight messages
+    # exactly like the reference (worker.py:91). Pipelined mode widens it
+    # to cover the in-flight window — the pipeline defers acks until a
+    # batch's commit is harvested, and a one-batch bound would make the
+    # broker withhold batch N+1 until batch N fully acked, serializing
+    # the loop back to sequential (ServiceConfig.prefetch_count).
+    broker = make_pika_broker(
+        config.rabbitmq_uri, prefetch=config.prefetch_count
+    )
     if config.database_uri:
         from analyzer_tpu.service.sql_store import SqlStore
 

@@ -1,64 +1,13 @@
 """Duck-typed fake ORM objects for parity tests.
 
-The reference's tests hand-build plain classes mirroring only the attributes
-``rate_match`` touches (``worker_test.py:6-63``) — no DB, no broker, no
-mocks. We keep that strategy (SURVEY.md section 4 calls it the single most
-important design fact to preserve) but build the fakes as SimpleNamespace
-factories covering the full 7-column rating schema, including the 5v5 pairs
-the reference's fixtures omit.
+The factories moved into the package (``analyzer_tpu/fixtures.py``) when
+the worker's warmup cost probe started encoding synthetic object graphs —
+one definition keeps production probe and parity tests from drifting.
+This module re-exports them so tests keep their historical import path.
 """
 
 from __future__ import annotations
 
-from types import SimpleNamespace
-
-from analyzer_tpu.core.constants import RATING_COLUMNS
-
-
-def fake_player(skill_tier=None, rank_points_ranked=None, rank_points_blitz=None,
-                **ratings):
-    attrs = {"api_id": "", "skill_tier": skill_tier,
-             "rank_points_ranked": rank_points_ranked,
-             "rank_points_blitz": rank_points_blitz}
-    for col in RATING_COLUMNS:
-        attrs[f"{col}_mu"] = None
-        attrs[f"{col}_sigma"] = None
-    attrs.update(ratings)
-    return SimpleNamespace(**attrs)
-
-
-def fake_items(**ratings):
-    attrs = {"api_id": "", "any_afk": False}
-    for col in RATING_COLUMNS[1:]:
-        attrs[f"{col}_mu"] = None
-        attrs[f"{col}_sigma"] = None
-    attrs.update(ratings)
-    return SimpleNamespace(**attrs)
-
-
-def fake_participant(player=None, items=None, skill_tier=0, went_afk=False):
-    return SimpleNamespace(
-        api_id="",
-        skill_tier=skill_tier,
-        went_afk=went_afk,
-        trueskill_mu=None,
-        trueskill_sigma=None,
-        trueskill_delta=None,
-        participant_items=[items if items is not None else fake_items()],
-        player=[player if player is not None else fake_player()],
-    )
-
-
-def fake_roster(winner, participants):
-    return SimpleNamespace(api_id="", winner=winner, participants=participants)
-
-
-def fake_match(game_mode, rosters, api_id=""):
-    return SimpleNamespace(
-        api_id=api_id,
-        game_mode=game_mode,
-        rosters=rosters,
-        participants=[p for r in rosters for p in r.participants],
-        trueskill_quality=None,
-        created_at=0,
-    )
+from analyzer_tpu.fixtures import (  # noqa: F401 — re-exports
+    fake_items, fake_match, fake_participant, fake_player, fake_roster,
+)

@@ -120,20 +120,27 @@ class TestEquivalence:
         assert run(False) == run(True)
 
     def test_uncloneable_store_degrades_to_sequential(self, tmp_path):
-        # A store whose clone() raises (e.g. in-memory sqlite — no second
-        # connection can see it) must fall the worker back to the
-        # sequential loop, not fail batches.
+        # A store whose clone() raises UncloneableStoreError (e.g.
+        # in-memory sqlite — no second connection can see it) must fall
+        # the worker back to the sequential loop PERMANENTLY, not fail
+        # batches (transient errors retry instead — see below).
+        from analyzer_tpu.service.store import UncloneableStoreError
+
         path = str(tmp_path / "seq.db")
         seed_db(path, n_matches=4)
         store = SqlStore(f"sqlite:///{path}")
         store.clone = lambda: (_ for _ in ()).throw(
-            RuntimeError("uncloneable")
+            UncloneableStoreError("uncloneable")
         )
         broker = InMemoryBroker()
         cfg = ServiceConfig(batch_size=2, idle_timeout=0.0)
         w = Worker(broker, store, cfg, RatingConfig(), pipeline=True)
         consume_all(w, broker, cfg, [f"m{i}" for i in range(4)])
         assert w.pipeline_enabled is False
+        assert w.pipeline_degraded is True
+        # QoS narrowed back to the reference's one-batch bound — the
+        # pipelined prefetch would starve competing consumers.
+        assert broker.prefetch == cfg.batch_size
         assert broker.qsize(cfg.failed_queue) == 0
         assert not broker._unacked
 
@@ -147,6 +154,48 @@ class TestEquivalence:
         store._sqlite_path = None  # what sqlite:// sets (_connect)
         with pytest.raises(RuntimeError, match="in-memory"):
             store.clone()
+
+    def test_transient_clone_failure_retries(self, tmp_path):
+        # A TRANSIENT failure at the engine's eager clone probe (a DB
+        # blip, not an uncloneable store) must not permanently degrade
+        # the worker: this batch runs sequentially, pipelined mode stays
+        # requested, and construction retries after a backoff (ADVICE
+        # r4: a brief outage was halving throughput until restart).
+        path = str(tmp_path / "transient.db")
+        seed_db(path, n_matches=12)
+        store = SqlStore(f"sqlite:///{path}")
+        real_clone = store.clone
+        fails = {"n": 1}
+
+        def clone():
+            if fails["n"]:
+                fails["n"] -= 1
+                raise OSError("transient DB outage")
+            return real_clone()
+
+        store.clone = clone
+        broker = InMemoryBroker()
+        t = [0.0]
+        cfg = ServiceConfig(batch_size=4, idle_timeout=0.0)
+        w = Worker(broker, store, cfg, RatingConfig(),
+                   clock=lambda: t[0], pipeline=True)
+        for i in range(12):
+            broker.publish(cfg.queue, f"m{i}".encode())
+        assert w.poll()  # flush 1: probe fails -> sequential fallback
+        assert w.pipeline_enabled is True  # NOT permanently disabled
+        assert w.pipeline_degraded is True
+        assert w.pipeline_engine_failures == 1
+        assert w._engine is None
+        assert w.poll()  # flush 2: inside the backoff window -> sequential
+        assert w._engine is None
+        t[0] = 10.0  # past the 5 s backoff
+        assert w.poll()  # flush 3: retry succeeds -> pipelined
+        assert w._engine is not None
+        assert w.pipeline_degraded is False
+        w.drain()
+        w.close()
+        assert broker.qsize(cfg.failed_queue) == 0
+        assert not broker._unacked
 
 
 class FlakyStore:

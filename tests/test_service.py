@@ -559,7 +559,37 @@ class TestPipelineConfig:
         assert ServiceConfig.from_env({}).pipeline is True
         assert ServiceConfig.from_env({"PIPELINE": "false"}).pipeline is False
         assert ServiceConfig.from_env({"PIPELINE_LAG": "3"}).pipeline_lag == 3
-        assert ServiceConfig.from_env({}).pipeline_lag == 6
+        # Unset = auto-tune at warmup (choose_pipeline_lag).
+        assert ServiceConfig.from_env({}).pipeline_lag is None
+
+    def test_prefetch_covers_the_inflight_window(self):
+        # Sequential mode: the reference's one-batch bound (worker.py:91).
+        assert ServiceConfig(batch_size=500).prefetch_count == 500
+        # Pipelined with a pinned lag: lag+1 batches can be legitimately
+        # unacked at once (acks defer to harvest) — a one-batch bound
+        # would serialize the loop back to sequential (ADVICE r4).
+        cfg = ServiceConfig(batch_size=500, pipeline=True, pipeline_lag=6)
+        assert cfg.prefetch_count == 500 * 7
+        # Auto lag sizes prefetch for the clamp ceiling.
+        from analyzer_tpu.config import PIPELINE_MAX_LAG
+
+        cfg = ServiceConfig(batch_size=500, pipeline=True)
+        assert cfg.prefetch_count == 500 * (PIPELINE_MAX_LAG + 1)
+
+    def test_choose_pipeline_lag(self):
+        from analyzer_tpu.config import PIPELINE_MAX_LAG, PIPELINE_MIN_LAG
+        from analyzer_tpu.service.pipeline import choose_pipeline_lag
+
+        # The tunneled dev rig's measured shape (~200 ms RTT, ~45 ms of
+        # host work per batch): ceil(200/45)+1 = 6 — the round-4 A/B
+        # winner falls out of the formula.
+        assert choose_pipeline_lag(0.200, 0.045) == 6
+        # A real TPU host (~1 ms dispatch) wants the floor, not 6.
+        assert choose_pipeline_lag(0.001, 0.045) == PIPELINE_MIN_LAG
+        # Host work dominating -> floor; RTT dominating -> ceiling.
+        assert choose_pipeline_lag(0.010, 0.600) == PIPELINE_MIN_LAG
+        assert choose_pipeline_lag(2.0, 0.010) == PIPELINE_MAX_LAG
+        assert choose_pipeline_lag(1.0, 0.0) == PIPELINE_MAX_LAG
 
     def test_worker_follows_config(self):
         broker = InMemoryBroker()
