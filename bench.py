@@ -8,11 +8,17 @@ Prints ONE JSON line:
 dev chip (whose latency drifts 1.5-4x between minutes): matmul link
 probes on BOTH sides of the timed window, every repeat's wall time, the
 >3x-stall drop count, spread and min/median of the survivors, and a
-``degraded`` flag (both probes > 160 ms, or the trailing repeats never
-converged — _tail_stable). Repeats EXTEND adaptively (up to 3x) while
-the tail hasn't converged, so min-of-N gets a chance to span a quiet
-window; if it never does the artifact says so instead of silently
-underreporting the chip (the round-3 -> round-2 artifact regression).
+``degraded`` flag with machine-readable reasons — both probes > 160 ms,
+the trailing repeats never converged (_tail_stable), or the min repeat
+sits >20% above the CALIBRATED COST-MODEL PREDICTION of quiet device
+time (predict_device_time; catches a uniformly slow link whose repeats
+converge tightly and whose probes read quiet, the round-4 artifact's
+failure mode). Repeats EXTEND adaptively (up to 3x) while the tail
+hasn't converged, so min-of-N gets a chance to span a quiet window; if
+it never does the artifact says so instead of silently underreporting
+the chip. The ``streamed`` block gives the rate_stream end-to-end line
+the same treatment: full repeat list, spread, and min/device ratio, so
+the streamed-feed distribution is recorded instead of a single sample.
 
 ``vs_baseline`` is measured throughput / the north-star target rate from
 BASELINE.json (~10M matches in <5 min on a v5e-8 = 33.3k matches/s pod
@@ -57,6 +63,36 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 # North-star: 10M matches / 300 s / 8 chips (BASELINE.json, BASELINE.md).
 BASELINE_MATCHES_PER_SEC_PER_CHIP = 10_000_000 / 300.0 / 8.0
+
+# The scheduler's batch-sizing cost model (sched.choose_batch_size:
+# steps x (STEP_FIXED_COST_S + B x MATCH_SLOT_COST_S)) predicts RELATIVE
+# schedule cost; as an ABSOLUTE device-time predictor it sits a uniform
+# ~1.45x below quiet-tunnel reality on the current kernel (two anchors,
+# BASELINE.md round 4: 500k defaults predict 0.372 s vs 0.55-0.60 s
+# measured quiet; north-star 10M/1.5M predicts 7.39 s vs 10.35-10.92 s —
+# ratios 1.40-1.48 at both scales). Calibrated, the prediction lands
+# within ~5% of every recorded quiet capture, which makes it the anchor
+# the round-4 verdict asked for: a capture whose min repeat exceeds the
+# prediction by >20% is degraded NO MATTER how stable the repeats look —
+# the exact failure mode of BENCH_r04.json (739,890 with converged
+# repeats on a uniformly slow link, 19% under the same-session quiet
+# headline, marked clean by the probe/spread checks alone).
+DEVICE_TIME_CALIBRATION = 1.45
+DEGRADED_ABOVE_PREDICTION = 1.20
+
+
+def predict_device_time(n_steps: int, batch_size: int) -> float:
+    """Calibrated quiet-tunnel device-time prediction for a packed
+    schedule (seconds)."""
+    from analyzer_tpu.sched.superstep import (
+        MATCH_SLOT_COST_S, STEP_FIXED_COST_S,
+    )
+
+    return (
+        n_steps
+        * (STEP_FIXED_COST_S + batch_size * MATCH_SLOT_COST_S)
+        * DEVICE_TIME_CALIBRATION
+    )
 
 
 def log(msg: str) -> None:
@@ -135,8 +171,10 @@ def main() -> None:
         np.asarray(state.table[:1])
         return state
 
+    predicted = predict_device_time(sched.n_steps, sched.batch_size)
     probe_ms = probe_tunnel()
-    log(f"tunnel probe: {probe_ms:.0f} ms (quiet reference ~90-120)")
+    log(f"tunnel probe: {probe_ms:.0f} ms (quiet reference ~90-120); "
+        f"cost model predicts {predicted:.3f}s quiet device time")
     state, best, times, stable = time_runs(run, repeats, max_extra=2 * repeats)
     rate = sched.n_matches / best
 
@@ -162,7 +200,10 @@ def main() -> None:
     # Fully-streamed: the first-fit ASSIGNMENT also overlaps the scan
     # (worker thread + watermark, sched/runner.py rate_stream). This is
     # the true end-to-end from a raw stream: includes choose_batch_size,
-    # assignment, packing, transfers, and the scan.
+    # assignment, packing, transfers, and the scan. Captured with the
+    # SAME repeat protocol as the device metric (round-4 verdict weak
+    # #5: the streamed ratio swung 0.80-1.51x across rounds on single
+    # samples with nothing recording the distribution).
     from analyzer_tpu.sched import rate_stream
 
     def run_stream():
@@ -170,15 +211,22 @@ def main() -> None:
         np.asarray(s_state.table[:1])
         return s_state
 
-    _, t_stream, _, _ = time_runs(run_stream, 2)
+    _, t_stream, s_times, s_stable = time_runs(
+        run_stream, repeats, max_extra=repeats
+    )
     log(f"end-to-end rate_stream (assignment overlapped too): {t_stream:.2f}s "
         f"= {t_stream / best:.2f}x device-only time")
+    streamed = streamed_stats(s_times, s_stable, best)
 
     sanity(state, state0.n_players)
 
     probe_after = probe_tunnel()
     log(f"tunnel probe after: {probe_after:.0f} ms")
-    emit_metric(rate, capture_stats(times, (probe_ms, probe_after), stable))
+    emit_metric(
+        rate,
+        capture_stats(times, (probe_ms, probe_after), stable, predicted),
+        streamed,
+    )
 
 
 def probe_tunnel() -> float:
@@ -221,30 +269,53 @@ def _tail_stable(times: list, repeats: int) -> bool:
             and min(tail) <= 1.1 * lo)
 
 
-def capture_stats(times: list, probes_ms: tuple, stable: bool) -> dict:
+def capture_stats(times: list, probes_ms: tuple, stable: bool,
+                  predicted_s: float | None = None) -> dict:
     """Self-describing capture quality: repeats with >3x-the-min samples
     dropped as tunnel stalls (the BASELINE.md A/B protocol, promoted into
     the artifact), spread and min/median of the survivors, link probes
-    from BOTH sides of the timed window, and a DEGRADED flag when the
-    link or the capture was visibly unstable — so a BENCH_rNN.json that
-    underreports carries its own explanation (the round-3 verdict's weak
-    #1: r03 recorded 24% below r02 with nothing in the artifact marking
-    the capture as bad)."""
+    from BOTH sides of the timed window, and a DEGRADED flag with
+    machine-readable reasons when the link or the capture was visibly
+    unstable — so a BENCH_rNN.json that underreports carries its own
+    explanation (the round-3 verdict's weak #1: r03 recorded 24% below
+    r02 with nothing in the artifact marking the capture as bad).
+
+    ``predicted_s`` anchors the flag to the calibrated cost model
+    (:func:`predict_device_time`): a min repeat >20% above the predicted
+    quiet device time is degraded even when the repeats converge tightly
+    and the probes read quiet — a UNIFORMLY slow link produces exactly
+    that signature (round-4 verdict weak #1: BENCH_r04 marked a
+    19%-degraded capture clean)."""
     lo = min(times)
     clean = [t for t in times if t <= 3 * lo]
     spread = max(clean) / lo
     med = sorted(clean)[len(clean) // 2]
-    return {
+    reasons = []
+    if min(probes_ms) > 160:
+        reasons.append("link_probe_slow_both_sides")
+    if not stable:
+        reasons.append("repeats_never_converged")
+    if (
+        predicted_s is not None
+        and lo > DEGRADED_ABOVE_PREDICTION * predicted_s
+    ):
+        reasons.append(
+            f"min_{lo / predicted_s:.2f}x_cost_model_prediction"
+        )
+    out = {
         "probe_ms_before": round(probes_ms[0], 1),
         "probe_ms_after": round(probes_ms[1], 1),
         "repeats_s": [round(t, 3) for t in times],
         "stalls_dropped": len(times) - len(clean),
         "spread": round(spread, 3),
         "min_over_median": round(lo / med, 3),
-        # The link was bad on BOTH sides of the window, or the repeats
-        # never converged (the same verdict time_runs stopped on).
-        "degraded": bool(min(probes_ms) > 160 or not stable),
+        "degraded": bool(reasons),
+        "degraded_reasons": reasons,
     }
+    if predicted_s is not None:
+        out["cost_model_predicted_s"] = round(predicted_s, 3)
+        out["min_over_predicted"] = round(lo / predicted_s, 3)
+    return out
 
 
 def time_runs(run, repeats, max_extra: int = 0):
@@ -286,7 +357,25 @@ def sanity(state, n_players, extra=""):
     assert np.isfinite(mu[rated, 0]).all()
 
 
-def emit_metric(rate, capture: dict | None = None):
+def streamed_stats(times: list, stable: bool, device_best: float) -> dict:
+    """The streamed-feed line's own mini-capture: full repeat list,
+    stall-dropped spread, and the min's ratio to the device-only best —
+    the artifact now records the streamed DISTRIBUTION instead of a
+    single sample (the 0.80x-1.51x round-to-round swing)."""
+    lo = min(times)
+    clean = [t for t in times if t <= 3 * lo]
+    return {
+        "repeats_s": [round(t, 3) for t in times],
+        "min_s": round(lo, 3),
+        "stalls_dropped": len(times) - len(clean),
+        "spread": round(max(clean) / lo, 3),
+        "stable": stable,
+        "min_over_device": round(lo / device_best, 3),
+    }
+
+
+def emit_metric(rate, capture: dict | None = None,
+                streamed: dict | None = None):
     line = {
         "metric": "matches_per_sec_per_chip",
         "value": round(rate, 1),
@@ -298,6 +387,8 @@ def emit_metric(rate, capture: dict | None = None):
         # tunnel window is marked IN the artifact instead of silently
         # underreporting the chip.
         line["capture"] = capture
+    if streamed is not None:
+        line["streamed"] = streamed
     print(json.dumps(line))
 
 
@@ -348,9 +439,10 @@ def bench_mesh(n_mesh, stream, state0, cfg, batch, repeats, t_gen):
         np.asarray(s_state.table[:1])
         return s_state
 
-    _, t_stream, _, _ = time_runs(run_stream, 2)
+    _, t_stream, s_times, s_stable = time_runs(run_stream, 2)
     log(f"end-to-end rate_stream(mesh): {t_stream:.2f}s "
         f"= {t_stream / best:.2f}x windowed-feed time")
+    streamed = streamed_stats(s_times, s_stable, best)
 
     if stream.n_matches <= 2_000_000:
         # Eager control: whole-schedule tensors + precomputed routing, so
@@ -374,7 +466,12 @@ def bench_mesh(n_mesh, stream, state0, cfg, batch, repeats, t_gen):
     sanity(state, state0.n_players, extra=f" over {n_mesh} chips")
     probe_after = probe_tunnel()
     log(f"tunnel probe after: {probe_after:.0f} ms")
-    emit_metric(rate, capture_stats(times, (probe_ms, probe_after), stable))
+    # No cost-model anchor on the mesh path: the sharded runner's
+    # single-chip constant (feed logistics, BASELINE.md round 4) sits
+    # outside the plain-scan calibration.
+    emit_metric(
+        rate, capture_stats(times, (probe_ms, probe_after), stable), streamed
+    )
 
 
 if __name__ == "__main__":
