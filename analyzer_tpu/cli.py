@@ -85,11 +85,18 @@ def cmd_synth(args) -> int:
         synthetic_telemetry,
     )
 
+    if args.synergy and not args.out.endswith(".npz"):
+        # Archetypes ride only in the npz block; a synergy-driven stream
+        # whose composition channel can't be saved would silently train
+        # heads against unexplainable outcomes.
+        print("error: --synergy requires an .npz output", file=sys.stderr)
+        return 2
     players = synthetic_players(args.players, seed=args.seed)
     stream = synthetic_stream(
         args.matches, players, seed=args.seed,
         activity_concentration=args.concentration,
         max_activity_share=args.max_share or None,
+        synergy_strength=args.synergy,
     )
     telemetry = None
     if args.telemetry:
@@ -104,7 +111,13 @@ def cmd_synth(args) -> int:
 
         write_history_db(args.out, stream, players)
     else:
-        save_stream(args.out, stream, telemetry=telemetry)
+        save_stream(
+            args.out, stream, telemetry=telemetry,
+            # npz streams always carry the composition channel so a
+            # synergy=0 control trains with the SAME feature set as the
+            # synergy run — a clean signal-vs-no-signal comparison.
+            archetype=players.archetype if args.out.endswith(".npz") else None,
+        )
     print(
         f"wrote {stream.n_matches} matches / {args.players} players to "
         f"{args.out}" + (" (+telemetry)" if telemetry is not None else "")
@@ -582,6 +595,24 @@ def cmd_train(args) -> int:
     with timer.phase("features"):
         sched = pack_schedule(stream, pad_row=state.pad_row, windowed=True)
         feats, ratable, _ = history_features(state, sched, cfg)
+        composition = False
+        if args.csv:
+            # PRE-MATCH composition features (teammate archetype-pair
+            # count differences) when the stream carries the archetype
+            # block — the channel through which a learned head can beat
+            # the rating-only baseline (synth --synergy; with synergy 0
+            # these columns are outcome-independent and the heads tie
+            # the baseline, the correct control).
+            from analyzer_tpu.io.csv_codec import load_archetypes
+            from analyzer_tpu.models.features import composition_features
+
+            arch = load_archetypes(args.csv)
+            if arch is not None:
+                feats = np.concatenate(
+                    [feats, composition_features(arch, stream.player_idx)],
+                    axis=1,
+                )
+                composition = True
         if args.telemetry:
             # Config 4's full-telemetry head: POST-GAME stats, so this
             # trains an analysis model (outcome from game stats), not a
@@ -680,6 +711,7 @@ def cmd_train(args) -> int:
             {
                 "model": args.model,
                 "matches": stream.n_matches,
+                "composition_features": composition,
                 "trained_on": int(fit.size),
                 "calibrated_on": int(cal.size) if cal is not fit else 0,
                 "eval_on": int(ev.size),
@@ -769,6 +801,14 @@ def main(argv=None) -> int:
         "--telemetry", action="store_true",
         help="also generate post-game telemetry (K/D/A, gold, cs) for the "
         "config-4 analysis head (.npz only)",
+    )
+    s.add_argument(
+        "--synergy", type=float, default=0.0, metavar="STRENGTH",
+        help="composition-dependent outcome term: teams gain "
+        "STRENGTH*400 skill points per unit of mean archetype-pair "
+        "synergy (io/synthetic.py synergy_matrix) — signal a per-player "
+        "rating system cannot represent, so learned heads with "
+        "composition features can beat the rating baseline (.npz only)",
     )
     s.set_defaults(fn=cmd_synth)
 

@@ -40,20 +40,25 @@ def save_stream_csv(path: str, stream: MatchStream) -> None:
 
 
 def save_stream_npz(
-    path: str, stream: MatchStream, telemetry: np.ndarray | None = None
+    path: str, stream: MatchStream, telemetry: np.ndarray | None = None,
+    archetype: np.ndarray | None = None,
 ) -> None:
     """Binary stream format — the bulk-interchange fast path. A 10M-match
     history is ~3 min each way as CSV text; as npz it is seconds. Same
     chronological-order contract as the CSV. ``telemetry`` optionally
     rides along (``[N, 2, T, 6]`` post-game stats, io/synthetic.py) for
     the config-4 analysis head — npz only, the CSV schema has no column
-    for it."""
+    for it. ``archetype`` (``[P]`` int32 playstyle buckets, a PRE-match
+    observable) likewise rides along for the composition features of the
+    forecasting heads (models/features.py composition_features)."""
     arrays = dict(
         player_idx=stream.player_idx,
         winner=stream.winner,
         mode_id=stream.mode_id,
         afk=stream.afk,
     )
+    if archetype is not None:
+        arrays["archetype"] = np.asarray(archetype, np.int32)
     if telemetry is not None:
         from analyzer_tpu.io.synthetic import TELEMETRY_STATS
 
@@ -86,12 +91,22 @@ def load_telemetry(path: str) -> np.ndarray | None:
         return z["telemetry"] if "telemetry" in z else None
 
 
+def load_archetypes(path: str) -> np.ndarray | None:
+    """The per-player archetype block of an ``.npz`` stream, or None
+    (absent / CSV stream)."""
+    if not path.endswith(".npz"):
+        return None
+    with np.load(path) as z:
+        return z["archetype"] if "archetype" in z else None
+
+
 def save_stream(
-    path: str, stream: MatchStream, telemetry: np.ndarray | None = None
+    path: str, stream: MatchStream, telemetry: np.ndarray | None = None,
+    archetype: np.ndarray | None = None,
 ) -> None:
     """Extension-dispatched save: ``.npz`` binary, anything else CSV."""
     if path.endswith(".npz"):
-        save_stream_npz(path, stream, telemetry)
+        save_stream_npz(path, stream, telemetry, archetype)
     elif telemetry is not None:
         raise ValueError("telemetry requires the .npz stream format")
     else:

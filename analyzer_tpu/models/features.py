@@ -115,6 +115,35 @@ def telemetry_features(telemetry, player_idx) -> "np.ndarray":
     ).astype(np.float32)
 
 
+def composition_features(archetype, player_idx) -> "np.ndarray":
+    """``[N, A*(A+1)/2]`` PRE-MATCH composition features: the difference
+    (team0 - team1) of unordered teammate-archetype-PAIR counts.
+
+    A team's synergy under any symmetric pairwise model is
+    ``sum_{i<j} S[a_i, a_j]`` — a LINEAR function of these pair counts,
+    so even the logistic head can represent the generator's hidden
+    synergy matrix exactly (io/synthetic.py synergy_matrix) and recover
+    it from outcomes. Archetypes are static player attributes (playstyle
+    buckets, known before the match like a draft), so these features are
+    leak-free forecasting inputs, unlike ``telemetry_features``.
+    Diagonal entries count same-archetype pairs C(c_a, 2)."""
+    import numpy as np
+
+    from analyzer_tpu.io.synthetic import N_ARCHETYPES
+
+    arch = np.asarray(archetype, np.int64)
+    if arch.ndim != 1:
+        raise ValueError(f"archetype must be [P], got shape {arch.shape}")
+    mask = player_idx >= 0
+    a = np.where(mask, arch[np.clip(player_idx, 0, None)], -1)  # [N,2,T]
+    counts = (a[..., None] == np.arange(N_ARCHETYPES)).sum(axis=2)  # [N,2,A]
+    iu, ju = np.triu_indices(N_ARCHETYPES)
+    ci = counts[:, :, iu]
+    cj = counts[:, :, ju]
+    pairs = np.where(iu == ju, ci * (ci - 1) // 2, ci * cj)  # [N,2,#pairs]
+    return (pairs[:, 0] - pairs[:, 1]).astype(np.float32)
+
+
 def history_features(state, sched, cfg: RatingConfig, steps_per_chunk: int = 8192):
     """Leak-free training data for the win-prob heads: one scan over the
     packed schedule that computes each match's features from the PRE-match

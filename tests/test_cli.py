@@ -158,6 +158,28 @@ class TestCli:
                    "--epochs", "15", "--hidden", "16")
         stats = json.loads(line)
         assert stats["model"] == "mlp" and stats["eval_logloss"] < 0.8
+        # CSV streams carry no archetype block -> no composition features.
+        assert stats["composition_features"] is False
+
+    def test_synth_synergy_npz_trains_with_composition(self, tmp_path, capsys):
+        # synth --synergy writes the archetype block; train auto-appends
+        # the pre-match composition features and says so in its output.
+        npz = str(tmp_path / "syn.npz")
+        run(capsys, "synth", "--matches", "400", "--players", "60",
+            "--synergy", "2.0", "--out", npz)
+        from analyzer_tpu.io.csv_codec import load_archetypes
+
+        arch = load_archetypes(npz)
+        assert arch is not None and arch.shape == (60,)
+        line = run(capsys, "train", "--csv", npz, "--model", "logistic",
+                   "--epochs", "5")
+        assert json.loads(line)["composition_features"] is True
+
+    def test_synth_synergy_requires_npz(self, tmp_path, capsys):
+        rc = main(["synth", "--matches", "10", "--players", "6",
+                   "--synergy", "1.0", "--out", str(tmp_path / "x.csv")])
+        assert rc == 2
+        assert "npz" in capsys.readouterr().err
 
     def test_elo_exact_ties_score_half(self, tmp_path, capsys):
         # Disjoint fresh players: every Elo prediction is exactly 0.5.

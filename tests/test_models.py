@@ -134,6 +134,89 @@ class TestFeaturesAndHeads:
         assert acc > 0.6, acc
 
 
+class TestSynergy:
+    """The composition channel (synth --synergy): outcome signal a
+    per-player rating system cannot represent, and the pre-match
+    composition features that let the heads recover it."""
+
+    def test_synergy_zero_is_backward_identical(self):
+        players = synthetic_players(200, seed=3)
+        a = synthetic_stream(800, players, seed=3)
+        b = synthetic_stream(800, players, seed=3, synergy_strength=0.0)
+        np.testing.assert_array_equal(a.player_idx, b.player_idx)
+        np.testing.assert_array_equal(a.winner, b.winner)
+        np.testing.assert_array_equal(a.mode_id, b.mode_id)
+        np.testing.assert_array_equal(a.afk, b.afk)
+
+    def test_composition_features_represent_pair_synergy_exactly(self):
+        # A linear model over the pair-count features can express the
+        # generator's hidden synergy term EXACTLY: features @ vec(S)
+        # equals the summed pair-synergy difference the outcome draw
+        # used. This is the design property that gives even the logistic
+        # head the capacity to recover S from outcomes.
+        from analyzer_tpu.io.synthetic import (
+            N_ARCHETYPES, _team_synergy, synergy_matrix,
+        )
+        from analyzer_tpu.models.features import composition_features
+
+        players = synthetic_players(100, seed=5)
+        stream = synthetic_stream(500, players, seed=5, synergy_strength=1.0)
+        s = synergy_matrix(5)
+        feats = composition_features(players.archetype, stream.player_idx)
+        iu, ju = np.triu_indices(N_ARCHETYPES)
+        lin = feats @ s[iu, ju]
+        syn = _team_synergy(players.archetype, stream.player_idx, 5)
+        mask = stream.player_idx >= 0
+        cnt = mask.sum(axis=2)
+        n_pairs = cnt * (cnt - 1) // 2
+        expect = syn[:, 0] * n_pairs[:, 0] - syn[:, 1] * n_pairs[:, 1]
+        np.testing.assert_allclose(lin, expect, rtol=1e-5, atol=1e-6)
+
+    def test_head_beats_rating_baseline_iff_synergy_on(self):
+        # The round-4 verdict's missing testbed: with synergy OFF the
+        # outcomes are drawn from latent skill alone, the closed-form
+        # rating baseline is (near-)Bayes-optimal, and the head can only
+        # tie it; with synergy ON the baseline cannot see composition
+        # and the head must WIN on the chronological holdout.
+        from analyzer_tpu.models.features import composition_features
+
+        def margin(strength):
+            players = synthetic_players(400, seed=11)
+            stream = synthetic_stream(
+                6000, players, seed=11, afk_rate=0.0,
+                unsupported_rate=0.0, synergy_strength=strength,
+            )
+            state = PlayerState.create(
+                400,
+                rank_points_ranked=players.rank_points_ranked,
+                rank_points_blitz=players.rank_points_blitz,
+                skill_tier=players.skill_tier,
+            )
+            sched = pack_schedule(stream, pad_row=state.pad_row, windowed=True)
+            feats, ratable, _ = history_features(state, sched, CFG)
+            x = np.concatenate(
+                [feats, composition_features(players.archetype, stream.player_idx)],
+                axis=1,
+            )
+            y = (stream.winner == 0).astype(np.float32)
+            rows = np.flatnonzero(ratable)
+            cut = int(rows.size * 0.8)
+            tr, ev = rows[:cut], rows[cut:]
+            eps = 1e-7
+
+            def ll(p, yy):
+                return float(
+                    -np.mean(yy * np.log(p + eps) + (1 - yy) * np.log(1 - p + eps))
+                )
+
+            model, _ = train_logistic(x[tr], y[tr], epochs=60, seed=0)
+            p = 1.0 / (1.0 + np.exp(-np.asarray(model.logits(x[ev]))))
+            return ll(feats[ev, 2].astype(np.float64), y[ev]) - ll(p, y[ev])
+
+        assert margin(2.0) > 0.008  # head beats the baseline (measured +0.0195)
+        assert margin(0.0) > -0.008  # control: at worst a tie (measured -0.003)
+
+
 class TestTelemetryHead:
     """BASELINE config 4's "full telemetry" analysis head: post-game
     K/D/A, gold, cs features must carry much more signal about the
