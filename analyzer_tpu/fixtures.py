@@ -70,6 +70,61 @@ def fake_match(game_mode, rosters, api_id=""):
     )
 
 
+def synthetic_raw_batch(n: int, team_size: int = 3,
+                        game_mode: str = "ranked") -> dict:
+    """``n`` well-formed two-team matches as a ``load_batch_raw``-shaped
+    raw row bundle (the columnar lane's input) — the warmup cost probe's
+    counterpart of :func:`synthetic_batch` for stores that will run the
+    columnar lane in production. Fresh tier-15 players, full 7-pair
+    rating schema, one items row per participant."""
+    pl_rating = [f"{c}_{x}" for c in RATING_COLUMNS for x in ("mu", "sigma")]
+    it_rating = [
+        f"{c}_{x}" for c in RATING_COLUMNS[1:] for x in ("mu", "sigma")
+    ]
+    match_rows, roster_rows, part_rows = [], [], []
+    player_rows, items_rows = [], []
+    for m in range(n):
+        mid = f"warm_m{m}"
+        match_rows.append((mid, game_mode, m))
+        for t in range(2):
+            rid = f"{mid}-r{t}"
+            roster_rows.append((rid, mid, int(t == 0)))
+            for s in range(team_size):
+                pid = f"warm_{m}_{t}_{s}"
+                paid = f"{mid}-{t}-{s}"
+                part_rows.append((paid, mid, rid, pid, 15, 0))
+                player_rows.append(
+                    (pid, None, None, 15) + (None,) * len(pl_rating)
+                )
+                items_rows.append(
+                    (paid + "-it", paid, 0) + (None,) * len(it_rating)
+                )
+    player_cols = [
+        "api_id", "rank_points_ranked", "rank_points_blitz", "skill_tier",
+    ] + pl_rating
+    items_cols = ["api_id", "participant_api_id", "any_afk"] + it_rating
+    return {
+        "match_rows": match_rows,
+        "roster_rows": roster_rows,
+        "part_rows": part_rows,
+        "player_cols": player_cols,
+        "player_rows": player_rows,
+        "items_cols": items_cols,
+        "items_rows": items_rows,
+        "schema_rating_cols": {
+            "player": pl_rating, "participant_items": it_rating,
+        },
+        "schema_columns": {
+            "match": {"api_id", "game_mode", "created_at",
+                      "trueskill_quality"},
+            "participant": {"api_id", "trueskill_mu", "trueskill_sigma",
+                            "trueskill_delta"},
+            "player": set(player_cols),
+            "participant_items": set(items_cols),
+        },
+    }
+
+
 def synthetic_batch(n: int, team_size: int = 3, game_mode: str = "ranked",
                     id_prefix: str = "warm") -> list:
     """``n`` well-formed two-team matches of fresh tier-15 players, every

@@ -267,12 +267,10 @@ class _Writer(threading.Thread):
                 job.status = "aborted"
             else:
                 try:
+                    from analyzer_tpu.service.columnar import finalize
+
                     outs = job.fetch.result()
-                    if outs is not None:
-                        job.enc.write_back(outs)
-                    commit = getattr(self.store, "commit", None)
-                    if commit is not None and job.enc.matches:
-                        commit(job.enc.matches)
+                    finalize(self.store, job.enc, outs)
                     job.status = "ok"
                 except BaseException as err:  # noqa: BLE001 — policy boundary
                     job.status = "failed"
@@ -349,7 +347,7 @@ class PipelineEngine:
         (harvest must apply the failure policy first), or lets a
         PoisonError propagate after the drained retry (the worker's
         isolation path takes over)."""
-        from analyzer_tpu.service.encode import EncodedBatch, PoisonError
+        from analyzer_tpu.service.encode import PoisonError
 
         w = self.worker
         # Gate: the store snapshot below must include every commit up to
@@ -357,20 +355,19 @@ class PipelineEngine:
         if not self.writer.wait_left(self.seq - self.lag):
             raise PipelineFallback("pipeline poisoned; harvest first")
         ids = [m.body.decode() for m in msgs]
-        matches = self._load_fresh(ids)
-        logger.info("processing batch of %s matches (pipelined)", len(matches))
-        if not matches:
-            self._enqueue(msgs, _EmptyBatch(), _done_future(None))
-            return
         try:
-            enc = EncodedBatch(matches, w.rating_config, bucket_rows=True)
+            enc = self._encode_fresh(ids)
         except PoisonError:
             # The stale snapshot can mis-decide the reference's
             # seed-consulted KeyError gate (module docstring); retry once
             # from fully committed state before isolating.
             self.drain()
-            matches = self._load_fresh(ids)
-            enc = EncodedBatch(matches, w.rating_config, bucket_rows=True)
+            enc = self._encode_fresh(ids)
+        n = len(enc.matches) if enc is not None else 0
+        logger.info("processing batch of %s matches (pipelined)", n)
+        if not n:
+            self._enqueue(msgs, _EmptyBatch(), _done_future(None))
+            return
         sched = w._bucketed_schedule(enc.stream, enc.state.pad_row)
 
         state = enc.state
@@ -417,20 +414,24 @@ class PipelineEngine:
             self.chain.append((enc.row_of, rows, final.table))
         self._enqueue(msgs, enc, fetch)
 
-    def _load_fresh(self, ids: list) -> list:
-        """``load_batch`` + read-snapshot release. The consumer connection
-        never commits in pipelined mode (the writer's clone does), so on
-        MySQL a REPEATABLE READ snapshot pinned at the first SELECT would
-        make every later load stale beyond the chain's ``lag`` window —
-        the gate invariant requires each load to see commits up to
-        ``seq - lag``. Rolling back after the objects are materialized
+    def _encode_fresh(self, ids: list):
+        """Load + encode (``Worker._encode_batch``, either lane) with the
+        read-snapshot release. The consumer connection never commits in
+        pipelined mode (the writer's clone does), so on MySQL a
+        REPEATABLE READ snapshot pinned at the first SELECT would make
+        every later load stale beyond the chain's ``lag`` window — the
+        gate invariant requires each load to see commits up to
+        ``seq - lag``. Rolling back after the rows are materialized
         forces the NEXT load to open a fresh snapshot (the same move
-        ``asset_urls`` / ``_dead_letter`` make; no-op on sqlite)."""
-        matches = self.worker.store.load_batch(ids)
-        rollback = getattr(self.worker.store, "rollback", None)
-        if rollback is not None:
-            rollback()
-        return matches
+        ``asset_urls`` / ``_dead_letter`` make; no-op on sqlite). The
+        rollback runs even when encode raises (poison) — the retry path
+        must reload from a fresh snapshot too."""
+        try:
+            return self.worker._encode_batch(ids)
+        finally:
+            rollback = getattr(self.worker.store, "rollback", None)
+            if rollback is not None:
+                rollback()
 
     def _enqueue(self, msgs: list, enc, fetch: Future) -> None:
         self.writer.submit(_Job(seq=self.seq, msgs=msgs, enc=enc, fetch=fetch))

@@ -106,8 +106,13 @@ def _connect(uri: str):
             # idempotently). Best-effort: an unsupported filesystem
             # leaves the journal mode unchanged.
             try:
-                conn.execute("PRAGMA journal_mode = WAL")
-                conn.execute("PRAGMA synchronous = NORMAL")
+                got = conn.execute("PRAGMA journal_mode = WAL").fetchone()
+                # The pragma REPORTS failure instead of raising (returns
+                # the old mode). Only relax synchronous under WAL: in
+                # rollback-journal mode NORMAL opens a power-loss
+                # corruption window, not just a lost-commit one.
+                if got and str(got[0]).lower() == "wal":
+                    conn.execute("PRAGMA synchronous = NORMAL")
             except Exception:  # pragma: no cover — e.g. network fs
                 pass
         return conn, "qmark", "sqlite", (path or None)
@@ -267,27 +272,23 @@ class SqlStore:
     # -- store protocol ---------------------------------------------------
     def load_batch(self, ids: Iterable[str]) -> list:
         """Dedupe + load the eager object graph, matches ordered by
-        ``created_at`` ascending (``worker.py:172,176-191``)."""
-        seen = list(dict.fromkeys(ids))
-        match_rows = self._select_in(
-            "match", MATCH_COLS, "api_id", seen, order_by="created_at"
-        )
+        ``created_at`` ascending (``worker.py:172,176-191``). Built from
+        the SAME raw row bundle as the columnar lane
+        (:meth:`load_batch_raw`) — one definition of the five selectin
+        queries, so the two lanes cannot drift on the load-bearing
+        arrival orders (roster arrival defines team 0/1, participant
+        arrival defines slots)."""
+        raw = self.load_batch_raw(ids)
         matches: list[SimpleNamespace] = []
-        mids = []
-        for api_id, game_mode, created_at in match_rows:
-            m = SimpleNamespace(
+        for api_id, game_mode, created_at in raw["match_rows"]:
+            matches.append(SimpleNamespace(
                 api_id=api_id, game_mode=game_mode, created_at=created_at,
                 trueskill_quality=None, rosters=[], participants=[],
-            )
-            matches.append(m)
-            mids.append(api_id)
+            ))
 
-        # selectin level 1: rosters of the batch's matches
         by_match: dict[str, SimpleNamespace] = {m.api_id: m for m in matches}
         rosters: dict[str, SimpleNamespace] = {}
-        for api_id, match_api_id, winner in self._select_in(
-            "roster", ROSTER_COLS, "match_api_id", mids
-        ):
+        for api_id, match_api_id, winner in raw["roster_rows"]:
             r = SimpleNamespace(
                 api_id=api_id, match_api_id=match_api_id, winner=winner,
                 participants=[],
@@ -295,47 +296,30 @@ class SqlStore:
             rosters[api_id] = r
             by_match[match_api_id].rosters.append(r)
 
-        # selectin level 2: participants (keyed by match, attached to both
-        # match.participants and roster.participants like the double
-        # relationship wiring at worker.py:52-66)
-        part_rows = self._select_in(
-            "participant", PARTICIPANT_COLS, "match_api_id", mids
-        )
-        player_ids = list(dict.fromkeys(r[3] for r in part_rows))
-        # selectin level 3: players, full reflected rating column set.
-        # player.skill_tier is not in the reference's load_only list
-        # (worker.py:184-190) but get_trueskill_seed reads it lazily
-        # (rater.py:57-60); reflection loads it eagerly when it exists.
-        player_cols = list(PLAYER_BASE_COLS) + self._rating_cols["player"]
-        if "skill_tier" in self.columns["player"]:
-            player_cols.insert(len(PLAYER_BASE_COLS), "skill_tier")
+        part_rows = raw["part_rows"]
         # Absent schema columns read as None. Computed ONCE per batch:
         # the per-object hasattr probe over every rating pair cost ~90k
         # dynamic attribute checks per 500-match batch (~30% of
         # load_batch, profiled round 5) for an answer that is a property
         # of the reflected schema, not of any row.
+        player_cols = raw["player_cols"]
         base = {"skill_tier": None}
         for col in RATING_COLUMNS:
             base[f"{col}_mu"] = None
             base[f"{col}_sigma"] = None
         players: dict[str, SimpleNamespace] = {}
-        for row in self._select_in("player", player_cols, "api_id", player_ids):
+        for row in raw["player_rows"]:
             p = SimpleNamespace(**base)
             p.__dict__.update(zip(player_cols, row))
             players[p.api_id] = p
 
-        # selectin level 3b: participant_items rows
-        items_cols = ["api_id", "participant_api_id", "any_afk"]
-        items_cols += self._rating_cols["participant_items"]
+        items_cols = raw["items_cols"]
         items_base = {}
         for col in RATING_COLUMNS[1:]:
             items_base[f"{col}_mu"] = None
             items_base[f"{col}_sigma"] = None
         items_by_part: dict[str, list[SimpleNamespace]] = {}
-        part_ids = [r[0] for r in part_rows]
-        for row in self._select_in(
-            "participant_items", items_cols, "participant_api_id", part_ids
-        ):
+        for row in raw["items_rows"]:
             it = SimpleNamespace(**items_base)
             it.__dict__.update(zip(items_cols, row))
             items_by_part.setdefault(it.participant_api_id, []).append(it)
@@ -358,6 +342,187 @@ class SqlStore:
             if roster_api_id in rosters:
                 rosters[roster_api_id].participants.append(part)
         return matches
+
+    # -- columnar batch lane ----------------------------------------------
+    def load_batch_raw(self, ids: Iterable[str]):
+        """The ONE implementation of the batch's five selectin queries
+        (dedupe, created_at order, arrival orders), returned as raw row
+        bundles. :class:`analyzer_tpu.service.columnar.ColumnarBatch`
+        consumes them directly (no object graphs — on this package's
+        1-core reference host the ~11k-SimpleNamespace build was the
+        single largest python cost of the service loop, profiled round
+        5); :meth:`load_batch` builds the duck-typed object graph from
+        the same bundle. player.skill_tier is not in the reference's
+        load_only list (worker.py:184-190) but get_trueskill_seed reads
+        it lazily (rater.py:57-60); reflection loads it eagerly when it
+        exists."""
+        seen = list(dict.fromkeys(ids))
+        match_rows = self._select_in(
+            "match", MATCH_COLS, "api_id", seen, order_by="created_at"
+        )
+        mids = [r[0] for r in match_rows]
+        roster_rows = self._select_in(
+            "roster", ROSTER_COLS, "match_api_id", mids
+        )
+        part_rows = self._select_in(
+            "participant", PARTICIPANT_COLS, "match_api_id", mids
+        )
+        player_ids = list(dict.fromkeys(r[3] for r in part_rows))
+        player_cols = list(PLAYER_BASE_COLS) + self._rating_cols["player"]
+        if "skill_tier" in self.columns["player"]:
+            player_cols.insert(len(PLAYER_BASE_COLS), "skill_tier")
+        player_rows = self._select_in("player", player_cols, "api_id", player_ids)
+        items_cols = ["api_id", "participant_api_id", "any_afk"]
+        items_cols += self._rating_cols["participant_items"]
+        part_ids = [r[0] for r in part_rows]
+        items_rows = self._select_in(
+            "participant_items", items_cols, "participant_api_id", part_ids
+        )
+        return {
+            "match_rows": match_rows,
+            "roster_rows": roster_rows,
+            "part_rows": part_rows,
+            "player_cols": player_cols,
+            "player_rows": player_rows,
+            "items_cols": items_cols,
+            "items_rows": items_rows,
+            "schema_rating_cols": dict(self._rating_cols),
+            # Full column sets of the write-target tables, so write_plan
+            # can apply the object lane's filter-before-building rule
+            # (columns the deployed schema lacks are dropped, exactly as
+            # automap never flushes a non-column attribute).
+            "schema_columns": {
+                t: set(self.columns[t])
+                for t in ("match", "participant", "player", "participant_items")
+            },
+        }
+
+    def load_batch_native(self, ids: Iterable[str]):
+        """[sqlite fastest path] The five batch queries through the C
+        columnar scanner (``fastsql.cc``): columns arrive as typed numpy
+        arrays with NO per-row python tuples — ``fetchall``'s tuple
+        building was the largest single cost of the columnar lane's
+        load (~58 ms of a 500-match batch, profiled round 5 on-rig).
+        Returns an array-form bundle for :class:`ColumnarBatch`, or None
+        when the native layer is unavailable (file-less DB, no g++, scan
+        failure, an id the literal quoting cannot carry) — callers fall
+        back to :meth:`load_batch_raw`.
+
+        Ties in ``created_at`` may order differently than the python
+        lane's chunked merge (both are within the reference's
+        unspecified tie behavior, ``worker.py:176``); team/slot arrival
+        orders can likewise differ for >CHUNKSIZE batches — all
+        rating-output-neutral (the kernel is team-symmetric given the
+        winner flag; outputs key by player)."""
+        if self._sqlite_path is None or self._native_sql is False:
+            return None
+        seen = list(dict.fromkeys(ids))
+        if not seen:
+            return None  # empty loads take the (trivial) python path
+        for v in seen:
+            if "\x00" in str(v):
+                return None  # a literal cannot carry NUL; bind path can
+        inlist = ",".join("'" + str(v).replace("'", "''") + "'" for v in seen)
+        q = self._q
+        m = self._native_scan(
+            f"SELECT {q('api_id')}, {q('game_mode')} FROM {q('match')} "
+            f"WHERE {q('api_id')} IN ({inlist}) "
+            f"ORDER BY {q('created_at')} ASC",
+            [("api_id", "str"), ("game_mode", "str")],
+        )
+        if m is None:
+            return None
+        mid_list = ",".join(
+            "'" + s.decode().replace("'", "''") + "'" for s in m["api_id"]
+        )
+        if not mid_list:
+            mid_list = "''"
+        ro = self._native_scan(
+            f"SELECT {q('api_id')}, {q('match_api_id')}, {q('winner')} "
+            f"FROM {q('roster')} WHERE {q('match_api_id')} IN ({mid_list})",
+            [("api_id", "str"), ("match_api_id", "str"), ("winner", "int")],
+        )
+        pa = self._native_scan(
+            f"SELECT {q('api_id')}, {q('match_api_id')}, "
+            f"{q('roster_api_id')}, {q('player_api_id')}, {q('went_afk')} "
+            f"FROM {q('participant')} "
+            f"WHERE {q('match_api_id')} IN ({mid_list})",
+            [("api_id", "str"), ("match_api_id", "str"),
+             ("roster_api_id", "str"), ("player_api_id", "str"),
+             ("went_afk", "int")],
+        )
+        if ro is None or pa is None:
+            return None
+        pid_set = dict.fromkeys(pa["player_api_id"].tolist())
+        pid_list = ",".join(
+            "'" + s.decode().replace("'", "''") + "'" for s in pid_set
+        ) or "''"
+        player_cols = list(PLAYER_BASE_COLS) + self._rating_cols["player"]
+        if "skill_tier" in self.columns["player"]:
+            player_cols.insert(len(PLAYER_BASE_COLS), "skill_tier")
+        # Every non-id column as float: NULL -> NaN keeps a missing
+        # skill_tier distinguishable from tier 0 for the out-of-table
+        # gate (the scanner's int convention folds NULL into 0).
+        spec = [("api_id", "str")] + [(c, "float") for c in player_cols[1:]]
+        pl = self._native_scan(
+            f"SELECT {', '.join(q(c) for c, _ in spec)} FROM {q('player')} "
+            f"WHERE {q('api_id')} IN ({pid_list})",
+            spec,
+        )
+        paid_list = ",".join(
+            "'" + s.decode().replace("'", "''") + "'" for s in pa["api_id"]
+        ) or "''"
+        it = self._native_scan(
+            f"SELECT {q('api_id')}, {q('participant_api_id')} "
+            f"FROM {q('participant_items')} "
+            f"WHERE {q('participant_api_id')} IN ({paid_list})",
+            [("api_id", "str"), ("participant_api_id", "str")],
+        )
+        if pl is None or it is None:
+            return None
+        return {
+            "match": m,
+            "roster": ro,
+            "participant": pa,
+            "player": pl,
+            "player_cols": player_cols,
+            "items": it,
+            "schema_rating_cols": dict(self._rating_cols),
+            "schema_columns": {
+                t: set(self.columns[t])
+                for t in ("match", "participant", "player",
+                          "participant_items")
+            },
+        }
+
+    def commit_columnar(self, plan) -> None:
+        """Array-lane counterpart of :meth:`commit`: flushes a
+        :meth:`ColumnarBatch.write_plan` in one transaction. The plan
+        writes ONLY touched columns/rows (exactly what the reference's
+        ORM flush would — automap never writes unmodified attributes),
+        which both shrinks the bind work and removes the object lane's
+        stale-snapshot rewrite hazard under pipelining (columnar.py)."""
+        try:
+            cur = self.conn.cursor()
+            mark = "?" if self._paramstyle == "qmark" else "%s"
+            for table, cols, key, rows in plan:
+                # No schema re-filtering here: the plan was built FROM
+                # the reflected schema (load_batch_raw ships
+                # schema_rating_cols), and rows are positional — dropping
+                # a column without its values would shift every bind.
+                if not rows or not cols:
+                    continue
+                sql = (
+                    f"UPDATE {self._q(table)} SET "
+                    + ", ".join(f"{self._q(c)} = {mark}" for c in cols)
+                    + f" WHERE {self._q(key)} = {mark}"
+                )
+                cur.executemany(sql, rows)
+            cur.close()
+            self.conn.commit()
+        except Exception:
+            self.conn.rollback()
+            raise
 
     # -- columnar full-history ingest -------------------------------------
     def _sqlite_bulk(

@@ -330,11 +330,26 @@ class Worker:
             dt = self.clock() - t0
             if i > 0:  # rep 0 pays the add's compile
                 rtt = dt if rtt is None else min(rtt, dt)
-        from analyzer_tpu.fixtures import synthetic_batch
+        # The probe must measure the LANE production batches will run —
+        # the columnar encode is several times cheaper than the object
+        # one, and an inflated host estimate would under-size the lag
+        # (lag ~ rtt / host).
+        columnar = getattr(self.store, "load_batch_raw", None) is not None
+        if columnar:
+            from analyzer_tpu.fixtures import synthetic_raw_batch
+            from analyzer_tpu.service.columnar import ColumnarBatch
 
-        matches = synthetic_batch(self.config.batch_size)
-        t0 = self.clock()
-        enc = EncodedBatch(matches, self.rating_config, bucket_rows=True)
+            t0 = self.clock()
+            enc = ColumnarBatch(
+                synthetic_raw_batch(self.config.batch_size),
+                self.rating_config, bucket_rows=True,
+            )
+        else:
+            from analyzer_tpu.fixtures import synthetic_batch
+
+            matches = synthetic_batch(self.config.batch_size)
+            t0 = self.clock()
+            enc = EncodedBatch(matches, self.rating_config, bucket_rows=True)
         sched = self._bucketed_schedule(enc.stream, enc.state.pad_row)
         host = self.clock() - t0
         _, outs = rate_history(
@@ -342,7 +357,10 @@ class Worker:
             steps_per_chunk=self._step_chunk,
         )
         t0 = self.clock()
-        enc.write_back(outs)
+        if columnar:
+            enc.write_plan(outs)
+        else:
+            enc.write_back(outs)
         host += self.clock() - t0
         self.measured_rtt_s = rtt
         self.measured_host_s = host
@@ -563,36 +581,65 @@ class Worker:
                         headers={"match_api_id": mid},
                     )
 
+    def _encode_batch(self, ids: list[str]):
+        """Loads + encodes one id batch through the store's best lane:
+        columnar (``load_batch_raw`` -> :class:`ColumnarBatch`, no object
+        graphs — the SqlStore fast path) or the object lane
+        (``load_batch`` -> :class:`EncodedBatch` — required where the
+        loaded objects ARE the store, e.g. InMemoryStore). Returns an
+        encoded batch whose ``matches`` is empty when no ids loaded."""
+        raw_loader = getattr(self.store, "load_batch_raw", None)
+        if raw_loader is not None:
+            from analyzer_tpu.service.columnar import ColumnarBatch
+
+            raw = None
+            native_loader = getattr(self.store, "load_batch_native", None)
+            if native_loader is not None:
+                # C scanner: typed column arrays, no per-row python
+                # tuples (None when unavailable — python rows instead).
+                raw = native_loader(ids)
+            if raw is None:
+                raw = raw_loader(ids)
+            return ColumnarBatch(
+                raw, self.rating_config, bucket_rows=True
+            )
+        matches = self.store.load_batch(ids)
+        if not matches:
+            return None
+        return EncodedBatch(matches, self.rating_config, bucket_rows=True)
+
     def process(self, ids: list[str]) -> list[str]:
         """Rates one batch of match ids. Pure until the final write-back:
         an exception anywhere leaves objects and state untouched."""
-        matches = self.store.load_batch(ids)
-        logger.info("processing batch of %s matches", len(matches))
-        if not matches:
-            return []
+        from analyzer_tpu.service.columnar import finalize
+
         # bucket_rows + pinned width + power-of-two step bucket: the three
         # shapes in the compiled scan's signature (table rows, batch
         # width, step count) all land on a few fixed sizes, so
         # consecutive batches of any size reuse one compiled scan.
-        enc = EncodedBatch(matches, self.rating_config, bucket_rows=True)
+        enc = self._encode_batch(ids)
+        n = len(enc.matches) if enc is not None else 0
+        logger.info("processing batch of %s matches", n)
+        if not n:
+            return []
         sched = self._bucketed_schedule(enc.stream, enc.state.pad_row)
         _, outs = rate_history(
             enc.state, sched, self.rating_config, collect=True,
             steps_per_chunk=self._step_chunk,
         )
-        enc.write_back(outs)
-        # Transactional stores (SqlStore) flush the mutated graph in one
-        # commit, rolling back internally on error (worker.py:194-199);
-        # the in-memory store's objects ARE the store, nothing to flush.
-        commit = getattr(self.store, "commit", None)
-        if commit is not None:
-            commit(matches)
-        self.matches_rated += len(matches)
+        # Transactional stores (SqlStore) flush in one commit, rolling
+        # back internally on error (worker.py:194-199); the in-memory
+        # store's objects ARE the store, nothing to flush beyond
+        # write_back's mutations.
+        finalize(self.store, enc, outs)
+        self.matches_rated += n
         logger.info(
             "batch rated: %d matches (%.1f matches/s since start)",
-            len(matches), self.matches_per_sec,
+            n, self.matches_per_sec,
         )
-        return [m.api_id for m in matches]
+        return [
+            m if isinstance(m, str) else m.api_id for m in enc.matches
+        ]
 
     # -- observability ----------------------------------------------------
     @property

@@ -108,6 +108,11 @@ def main() -> int:
         "--no-pipeline", action="store_true",
         help="sequential reference-shaped loop (the round-3 baseline)",
     )
+    ap.add_argument(
+        "--lag", type=int, default=None,
+        help="pin the pipelined commit lag (default: auto-tune from the "
+        "warmup cost probe, config.py pipeline_lag)",
+    )
     args = ap.parse_args()
     n_players = args.players or max(args.matches // 3, 12)
 
@@ -123,11 +128,17 @@ def main() -> int:
           f"players in {time.perf_counter() - t0:.1f} s", flush=True)
 
     broker = InMemoryBroker()
-    cfg = ServiceConfig(batch_size=BATCH, idle_timeout=0.0)
+    cfg = ServiceConfig(
+        batch_size=BATCH, idle_timeout=0.0, pipeline_lag=args.lag
+    )
     worker = Worker(
         broker, store, cfg, RatingConfig(), pipeline=not args.no_pipeline
     )
     worker.warmup()
+    if not args.no_pipeline:
+        eng = worker._ensure_engine()
+        print(f"pipeline lag: {eng.lag if eng else None}"
+              + (" (auto)" if args.lag is None else " (pinned)"), flush=True)
 
     for mid in ids:
         broker.publish(cfg.queue, mid.encode()

@@ -1,0 +1,293 @@
+"""Columnar service lane (service/columnar.py): differential parity with
+the object lane.
+
+The columnar lane's whole contract is "same semantics, no objects" — so
+every test here is differential: run the SAME batch through both lanes
+and require identical final DATABASE STATE (full four-table dumps) and
+identical poison/gate decisions (exception types + api_id sets). The
+fixture generator is the synthetic stream writer (reference-schema
+sqlite, io/dbgen.py) with AFK matches, unsupported modes, 3v3+5v5 mixes
+and returning players — the shapes the gates actually branch on.
+"""
+
+import sqlite3
+
+import numpy as np
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from analyzer_tpu.config import RatingConfig, ServiceConfig
+from analyzer_tpu.io.dbgen import write_history_db
+from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
+from analyzer_tpu.service import InMemoryBroker, SqlStore, Worker
+from analyzer_tpu.service.columnar import ColumnarBatch
+from analyzer_tpu.service.encode import (
+    EncodedBatch, PoisonMatchError, PoisonTierError,
+)
+from tests.test_sql_store import seed_db
+
+
+def dump_db(path):
+    """Full value dump of every write-target table, ordered by api_id."""
+    conn = sqlite3.connect(path)
+    out = {}
+    for table, cols in (
+        ("match", "api_id, trueskill_quality"),
+        ("participant",
+         "api_id, trueskill_mu, trueskill_sigma, trueskill_delta"),
+        ("player", "api_id, trueskill_mu, trueskill_sigma,"
+         " trueskill_casual_mu, trueskill_casual_sigma,"
+         " trueskill_ranked_mu, trueskill_ranked_sigma,"
+         " trueskill_blitz_mu, trueskill_blitz_sigma"),
+        ("participant_items", "api_id, any_afk,"
+         " trueskill_ranked_mu, trueskill_ranked_sigma"),
+    ):
+        out[table] = conn.execute(
+            f"SELECT {cols} FROM {table} ORDER BY api_id"
+        ).fetchall()
+    conn.close()
+    return out
+
+
+def make_fixture(path, n_matches=120, n_players=30, seed=9):
+    players = synthetic_players(n_players, seed=seed)
+    stream = synthetic_stream(
+        n_matches, players, seed=seed, afk_rate=0.08, unsupported_rate=0.05
+    )
+    write_history_db(path, stream, players)
+    conn = sqlite3.connect(path)
+    ids = [r[0] for r in conn.execute(
+        "SELECT api_id FROM match ORDER BY created_at ASC"
+    ).fetchall()]
+    conn.close()
+    return ids
+
+
+class _ObjectLane:
+    """Hides the columnar-lane surface (load_batch_raw/commit_columnar)
+    so the worker takes the object path against the same database."""
+
+    load_batch_raw = None
+    commit_columnar = None
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def clone(self):
+        return _ObjectLane(self._inner.clone())
+
+
+def run_worker(path, ids, force_object_lane=False, pipeline=False,
+               batch_size=16):
+    broker = InMemoryBroker()
+    store = SqlStore(f"sqlite:///{path}")
+    if force_object_lane:
+        store = _ObjectLane(store)
+    cfg = ServiceConfig(batch_size=batch_size, idle_timeout=0.0)
+    w = Worker(broker, store, cfg, RatingConfig(), pipeline=pipeline)
+    for mid in ids:
+        broker.publish(cfg.queue, mid.encode())
+    for _ in range(5 * len(ids) + 10):
+        if not w.poll() and broker.qsize(cfg.queue) == 0:
+            break
+    w.drain()
+    w.close()
+    failed = sorted(
+        m.body.decode() for m in broker.queues[cfg.failed_queue]
+    )
+    assert not broker._unacked
+    store.close()
+    return failed
+
+
+class TestDifferential:
+    def test_sequential_lanes_identical_db_state(self, tmp_path):
+        a, b = str(tmp_path / "obj.db"), str(tmp_path / "col.db")
+        ids = make_fixture(a)
+        make_fixture(b)
+        fa = run_worker(a, ids, force_object_lane=True)
+        fb = run_worker(b, ids, force_object_lane=False)
+        assert fa == fb == []
+        assert dump_db(a) == dump_db(b)
+
+    def test_pipelined_columnar_equals_sequential_columnar(self, tmp_path):
+        a, b = str(tmp_path / "seq.db"), str(tmp_path / "pipe.db")
+        ids = make_fixture(a, n_matches=160, n_players=18, seed=4)
+        make_fixture(b, n_matches=160, n_players=18, seed=4)
+        fa = run_worker(a, ids, pipeline=False, batch_size=16)
+        fb = run_worker(b, ids, pipeline=True, batch_size=16)
+        assert fa == fb == []
+        assert dump_db(a) == dump_db(b)
+
+    def test_returning_players_roundtrip(self, tmp_path):
+        # Second consume of the SAME ids: priors come from the rows the
+        # first pass wrote — exercises the loaded-rating -> state path
+        # of both lanes end to end.
+        a, b = str(tmp_path / "r_obj.db"), str(tmp_path / "r_col.db")
+        ids = make_fixture(a, n_matches=60, n_players=12, seed=7)
+        make_fixture(b, n_matches=60, n_players=12, seed=7)
+        for _ in range(2):
+            fa = run_worker(a, ids, force_object_lane=True)
+            fb = run_worker(b, ids, force_object_lane=False)
+            assert fa == fb == []
+        assert dump_db(a) == dump_db(b)
+
+
+def both_lane_errors(path, ids):
+    """(object_exc, columnar_exc) raised while encoding ``ids``."""
+    store = SqlStore(f"sqlite:///{path}")
+    cfg = RatingConfig()
+    exc_obj = exc_col = None
+    try:
+        EncodedBatch(store.load_batch(ids), cfg, bucket_rows=True)
+    except Exception as e:  # noqa: BLE001 — parity capture
+        exc_obj = e
+    try:
+        ColumnarBatch(store.load_batch_raw(ids), cfg, bucket_rows=True)
+    except Exception as e:  # noqa: BLE001
+        exc_col = e
+    store.close()
+    return exc_obj, exc_col
+
+
+class TestPoisonParity:
+    def test_winner_tie(self, tmp_path):
+        path = str(tmp_path / "tie.db")
+        seed_db(path, n_matches=3)
+        conn = sqlite3.connect(path)
+        conn.execute("UPDATE roster SET winner = 0 WHERE match_api_id = 'm1'")
+        conn.commit()
+        conn.close()
+        a, b = both_lane_errors(path, ["m0", "m1", "m2"])
+        assert type(a) is type(b) is PoisonMatchError
+        assert sorted(a.api_ids) == sorted(b.api_ids) == ["m1"]
+        assert str(a) == str(b)
+
+    def test_oversized_team(self, tmp_path):
+        path = str(tmp_path / "big.db")
+        seed_db(path, n_matches=2)
+        conn = sqlite3.connect(path)
+        for x in range(6):  # 3 + 6 = 9 > MAX_TEAM_SIZE
+            conn.execute(
+                "INSERT INTO participant (api_id, match_api_id,"
+                " roster_api_id, player_api_id, skill_tier, went_afk)"
+                " VALUES (?, 'm0', 'm0-r0', 'p0', 15, 0)",
+                (f"extra{x}",),
+            )
+            conn.execute(
+                "INSERT INTO participant_items (api_id, participant_api_id)"
+                " VALUES (?, ?)", (f"extra{x}-items", f"extra{x}"),
+            )
+        conn.commit()
+        conn.close()
+        a, b = both_lane_errors(path, ["m0", "m1"])
+        assert type(a) is type(b) is PoisonMatchError
+        assert sorted(a.api_ids) == sorted(b.api_ids) == ["m0"]
+
+    def test_missing_items_row(self, tmp_path):
+        path = str(tmp_path / "noitems.db")
+        seed_db(path, n_matches=3)
+        conn = sqlite3.connect(path)
+        conn.execute(
+            "DELETE FROM participant_items WHERE participant_api_id ="
+            " 'm2-p4'"
+        )
+        conn.commit()
+        conn.close()
+        a, b = both_lane_errors(path, ["m0", "m1", "m2"])
+        assert type(a) is type(b) is PoisonMatchError
+        assert sorted(a.api_ids) == sorted(b.api_ids) == ["m2"]
+        assert str(a) == str(b)
+
+    def test_out_of_table_tier(self, tmp_path):
+        path = str(tmp_path / "tier.db")
+        seed_db(path, n_matches=2, tier=35)  # outside [-1, 29], fresh seeds
+        a, b = both_lane_errors(path, ["m0", "m1"])
+        assert type(a) is type(b) is PoisonTierError
+        assert sorted(a.api_ids) == sorted(b.api_ids) == ["m0", "m1"]
+
+    def test_clean_batch_no_errors_and_equal_tensors(self, tmp_path):
+        path = str(tmp_path / "clean.db")
+        seed_db(path, n_matches=4, afk_match=1)
+        store = SqlStore(f"sqlite:///{path}")
+        cfg = RatingConfig()
+        ids = ["m0", "m1", "m2", "m3"]
+        obj = EncodedBatch(store.load_batch(ids), cfg, bucket_rows=True)
+        col = ColumnarBatch(store.load_batch_raw(ids), cfg, bucket_rows=True)
+        assert obj.row_of == col.row_of
+        np.testing.assert_array_equal(
+            obj.stream.player_idx, col.stream.player_idx
+        )
+        np.testing.assert_array_equal(obj.stream.winner, col.stream.winner)
+        np.testing.assert_array_equal(obj.stream.mode_id, col.stream.mode_id)
+        np.testing.assert_array_equal(obj.stream.afk, col.stream.afk)
+        np.testing.assert_array_equal(
+            np.asarray(obj.state.table), np.asarray(col.state.table)
+        )
+        store.close()
+
+
+class TestNativeLoader:
+    def test_native_and_row_bundles_encode_identically(self, tmp_path):
+        # Same batch through load_batch_native (C scanner, typed arrays)
+        # and load_batch_raw (python rows): identical tensors, row
+        # numbering, and id maps. Sub-CHUNKSIZE batch so even arrival
+        # orders must agree (one query per table on both paths).
+        path = str(tmp_path / "nat.db")
+        seed_db(path, n_matches=5, afk_match=2)
+        store = SqlStore(f"sqlite:///{path}")
+        ids = [f"m{i}" for i in range(5)]
+        native = store.load_batch_native(ids)
+        if native is None:
+            pytest.skip("native scanner unavailable in this environment")
+        cfg = RatingConfig()
+        a = ColumnarBatch(native, cfg, bucket_rows=True)
+        b = ColumnarBatch(store.load_batch_raw(ids), cfg, bucket_rows=True)
+        assert a.api_ids == b.api_ids
+        assert a.row_of == b.row_of
+        np.testing.assert_array_equal(
+            a.stream.player_idx, b.stream.player_idx
+        )
+        np.testing.assert_array_equal(a.stream.afk, b.stream.afk)
+        np.testing.assert_array_equal(
+            np.asarray(a.state.table), np.asarray(b.state.table)
+        )
+        assert list(a._item0_api) == list(b._item0_api)
+        store.close()
+
+    def test_native_quoting_handles_hostile_ids(self, tmp_path):
+        # Broker bodies are untrusted: ids with quotes must be carried
+        # literally (or refused), never spliced as SQL.
+        path = str(tmp_path / "quote.db")
+        seed_db(path, n_matches=2)
+        store = SqlStore(f"sqlite:///{path}")
+        hostile = ["m0", "x'); DROP TABLE player; --", "m'1", "nul\x00id"]
+        raw = store.load_batch_native(hostile)
+        if raw is None:
+            # NUL forces the bind-parameter path — equally safe.
+            raw = store.load_batch_raw(hostile)
+            assert [r[0] for r in raw["match_rows"]] == ["m0"]
+        else:
+            assert list(np.char.decode(raw["match"]["api_id"], "utf-8")) == ["m0"]
+        # The tables survived.
+        assert store.conn.execute("SELECT COUNT(*) FROM player").fetchone()[0] == 6
+        store.close()
+
+
+class TestFuzzDifferential:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_histories(self, tmp_path, seed):
+        a = str(tmp_path / f"fo{seed}.db")
+        b = str(tmp_path / f"fc{seed}.db")
+        ids = make_fixture(a, n_matches=80, n_players=14, seed=100 + seed)
+        make_fixture(b, n_matches=80, n_players=14, seed=100 + seed)
+        fa = run_worker(a, ids, force_object_lane=True, batch_size=8)
+        fb = run_worker(b, ids, force_object_lane=False, batch_size=8)
+        assert fa == fb
+        assert dump_db(a) == dump_db(b)

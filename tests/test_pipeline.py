@@ -200,7 +200,10 @@ class TestEquivalence:
 
 class FlakyStore:
     """Delegating store whose Nth commit raises — shared across clones so
-    the writer thread's commit (the pipelined path) trips it too."""
+    the writer thread's commit (the pipelined path) trips it too. Both
+    commit surfaces are intercepted: ``commit`` (object lane) and
+    ``commit_columnar`` (the SqlStore columnar lane) count into the same
+    budget, so the test is lane-agnostic."""
 
     def __init__(self, inner, fail_on_commit: int, state=None):
         self._inner = inner
@@ -213,11 +216,18 @@ class FlakyStore:
     def clone(self):
         return FlakyStore(self._inner.clone(), self._fail_on, self._state)
 
-    def commit(self, matches):
+    def _tick(self):
         self._state["commits"] += 1
         if self._state["commits"] == self._fail_on:
             raise RuntimeError("injected commit failure")
+
+    def commit(self, matches):
+        self._tick()
         return self._inner.commit(matches)
+
+    def commit_columnar(self, plan):
+        self._tick()
+        return self._inner.commit_columnar(plan)
 
 
 class TestFailureDuringOverlap:
