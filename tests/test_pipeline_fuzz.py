@@ -16,7 +16,10 @@ ordinal-keyed faults would diverge by construction.
 Provenance: 166 seeds checked divergence-free offline in round 4 — the
 6 committed here, 120 more of this shape, and 40 stress variants (MULTIPLE
 content-keyed failures per run, duplicate message deliveries, batch sizes
-down to 1).
+down to 1). Round 5 re-ran 80 fresh seeds (200-279) divergence-free after
+the COLUMNAR lane became the SqlStore default — the fault injection is
+lane-agnostic (commit_columnar keyed on the plan's match api_ids), so the
+sweep exercises the columnar pipelined writer end to end.
 """
 
 import sqlite3
@@ -47,15 +50,31 @@ class ContentKeyedFlakyStore:
             self._inner.clone(), self._fail_id, self._state
         )
 
-    def commit(self, matches):
+    def _maybe_fire(self, batch_match_ids):
         if (
             self._fail_id is not None
             and not self._state["fired"]
-            and any(m.api_id == self._fail_id for m in matches)
+            and self._fail_id in batch_match_ids
         ):
             self._state["fired"] = True
             raise RuntimeError(f"injected commit failure on {self._fail_id}")
+
+    def commit(self, matches):
+        self._maybe_fire({m.api_id for m in matches})
         return self._inner.commit(matches)
+
+    def commit_columnar(self, plan):
+        # Lane-agnostic injection: the columnar lane commits through a
+        # write plan, whose match-table rows carry the batch's api_ids
+        # as the last bind parameter.
+        ids = {
+            r[-1]
+            for table, _cols, _key, rows in plan
+            if table == "match"
+            for r in rows
+        }
+        self._maybe_fire(ids)
+        return self._inner.commit_columnar(plan)
 
 
 def dump_db(path):
