@@ -106,6 +106,13 @@ class Worker:
             row_bucket(self.config.batch_size * 2 * MAX_TEAM_SIZE) + 1
         )
 
+        # Service-lane journal mode: WAL overlap + cheap commits (see
+        # SqlStore.enable_wal — deliberately NOT on for the bulk
+        # full-history lane, where WAL measured 1.7x slower).
+        enable_wal = getattr(store, "enable_wal", None)
+        if enable_wal is not None:
+            enable_wal()
+
         c = self.config
         # The reference declares queue/failed/crunch/telesuck but NOT sew
         # (worker.py:87-90) — sew is assumed to exist; we keep that contract.
