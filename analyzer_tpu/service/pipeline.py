@@ -13,9 +13,12 @@ batch's host work:
     batch N's posteriors until N's outputs are fetched and committed.
     Instead, N+1 is encoded from a (stale-by-<=lag) store snapshot and its
     player table is PATCHED ON DEVICE from the final device-resident
-    tables of the in-flight batches: one jitted row scatter per in-flight
-    batch (``_chain_patch``), keyed by player-id overlap computed on the
-    host from the encoders' ``row_of`` maps. The posterior never visits
+    tables of the in-flight batches, held in a ``[lag, rows, W]`` ring:
+    ONE jitted call applies the whole chain oldest-first
+    (``_chain_patch_ring``), keyed by player-id overlap computed on the
+    host from the encoders' ``row_of`` maps. Only the 14 rating columns
+    copy — seeds derive from static features the worker never writes,
+    and the destination batch's are fresher. The posterior never visits
     the host on the critical path.
   * **Async D2H at dispatch**: each batch's packed-outputs transfer is
     issued (``copy_to_host_async``) the moment its scan is enqueued, so
@@ -129,26 +132,58 @@ def _canonical_rows(table, rows: int):
 
 
 @partial(jax.jit, donate_argnums=(0,))
-def _chain_patch(dst_table, src_table, dst_idx):
-    """Copies the 14 rating columns of every ``src_table`` row to
-    ``dst_table[dst_idx[r]]``. Rows with no destination point at the dst
-    padding row (writes park there, like every masked scatter in the
-    framework). Seed columns are NOT copied — seeds derive from static
-    features the worker never writes, and the destination batch's are
-    fresher."""
-    vals = src_table[:, MU_LO:SIGMA_HI]
-    return dst_table.at[dst_idx, MU_LO:SIGMA_HI].set(vals)
+def _ring_put(ring, slot, table):
+    """Writes one canonicalized batch table into the chain ring."""
+    return ring.at[slot].set(table)
 
 
-def chain_dst_index(src_row_of: dict, src_rows: int, dst_row_of: dict,
-                    dst_pad_row: int) -> np.ndarray:
-    """Host half of the patch: src row -> dst row (or dst pad row)."""
-    dst = np.full(src_rows, dst_pad_row, np.int32)
-    for pid, r in src_row_of.items():
-        d = dst_row_of.get(pid)
-        if d is not None:
-            dst[r] = d
-    return dst
+@partial(jax.jit, donate_argnums=(0,))
+def _chain_patch_pairs(dst_table, ring, pairs):
+    """Applies the WHOLE chain in one dispatch from compacted pairs:
+    ``pairs`` is ``[3, K]`` (ring slot, ring row, destination row), one
+    gather + one scatter. Padding entries point their destination at the
+    table's padding row, where writes park like every masked scatter in
+    the framework (the pad row's value is garbage by design, so the
+    duplicate pad writes' ordering is irrelevant); NON-pad destinations
+    are UNIQUE by construction — the host deduplicates newest-entry-wins
+    (chain_pairs), which also preserves the sequential oldest-first
+    patch order's final values without any in-kernel ordering.
+
+    Why pairs and not a dense [lag, rows] index grid: the grid's H2D
+    upload scales with lag (lag 12 = ~390 KB/batch), and the tunneled
+    dev rig's ~3 MB/s H2D made deep commit lags collapse (~130 ms/batch
+    of index upload alone — measured round 5: lag 12 ran at 1.4-1.5k
+    matches/s under BOTH the per-entry and dense-grid designs). The
+    compact form is lag-independent (~48 KB at the service default)."""
+    slots = pairs[0].astype(jax.numpy.int32)
+    srcs = pairs[1].astype(jax.numpy.int32)
+    dsts = pairs[2].astype(jax.numpy.int32)
+    vals = ring[slots, srcs, MU_LO:SIGMA_HI]
+    return dst_table.at[dsts, MU_LO:SIGMA_HI].set(vals)
+
+
+def chain_pairs(chain, lag: int, dst_row_of: dict, dst_pad_row: int,
+                canon_rows: int, dtype) -> np.ndarray:
+    """Host half of the ring patch: ``[3, canon_rows]`` (slot, src row,
+    dst row) with newest-first dedup per destination — the final value
+    of applying the chain oldest-first is exactly the newest in-flight
+    batch's row for each overlapping player. Unused capacity points at
+    the destination padding row."""
+    pairs = np.zeros((3, canon_rows), dtype)
+    pairs[2, :] = dst_pad_row
+    seen: set = set()
+    n = 0
+    for seq_e, row_of in reversed(chain):  # newest first
+        slot = seq_e % lag
+        for pid, r in row_of.items():
+            d = dst_row_of.get(pid)
+            if d is not None and d not in seen:
+                seen.add(d)
+                pairs[0, n] = slot
+                pairs[1, n] = r
+                pairs[2, n] = d
+                n += 1
+    return pairs
 
 
 class _LazyFetch:
@@ -307,16 +342,7 @@ class PipelineEngine:
     def __init__(self, worker, lag: int | None = None):
         self.worker = worker
         if lag is None:
-            rtt = getattr(worker, "measured_rtt_s", None)
-            host = getattr(worker, "measured_host_s", None)
-            if rtt is not None and host is not None:
-                lag = choose_pipeline_lag(rtt, host)
-                logger.info(
-                    "pipeline lag auto-tuned to %d (rtt %.0f ms, host "
-                    "%.0f ms/batch)", lag, rtt * 1e3, host * 1e3,
-                )
-            else:
-                lag = DEFAULT_LAG
+            lag = worker.resolved_pipeline_lag()
         self.lag = max(1, int(lag))
         store = worker.store
         clone = getattr(store, "clone", None)
@@ -330,14 +356,22 @@ class PipelineEngine:
             factory = lambda: store  # noqa: E731 — shared-object stores
         self.writer = _Writer(factory)
         self.writer.start()
-        # Chaining sources: (row_of, n_rows, final_table) of the last
-        # `lag` dispatched batches, newest last; tables canonicalized to
-        # the max row bucket (see _canonical_rows).
+        # Chaining sources: (seq, row_of) of the last `lag` dispatched
+        # batches, newest last. The batches' canonicalized final tables
+        # live DEVICE-SIDE in a [lag, canon_rows, W] ring (slot =
+        # seq % lag), so the whole chain applies in one dispatch
+        # (_chain_patch_ring) instead of one per entry.
         self.chain: deque = deque(maxlen=self.lag)
+        self._ring = None  # lazy: created at the first ringable batch
         self.seq = 0
         # One owner for the compile-shape knobs: the worker (warmup and
         # schedule bucketing read the same attributes).
         self._canon_rows = worker._canon_rows
+        # int16 halves the per-batch pair upload; row/pad indices only
+        # exceed it under a far-over-default BATCHSIZE.
+        self._pair_dtype = (
+            np.int16 if self._canon_rows <= 32000 else np.int32
+        )
 
     # -- submission -------------------------------------------------------
     def submit(self, msgs: list) -> None:
@@ -371,10 +405,16 @@ class PipelineEngine:
         sched = w._bucketed_schedule(enc.stream, enc.state.pad_row)
 
         state = enc.state
-        for row_of, rows, table in self.chain:
-            dst = chain_dst_index(row_of, rows, enc.row_of, enc.state.pad_row)
+        if self.chain:
+            pairs = chain_pairs(
+                self.chain, self.lag, enc.row_of, enc.state.pad_row,
+                self._canon_rows, self._pair_dtype,
+            )
             state = dataclasses.replace(
-                state, table=_chain_patch(state.table, table, dst)
+                state,
+                table=_chain_patch_pairs(
+                    state.table, self._ring, jax.numpy.asarray(pairs)
+                ),
             )
         # Chunked dispatch at the fixed service step shape (the schedule
         # is padded to a SERVICE_STEP_CHUNK multiple): any chain depth
@@ -406,13 +446,28 @@ class PipelineEngine:
         )
         rows = int(final.table.shape[0])
         if rows <= self._canon_rows:
-            self.chain.append(
-                (enc.row_of, self._canon_rows,
-                 _canonical_rows(final.table, self._canon_rows))
+            import jax.numpy as jnp
+
+            from analyzer_tpu.core.state import TABLE_WIDTH
+
+            if self._ring is None:
+                self._ring = jnp.zeros(
+                    (self.lag, self._canon_rows, TABLE_WIDTH), jnp.float32
+                )
+            self._ring = _ring_put(
+                self._ring, self.seq % self.lag,
+                _canonical_rows(final.table, self._canon_rows),
             )
-        else:  # defensive: an over-bucket batch chains raw (lazy compile)
-            self.chain.append((enc.row_of, rows, final.table))
-        self._enqueue(msgs, enc, fetch)
+            self.chain.append((self.seq, enc.row_of))
+            self._enqueue(msgs, enc, fetch)
+        else:
+            # Defensive only — canon_rows is sized for the largest batch
+            # the config can produce, so an over-bucket batch means the
+            # sizing contract broke. It cannot ride the fixed-shape
+            # ring; enqueue, then DRAIN so no later batch needs to chain
+            # off it (one sequentialized batch, correctness intact).
+            self._enqueue(msgs, enc, fetch)
+            self.drain()
 
     def _encode_fresh(self, ids: list):
         """Load + encode (``Worker._encode_batch``, either lane) with the

@@ -273,31 +273,6 @@ class Worker:
                 state, sched, self.rating_config, collect=True,
                 steps_per_chunk=self._step_chunk,
             )
-        if self.pipeline_enabled:
-            import jax.numpy as jnp
-
-            from analyzer_tpu.core.state import TABLE_WIDTH
-            from analyzer_tpu.service.pipeline import (
-                _canonical_rows, _chain_patch,
-            )
-
-            canon = self._canon_rows
-            src = jnp.zeros((canon, TABLE_WIDTH), jnp.float32)
-            idx = jnp.zeros((canon,), jnp.int32)
-            for alloc in ladder:
-                # Every batch's final table canonicalizes once (per-rung
-                # compile) and every destination rung patches from the
-                # canonical shape — the full pair grid needs 2 compiles
-                # per rung, not rung^2.
-                _canonical_rows(
-                    jnp.zeros((alloc + 1, TABLE_WIDTH), jnp.float32), canon
-                ).block_until_ready()
-                dst = jnp.zeros((alloc + 1, TABLE_WIDTH), jnp.float32)
-                _chain_patch(dst, src, idx).block_until_ready()
-        logger.info(
-            "warmup compiled the %d-rung row ladder in %.1fs",
-            len(ladder), self.clock() - t0,
-        )
         if self.pipeline_enabled and self.config.pipeline_lag is None:
             try:
                 self._measure_pipeline_costs()
@@ -308,6 +283,37 @@ class Worker:
                     "pipeline cost probe failed; lag falls back to the "
                     "default"
                 )
+        if self.pipeline_enabled:
+            import jax.numpy as jnp
+
+            from analyzer_tpu.core.state import TABLE_WIDTH
+            from analyzer_tpu.service.pipeline import (
+                _canonical_rows, _chain_patch_pairs, _ring_put,
+            )
+
+            # The probe ran FIRST so the ring compiles at the lag the
+            # engine will actually resolve.
+            lag = self.resolved_pipeline_lag()
+            canon = self._canon_rows
+            pair_dtype = np.int16 if canon <= 32000 else np.int32
+            ring = jnp.zeros((lag, canon, TABLE_WIDTH), jnp.float32)
+            pairs = jnp.zeros((3, canon), pair_dtype)
+            src = jnp.zeros((canon, TABLE_WIDTH), jnp.float32)
+            ring = _ring_put(ring, 0, src)  # donates its input: reassign
+            ring.block_until_ready()
+            for alloc in ladder:
+                # Every batch's final table canonicalizes once (per-rung
+                # compile) and every destination rung patches the whole
+                # ring in one call — 2 compiles per rung, not rung^2.
+                _canonical_rows(
+                    jnp.zeros((alloc + 1, TABLE_WIDTH), jnp.float32), canon
+                ).block_until_ready()
+                dst = jnp.zeros((alloc + 1, TABLE_WIDTH), jnp.float32)
+                _chain_patch_pairs(dst, ring, pairs).block_until_ready()
+        logger.info(
+            "warmup compiled the %d-rung row ladder in %.1fs",
+            len(ladder), self.clock() - t0,
+        )
 
     def _measure_pipeline_costs(self) -> None:
         """Feeds ``choose_pipeline_lag``: the dispatch->fetch round trip
@@ -375,6 +381,28 @@ class Worker:
             "pipeline cost probe: rtt %.0f ms, host %.0f ms/batch",
             (rtt or 0.0) * 1e3, host * 1e3,
         )
+
+    def resolved_pipeline_lag(self) -> int:
+        """The commit lag the pipelined engine will run with: the pinned
+        ``PIPELINE_LAG`` when set, else the warmup probe's measurement
+        through ``choose_pipeline_lag``, else the default. One owner —
+        warmup compiles the chain ring at this depth and the engine must
+        build it identically."""
+        from analyzer_tpu.service.pipeline import (
+            DEFAULT_LAG, choose_pipeline_lag,
+        )
+
+        if self.config.pipeline_lag is not None:
+            return max(1, int(self.config.pipeline_lag))
+        if self.measured_rtt_s is not None and self.measured_host_s is not None:
+            lag = choose_pipeline_lag(self.measured_rtt_s, self.measured_host_s)
+            logger.info(
+                "pipeline lag auto-tuned to %d (rtt %.0f ms, host "
+                "%.0f ms/batch)", lag, self.measured_rtt_s * 1e3,
+                self.measured_host_s * 1e3,
+            )
+            return lag
+        return DEFAULT_LAG
 
     # -- batch pipeline ---------------------------------------------------
     def _bucketed_schedule(self, stream, pad_row: int):
