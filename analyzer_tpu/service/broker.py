@@ -141,6 +141,7 @@ def make_pika_broker(uri: str, prefetch: int = 0):
             self._prefetch = int(prefetch or 0)
             self._declared: list[str] = []
             self._consuming: list[str] = []
+            self._consumer_tag: dict[str, object] = {}  # queue -> tag
             self._buf: dict[str, deque[Message]] = {}
             self._tags = itertools.count(1)
             self._live: dict[int, int] = {}  # synthetic -> channel tag
@@ -164,6 +165,7 @@ def make_pika_broker(uri: str, prefetch: int = 0):
             # tags can never ack a new-channel message.
             self._buf = {q: deque() for q in self._buf}
             self._live.clear()
+            self._consumer_tag.clear()  # old channel's tags are invalid
             try:
                 self._conn.close()
             except Exception:  # noqa: BLE001 — already dead is fine
@@ -193,11 +195,12 @@ def make_pika_broker(uri: str, prefetch: int = 0):
                 )
 
             try:
-                self._ch.basic_consume(
+                tag = self._ch.basic_consume(
                     queue=queue, on_message_callback=on_message
                 )
             except TypeError:  # pika 0.10 legacy signature (the reference's pin)
-                self._ch.basic_consume(on_message, queue=queue)
+                tag = self._ch.basic_consume(on_message, queue=queue)
+            self._consumer_tag[queue] = tag
 
         # -- Broker protocol ---------------------------------------------
         def declare_queue(self, name: str) -> None:
@@ -256,19 +259,34 @@ def make_pika_broker(uri: str, prefetch: int = 0):
                 self._reconnect(e)
 
         def set_prefetch(self, prefetch: int) -> None:
-            """Re-bounds the per-consumer QoS window on the live channel
-            (and across reconnects). Used by a worker whose pipelined
-            mode permanently degrades: the wide in-flight window sized
-            for deferred acks would otherwise keep hogging deliveries a
+            """Re-bounds the per-consumer QoS window (and across
+            reconnects). Used by a worker whose pipelined mode
+            permanently degrades: the wide in-flight window sized for
+            deferred acks would otherwise keep hogging deliveries a
             sequential consumer can't keep up with, starving healthy
-            competing consumers on the same queue."""
+            competing consumers on the same queue.
+
+            RabbitMQ applies per-consumer (global=false) QoS at
+            CONSUMER CREATION, so changing basic_qos alone would be a
+            no-op for the live subscription — existing consumers are
+            cancelled and re-registered under the new bound. Deliveries
+            already buffered stay valid (their unacked window drains as
+            the caller processes them)."""
             self._prefetch = int(prefetch or 0)
-            if self._prefetch:
-                self._retry(
-                    lambda: self._ch.basic_qos(
-                        prefetch_count=self._prefetch
-                    )
-                )
+
+            def op():
+                if self._prefetch:
+                    self._ch.basic_qos(prefetch_count=self._prefetch)
+                for queue, tag in list(self._consumer_tag.items()):
+                    try:
+                        self._ch.basic_cancel(tag)
+                    except Exception:  # noqa: BLE001 — already-gone tag
+                        pass
+                    self._consumer_tag.pop(queue, None)
+                for queue in self._consuming:
+                    self._subscribe(queue)
+
+            self._retry(op)
 
         def ack(self, delivery_tag: int) -> None:
             self._settle(delivery_tag, self._ch.basic_ack)

@@ -88,10 +88,6 @@ def _cumcount(keys: np.ndarray) -> np.ndarray:
     return out
 
 
-def _obj_col(rows: list, idx: int) -> np.ndarray:
-    return np.array([r[idx] for r in rows], dtype=object)
-
-
 def _float_col(col) -> np.ndarray:
     """column (object numbers/None, or an already-typed array) ->
     float64 with NaN for NULL."""

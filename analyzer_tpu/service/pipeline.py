@@ -14,8 +14,8 @@ batch's host work:
     Instead, N+1 is encoded from a (stale-by-<=lag) store snapshot and its
     player table is PATCHED ON DEVICE from the final device-resident
     tables of the in-flight batches, held in a ``[lag, rows, W]`` ring:
-    ONE jitted call applies the whole chain oldest-first
-    (``_chain_patch_ring``), keyed by player-id overlap computed on the
+    ONE jitted call applies the whole chain
+    (``_chain_patch_pairs``), keyed by player-id overlap computed on the
     host from the encoders' ``row_of`` maps. Only the 14 rating columns
     copy — seeds derive from static features the worker never writes,
     and the destination batch's are fresher. The posterior never visits
@@ -123,7 +123,7 @@ class PipelineFallback(Exception):
 @partial(jax.jit, static_argnames=("rows",))
 def _canonical_rows(table, rows: int):
     """Zero-pads a final batch table to the worker's MAX row bucket.
-    Chain sources are canonicalized ONCE per batch so ``_chain_patch``
+    Chain sources are canonicalized ONCE per batch so ``_chain_patch_pairs``
     compiles per destination rung only — without this, mixed-size
     batch successions (a full batch after an idle flush) would compile
     every (dst_rows, src_rows) PAIR in the ladder (64 shapes at
@@ -135,6 +135,28 @@ def _canonical_rows(table, rows: int):
 def _ring_put(ring, slot, table):
     """Writes one canonicalized batch table into the chain ring."""
     return ring.at[slot].set(table)
+
+
+def pair_index_dtype(canon_rows: int):
+    """int16 halves the per-batch pair upload; row/pad indices only
+    exceed it under a far-over-default BATCHSIZE."""
+    return np.int16 if canon_rows <= 32000 else np.int32
+
+
+def chain_buffers(lag: int, canon_rows: int):
+    """(ring, pairs, pair_dtype) for a chain of depth ``lag`` over
+    ``canon_rows``-row canonical tables — the ONE owner of the ring
+    shape and the pair index dtype, shared by ``Worker.warmup`` (which
+    must compile exactly the shapes production hits) and
+    ``PipelineEngine`` (which runs them)."""
+    import jax.numpy as jnp
+
+    from analyzer_tpu.core.state import TABLE_WIDTH
+
+    dtype = pair_index_dtype(canon_rows)
+    ring = jnp.zeros((lag, canon_rows, TABLE_WIDTH), jnp.float32)
+    pairs = jnp.zeros((3, canon_rows), dtype)
+    return ring, pairs, dtype
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -255,10 +277,16 @@ class _Writer(threading.Thread):
     def wait_left(self, seq: int) -> bool:
         """Blocks until every job with ``seq' <= seq`` has left the
         writer (ok OR aborted). Returns False when the stream is
-        poisoned — the caller must go through harvest."""
+        poisoned OR the writer thread is dead (jobs can never leave a
+        dead writer — without the liveness check this gate would hang
+        the consumer forever) — either way the caller must go through
+        harvest, which aborts stranded jobs for sequential
+        reprocessing."""
         with self.cv:
             while self.left_seq < seq and not self.poisoned:
-                self.cv.wait()
+                if not self.is_alive():
+                    return False
+                self.cv.wait(0.1)
             return not self.poisoned
 
     def wait_idle(self) -> None:
@@ -360,18 +388,14 @@ class PipelineEngine:
         # batches, newest last. The batches' canonicalized final tables
         # live DEVICE-SIDE in a [lag, canon_rows, W] ring (slot =
         # seq % lag), so the whole chain applies in one dispatch
-        # (_chain_patch_ring) instead of one per entry.
+        # (_chain_patch_pairs) instead of one per entry.
         self.chain: deque = deque(maxlen=self.lag)
         self._ring = None  # lazy: created at the first ringable batch
         self.seq = 0
         # One owner for the compile-shape knobs: the worker (warmup and
         # schedule bucketing read the same attributes).
         self._canon_rows = worker._canon_rows
-        # int16 halves the per-batch pair upload; row/pad indices only
-        # exceed it under a far-over-default BATCHSIZE.
-        self._pair_dtype = (
-            np.int16 if self._canon_rows <= 32000 else np.int32
-        )
+        self._pair_dtype = pair_index_dtype(self._canon_rows)
 
     # -- submission -------------------------------------------------------
     def submit(self, msgs: list) -> None:
@@ -386,8 +410,14 @@ class PipelineEngine:
         w = self.worker
         # Gate: the store snapshot below must include every commit up to
         # seq - lag, so at most `lag` uncommitted batches need chaining.
-        if not self.writer.wait_left(self.seq - self.lag):
-            raise PipelineFallback("pipeline poisoned; harvest first")
+        # The liveness check runs even when no waiting is needed — an
+        # early-lag gate passes trivially, and enqueuing to a dead
+        # writer would strand the batch's messages unacked forever.
+        if not self.writer.is_alive() or not self.writer.wait_left(
+            self.seq - self.lag
+        ):
+            raise PipelineFallback("pipeline poisoned or writer dead; "
+                                   "harvest first")
         ids = [m.body.decode() for m in msgs]
         try:
             enc = self._encode_fresh(ids)
@@ -396,6 +426,11 @@ class PipelineEngine:
             # seed-consulted KeyError gate (module docstring); retry once
             # from fully committed state before isolating.
             self.drain()
+            if not self.worker.pipeline_enabled or not self.writer.is_alive():
+                # The drain's harvest disabled the pipeline (dead
+                # writer): this engine is orphaned — enqueuing to it
+                # would strand the batch's messages unacked forever.
+                raise PipelineFallback("pipeline disabled during drain")
             enc = self._encode_fresh(ids)
         n = len(enc.matches) if enc is not None else 0
         logger.info("processing batch of %s matches (pipelined)", n)
@@ -446,14 +481,8 @@ class PipelineEngine:
         )
         rows = int(final.table.shape[0])
         if rows <= self._canon_rows:
-            import jax.numpy as jnp
-
-            from analyzer_tpu.core.state import TABLE_WIDTH
-
             if self._ring is None:
-                self._ring = jnp.zeros(
-                    (self.lag, self._canon_rows, TABLE_WIDTH), jnp.float32
-                )
+                self._ring, _, _ = chain_buffers(self.lag, self._canon_rows)
             self._ring = _ring_put(
                 self._ring, self.seq % self.lag,
                 _canonical_rows(final.table, self._canon_rows),
@@ -498,11 +527,12 @@ class PipelineEngine:
         fan-out for successes, the worker's failure policy for the first
         failure, sequential reprocessing for aborted followers."""
         w = self.worker
-        if not self.writer.is_alive() and self.writer.poisoned:
+        if not self.writer.is_alive():
             self.writer.wait_idle()  # recover jobs stranded by a dead writer
             # A dead writer never produces a `failed` job to reset the
-            # poison, so without this every later flush would pay
-            # PipelineFallback + sequential reprocessing forever.
+            # poison (or to advance left_seq at all), so without this
+            # every later flush would pay PipelineFallback + sequential
+            # reprocessing forever — or hang on the submit gate.
             self.chain.clear()
             w._disable_pipeline("pipeline writer died")
         jobs = self._pop_done()

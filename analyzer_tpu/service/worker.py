@@ -289,15 +289,15 @@ class Worker:
             from analyzer_tpu.core.state import TABLE_WIDTH
             from analyzer_tpu.service.pipeline import (
                 _canonical_rows, _chain_patch_pairs, _ring_put,
+                chain_buffers,
             )
 
             # The probe ran FIRST so the ring compiles at the lag the
-            # engine will actually resolve.
+            # engine will actually resolve; one owner (chain_buffers)
+            # keeps these the shapes production hits.
             lag = self.resolved_pipeline_lag()
             canon = self._canon_rows
-            pair_dtype = np.int16 if canon <= 32000 else np.int32
-            ring = jnp.zeros((lag, canon, TABLE_WIDTH), jnp.float32)
-            pairs = jnp.zeros((3, canon), pair_dtype)
+            ring, pairs, _ = chain_buffers(lag, canon)
             src = jnp.zeros((canon, TABLE_WIDTH), jnp.float32)
             ring = _ring_put(ring, 0, src)  # donates its input: reassign
             ring.block_until_ready()
@@ -527,6 +527,12 @@ class Worker:
             self._process_batch_sequential(batch)
             return
         engine.harvest()  # apply whatever completed since the last flush
+        if not self.pipeline_enabled or self._engine is None:
+            # harvest itself disabled the pipeline (dead writer):
+            # submitting to the orphaned engine would strand this
+            # batch's messages unacked in a queue nothing drains.
+            self._process_batch_sequential(batch)
+            return
         try:
             engine.submit(batch)
         except PipelineFallback:

@@ -85,8 +85,9 @@ def make_stub_pika():
             self.acked = []
             self.nacked = []
             self._tag = 0
+            self._ctag_seq = 0
             self._prefetch = 0
-            self._consumers: list[tuple[str, object]] = []
+            self._consumers: list[tuple[str, str, object]] = []
             self._unacked: dict[int, tuple] = {}
 
         def _check(self):
@@ -104,7 +105,16 @@ def make_stub_pika():
 
         def basic_consume(self, queue=None, on_message_callback=None):
             self._check()
-            self._consumers.append((queue, on_message_callback))
+            tag = f"ctag{self._ctag_seq}"
+            self._ctag_seq += 1
+            self._consumers.append((tag, queue, on_message_callback))
+            return tag
+
+        def basic_cancel(self, consumer_tag):
+            self._check()
+            self._consumers = [
+                c for c in self._consumers if c[0] != consumer_tag
+            ]
 
         def basic_publish(self, exchange, routing_key, body, properties=None):
             self._check()
@@ -118,7 +128,7 @@ def make_stub_pika():
 
         def _pump(self):
             self._check()
-            for queue, cb in self._consumers:
+            for _tag, queue, cb in self._consumers:
                 q = self._server.queues.get(queue)
                 while q and (
                     self._prefetch == 0 or len(self._unacked) < self._prefetch
@@ -321,6 +331,29 @@ class TestPushConsume:
             broker.ack(m.delivery_tag)
         got2 = broker.get("q", 10)
         assert [m.body for m in got2] == [b"2", b"3"]
+
+    def test_set_prefetch_rebounds_the_live_consumer(self, stub_pika):
+        # RabbitMQ fixes per-consumer QoS at consumer creation, so a
+        # bare basic_qos would be a no-op for the live subscription —
+        # set_prefetch must cancel + re-register (ADVICE-style finding,
+        # round 5: a degraded worker narrowing its window).
+        from analyzer_tpu.service.broker import make_pika_broker
+
+        broker = make_pika_broker("amqp://localhost", prefetch=4)
+        broker.declare_queue("q")
+        for i in range(8):
+            broker.publish("q", f"{i}".encode())
+        got = broker.get("q", 10)
+        assert len(got) == 4  # wide window
+        broker.set_prefetch(1)
+        # exactly ONE consumer remains (cancel + re-subscribe, no dup)
+        assert len(broker._ch._consumers) == 1
+        assert broker._ch._prefetch == 1
+        for m in got:
+            broker.ack(m.delivery_tag)
+        got2 = broker.get("q", 10)
+        assert len(got2) == 1  # narrowed window actually bounds pushes
+        broker.ack(got2[0].delivery_tag)
 
     def test_dropped_connection_reconnects_and_redelivers(self, stub_pika):
         from analyzer_tpu.service.broker import make_pika_broker

@@ -230,7 +230,52 @@ class FlakyStore:
         return self._inner.commit_columnar(plan)
 
 
-class TestFailureDuringOverlap:
+class TestDeadWriter:
+    def test_writer_death_midstream_degrades_without_stranding(self, tmp_path):
+        # A writer thread that exits mid-stream must never strand
+        # messages: the submit gate detects the dead thread instead of
+        # waiting forever (wait_left liveness check), harvest aborts any
+        # stranded jobs for sequential reprocessing, and the worker
+        # degrades to the sequential loop. Every message ends acked or
+        # dead-lettered; the final rows equal an all-sequential run.
+        n, bs = 24, 4
+
+        def run(kill_after: int | None):
+            path = str(tmp_path / f"dead_{kill_after}.db")
+            seed_db(path, n_matches=n)
+            broker = InMemoryBroker()
+            store = SqlStore(f"sqlite:///{path}")
+            cfg = ServiceConfig(batch_size=bs, idle_timeout=0.0)
+            w = Worker(broker, store, cfg, RatingConfig(),
+                       pipeline=kill_after is not None)
+            for i in range(n):
+                broker.publish(cfg.queue, f"m{i}".encode())
+            flushes = 0
+            for _ in range(10 * n):
+                if w.poll():
+                    flushes += 1
+                    if kill_after is not None and flushes == kill_after:
+                        eng = w._engine
+                        assert eng is not None
+                        eng.writer.stop()  # thread exits once drained
+                        eng.writer.join(timeout=10)
+                        assert not eng.writer.is_alive()
+                if broker.qsize(cfg.queue) == 0 and not w.queue:
+                    if w._engine is None or w._engine.idle:
+                        break
+            w.drain()
+            w.close()
+            assert not broker._unacked
+            assert broker.qsize(cfg.failed_queue) == 0
+            conn = sqlite3.connect(path)
+            rows = conn.execute(
+                "SELECT api_id, trueskill_mu, trueskill_ranked_mu"
+                " FROM player ORDER BY api_id"
+            ).fetchall()
+            conn.close()
+            return rows
+
+        assert run(kill_after=2) == run(kill_after=None)
     def test_failed_batch_does_not_taint_followers(self, tmp_path):
         """Batch 2's commit fails while batch 3 is already in flight
         (chained off batch 2's uncommitted device state). Required
