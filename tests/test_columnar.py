@@ -233,6 +233,31 @@ class TestPoisonParity:
         store.close()
 
 
+class TestEdges:
+    def test_unknown_ids_fall_through_to_ack(self, tmp_path):
+        # The reference's query simply returns no rows for unknown ids
+        # and the messages ack (worker.py:122-129); the columnar lane
+        # must do the same — including an ALL-unknown batch (empty
+        # encode) and a mixed one.
+        path = str(tmp_path / "ghost.db")
+        seed_db(path, n_matches=2)
+        broker = InMemoryBroker()
+        store = SqlStore(f"sqlite:///{path}")
+        cfg = ServiceConfig(batch_size=3, idle_timeout=0.0)
+        w = Worker(broker, store, cfg, RatingConfig(), pipeline=True)
+        for mid in ("ghost1", "ghost2", "ghost3", "m0", "ghost4", "m1"):
+            broker.publish(cfg.queue, mid.encode())
+        for _ in range(40):
+            if not w.poll() and broker.qsize(cfg.queue) == 0:
+                break
+        w.drain()
+        w.close()
+        assert w.matches_rated == 2
+        assert broker.qsize(cfg.failed_queue) == 0
+        assert not broker._unacked
+        store.close()
+
+
 class TestNativeLoader:
     def test_native_and_row_bundles_encode_identically(self, tmp_path):
         # Same batch through load_batch_native (C scanner, typed arrays)
