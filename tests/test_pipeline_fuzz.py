@@ -16,13 +16,14 @@ ordinal-keyed faults would diverge by construction.
 Provenance: 166 seeds checked divergence-free offline in round 4 — the
 6 committed here, 120 more of this shape, and 40 stress variants (MULTIPLE
 content-keyed failures per run, duplicate message deliveries, batch sizes
-down to 1). Round 5 re-ran 140 fresh seeds divergence-free after the
-COLUMNAR lane became the SqlStore default — 80 of this shape (seeds
-200-279) plus 60 stress variants (seeds 500-559: up to 2 content-keyed
-failures per run, ~20% duplicate deliveries, batch sizes down to 1) —
-the fault injection is lane-agnostic (commit_columnar keyed on the
-plan's match api_ids), so the sweeps exercise the columnar pipelined
-writer end to end.
+down to 1). Round 5 ran 310 fresh seeds divergence-free across the
+COLUMNAR lane's introduction and the chain-ring/pairs redesigns — 80 of
+this shape (seeds 200-279), 60 stress variants (seeds 500-559: up to 2
+content-keyed failures per run, ~20% duplicate deliveries, batch sizes
+down to 1), 50 after the device-ring chain (900-949), and 120 after the
+final compact-pairs design (1000-1119) — the fault injection is
+lane-agnostic (commit_columnar keyed on the plan's match api_ids), so
+the sweeps exercise the columnar pipelined writer end to end.
 """
 
 import sqlite3
