@@ -688,6 +688,30 @@ class Worker:
         dt = self.clock() - self._started_at
         return self.matches_rated / dt if dt > 0 else 0.0
 
+    def stats(self) -> dict:
+        """One operator-facing snapshot of the counters the reference
+        never had (SURVEY.md section 5.5: its only observability was
+        debug logs): throughput, failure counts, and the pipelined
+        lane's health — ready for a metrics scraper or a periodic log
+        line."""
+        return {
+            "matches_rated": self.matches_rated,
+            "batches_failed": self.batches_failed,
+            "matches_per_sec": round(self.matches_per_sec, 1),
+            "pipeline_enabled": self.pipeline_enabled,
+            "pipeline_degraded": self.pipeline_degraded,
+            "pipeline_engine_failures": self.pipeline_engine_failures,
+            "pipeline_lag": self._engine.lag if self._engine else None,
+            "measured_rtt_ms": (
+                round(self.measured_rtt_s * 1e3, 1)
+                if self.measured_rtt_s is not None else None
+            ),
+            "measured_host_ms": (
+                round(self.measured_host_s * 1e3, 1)
+                if self.measured_host_s is not None else None
+            ),
+        }
+
     @property
     def pipeline_degraded(self) -> bool:
         """True while a pipeline-configured worker is routing batches

@@ -126,6 +126,29 @@ class TestCompileChurn:
 
 
 class TestWarmup:
+    def test_warmup_probe_feeds_auto_lag_and_stats(self):
+        # With PIPELINE_LAG unset, warmup measures the dispatch->fetch
+        # round trip and per-batch host cost; the resolved lag must land
+        # inside the clamp and surface through the stats() snapshot.
+        from analyzer_tpu.config import PIPELINE_MAX_LAG, PIPELINE_MIN_LAG
+
+        w = Worker(
+            InMemoryBroker(), InMemoryStore(),
+            ServiceConfig(batch_size=8, idle_timeout=0.0),
+            RatingConfig(), pipeline=True,
+        )
+        w.warmup()
+        assert w.measured_rtt_s is not None and w.measured_rtt_s > 0
+        assert w.measured_host_s is not None and w.measured_host_s > 0
+        assert (
+            PIPELINE_MIN_LAG <= w.resolved_pipeline_lag() <= PIPELINE_MAX_LAG
+        )
+        s = w.stats()
+        assert s["measured_rtt_ms"] > 0 and s["measured_host_ms"] > 0
+        assert s["pipeline_enabled"] is True
+        assert s["pipeline_degraded"] is False
+        assert s["matches_rated"] == 0
+
     def test_warmup_precompiles_full_batch_shape(self):
         # After warmup, a full batch of fresh 3v3 matches must hit the
         # jit cache — zero compilation on the first real message.
