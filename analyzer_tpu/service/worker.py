@@ -199,7 +199,8 @@ class Worker:
                     self._first_message_at = None
                     self._stop_requested = False
                     logger.info(
-                        "stop requested; exiting after %s batches", flushes
+                        "stop requested; exiting after %s batches: %s",
+                        flushes, self.stats(),
                     )
                     return
                 if deadline is not None and self.clock() > deadline:
