@@ -258,6 +258,64 @@ class TestEdges:
         store.close()
 
 
+class TestSchemaVariants:
+    def test_reduced_schema_keeps_lane_parity(self, tmp_path):
+        # Runtime reflection is the reference's L2 contract: columns the
+        # deployed schema lacks are silently dropped at write time
+        # (automap never flushes a non-column attribute). Drop
+        # participant.trueskill_delta and the casual/br/5v5 player pairs
+        # and require both lanes to agree on the surviving columns.
+        import sqlite3 as sq
+
+        def build(path):
+            seed_db(path, n_matches=8)
+            conn = sq.connect(path)
+            try:
+                conn.execute(
+                    "ALTER TABLE participant DROP COLUMN trueskill_delta"
+                )
+                for col in ("trueskill_casual_mu", "trueskill_casual_sigma",
+                            "trueskill_br_mu", "trueskill_br_sigma"):
+                    conn.execute(f"ALTER TABLE player DROP COLUMN {col}")
+            except sq.OperationalError:
+                pytest.skip("sqlite without DROP COLUMN support")
+            conn.commit()
+            conn.close()
+
+        def dump(path):
+            conn = sq.connect(path)
+            out = {}
+            for table in ("match", "participant", "player",
+                          "participant_items"):
+                cols = [r[1] for r in conn.execute(
+                    f"PRAGMA table_info({table})"
+                ).fetchall()]
+                out[table] = conn.execute(
+                    f"SELECT {', '.join(cols)} FROM {table}"
+                    " ORDER BY api_id"
+                ).fetchall()
+            conn.close()
+            return out
+
+        a, b = str(tmp_path / "ro.db"), str(tmp_path / "rc.db")
+        build(a)
+        build(b)
+        ids = [f"m{i}" for i in range(8)]
+        fa = run_worker(a, ids, force_object_lane=True, batch_size=4)
+        fb = run_worker(b, ids, force_object_lane=False, batch_size=4)
+        assert fa == fb == []
+        da, db = dump(a), dump(b)
+        assert da == db
+        # The surviving ranked pair was actually written.
+        conn = sq.connect(b)
+        n = conn.execute(
+            "SELECT COUNT(*) FROM player WHERE trueskill_ranked_mu"
+            " IS NOT NULL"
+        ).fetchone()[0]
+        conn.close()
+        assert n > 0
+
+
 class TestNativeLoader:
     def test_native_and_row_bundles_encode_identically(self, tmp_path):
         # Same batch through load_batch_native (C scanner, typed arrays)
