@@ -702,7 +702,14 @@ class Worker:
             "pipeline_enabled": self.pipeline_enabled,
             "pipeline_degraded": self.pipeline_degraded,
             "pipeline_engine_failures": self.pipeline_engine_failures,
-            "pipeline_lag": self._engine.lag if self._engine else None,
+            # The engine is built lazily at the first flush, but the lag
+            # is already resolved (warmup probe / pinned config) — report
+            # it whenever pipelined mode is on, None only when it's off.
+            "pipeline_lag": (
+                self._engine.lag if self._engine is not None
+                else (self.resolved_pipeline_lag()
+                      if self.pipeline_enabled else None)
+            ),
             "measured_rtt_ms": (
                 round(self.measured_rtt_s * 1e3, 1)
                 if self.measured_rtt_s is not None else None
