@@ -14,6 +14,7 @@ Subcommands:
   elo     Elo re-rate of a stream + prediction accuracy
   bench   the headline throughput benchmark (one JSON line)
   worker  the broker-consuming service loop (needs pika)
+  lint    graftlint static analysis (JAX hazards + native ABI, docs/lint.md)
 """
 
 from __future__ import annotations
@@ -752,6 +753,22 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """graftlint: the JAX-hazard + native-ABI static analysis pass.
+
+    Deliberately a thin delegate — the lint package is jax- and
+    numpy-free so CI can gate on it in milliseconds; everything heavy in
+    this module stays behind the other subcommands' lazy imports."""
+    from analyzer_tpu.lint.__main__ import main as lint_main
+
+    argv = list(args.paths)
+    if args.json:
+        argv.append("--json")
+    if args.rules:
+        argv.append("--rules")
+    return lint_main(argv)
+
+
 def cmd_worker(args) -> int:
     if args.requeue_failed:
         # Dead-letter redrive: move <QUEUE>_failed back onto the main
@@ -889,6 +906,21 @@ def main(argv=None) -> int:
 
     s = sub.add_parser("bench", help="headline throughput benchmark")
     s.set_defaults(fn=cmd_bench)
+
+    s = sub.add_parser(
+        "lint",
+        help="graftlint: JAX-hazard + native-ABI static analysis "
+        "(docs/lint.md; exit 1 on findings)",
+    )
+    s.add_argument(
+        "paths", nargs="*", default=["analyzer_tpu"],
+        help="files or directories to lint (default: analyzer_tpu)",
+    )
+    s.add_argument("--json", action="store_true", help="JSON output")
+    s.add_argument(
+        "--rules", action="store_true", help="print the rule catalog"
+    )
+    s.set_defaults(fn=cmd_lint)
 
     s = sub.add_parser("worker", help="broker-consuming service loop")
     s.add_argument(

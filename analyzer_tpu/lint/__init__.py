@@ -1,0 +1,12 @@
+"""graftlint — the repo's JAX-hazard + native-ABI static analysis pass.
+
+Run ``python -m analyzer_tpu.lint [paths]`` (or ``python -m
+analyzer_tpu.cli lint``). Rule catalog and suppression syntax:
+``docs/lint.md``. Pure stdlib ``ast`` — importing this package never
+imports jax/numpy, so it lints in milliseconds anywhere.
+"""
+
+from analyzer_tpu.lint.findings import RULES, Finding
+from analyzer_tpu.lint.runner import lint_paths, lint_source
+
+__all__ = ["Finding", "RULES", "lint_paths", "lint_source"]

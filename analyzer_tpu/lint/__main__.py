@@ -1,0 +1,80 @@
+"""CLI entry: ``python -m analyzer_tpu.lint [paths] [--json]``.
+
+Exit codes (CI contract):
+  0  clean
+  1  findings (or unparseable files)
+  2  usage error
+
+The linter itself never imports jax, but a linted loader module is next
+to ``.so`` artifacts and the process may be embedded in larger tooling —
+pin JAX_PLATFORMS=cpu defensively so nothing an import chain drags in
+ever probes for a TPU.
+"""
+
+from __future__ import annotations
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import argparse  # noqa: E402
+import json  # noqa: E402
+import sys  # noqa: E402
+
+from analyzer_tpu.lint.findings import RULES  # noqa: E402
+from analyzer_tpu.lint.runner import lint_paths  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m analyzer_tpu.lint",
+        description="graftlint: JAX-hazard + native-ABI static analysis",
+    )
+    p.add_argument(
+        "paths", nargs="*", default=["analyzer_tpu"],
+        help="files or directories to lint (default: analyzer_tpu)",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="machine-readable output (one JSON object)",
+    )
+    p.add_argument(
+        "--rules", action="store_true", help="print the rule catalog and exit"
+    )
+    try:
+        args = p.parse_args(argv)
+    except SystemExit as e:
+        return 0 if e.code in (0, None) else 2
+    if args.rules:
+        for rule_id, desc in sorted(RULES.items()):
+            print(f"{rule_id}  {desc}")
+        return 0
+    missing = [path for path in args.paths if not os.path.exists(path)]
+    if missing:
+        print(f"error: no such path: {', '.join(missing)}", file=sys.stderr)
+        return 2
+    findings, errors = lint_paths(args.paths)
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_json() for f in findings],
+                    "errors": errors,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        for e in errors:
+            print(f"error: {e}", file=sys.stderr)
+        if not findings and not errors:
+            print("graftlint: clean")
+        elif findings:
+            print(f"graftlint: {len(findings)} finding(s)")
+    return 1 if findings or errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

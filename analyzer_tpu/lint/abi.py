@@ -1,0 +1,356 @@
+"""Native ABI cross-check (GL010-GL013): ``extern "C"`` signatures in the
+C++ sources vs the ``argtypes``/``restype`` declarations in their ctypes
+loaders.
+
+ctypes has no compiler in the loop — a drifted declaration (wrong width,
+missed pointer, stale arity) is undefined behavior at call time, usually
+a heap smash that surfaces far from the cause. This pass re-derives both
+sides: a small tokenizer over the ``.cc`` (no clang dependency; the
+sources keep to plain C types + simple typedefs, which is all the ABI
+boundary may use anyway) and an AST walk over the loader. Types compare
+as (kind, bits, pointer-depth); signedness counts.
+
+Pairs are discovered, not configured: any linted module that calls
+``build_and_load`` and names exactly one ``.cc`` source is checked
+against that source (resolved next to the module, the loader layout).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from analyzer_tpu.lint.findings import Finding
+
+#: kind -> canonical (category, bits). ``char``/``void`` stay nominal so
+#: char* vs void* mismatches are visible in messages.
+_C_BASE = {
+    "void": "void", "char": "char", "bool": "u8",
+    "int": "i32", "unsigned": "u32", "unsigned int": "u32",
+    "short": "i16", "unsigned short": "u16", "short int": "i16",
+    "long": "i64", "unsigned long": "u64", "long long": "i64",
+    "unsigned long long": "u64", "long int": "i64",
+    "float": "f32", "double": "f64",
+    "int8_t": "i8", "uint8_t": "u8", "int16_t": "i16", "uint16_t": "u16",
+    "int32_t": "i32", "uint32_t": "u32", "int64_t": "i64", "uint64_t": "u64",
+    "size_t": "u64", "ssize_t": "i64", "intptr_t": "i64", "uintptr_t": "u64",
+}
+
+_CTYPES_BASE = {
+    "c_int8": "i8", "c_byte": "i8", "c_uint8": "u8", "c_ubyte": "u8",
+    "c_int16": "i16", "c_short": "i16", "c_uint16": "u16", "c_ushort": "u16",
+    "c_int32": "i32", "c_int": "i32", "c_uint32": "u32", "c_uint": "u32",
+    "c_int64": "i64", "c_long": "i64", "c_longlong": "i64",
+    "c_uint64": "u64", "c_ulong": "u64", "c_ulonglong": "u64",
+    "c_size_t": "u64", "c_ssize_t": "i64",
+    "c_float": "f32", "c_double": "f64",
+    "c_char": "char", "c_bool": "u8",
+}
+
+_COMMENT_RE = re.compile(r"//[^\n]*|/\*.*?\*/", re.DOTALL)
+_TYPEDEF_RE = re.compile(r"typedef\s+([A-Za-z_][\w\s]*?)\s+(\w+)\s*;")
+
+
+class CType:
+    """(kind, pointer depth). ``kind='?'`` means unparseable — compared
+    as compatible so an exotic type degrades to silence, not noise."""
+
+    __slots__ = ("kind", "depth")
+
+    def __init__(self, kind: str, depth: int = 0):
+        self.kind = kind
+        self.depth = depth
+
+    def __eq__(self, other) -> bool:
+        if "?" in (self.kind, other.kind):
+            return True
+        return self.kind == other.kind and self.depth == other.depth
+
+    def __repr__(self) -> str:
+        return self.kind + "*" * self.depth
+
+
+def _strip_comments(text: str) -> str:
+    # Replace with spaces/newlines preserved so offsets->lines survive.
+    def blank(m: re.Match) -> str:
+        return "".join("\n" if c == "\n" else " " for c in m.group(0))
+
+    return _COMMENT_RE.sub(blank, text)
+
+
+def _parse_c_type(tokens: list[str], typedefs: dict[str, str]) -> CType | None:
+    depth = tokens.count("*")
+    words = [t for t in tokens if t != "*"]
+    words = [w for w in words if w not in ("const", "volatile", "restrict",
+                                           "struct", "signed")]
+    words = [typedefs.get(w, w) for w in words]
+    if not words:
+        return None
+    base = " ".join(words)
+    if base in _C_BASE:
+        return CType(_C_BASE[base], depth)
+    if len(words) > 1:
+        # Last word may be the parameter name: retry without it.
+        base = " ".join(words[:-1])
+        if base in _C_BASE:
+            return CType(_C_BASE[base], depth)
+    return CType("?", depth)
+
+
+def _parse_sig(decl: str, typedefs: dict[str, str]):
+    m = re.match(r"^(.*?)\b(\w+)\s*\(\s*(.*?)\s*\)$", decl.strip(), re.DOTALL)
+    if not m:
+        return None
+    ret_txt, name, params_txt = m.groups()
+    ret_tokens = ret_txt.replace("*", " * ").split()
+    if not ret_tokens or any(
+        t in ("return", "if", "while", "switch", "for", "sizeof", "=")
+        for t in ret_tokens
+    ):
+        return None
+    ret = _parse_c_type(ret_tokens, typedefs)
+    if ret is None:
+        return None
+    args: list[CType] = []
+    if params_txt and params_txt != "void":
+        for param in params_txt.split(","):
+            t = _parse_c_type(param.replace("*", " * ").split(), typedefs)
+            if t is None:
+                return None
+            args.append(t)
+    return name, ret, args
+
+
+def _signatures_in(text: str, typedefs: dict[str, str], line0: int):
+    """Yields (name, ret, args, line) for function definitions/prototypes
+    at brace depth 0 of ``text`` (bodies are skipped wholesale)."""
+    i, buf_start, line = 0, 0, line0
+    while i < len(text):
+        c = text[i]
+        if c == "\n":
+            line += 1
+        if c in "{;":
+            decl = text[buf_start:i]
+            sig = _parse_sig(decl, typedefs)
+            if sig:
+                yield (*sig, line - decl.count("\n") + decl[: max(
+                    decl.find(sig[0]), 0)].count("\n"))
+            if c == "{":
+                depth = 1
+                i += 1
+                while i < len(text) and depth:
+                    if text[i] == "{":
+                        depth += 1
+                    elif text[i] == "}":
+                        depth -= 1
+                    elif text[i] == "\n":
+                        line += 1
+                    i += 1
+                buf_start = i
+                continue
+            buf_start = i + 1
+        i += 1
+
+
+def parse_extern_c(cc_path: str) -> dict[str, dict]:
+    """name -> {ret, args, line} for every ``extern "C"`` function in the
+    file — both the block form and per-declaration form."""
+    with open(cc_path, encoding="utf-8", errors="replace") as f:
+        text = _strip_comments(f.read())
+    typedefs = {
+        m.group(2): m.group(1).strip() for m in _TYPEDEF_RE.finditer(text)
+    }
+    out: dict[str, dict] = {}
+    for m in re.finditer(r'extern\s*"C"', text):
+        i = m.end()
+        while i < len(text) and text[i].isspace():
+            i += 1
+        line = text[: i].count("\n") + 1
+        if i < len(text) and text[i] == "{":
+            depth, j = 1, i + 1
+            while j < len(text) and depth:
+                if text[j] == "{":
+                    depth += 1
+                elif text[j] == "}":
+                    depth -= 1
+                j += 1
+            region = text[i + 1 : j - 1]
+            for name, ret, args, ln in _signatures_in(
+                region, typedefs, line
+            ):
+                out[name] = {"ret": ret, "args": args, "line": ln}
+        else:
+            j = i
+            while j < len(text) and text[j] not in "{;":
+                j += 1
+            sig = _parse_sig(text[i:j], typedefs)
+            if sig:
+                out[sig[0]] = {"ret": sig[1], "args": sig[2], "line": line}
+    return out
+
+
+# ----------------------------------------------------------------------
+def _ctypes_desc(node: ast.AST) -> CType | None:
+    if isinstance(node, ast.Constant) and node.value is None:
+        return CType("void", 0)
+    if isinstance(node, ast.Call):
+        name = (
+            node.func.attr if isinstance(node.func, ast.Attribute)
+            else node.func.id if isinstance(node.func, ast.Name) else None
+        )
+        if name == "POINTER" and node.args:
+            inner = _ctypes_desc(node.args[0])
+            if inner is None:
+                return None
+            return CType(inner.kind, inner.depth + 1)
+        return None
+    name = (
+        node.attr if isinstance(node, ast.Attribute)
+        else node.id if isinstance(node, ast.Name) else None
+    )
+    if name == "c_char_p":
+        return CType("char", 1)
+    if name == "c_wchar_p":
+        return CType("?", 1)
+    if name == "c_void_p":
+        return CType("void", 1)
+    if name in _CTYPES_BASE:
+        return CType(_CTYPES_BASE[name], 0)
+    return None
+
+
+def loader_declarations(tree: ast.Module) -> dict[str, dict]:
+    """name -> {argtypes: [CType]|None, restype: CType|None, line} from
+    ``<lib>.<name>.argtypes = [...]`` / ``.restype = ...`` assignments."""
+    out: dict[str, dict] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if not (
+            isinstance(t, ast.Attribute)
+            and t.attr in ("argtypes", "restype")
+            and isinstance(t.value, ast.Attribute)
+        ):
+            continue
+        sym = t.value.attr
+        entry = out.setdefault(
+            sym, {"argtypes": None, "restype": None, "line": node.lineno}
+        )
+        if t.attr == "argtypes":
+            elts = (
+                node.value.elts
+                if isinstance(node.value, (ast.List, ast.Tuple))
+                else None
+            )
+            entry["argtypes"] = (
+                [_ctypes_desc(e) or CType("?") for e in elts]
+                if elts is not None else None
+            )
+            entry["argtypes_line"] = node.lineno
+        else:
+            entry["restype"] = _ctypes_desc(node.value) or CType("?")
+            entry["restype_line"] = node.lineno
+    return out
+
+
+def discover_cc_source(py_path: str, tree: ast.Module) -> str | None:
+    """The paired ``.cc`` for a loader module: it must call
+    ``build_and_load`` and name exactly one ``.cc`` string constant."""
+    calls_build = any(
+        isinstance(n, ast.Call)
+        and (
+            (isinstance(n.func, ast.Name) and n.func.id == "build_and_load")
+            or (
+                isinstance(n.func, ast.Attribute)
+                and n.func.attr == "build_and_load"
+            )
+        )
+        for n in ast.walk(tree)
+    )
+    if not calls_build:
+        return None
+    cc_names = {
+        n.value
+        for n in ast.walk(tree)
+        if isinstance(n, ast.Constant)
+        and isinstance(n.value, str)
+        and n.value.endswith(".cc")
+    }
+    if len(cc_names) != 1:
+        return None
+    return os.path.join(os.path.dirname(os.path.abspath(py_path)),
+                        cc_names.pop())
+
+
+def cross_check(py_path: str, tree: ast.Module) -> list[Finding]:
+    """GL010-GL013 for one loader module (no-op for non-loaders)."""
+    cc_path = discover_cc_source(py_path, tree)
+    if cc_path is None:
+        return []
+    findings: list[Finding] = []
+    if not os.path.exists(cc_path):
+        return [
+            Finding(
+                "GL012", py_path, 1, 1,
+                f"loader names native source {os.path.basename(cc_path)} "
+                "but it does not exist next to the module",
+            )
+        ]
+    c_sigs = parse_extern_c(cc_path)
+    decls = loader_declarations(tree)
+    cc_name = os.path.basename(cc_path)
+    for sym, d in sorted(decls.items()):
+        line = d.get("argtypes_line", d["line"])
+        if sym not in c_sigs:
+            findings.append(
+                Finding(
+                    "GL012", py_path, line, 1,
+                    f"ctypes declares `{sym}` but {cc_name} exports no "
+                    "such extern \"C\" symbol",
+                )
+            )
+            continue
+        sig = c_sigs[sym]
+        if d["argtypes"] is not None:
+            if len(d["argtypes"]) != len(sig["args"]):
+                findings.append(
+                    Finding(
+                        "GL010", py_path, line, 1,
+                        f"`{sym}` argtypes has {len(d['argtypes'])} entries "
+                        f"but the extern \"C\" signature in {cc_name}:"
+                        f"{sig['line']} takes {len(sig['args'])}",
+                    )
+                )
+            else:
+                for i, (py_t, c_t) in enumerate(
+                    zip(d["argtypes"], sig["args"])
+                ):
+                    if py_t != c_t:
+                        findings.append(
+                            Finding(
+                                "GL011", py_path, line, 1,
+                                f"`{sym}` arg {i}: ctypes says {py_t!r} but "
+                                f"{cc_name}:{sig['line']} says {c_t!r}",
+                            )
+                        )
+        if d["restype"] is not None and d["restype"] != sig["ret"]:
+            findings.append(
+                Finding(
+                    "GL011", py_path, d.get("restype_line", line), 1,
+                    f"`{sym}` restype: ctypes says {d['restype']!r} but "
+                    f"{cc_name}:{sig['line']} returns {sig['ret']!r}",
+                )
+            )
+    for sym, sig in sorted(c_sigs.items()):
+        if sym not in decls:
+            findings.append(
+                Finding(
+                    "GL013", py_path, 1, 1,
+                    f"extern \"C\" `{sym}` ({cc_name}:{sig['line']}) has no "
+                    "argtypes declaration in this loader — calls would "
+                    "default every argument to int",
+                )
+            )
+    return findings

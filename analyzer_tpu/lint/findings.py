@@ -1,0 +1,87 @@
+"""Finding record, rule catalog, and suppression handling for graftlint.
+
+A finding is one (rule, file, line) occurrence. Suppressions are line
+scoped: a ``# graftlint: disable=GL004`` comment on the flagged line (or
+on the line directly above it) silences that rule there — IDs are
+comma-separated, ``all`` silences every rule on the line. There is
+deliberately no file- or project-level off switch: the linter exists to
+keep the whole tree clean, and a wide suppression would rot silently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+#: Stable rule catalog. IDs are append-only — a retired rule keeps its
+#: number (documented in docs/lint.md) so old suppressions never change
+#: meaning.
+RULES: dict[str, str] = {
+    # -- JAX hazards (host-device sync, PRNG hygiene, retrace storms) --
+    "GL001": "host sync inside jitted code: .item()/.tolist() on a traced value",
+    "GL002": "host sync inside jitted code: float()/int()/bool() on a traced value",
+    "GL003": "host sync inside jitted code: np.asarray/np.array on a traced value",
+    "GL004": "Python branch on a traced value inside jitted code",
+    "GL005": "PRNG key reused by two consumers without an interposing split",
+    "GL006": "PRNG key minted from a literal or defaulted seed in library code",
+    "GL007": "jax.jit called inside a loop body (retrace/recompile storm)",
+    "GL008": "jit static arg with an unhashable (mutable) default",
+    "GL009": "leftover jax.debug.* call",
+    # -- Native ABI cross-check (extern \"C\" vs ctypes loader) --
+    "GL010": "ctypes argtypes arity differs from the extern \"C\" signature",
+    "GL011": "ctypes arg/restype width or pointer-ness differs from the C type",
+    "GL012": "ctypes loader declares a symbol the .cc does not export",
+    "GL013": "extern \"C\" symbol has no argtypes declaration in its loader",
+    # -- Service-shell rules --
+    "GL020": "bare except: (catches SystemExit/KeyboardInterrupt)",
+    "GL021": "import fallback caught too broadly (catch ImportError, not Exception)",
+    "GL022": "mutable default argument",
+}
+
+_DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def suppressed_rules(source: str) -> dict[int, set[str]]:
+    """Maps 1-based line number -> set of rule IDs disabled there.
+
+    A disable comment covers its own line AND the next line, so the
+    comment can sit above a long flagged statement without fighting the
+    line-length budget. (AST nodes report their first line, which is
+    where multi-line statements are flagged.)
+    """
+    out: dict[int, set[str]] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _DISABLE_RE.search(text)
+        if not m:
+            continue
+        ids = {t.strip().upper() for t in m.group(1).split(",") if t.strip()}
+        if "ALL" in ids:
+            ids = set(RULES)
+        for line in (i, i + 1):
+            out.setdefault(line, set()).update(ids)
+    return out
+
+
+def apply_suppressions(
+    findings: list[Finding], suppressions: dict[int, set[str]]
+) -> list[Finding]:
+    return [
+        f
+        for f in findings
+        if f.rule not in suppressions.get(f.line, ())
+    ]

@@ -1,0 +1,591 @@
+"""JAX hazard rules (GL001-GL009): host-device sync points inside jitted
+code, PRNG key hygiene, and retrace storms.
+
+Everything here is stdlib ``ast`` — the linter never imports jax (it must
+stay lint-fast and runnable on machines with no accelerator stack). The
+analysis is deliberately conservative:
+
+* "Jitted context" = a function decorated with ``jax.jit`` /
+  ``partial(jax.jit, ...)``, a def wrapped by name anywhere in the module
+  (``f2 = jax.jit(f)``), or any def nested inside one (scan/cond bodies).
+  Functions merely *called from* jitted code are not chased — that would
+  need whole-program analysis and the callee is usually jitted (or
+  jit-safe) in its own right.
+* "Traced" = the jitted function's parameters minus its
+  ``static_argnames``/``static_argnums``, propagated through simple
+  assignments. Shape/dtype attribute reads (``x.shape``, ``x.ndim``,
+  ...) and ``len(x)`` are static under trace and do not taint.
+
+False negatives are acceptable; false positives are bugs (the clean-tree
+test pins zero findings over the package, so every spurious rule firing
+breaks CI).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analyzer_tpu.lint.findings import Finding
+
+#: Attribute reads on a traced array that are static under trace.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "sharding"}
+
+#: Builtins whose result over a traced array is static (rank/type info).
+_STATIC_CALLS = {"len", "isinstance", "hasattr", "getattr", "type"}
+
+_KEY_PRODUCERS = {"jax.random.PRNGKey", "jax.random.key", "jax.random.split",
+                  "jax.random.fold_in", "jax.random.wrap_key_data"}
+#: Consuming a key through these is the sanctioned terminal use.
+_KEY_MINTERS = {"jax.random.PRNGKey", "jax.random.key"}
+
+_DEBUG_CALLS = {"jax.debug.print", "jax.debug.breakpoint", "jax.debug.callback",
+                "jax.debug.visualize_array_sharding"}
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                     ast.SetComp)
+
+
+class _Imports:
+    """Local-name -> dotted-path resolution from the module's imports."""
+
+    def __init__(self, tree: ast.Module):
+        self.table: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        self.table[alias.asname] = alias.name
+                    else:
+                        head = alias.name.split(".")[0]
+                        self.table[head] = head
+            elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+                for alias in node.names:
+                    self.table[alias.asname or alias.name] = (
+                        f"{node.module}.{alias.name}"
+                    )
+
+    def resolve(self, node: ast.AST) -> str | None:
+        """Dotted path of a Name/Attribute chain, e.g. ``jnp.pad`` ->
+        ``jax.numpy.pad``; None for anything not a plain chain."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        head = self.table.get(node.id, node.id)
+        return ".".join([head, *reversed(parts)])
+
+
+def _jit_spec(imports: _Imports, call_or_deco: ast.AST):
+    """(is_jit, static_names, static_nums) for a decorator/call expression.
+
+    Recognizes ``jax.jit``, bare ``jit`` imported from jax, and
+    ``partial(jax.jit, ...)`` (functools.partial by any alias)."""
+    node = call_or_deco
+    kwargs: list[ast.keyword] = []
+    if isinstance(node, ast.Call):
+        resolved = imports.resolve(node.func)
+        if resolved == "functools.partial" and node.args:
+            inner = imports.resolve(node.args[0])
+            if inner != "jax.jit":
+                return False, set(), set()
+            kwargs = node.keywords
+        elif resolved == "jax.jit":
+            kwargs = node.keywords
+        else:
+            return False, set(), set()
+    elif imports.resolve(node) != "jax.jit":
+        return False, set(), set()
+    names: set[str] = set()
+    nums: set[int] = set()
+    for kw in kwargs:
+        vals = (
+            kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List))
+            else [kw.value]
+        )
+        consts = [v.value for v in vals if isinstance(v, ast.Constant)]
+        if kw.arg == "static_argnames":
+            names.update(c for c in consts if isinstance(c, str))
+        elif kw.arg == "static_argnums":
+            nums.update(c for c in consts if isinstance(c, int))
+    return True, names, nums
+
+
+def _positional_params(fn: ast.FunctionDef) -> list[str]:
+    return [a.arg for a in (*fn.args.posonlyargs, *fn.args.args)]
+
+
+def _all_params(fn: ast.FunctionDef) -> list[str]:
+    out = _positional_params(fn) + [a.arg for a in fn.args.kwonlyargs]
+    for v in (fn.args.vararg, fn.args.kwarg):
+        if v is not None:
+            out.append(v.arg)
+    return out
+
+
+def _mentions_traced(node: ast.AST, traced: set[str]) -> bool:
+    """Whether evaluating ``node`` touches a traced value — with the
+    static escape hatches (``x.shape``, ``len(x)``, ...) excluded."""
+    if isinstance(node, ast.Name):
+        return node.id in traced
+    if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+        return False
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in _STATIC_CALLS:
+            return False
+        return any(
+            _mentions_traced(c, traced) for c in ast.iter_child_nodes(node)
+        )
+    return any(_mentions_traced(c, traced) for c in ast.iter_child_nodes(node))
+
+
+def _traced_bool_test(test: ast.AST, traced: set[str]) -> bool:
+    """Whether an if/while test would force a traced value to a Python
+    bool. ``x is None`` comparisons are host-side and fine."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _traced_bool_test(test.operand, traced)
+    if isinstance(test, ast.BoolOp):
+        return any(_traced_bool_test(v, traced) for v in test.values)
+    if isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+    ):
+        return False
+    return _mentions_traced(test, traced)
+
+
+class _JittedBody(ast.NodeVisitor):
+    """Flags GL001-GL004 inside one jitted function body."""
+
+    def __init__(self, module: "JaxHazards", traced: set[str]):
+        self.m = module
+        self.traced = traced
+
+    def _flag(self, rule: str, node: ast.AST, msg: str) -> None:
+        self.m.flag(rule, node, msg)
+
+    def _taint_targets(self, targets, value) -> None:
+        if value is None or not _mentions_traced(value, self.traced):
+            return
+        for t in targets:
+            for leaf in ast.walk(t):
+                if isinstance(leaf, ast.Name):
+                    self.traced.add(leaf.id)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.visit(node.value)
+        self._taint_targets(node.targets, node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self.visit(node.value)
+            self._taint_targets([node.target], node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.visit(node.value)
+        self._taint_targets([node.target], node.value)
+
+    def visit_For(self, node: ast.For) -> None:
+        self.visit(node.iter)
+        self._taint_targets([node.target], node.iter)
+        for stmt in (*node.body, *node.orelse):
+            self.visit(stmt)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # A def nested in jitted code (scan/cond body) traces its params.
+        inner = _JittedBody(self.m, self.traced | set(_all_params(node)))
+        for stmt in node.body:
+            inner.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_If(self, node: ast.If) -> None:
+        if _traced_bool_test(node.test, self.traced):
+            self._flag(
+                "GL004", node,
+                "Python `if` on a traced value inside jitted code — this "
+                "either crashes at trace time or bakes one branch in; use "
+                "jnp.where / lax.cond",
+            )
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        if _traced_bool_test(node.test, self.traced):
+            self._flag(
+                "GL004", node,
+                "Python `while` on a traced value inside jitted code — use "
+                "lax.while_loop",
+            )
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        if _traced_bool_test(node.test, self.traced):
+            self._flag(
+                "GL004", node,
+                "ternary on a traced value inside jitted code — use "
+                "jnp.where",
+            )
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        if _traced_bool_test(node.test, self.traced):
+            self._flag(
+                "GL004", node,
+                "assert on a traced value inside jitted code — use "
+                "checkify or a host-side precondition",
+            )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in ("item", "tolist")
+            and _mentions_traced(func.value, self.traced)
+        ):
+            self._flag(
+                "GL001", node,
+                f".{func.attr}() on a traced value inside jitted code "
+                "forces a host-device sync (or fails to trace)",
+            )
+        if (
+            isinstance(func, ast.Name)
+            and func.id in ("float", "int", "bool", "complex")
+            and node.args
+            and any(_mentions_traced(a, self.traced) for a in node.args)
+        ):
+            self._flag(
+                "GL002", node,
+                f"{func.id}() on a traced value inside jitted code forces "
+                "a host-device sync (or fails to trace); keep it an array "
+                "or make the argument static",
+            )
+        resolved = self.m.imports.resolve(func)
+        if (
+            resolved in ("numpy.asarray", "numpy.array")
+            and any(_mentions_traced(a, self.traced) for a in node.args)
+        ):
+            self._flag(
+                "GL003", node,
+                "np.asarray/np.array on a traced value inside jitted code "
+                "pulls the array to host; use jnp.asarray",
+            )
+        self.generic_visit(node)
+
+
+class JaxHazards:
+    """One module's GL001-GL009 pass. ``run`` returns raw findings
+    (suppressions are applied by the runner)."""
+
+    def __init__(self, path: str, tree: ast.Module):
+        self.path = path
+        self.tree = tree
+        self.imports = _Imports(tree)
+        self.findings: list[Finding] = []
+        self._seen: set[tuple] = set()
+
+    def flag(self, rule: str, node: ast.AST, msg: str) -> None:
+        key = (rule, node.lineno, node.col_offset)
+        if key not in self._seen:
+            self._seen.add(key)
+            self.findings.append(
+                Finding(rule, self.path, node.lineno, node.col_offset + 1, msg)
+            )
+
+    # ------------------------------------------------------------------
+    def run(self) -> list[Finding]:
+        defs: dict[str, list[ast.FunctionDef]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+
+        jitted: list[tuple[ast.FunctionDef, set[str], set[int]]] = []
+        for name, fns in defs.items():
+            for fn in fns:
+                for deco in fn.decorator_list:
+                    is_jit, names, nums = _jit_spec(self.imports, deco)
+                    if is_jit:
+                        jitted.append((fn, names, nums))
+                        self._check_static_defaults(fn, names, nums)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            is_jit, names, nums = _jit_spec(self.imports, node)
+            if not is_jit or isinstance(node.func, ast.Name):
+                # partial(jax.jit, ...) used as decorator lands here too
+                # when scanned as a bare Call; only wrap-by-name counts.
+                pass
+            if is_jit and isinstance(node.args[0], ast.Name):
+                for fn in defs.get(node.args[0].id, []):
+                    jitted.append((fn, names, nums))
+                    self._check_static_defaults(fn, names, nums)
+
+        analyzed: set[int] = set()
+        for fn, names, nums in jitted:
+            if id(fn) in analyzed:
+                continue
+            analyzed.add(id(fn))
+            pos = _positional_params(fn)
+            static = set(names)
+            static.update(pos[i] for i in nums if i < len(pos))
+            traced = {p for p in _all_params(fn) if p not in static}
+            traced.discard("self")
+            body = _JittedBody(self, traced)
+            for stmt in fn.body:
+                body.visit(stmt)
+
+        self._check_loops_and_debug()
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_keys(node)
+        return self.findings
+
+    # ------------------------------------------------------------------
+    def _check_static_defaults(self, fn, names: set[str], nums: set[int]):
+        """GL008: a static arg default that is unhashable retraces (or
+        crashes) on every call that relies on it."""
+        pos = _positional_params(fn)
+        static = set(names) | {pos[i] for i in nums if i < len(pos)}
+        params = [*fn.args.posonlyargs, *fn.args.args]
+        defaults = fn.args.defaults
+        for param, default in zip(params[len(params) - len(defaults):], defaults):
+            if param.arg in static and isinstance(default, _MUTABLE_LITERALS):
+                self.flag(
+                    "GL008", default,
+                    f"static arg `{param.arg}` has a mutable (unhashable) "
+                    "default — jit requires hashable statics; use a tuple "
+                    "or None-sentinel",
+                )
+        for param, default in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+            if (
+                default is not None
+                and param.arg in static
+                and isinstance(default, _MUTABLE_LITERALS)
+            ):
+                self.flag(
+                    "GL008", default,
+                    f"static arg `{param.arg}` has a mutable (unhashable) "
+                    "default — jit requires hashable statics; use a tuple "
+                    "or None-sentinel",
+                )
+
+    # ------------------------------------------------------------------
+    def _check_loops_and_debug(self) -> None:
+        """GL007 (jit built inside a loop body) and GL009 (jax.debug.*).
+
+        Loop context resets at nested def boundaries: a function defined
+        inside a loop runs elsewhere, but its *decorators* evaluate in
+        the loop, so a jit-decorated def inside a loop still flags."""
+
+        hazards = self
+
+        class V(ast.NodeVisitor):
+            def __init__(self, loop_depth: int = 0):
+                self.loop_depth = loop_depth
+
+            def _loop(self, node):
+                inner = V(self.loop_depth + 1)
+                for child in ast.iter_child_nodes(node):
+                    inner.visit(child)
+
+            visit_For = visit_While = visit_AsyncFor = _loop
+
+            def visit_FunctionDef(self, node):
+                for deco in node.decorator_list:
+                    if self.loop_depth and _jit_spec(hazards.imports, deco)[0]:
+                        hazards.flag(
+                            "GL007", deco,
+                            "jit-decorated function built inside a loop "
+                            "body — every iteration mints a fresh jit "
+                            "cache (retrace storm); hoist the jit",
+                        )
+                body = V(0)
+                for child in node.body:
+                    body.visit(child)
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Call(self, node):
+                if self.loop_depth and _jit_spec(hazards.imports, node)[0]:
+                    hazards.flag(
+                        "GL007", node,
+                        "jax.jit(...) called inside a loop body — every "
+                        "iteration mints a fresh jit cache (retrace "
+                        "storm); hoist the jit",
+                    )
+                if hazards.imports.resolve(node.func) in _DEBUG_CALLS:
+                    hazards.flag(
+                        "GL009", node,
+                        "leftover jax.debug.* call — host callbacks "
+                        "serialize the device stream; remove before "
+                        "shipping",
+                    )
+                self.generic_visit(node)
+
+        V().visit(self.tree)
+
+    # ------------------------------------------------------------------
+    def _is_key_producer(self, node: ast.AST) -> str | None:
+        if isinstance(node, ast.Call):
+            resolved = self.imports.resolve(node.func)
+            if resolved in _KEY_PRODUCERS:
+                return resolved
+        return None
+
+    def _check_keys(self, fn: ast.FunctionDef) -> None:
+        """GL005/GL006 for one function scope, statements in order.
+
+        Key names are bound by ``k = PRNGKey(...)`` / ``a, b = split(k)``;
+        every later plain-Name use consumes the key. Two consumptions of
+        the same binding (or one consumption inside a loop the binding is
+        outside of) is reuse — identical randomness at both sites.
+        Subscript reads (``keys[i]``) are exempt: elements of a split are
+        distinct keys."""
+        literal_defaults = self._literal_default_params(fn)
+        bindings: dict[str, dict] = {}
+
+        def note_mint(call: ast.Call) -> None:
+            resolved = self.imports.resolve(call.func)
+            if resolved in _KEY_MINTERS and call.args:
+                seed = call.args[0]
+                if isinstance(seed, ast.Constant) and isinstance(seed.value, int):
+                    self.flag(
+                        "GL006", call,
+                        "PRNG key minted from a literal seed in library "
+                        "code — every call site gets the same stream; "
+                        "take the seed (or a key) from the caller",
+                    )
+                elif (
+                    isinstance(seed, ast.Name) and seed.id in literal_defaults
+                ):
+                    self.flag(
+                        "GL006", call,
+                        f"PRNG key minted from `{seed.id}` whose default "
+                        f"is the literal {literal_defaults[seed.id]!r} — "
+                        "callers that omit it silently share one stream; "
+                        "make the seed required at the mint site",
+                    )
+
+        def consume(
+            name_node: ast.Name, loop_depth: int, rebinding: set[str] = frozenset()
+        ) -> None:
+            b = bindings.get(name_node.id)
+            if b is None:
+                return
+            # `key, sub = split(key)` in a loop rebinds the name every
+            # iteration — the split consumption never repeats on the
+            # same binding, so it must not take the in-loop weight.
+            in_loop = loop_depth > b["loop_depth"] and (
+                name_node.id not in rebinding
+            )
+            weight = 2 if in_loop else 1
+            b["uses"] += weight
+            if b["uses"] >= 2 and not b["flagged"]:
+                b["flagged"] = True
+                self.flag(
+                    "GL005", name_node,
+                    f"PRNG key `{name_node.id}` reused without an "
+                    "interposing split — both consumers draw identical "
+                    "randomness; jax.random.split it first",
+                )
+
+        def walk_expr(
+            node: ast.AST, loop_depth: int, skip: set[int],
+            rebinding: set[str] = frozenset(),
+        ) -> None:
+            for sub in ast.walk(node):
+                if id(sub) in skip:
+                    continue
+                if isinstance(sub, ast.Call):
+                    note_mint(sub)
+                if isinstance(sub, ast.Subscript):
+                    # keys[i]: element reads are distinct keys
+                    skip.update(id(x) for x in ast.walk(sub.value))
+                if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                    consume(sub, loop_depth, rebinding)
+
+        def bind_targets(targets, value, loop_depth: int) -> None:
+            produced = self._is_key_producer(value)
+            names: list[str] = []
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    names.append(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    names.extend(
+                        e.id for e in t.elts if isinstance(e, ast.Name)
+                    )
+            for n in names:
+                if produced:
+                    bindings[n] = {
+                        "uses": 0, "flagged": False, "loop_depth": loop_depth
+                    }
+                else:
+                    bindings.pop(n, None)  # rebound to a non-key
+
+        def walk_stmt(stmt: ast.stmt, loop_depth: int) -> None:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return  # own scope, checked separately
+            if isinstance(stmt, ast.Assign):
+                rebinding = (
+                    {
+                        leaf.id
+                        for t in stmt.targets
+                        for leaf in ast.walk(t)
+                        if isinstance(leaf, ast.Name)
+                    }
+                    if self._is_key_producer(stmt.value)
+                    else frozenset()
+                )
+                walk_expr(stmt.value, loop_depth, set(), rebinding)
+                bind_targets(stmt.targets, stmt.value, loop_depth)
+                return
+            if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                walk_expr(stmt.value, loop_depth, set())
+                bind_targets([stmt.target], stmt.value, loop_depth)
+                return
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                walk_expr(stmt.iter, loop_depth, set())
+                for s in (*stmt.body, *stmt.orelse):
+                    walk_stmt(s, loop_depth + 1)
+                return
+            if isinstance(stmt, ast.While):
+                walk_expr(stmt.test, loop_depth + 1, set())
+                for s in (*stmt.body, *stmt.orelse):
+                    walk_stmt(s, loop_depth + 1)
+                return
+            if isinstance(stmt, (ast.If, ast.With, ast.Try)):
+                for expr in ast.iter_child_nodes(stmt):
+                    if isinstance(expr, ast.expr):
+                        walk_expr(expr, loop_depth, set())
+                for field in ("body", "orelse", "finalbody", "handlers", "items"):
+                    for s in getattr(stmt, field, []):
+                        if isinstance(s, ast.stmt):
+                            walk_stmt(s, loop_depth)
+                        elif isinstance(s, ast.ExceptHandler):
+                            for inner in s.body:
+                                walk_stmt(inner, loop_depth)
+                return
+            walk_expr(stmt, loop_depth, set())
+
+        for stmt in fn.body:
+            walk_stmt(stmt, 0)
+
+    @staticmethod
+    def _literal_default_params(fn: ast.FunctionDef) -> dict[str, int]:
+        """Param name -> literal-int default, for the GL006 defaulted-seed
+        check."""
+        out: dict[str, int] = {}
+        params = [*fn.args.posonlyargs, *fn.args.args]
+        defaults = fn.args.defaults
+        for param, default in zip(params[len(params) - len(defaults):], defaults):
+            if isinstance(default, ast.Constant) and isinstance(default.value, int):
+                out[param.arg] = default.value
+        for param, default in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+            if (
+                default is not None
+                and isinstance(default, ast.Constant)
+                and isinstance(default.value, int)
+            ):
+                out[param.arg] = default.value
+        return out

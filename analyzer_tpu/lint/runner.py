@@ -1,0 +1,63 @@
+"""File walking and per-file orchestration for graftlint."""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from analyzer_tpu.lint.abi import cross_check
+from analyzer_tpu.lint.findings import (
+    Finding,
+    apply_suppressions,
+    suppressed_rules,
+)
+from analyzer_tpu.lint.jaxrules import JaxHazards
+from analyzer_tpu.lint.shellrules import ShellRules
+
+_SKIP_DIRS = {"__pycache__", ".git", ".hypothesis", ".pytest_cache"}
+
+
+def iter_py_files(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            out.append(path)
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+            out.extend(
+                os.path.join(root, f) for f in sorted(files)
+                if f.endswith(".py")
+            )
+    return out
+
+
+def lint_source(source: str, path: str = "<string>") -> list[Finding]:
+    """Lints one python source string. Raises SyntaxError on bad input —
+    callers decide whether that is a finding (CLI) or a crash (tests)."""
+    tree = ast.parse(source, filename=path)
+    findings = JaxHazards(path, tree).run()
+    findings += ShellRules(path, tree).run()
+    findings += cross_check(path, tree)
+    findings = apply_suppressions(findings, suppressed_rules(source))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def lint_paths(paths: list[str]) -> tuple[list[Finding], list[str]]:
+    """Lints every ``.py`` under ``paths``. Returns (findings, errors) —
+    errors are unreadable/unparseable files, reported separately so a
+    syntax error can't masquerade as a clean run."""
+    findings: list[Finding] = []
+    errors: list[str] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            errors.append(f"{path}: unreadable: {e}")
+            continue
+        try:
+            findings.extend(lint_source(source, path))
+        except SyntaxError as e:
+            errors.append(f"{path}: syntax error: {e}")
+    return findings, errors

@@ -1,0 +1,111 @@
+"""Service-shell rules (GL020-GL022): exception hygiene and mutable
+defaults.
+
+These target the worker/pipeline layer's failure-policy code, where a
+too-broad catch silently converts "the native extension is broken" into
+"the fallback engaged" — but they hold everywhere, so the pass runs on
+every linted file.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analyzer_tpu.lint.findings import Finding
+
+_BROAD = {"Exception", "BaseException"}
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                     ast.SetComp)
+_MUTABLE_CALLS = {"list", "dict", "set", "bytearray", "defaultdict",
+                  "OrderedDict", "Counter", "deque"}
+
+
+def _handler_names(handler: ast.ExceptHandler) -> set[str]:
+    t = handler.type
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    out = set()
+    for e in elts:
+        if isinstance(e, ast.Name):
+            out.add(e.id)
+        elif isinstance(e, ast.Attribute):
+            out.add(e.attr)
+    return out
+
+
+def _contains_import(stmts: list[ast.stmt]) -> bool:
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                return True
+    return False
+
+
+class ShellRules:
+    def __init__(self, path: str, tree: ast.Module):
+        self.path = path
+        self.tree = tree
+        self.findings: list[Finding] = []
+
+    def _flag(self, rule: str, node: ast.AST, msg: str) -> None:
+        self.findings.append(
+            Finding(rule, self.path, node.lineno, node.col_offset + 1, msg)
+        )
+
+    def run(self) -> list[Finding]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Try):
+                self._check_try(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_defaults(node)
+        return self.findings
+
+    def _check_try(self, node: ast.Try) -> None:
+        body_imports = _contains_import(node.body)
+        for handler in node.handlers:
+            if handler.type is None:
+                self._flag(
+                    "GL020", handler,
+                    "bare `except:` also swallows SystemExit/"
+                    "KeyboardInterrupt; catch Exception (or narrower) "
+                    "and say why",
+                )
+                if body_imports:
+                    self._flag(
+                        "GL021", handler,
+                        "import fallback guarded by a bare except — a "
+                        "broken module (SyntaxError, bad native build) "
+                        "silently engages the fallback; catch ImportError",
+                    )
+            elif body_imports and _handler_names(handler) & _BROAD:
+                self._flag(
+                    "GL021", handler,
+                    "import fallback guarded by `except "
+                    f"{'/'.join(sorted(_handler_names(handler) & _BROAD))}` "
+                    "— a broken module (SyntaxError, bad native build) "
+                    "silently engages the fallback; catch ImportError",
+                )
+
+    def _check_defaults(self, fn) -> None:
+        params = [*fn.args.posonlyargs, *fn.args.args]
+        pairs = list(
+            zip(params[len(params) - len(fn.args.defaults):], fn.args.defaults)
+        )
+        pairs += [
+            (p, d)
+            for p, d in zip(fn.args.kwonlyargs, fn.args.kw_defaults)
+            if d is not None
+        ]
+        for param, default in pairs:
+            mutable = isinstance(default, _MUTABLE_LITERALS) or (
+                isinstance(default, ast.Call)
+                and isinstance(default.func, ast.Name)
+                and default.func.id in _MUTABLE_CALLS
+                and not default.args
+                and not default.keywords
+            )
+            if mutable:
+                self._flag(
+                    "GL022", default,
+                    f"mutable default for `{param.arg}` is shared across "
+                    "calls; default to None and allocate inside",
+                )

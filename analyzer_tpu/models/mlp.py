@@ -45,7 +45,9 @@ class MLPModel:
         return jax.nn.sigmoid(self.logits(x))
 
 
-def init_mlp(n_features: int, hidden: int = 64, seed: int = 0) -> MLPModel:
+def init_mlp(n_features: int, hidden: int = 64, *, seed: int) -> MLPModel:
+    # ``seed`` is required at the mint site: a defaulted seed here would
+    # hand every caller that omits it the same weight stream (GL006).
     k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
     s1 = (2.0 / n_features) ** 0.5
     s2 = (2.0 / hidden) ** 0.5
@@ -77,7 +79,7 @@ def train_mlp(
 ) -> tuple[MLPModel, float]:
     """Trains on ``[N, F]`` features; returns (model, final mean NLL).
     ``mesh`` shards the minibatch axis (models.training)."""
-    model = init_mlp(features.shape[1], hidden, seed)
+    model = init_mlp(features.shape[1], hidden, seed=seed)
     return train_minibatch(
         model, _nll, features, team0_won, epochs, batch_size, lr, seed,
         mesh=mesh,

@@ -17,7 +17,36 @@ import subprocess
 import tempfile
 
 
-def _compile(src: str, lib: str) -> None:
+def sanitize_spec(env=None) -> tuple[str, list[str]]:
+    """(filename tag, extra g++ flags) from ``ANALYZER_TPU_SANITIZE``.
+
+    ``ANALYZER_TPU_SANITIZE=address,undefined`` compiles every native
+    extension with ``-fsanitize=address,undefined -g
+    -fno-omit-frame-pointer``. The tag lands in the ``.so`` name
+    (``_packer.san-address-undefined.so``) so sanitized and normal builds
+    never collide — flipping the env var always triggers a fresh build of
+    the other flavor instead of silently reusing the wrong one.
+
+    NOTE an ASan-instrumented ``.so`` only loads into a process with the
+    ASan runtime already mapped (``LD_PRELOAD=$(g++ -print-file-name=
+    libasan.so)``); without it the CDLL load fails and callers fall back
+    to pure python like any other bad build. tests/test_native_sanitize.py
+    runs the whole dance in a subprocess.
+    """
+    env = os.environ if env is None else env
+    san = ",".join(
+        s.strip() for s in env.get("ANALYZER_TPU_SANITIZE", "").split(",")
+        if s.strip()
+    )
+    if not san:
+        return "", []
+    return (
+        "san-" + san.replace(",", "-"),
+        [f"-fsanitize={san}", "-g", "-fno-omit-frame-pointer"],
+    )
+
+
+def _compile(src: str, lib: str, extra_flags: list[str] = ()) -> None:
     """Atomic compile: temp name + rename, so concurrent importers either
     see the finished .so or rebuild harmlessly. Raises ImportError."""
     tmp = None
@@ -25,7 +54,8 @@ def _compile(src: str, lib: str) -> None:
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(lib))
         os.close(fd)
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, src],
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+             *extra_flags, "-o", tmp, src],
             check=True,
             capture_output=True,
         )
@@ -40,7 +70,13 @@ def _compile(src: str, lib: str) -> None:
 
 def build_and_load(src: str, lib: str) -> ctypes.CDLL:
     """Compiles ``src`` to ``lib`` when missing/stale and returns the CDLL.
-    Raises ImportError on ANY failure (build or load)."""
+    Raises ImportError on ANY failure (build or load). Under
+    ``ANALYZER_TPU_SANITIZE`` the library builds sanitized to a
+    tag-suffixed path (see :func:`sanitize_spec`)."""
+    tag, extra_flags = sanitize_spec()
+    if tag:
+        base, ext = os.path.splitext(lib)
+        lib = f"{base}.{tag}{ext}"
     try:
         stale = not os.path.exists(lib) or (
             os.path.getmtime(lib) < os.path.getmtime(src)
@@ -48,7 +84,7 @@ def build_and_load(src: str, lib: str) -> ctypes.CDLL:
     except OSError as e:
         raise ImportError(f"native source unavailable: {e}") from e
     if stale:
-        _compile(src, lib)
+        _compile(src, lib, extra_flags)
     try:
         return ctypes.CDLL(lib)
     except OSError as e:  # corrupt/foreign-arch .so — rebuild once, then give up
@@ -56,7 +92,7 @@ def build_and_load(src: str, lib: str) -> ctypes.CDLL:
             os.unlink(lib)
         except OSError:
             pass
-        _compile(src, lib)
+        _compile(src, lib, extra_flags)
         try:
             return ctypes.CDLL(lib)
         except OSError as e2:

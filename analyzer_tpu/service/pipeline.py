@@ -83,6 +83,7 @@ import numpy as np
 from analyzer_tpu.core.state import MU_LO, SIGMA_HI
 from analyzer_tpu.logging_utils import get_logger
 from analyzer_tpu.sched.runner import _gather_outputs, _scan_chunk
+from analyzer_tpu.service.columnar import finalize
 from analyzer_tpu.utils.host import fetch_tree
 
 logger = get_logger(__name__)
@@ -330,8 +331,6 @@ class _Writer(threading.Thread):
                 job.status = "aborted"
             else:
                 try:
-                    from analyzer_tpu.service.columnar import finalize
-
                     outs = job.fetch.result()
                     finalize(self.store, job.enc, outs)
                     job.status = "ok"

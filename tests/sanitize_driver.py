@@ -1,0 +1,107 @@
+"""Subprocess body for tests/test_native_sanitize.py.
+
+Exercises all three native extensions — fastcsv, packer, fastsql —
+compiled under ``ANALYZER_TPU_SANITIZE`` and loaded into THIS process
+(the parent test set ``LD_PRELOAD`` to the sanitizer runtimes; an
+ASan-instrumented ``.so`` cannot load without them, which is why this is
+a subprocess and not a plain test). Asserts the sanitized builds produce
+the same answers the fixture tests pin, then prints the OK marker the
+parent greps for. Any sanitizer report aborts the process -> nonzero
+exit -> test failure.
+"""
+
+import os
+import sqlite3
+import sys
+import tempfile
+from types import SimpleNamespace
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    assert os.environ.get("ANALYZER_TPU_SANITIZE"), "driver needs the env set"
+
+    # --- fastcsv: writer-format roundtrip through the sanitized parser.
+    from analyzer_tpu.core import constants
+    from analyzer_tpu.io import _native_csv
+
+    assert _native_csv._lib._name.endswith(
+        f".san-{os.environ['ANALYZER_TPU_SANITIZE'].replace(',', '-')}.so"
+    ), f"loaded unsanitized library: {_native_csv._lib._name}"
+    csv_bytes = (
+        b"match_id,mode,winner,afk,team0,team1\n"
+        b"0,ranked,0,0,0;1,2;3\n"
+        b"1,casual,1,0,0;2,1;3\n"
+        b"2,ranked,0,1,4,5\n"
+    )
+    parsed = _native_csv.parse_stream_csv(
+        csv_bytes, list(constants.MODES), 16
+    )
+    assert parsed is not None, "native CSV fast path rejected writer format"
+    player_idx, winner, mode_id, afk = parsed
+    assert player_idx.shape == (3, 2, 2), player_idx.shape
+    assert winner.tolist() == [0, 1, 0]
+    assert afk.tolist() == [False, False, True]
+    assert player_idx[0].tolist() == [[0, 1], [2, 3]]
+
+    # --- packer: ASAP supersteps + capacity-1 first-fit on a chain.
+    from analyzer_tpu.sched import _native
+
+    idx = np.array(
+        [[[0, 1], [2, 3]], [[0, 2], [1, 3]], [[4, 5], [6, 7]]], np.int32
+    )
+    stream = SimpleNamespace(
+        n_matches=3,
+        player_idx=idx,
+        team_size=2,
+        ratable=np.array([1, 1, 1], np.uint8),
+    )
+    steps = _native.assign_supersteps(stream)
+    assert steps.tolist() == [0, 1, 0], steps.tolist()
+    # Capacity 2: match 1 conflicts with match 0 (shared players) so it
+    # lands strictly later; match 2 is disjoint and backfills batch 0.
+    batch, slot = _native.assign_batches_first_fit(stream, 2)
+    assert batch.tolist() == [0, 1, 0], batch.tolist()
+    assert slot.tolist() == [0, 0, 1], slot.tolist()
+
+    # --- fastsql: scan (str/int/float incl. NULLs), cumcount, lookup.
+    from analyzer_tpu.service import _native_sql
+
+    path = tempfile.mktemp(suffix=".db")
+    try:
+        conn = sqlite3.connect(path)
+        conn.execute("CREATE TABLE t (s TEXT, i INTEGER, f REAL)")
+        conn.executemany(
+            "INSERT INTO t VALUES (?, ?, ?)",
+            [("alpha", 7, 1.5), (None, None, None), ("b", -3, 2.25)],
+        )
+        conn.commit()
+        conn.close()
+        out = _native_sql.scan_query(
+            path,
+            "SELECT s, i, f FROM t ORDER BY rowid ASC",
+            [("s", "str"), ("i", "int"), ("f", "float")],
+        )
+        assert out["s"].tolist() == [b"alpha", b"", b"b"]
+        assert out["i"].tolist() == [7, 0, -3]
+        assert out["f"][0] == 1.5 and np.isnan(out["f"][1])
+    finally:
+        if os.path.exists(path):
+            os.unlink(path)
+    assert _native_sql.cumcount(
+        np.array([2, 0, 2, 2, 0], np.int64), 3
+    ).tolist() == [0, 0, 1, 2, 1]
+    assert _native_sql.lookup(
+        np.array([b"aa", b"bb", b"aa"]), np.array([b"bb", b"aa", b"zz"])
+    ).tolist() == [1, 0, -1]
+
+    print("SANITIZE_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

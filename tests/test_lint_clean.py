@@ -1,0 +1,40 @@
+"""Tier-1 gate: the linter over ``analyzer_tpu/`` must report NOTHING.
+
+This is the rule-quality contract as much as the tree-quality one: a
+rule that false-positives on legitimate framework idiom (static shape
+branches, config-object ifs, fallback ImportError guards) breaks this
+test and must be fixed in the rule, not suppressed in the tree.
+"""
+
+import os
+
+from analyzer_tpu.lint.runner import lint_paths
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_package_tree_is_lint_clean():
+    findings, errors = lint_paths([os.path.join(_REPO, "analyzer_tpu")])
+    assert errors == []
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+def test_linter_does_not_import_jax():
+    """The lint pass must stay runnable in milliseconds on machines with
+    no accelerator stack: importing it (and linting a file) may not drag
+    in jax or numpy."""
+    import subprocess
+    import sys
+
+    probe = (
+        "import sys\n"
+        "from analyzer_tpu.lint import lint_source\n"
+        "lint_source('x = 1')\n"
+        "leaked = [m for m in ('jax', 'numpy') if m in sys.modules]\n"
+        "assert not leaked, leaked\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", probe],
+        capture_output=True, text=True, timeout=60, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stderr

@@ -1,0 +1,694 @@
+"""graftlint rule tests: a table of small sources -> expected rule IDs,
+positive AND negative cases per rule, plus the ABI drift tests (a copied
+``.cc`` with a mutated signature must be caught by the cross-checker).
+
+The table runs through :func:`analyzer_tpu.lint.lint_source` in-process —
+no subprocess per case — and the CLI contract (exit codes, JSON shape)
+gets its own tests at the bottom.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from analyzer_tpu.lint import lint_source
+from analyzer_tpu.lint.runner import lint_paths
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(src: str, path: str = "snippet.py") -> list[str]:
+    return [f.rule for f in lint_source(textwrap.dedent(src), path)]
+
+
+# Each entry: (case name, source, expected rule IDs in line order).
+CASES = [
+    # ---------------- GL001: .item()/.tolist() in jitted code ----------
+    (
+        "item_in_jit",
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.item()
+        """,
+        ["GL001"],
+    ),
+    (
+        "item_outside_jit_ok",
+        """
+        import jax
+
+        def f(x):
+            return x.item()
+        """,
+        [],
+    ),
+    (
+        "tolist_in_scan_body",
+        """
+        import jax
+
+        @jax.jit
+        def f(xs):
+            def step(carry, x):
+                return carry, x.tolist()
+            return jax.lax.scan(step, 0.0, xs)
+        """,
+        ["GL001"],
+    ),
+    # ---------------- GL002: float()/int() on traced ------------------
+    (
+        "float_on_traced",
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x) * 2
+        """,
+        ["GL002"],
+    ),
+    (
+        "int_on_shape_ok",
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            n = int(x.shape[0])
+            return x * n
+        """,
+        [],
+    ),
+    (
+        "float_on_static_ok",
+        """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def f(x, cfg):
+            return x * float(cfg)
+        """,
+        [],
+    ),
+    # ---------------- GL003: np.asarray on traced ----------------------
+    (
+        "asarray_on_traced",
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.asarray(x) + 1
+        """,
+        ["GL003"],
+    ),
+    (
+        "jnp_asarray_ok",
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return jnp.asarray(x) + 1
+        """,
+        [],
+    ),
+    (
+        "asarray_on_constant_ok",
+        """
+        import jax
+        import numpy as np
+
+        TABLE = [1.0, 2.0]
+
+        @jax.jit
+        def f(x):
+            return x + np.asarray(TABLE)
+        """,
+        [],
+    ),
+    # ---------------- GL004: Python branch on traced -------------------
+    (
+        "if_on_traced",
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+        """,
+        ["GL004"],
+    ),
+    (
+        "if_on_none_ok",
+        """
+        import jax
+
+        @jax.jit
+        def f(x, mask=None):
+            if mask is None:
+                return x
+            return x * mask
+        """,
+        [],
+    ),
+    (
+        "while_on_traced_propagated",
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            y = x * 2
+            while y < 10:
+                y = y + 1
+            return y
+        """,
+        ["GL004"],
+    ),
+    (
+        "jit_by_name_if_on_traced",
+        """
+        import jax
+
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+
+        g = jax.jit(f)
+        """,
+        ["GL004"],
+    ),
+    (
+        "jit_by_name_static_ok",
+        """
+        import jax
+
+        def f(x, n):
+            if n > 0:
+                return x
+            return -x
+
+        g = jax.jit(f, static_argnums=1)
+        """,
+        [],
+    ),
+    # ---------------- GL005: key reuse --------------------------------
+    (
+        "key_reused",
+        """
+        import jax
+
+        def f(seed):
+            key = jax.random.PRNGKey(seed)
+            a = jax.random.normal(key, (3,))
+            b = jax.random.normal(key, (3,))
+            return a + b
+        """,
+        ["GL005"],
+    ),
+    (
+        "key_split_ok",
+        """
+        import jax
+
+        def f(seed):
+            k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+            a = jax.random.normal(k1, (3,))
+            b = jax.random.normal(k2, (3,))
+            return a + b
+        """,
+        [],
+    ),
+    (
+        "key_used_in_loop",
+        """
+        import jax
+
+        def f(seed, n):
+            key = jax.random.PRNGKey(seed)
+            out = []
+            for _ in range(n):
+                out.append(jax.random.normal(key, (3,)))
+            return out
+        """,
+        ["GL005"],
+    ),
+    (
+        "split_elements_ok",
+        """
+        import jax
+
+        def f(seed, n):
+            keys = jax.random.split(jax.random.PRNGKey(seed), n)
+            return [jax.random.normal(keys[i], (3,)) for i in range(n)]
+        """,
+        [],
+    ),
+    (
+        "key_rebound_ok",
+        """
+        import jax
+
+        def f(seed, n):
+            key = jax.random.PRNGKey(seed)
+            total = 0.0
+            for _ in range(n):
+                key, sub = jax.random.split(key)
+                total = total + jax.random.normal(sub, ())
+            return total
+        """,
+        [],
+    ),
+    # ---------------- GL006: literal / defaulted seed ------------------
+    (
+        "literal_seed",
+        """
+        import jax
+
+        def init():
+            return jax.random.PRNGKey(0)
+        """,
+        ["GL006"],
+    ),
+    (
+        "defaulted_seed",
+        """
+        import jax
+
+        def init(seed=0):
+            return jax.random.PRNGKey(seed)
+        """,
+        ["GL006"],
+    ),
+    (
+        "required_seed_ok",
+        """
+        import jax
+
+        def init(seed):
+            return jax.random.PRNGKey(seed)
+        """,
+        [],
+    ),
+    # ---------------- GL007: jit in loop body --------------------------
+    (
+        "jit_call_in_loop",
+        """
+        import jax
+
+        def f(fns, x):
+            outs = []
+            for fn in fns:
+                outs.append(jax.jit(fn)(x))
+            return outs
+        """,
+        ["GL007"],
+    ),
+    (
+        "jit_decorated_def_in_loop",
+        """
+        import jax
+
+        def f(xs):
+            outs = []
+            for x in xs:
+                @jax.jit
+                def g(y):
+                    return y * x
+                outs.append(g(x))
+            return outs
+        """,
+        ["GL007"],
+    ),
+    (
+        "jit_hoisted_ok",
+        """
+        import jax
+
+        def f(fn, xs):
+            jfn = jax.jit(fn)
+            return [jfn(x) for x in xs]
+        """,
+        [],
+    ),
+    # ---------------- GL008: unhashable static default -----------------
+    (
+        "mutable_static_default",
+        """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("dims",))
+        def f(x, dims=[1, 2]):
+            return x.sum(dims)
+        """,
+        ["GL008", "GL022"],
+    ),
+    (
+        "tuple_static_default_ok",
+        """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("dims",))
+        def f(x, dims=(1, 2)):
+            return x.sum(dims)
+        """,
+        [],
+    ),
+    # ---------------- GL009: jax.debug leftovers -----------------------
+    (
+        "debug_print",
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            jax.debug.print("x = {}", x)
+            return x
+        """,
+        ["GL009"],
+    ),
+    (
+        "logger_ok",
+        """
+        import logging
+
+        def f(x):
+            logging.getLogger(__name__).debug("x = %s", x)
+            return x
+        """,
+        [],
+    ),
+    # ---------------- GL020/GL021: exception hygiene -------------------
+    (
+        "bare_except",
+        """
+        def f():
+            try:
+                return 1
+            except:
+                return 0
+        """,
+        ["GL020"],
+    ),
+    (
+        "broad_import_swallow",
+        """
+        try:
+            from fast_impl import go
+        except Exception:
+            def go():
+                return None
+        """,
+        ["GL021"],
+    ),
+    (
+        "bare_import_swallow_both",
+        """
+        try:
+            import fast_impl
+        except:
+            fast_impl = None
+        """,
+        ["GL020", "GL021"],
+    ),
+    (
+        "import_error_ok",
+        """
+        try:
+            from fast_impl import go
+        except ImportError:
+            def go():
+                return None
+        """,
+        [],
+    ),
+    (
+        "broad_except_no_import_ok",
+        """
+        def f(job):
+            try:
+                job.run()
+            except Exception:
+                job.status = "failed"
+        """,
+        [],
+    ),
+    # ---------------- GL022: mutable defaults ---------------------------
+    (
+        "mutable_default_list",
+        """
+        def f(x, acc=[]):
+            acc.append(x)
+            return acc
+        """,
+        ["GL022"],
+    ),
+    (
+        "mutable_default_dict_call",
+        """
+        def f(x, *, opts=dict()):
+            return opts.get(x)
+        """,
+        ["GL022"],
+    ),
+    (
+        "none_default_ok",
+        """
+        def f(x, acc=None):
+            acc = [] if acc is None else acc
+            acc.append(x)
+            return acc
+        """,
+        [],
+    ),
+    # ---------------- suppression syntax --------------------------------
+    (
+        "suppressed_same_line",
+        """
+        def f(x, acc=[]):  # graftlint: disable=GL022
+            return acc
+        """,
+        [],
+    ),
+    (
+        "suppressed_line_above",
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            # graftlint: disable=GL001
+            return x.item()
+        """,
+        [],
+    ),
+    (
+        "suppression_wrong_rule_still_fires",
+        """
+        def f(x, acc=[]):  # graftlint: disable=GL020
+            return acc
+        """,
+        ["GL022"],
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "src,expected", [c[1:] for c in CASES], ids=[c[0] for c in CASES]
+)
+def test_rule_table(src, expected):
+    assert rules_of(src) == expected
+
+
+# ----------------------------------------------------------------------
+# ABI cross-check: real loaders validate; deliberate drift is caught.
+
+_LOADER_TEMPLATE = """
+import ctypes
+import os
+
+from analyzer_tpu.native_build import build_and_load
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_lib = build_and_load(
+    os.path.join(_DIR, "packer.cc"), os.path.join(_DIR, "_packer.so")
+)
+_lib.assign_supersteps.argtypes = [
+    ctypes.POINTER(ctypes.c_int32),
+    ctypes.c_int64,
+    ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_uint8),
+    ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_int64),
+]
+_lib.assign_supersteps.restype = None
+_lib.assign_batches_first_fit.argtypes = [
+    ctypes.POINTER(ctypes.c_int32),
+    ctypes.c_int64,
+    ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_uint8),
+    ctypes.c_int64,
+    ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_int64),
+    ctypes.POINTER(ctypes.c_int64),
+    ctypes.POINTER(ctypes.c_int64),
+]
+_lib.assign_batches_first_fit.restype = None
+"""
+
+
+class TestAbiCrossCheck:
+    def _packer_cc(self) -> str:
+        with open(
+            os.path.join(_REPO, "analyzer_tpu", "sched", "packer.cc")
+        ) as f:
+            return f.read()
+
+    def _run(self, tmp_path, cc_text: str, loader_text: str = _LOADER_TEMPLATE):
+        (tmp_path / "packer.cc").write_text(cc_text)
+        loader = tmp_path / "_native.py"
+        loader.write_text(loader_text)
+        findings, errors = lint_paths([str(loader)])
+        assert errors == []
+        return [f for f in findings if f.rule.startswith("GL01")]
+
+    def test_real_tree_pairs_validate(self):
+        """All three .cc <-> loader pairs in the repo parse and agree."""
+        for loader in (
+            "analyzer_tpu/io/_native_csv.py",
+            "analyzer_tpu/sched/_native.py",
+            "analyzer_tpu/service/_native_sql.py",
+        ):
+            findings, errors = lint_paths([os.path.join(_REPO, loader)])
+            abi = [f for f in findings if f.rule.startswith("GL01")]
+            assert abi == [] and errors == [], (loader, abi, errors)
+
+    def test_unmutated_copy_is_clean(self, tmp_path):
+        assert self._run(tmp_path, self._packer_cc()) == []
+
+    def test_narrowed_width_is_caught(self, tmp_path):
+        # int64_t n_matches -> int32_t: a silent 4-byte/8-byte mismatch
+        # that corrupts every argument after it at call time.
+        # count=1: both packer entry points share this prefix; mutate
+        # only assign_supersteps so the finding count is deterministic.
+        cc = self._packer_cc().replace(
+            "const int32_t* idx, int64_t n_matches",
+            "const int32_t* idx, int32_t n_matches",
+            1,
+        )
+        assert cc != self._packer_cc()
+        found = self._run(tmp_path, cc)
+        assert [f.rule for f in found] == ["GL011"]
+        assert "assign_supersteps" in found[0].message
+        assert "arg 1" in found[0].message
+
+    def test_dropped_pointer_is_caught(self, tmp_path):
+        cc = self._packer_cc().replace(
+            "int64_t slots, const uint8_t* ratable",
+            "int64_t slots, uint8_t ratable",
+            1,
+        )
+        found = self._run(tmp_path, cc)
+        assert [f.rule for f in found] == ["GL011"]
+
+    def test_arity_drift_is_caught(self, tmp_path):
+        cc = self._packer_cc().replace(
+            "void assign_supersteps(const int32_t* idx, int64_t n_matches,",
+            "void assign_supersteps(const int32_t* idx,",
+        )
+        found = self._run(tmp_path, cc)
+        assert "GL010" in [f.rule for f in found]
+
+    def test_renamed_symbol_is_caught_both_ways(self, tmp_path):
+        cc = self._packer_cc().replace(
+            "assign_supersteps", "assign_supersteps_v2"
+        )
+        rules = sorted(f.rule for f in self._run(tmp_path, cc))
+        # Loader declares a symbol the .cc lost (GL012) AND the .cc
+        # exports one the loader never declared (GL013).
+        assert rules == ["GL012", "GL013"]
+
+    def test_restype_drift_is_caught(self, tmp_path):
+        cc = self._packer_cc().replace(
+            "void assign_supersteps", "int64_t assign_supersteps"
+        )
+        found = self._run(tmp_path, cc)
+        assert [f.rule for f in found] == ["GL011"]
+        assert "restype" in found[0].message
+
+
+# ----------------------------------------------------------------------
+# CLI contract: exit codes and JSON mode.
+
+class TestCli:
+    def _lint(self, *argv, cwd=_REPO):
+        return subprocess.run(
+            [sys.executable, "-m", "analyzer_tpu.lint", *argv],
+            capture_output=True, text=True, timeout=120, cwd=cwd,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+
+    def test_dirty_file_exits_1_with_ids(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def f(x, acc=[]):\n    return acc\n")
+        proc = self._lint(str(bad))
+        assert proc.returncode == 1
+        assert "GL022" in proc.stdout
+
+    def test_clean_file_exits_0(self, tmp_path):
+        good = tmp_path / "good.py"
+        good.write_text("def f(x):\n    return x\n")
+        proc = self._lint(str(good))
+        assert proc.returncode == 0
+        assert "clean" in proc.stdout
+
+    def test_missing_path_exits_2(self, tmp_path):
+        proc = self._lint(str(tmp_path / "nope"))
+        assert proc.returncode == 2
+
+    def test_syntax_error_exits_1(self, tmp_path):
+        bad = tmp_path / "broken.py"
+        bad.write_text("def f(:\n")
+        proc = self._lint(str(bad))
+        assert proc.returncode == 1
+        assert "syntax error" in proc.stderr
+
+    def test_json_mode(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import jax\n\n@jax.jit\ndef f(x):\n    return x.item()\n"
+        )
+        proc = self._lint("--json", str(bad))
+        assert proc.returncode == 1
+        out = json.loads(proc.stdout)
+        assert [f["rule"] for f in out["findings"]] == ["GL001"]
+        assert out["findings"][0]["line"] == 5
+        assert out["errors"] == []
+
+    def test_cli_lint_subcommand(self, tmp_path):
+        good = tmp_path / "good.py"
+        good.write_text("def f(x):\n    return x\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "analyzer_tpu.cli", "lint", str(good)],
+            capture_output=True, text=True, timeout=120, cwd=_REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "clean" in proc.stdout

@@ -1,0 +1,82 @@
+"""ASan/UBSan variant of the native-extension tests (ISSUE 1 satellite).
+
+``ANALYZER_TPU_SANITIZE=address,undefined`` makes ``native_build``
+compile the three C++ extensions with ``-fsanitize=address,undefined``
+into tag-suffixed ``.so`` files. An instrumented ``.so`` only loads when
+the sanitizer runtimes are already in the process, so the exercise runs
+in a subprocess with ``LD_PRELOAD`` pointing at libasan/libubsan
+(``tests/sanitize_driver.py``); a sanitizer report aborts that process
+and fails the test with the report in the assertion message.
+
+Skips cleanly where g++ or the sanitizer runtimes are unavailable —
+matching the ImportError-fallback contract of the normal builds.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.sanitize
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DRIVER = os.path.join(_REPO, "tests", "sanitize_driver.py")
+
+
+def _runtime(name: str) -> str | None:
+    """Absolute path of a sanitizer runtime, or None if g++ can't name
+    one (``-print-file-name`` echoes the bare name back on a miss)."""
+    try:
+        out = subprocess.run(
+            ["g++", f"-print-file-name={name}"],
+            capture_output=True, text=True, timeout=30, check=True,
+        ).stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return out if os.path.isabs(out) and os.path.exists(out) else None
+
+
+def test_all_native_extensions_pass_under_asan_ubsan():
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ on this machine")
+    asan, ubsan = _runtime("libasan.so"), _runtime("libubsan.so")
+    if asan is None or ubsan is None:
+        pytest.skip("sanitizer runtimes not installed")
+    env = dict(
+        os.environ,
+        ANALYZER_TPU_SANITIZE="address,undefined",
+        LD_PRELOAD=f"{asan} {ubsan}",
+        # Python leaks by design (interned objects, arenas); leak checking
+        # would drown real findings. halt_on_error keeps UBSan fatal so a
+        # silent-by-default report can't pass the test.
+        ASAN_OPTIONS="detect_leaks=0",
+        UBSAN_OPTIONS="halt_on_error=1:print_stacktrace=1",
+        JAX_PLATFORMS="cpu",
+    )
+    proc = subprocess.run(
+        [sys.executable, _DRIVER],
+        capture_output=True, text=True, timeout=300, env=env, cwd=_REPO,
+    )
+    report = f"\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    assert proc.returncode == 0, f"sanitized driver failed{report}"
+    assert "SANITIZE_OK" in proc.stdout, f"driver exited early{report}"
+    for marker in ("AddressSanitizer", "runtime error:"):
+        assert marker not in proc.stderr, f"sanitizer report{report}"
+
+
+def test_sanitized_build_uses_distinct_so(tmp_path):
+    """The tag-suffixed path keeps sanitized and normal artifacts from
+    clobbering each other — checked without a compile by inspecting the
+    path logic itself."""
+    from analyzer_tpu.native_build import sanitize_spec
+
+    tag, flags = sanitize_spec({"ANALYZER_TPU_SANITIZE": "address,undefined"})
+    assert tag == "san-address-undefined"
+    assert flags[0] == "-fsanitize=address,undefined"
+    assert "-fno-omit-frame-pointer" in flags
+    assert sanitize_spec({}) == ("", [])
+    # Whitespace/empty segments normalize instead of poisoning the flag.
+    tag, flags = sanitize_spec({"ANALYZER_TPU_SANITIZE": " address , "})
+    assert tag == "san-address" and flags[0] == "-fsanitize=address"
