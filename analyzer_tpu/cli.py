@@ -15,6 +15,8 @@ Subcommands:
   bench   the headline throughput benchmark (one JSON line)
   worker  the broker-consuming service loop (needs pika)
   lint    graftlint static analysis (JAX hazards + native ABI, docs/lint.md)
+  metrics runtime telemetry snapshots (docs/observability.md): render a
+          --metrics-out artifact (or this process) as JSON/Prometheus/text
 """
 
 from __future__ import annotations
@@ -267,7 +269,42 @@ def _half_credit_accuracy(p: np.ndarray, team0_won: np.ndarray) -> float:
     return float(hit.mean())
 
 
+def _obs_begin(args) -> None:
+    """Arms the telemetry surface for a ``--metrics-out``/``--trace-events``
+    run: the jax.monitoring compile listeners make retraces countable from
+    the first compile."""
+    if getattr(args, "metrics_out", None) or getattr(args, "trace_events", None):
+        from analyzer_tpu.obs import install_jax_hooks
+
+        install_jax_hooks()
+
+
+def _obs_write(args) -> None:
+    """Writes the snapshot/trace artifacts a run asked for."""
+    if getattr(args, "metrics_out", None):
+        from analyzer_tpu.obs import write_snapshot
+
+        write_snapshot(args.metrics_out)
+        print(f"wrote metrics snapshot to {args.metrics_out}", file=sys.stderr)
+    if getattr(args, "trace_events", None):
+        from analyzer_tpu.obs import write_chrome_trace
+
+        n = write_chrome_trace(args.trace_events)
+        print(
+            f"wrote {n} Chrome trace events to {args.trace_events} "
+            "(open in Perfetto)", file=sys.stderr,
+        )
+
+
 def cmd_rate(args) -> int:
+    _obs_begin(args)
+    rc = _cmd_rate_impl(args)
+    if rc == 0:
+        _obs_write(args)
+    return rc
+
+
+def _cmd_rate_impl(args) -> int:
     from analyzer_tpu.config import RatingConfig
     from analyzer_tpu.core.state import PlayerState
     from analyzer_tpu.io.checkpoint import load_checkpoint, save_checkpoint
@@ -749,7 +786,32 @@ def cmd_bench(args) -> int:
     spec = importlib.util.spec_from_file_location("bench", path)
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
-    bench.main()
+    bench.main(metrics_out=getattr(args, "metrics_out", None))
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    """Renders a telemetry snapshot: a saved ``--metrics-out`` artifact
+    when a path is given, else the live registry of THIS process (mostly
+    the declared schema — useful to list the metric catalog)."""
+    from analyzer_tpu.obs import prometheus_text, render_summary, snapshot
+
+    if args.snapshot:
+        try:
+            with open(args.snapshot, encoding="utf-8") as f:
+                snap = json.load(f)
+        except (OSError, ValueError) as err:
+            print(f"error: cannot read snapshot: {err}", file=sys.stderr)
+            return 2
+    else:
+        snap = snapshot()
+    if args.format == "prom":
+        sys.stdout.write(prometheus_text(snap))
+    elif args.format == "summary":
+        sys.stdout.write(render_summary(snap))
+    else:
+        json.dump(snap, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
     return 0
 
 
@@ -856,6 +918,17 @@ def main(argv=None) -> int:
     )
     s.add_argument("--trace", help="jax.profiler trace output dir")
     s.add_argument(
+        "--metrics-out", metavar="PATH",
+        help="write the runtime telemetry snapshot (counters/gauges/"
+        "histograms, batch spans, retrace counts — docs/observability.md) "
+        "as JSON after a successful run",
+    )
+    s.add_argument(
+        "--trace-events", metavar="PATH",
+        help="write the span ring as Chrome trace-event JSONL "
+        "(Perfetto-loadable, alongside --trace's XLA capture)",
+    )
+    s.add_argument(
         "--mesh", type=int, metavar="N",
         help="data-parallel re-rate over a device mesh: N devices, or 0 for "
         "all (global under jax.distributed — set COORDINATOR_ADDRESS/"
@@ -905,6 +978,11 @@ def main(argv=None) -> int:
     s.set_defaults(fn=cmd_elo)
 
     s = sub.add_parser("bench", help="headline throughput benchmark")
+    s.add_argument(
+        "--metrics-out", metavar="PATH",
+        help="also write the full telemetry snapshot as JSON (the BENCH "
+        "line embeds the phase/retrace breakdown either way)",
+    )
     s.set_defaults(fn=cmd_bench)
 
     s = sub.add_parser(
@@ -921,6 +999,22 @@ def main(argv=None) -> int:
         "--rules", action="store_true", help="print the rule catalog"
     )
     s.set_defaults(fn=cmd_lint)
+
+    s = sub.add_parser(
+        "metrics",
+        help="render a runtime telemetry snapshot (docs/observability.md)",
+    )
+    s.add_argument(
+        "snapshot", nargs="?",
+        help="a --metrics-out JSON artifact; omitted = this process's "
+        "live registry (the declared metric catalog)",
+    )
+    s.add_argument(
+        "--format", choices=("json", "prom", "summary"), default="json",
+        help="json (default), prom (Prometheus text exposition), or "
+        "summary (human digest)",
+    )
+    s.set_defaults(fn=cmd_metrics)
 
     s = sub.add_parser("worker", help="broker-consuming service loop")
     s.add_argument(

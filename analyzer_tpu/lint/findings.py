@@ -36,6 +36,7 @@ RULES: dict[str, str] = {
     "GL020": "bare except: (catches SystemExit/KeyboardInterrupt)",
     "GL021": "import fallback caught too broadly (catch ImportError, not Exception)",
     "GL022": "mutable default argument",
+    "GL023": "raw time.perf_counter() timing in service/sched code (use analyzer_tpu.obs)",
 }
 
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")

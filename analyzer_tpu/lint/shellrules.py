@@ -1,10 +1,17 @@
-"""Service-shell rules (GL020-GL022): exception hygiene and mutable
-defaults.
+"""Service-shell rules (GL020-GL023): exception hygiene, mutable
+defaults, and raw-clock timing.
 
-These target the worker/pipeline layer's failure-policy code, where a
-too-broad catch silently converts "the native extension is broken" into
-"the fallback engaged" — but they hold everywhere, so the pass runs on
-every linted file.
+GL020-GL022 target the worker/pipeline layer's failure-policy code, where
+a too-broad catch silently converts "the native extension is broken" into
+"the fallback engaged" — but they hold everywhere, so those passes run on
+every linted file. GL023 is PATH-SCOPED: inside
+``analyzer_tpu/service/`` and ``analyzer_tpu/sched/`` a raw
+``time.perf_counter()`` is a measurement the obs layer
+(``analyzer_tpu/obs``: PhaseTimer histograms, tracer spans) should own —
+ad-hoc clocks there produced exactly the numbers-nobody-can-find state
+this repo's telemetry PR replaced. The few legitimate uses (a stats
+contract that must not ride the global registry) carry a line-scoped
+``# graftlint: disable=GL023`` with a reason, like every other escape.
 """
 
 from __future__ import annotations
@@ -12,6 +19,9 @@ from __future__ import annotations
 import ast
 
 from analyzer_tpu.lint.findings import Finding
+
+#: Directories where GL023 applies (normalized path fragments).
+_GL023_DIRS = ("analyzer_tpu/service/", "analyzer_tpu/sched/")
 
 _BROAD = {"Exception", "BaseException"}
 _MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
@@ -52,12 +62,38 @@ class ShellRules:
         )
 
     def run(self) -> list[Finding]:
+        timed_layer = self._in_timed_layer()
         for node in ast.walk(self.tree):
             if isinstance(node, ast.Try):
                 self._check_try(node)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._check_defaults(node)
+            elif timed_layer and isinstance(node, ast.Call):
+                self._check_raw_clock(node)
         return self.findings
+
+    def _in_timed_layer(self) -> bool:
+        path = self.path.replace("\\", "/")
+        return any(frag in path for frag in _GL023_DIRS)
+
+    def _check_raw_clock(self, node: ast.Call) -> None:
+        """GL023: ``time.perf_counter()`` (or a bare imported
+        ``perf_counter()``) in the service/sched layers — timing there
+        belongs on the obs registry/tracer so it lands in snapshots."""
+        f = node.func
+        named = (
+            (isinstance(f, ast.Attribute) and f.attr == "perf_counter")
+            or (isinstance(f, ast.Name) and f.id == "perf_counter")
+        )
+        if named:
+            self._flag(
+                "GL023", node,
+                "raw time.perf_counter() timing in the service/sched "
+                "layer is invisible to metrics snapshots; use "
+                "analyzer_tpu.obs (PhaseTimer / tracer spans), or "
+                "disable with a reason if the clock feeds a non-metrics "
+                "contract",
+            )
 
     def _check_try(self, node: ast.Try) -> None:
         body_imports = _contains_import(node.body)

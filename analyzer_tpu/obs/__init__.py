@@ -1,0 +1,68 @@
+"""Unified runtime telemetry: metrics registry, span tracer, retrace
+accounting.
+
+The static half of observability is graftlint (``docs/lint.md``): GL001+
+flag the *hazards* — host syncs, retrace storms — before they ship. This
+package is the runtime half: when a bench run or a degraded worker is
+slow, the snapshot says *why* (which phase dominated, which jitted
+entrypoint retraced, how much schedule padding burned, how far the
+pipeline lagged) instead of just *that* it was slow.
+
+Three stdlib-only cores (importable without jax — the CLI's ``metrics``
+subcommand and the lint layer must stay light):
+
+  * :mod:`~analyzer_tpu.obs.registry` — process-wide counters, gauges and
+    histograms with quantile summaries, JSON-snapshot and Prometheus-text
+    exposition;
+  * :mod:`~analyzer_tpu.obs.tracer` — span tracing into a bounded ring,
+    exported as Chrome trace-event JSONL (open in Perfetto alongside the
+    XLA traces ``utils.trace`` captures);
+  * :mod:`~analyzer_tpu.obs.snapshot` — the one-file JSON artifact
+    (`cli rate --metrics-out`) joining metrics, spans and retrace counts.
+
+Plus one jax-aware module, :mod:`~analyzer_tpu.obs.retrace`, hooking
+``jax.monitoring``'s compile events and tracking named jitted entrypoints
+via their ``_cache_size()`` — GL004's retrace hazard as a measurable
+runtime counter.
+
+Metric name catalog: docs/observability.md.
+"""
+
+from analyzer_tpu.obs.registry import (
+    MetricsRegistry,
+    get_registry,
+    reset_registry,
+)
+from analyzer_tpu.obs.retrace import (
+    install_jax_hooks,
+    jax_hooks_installed,
+    retrace_counts,
+    track_jit,
+)
+from analyzer_tpu.obs.snapshot import (
+    prometheus_text,
+    render_summary,
+    snapshot,
+    write_chrome_trace,
+    write_snapshot,
+)
+from analyzer_tpu.obs.tracer import Tracer, get_tracer, instant, span
+
+__all__ = [
+    "MetricsRegistry",
+    "Tracer",
+    "get_registry",
+    "get_tracer",
+    "install_jax_hooks",
+    "instant",
+    "jax_hooks_installed",
+    "prometheus_text",
+    "render_summary",
+    "reset_registry",
+    "retrace_counts",
+    "snapshot",
+    "span",
+    "track_jit",
+    "write_chrome_trace",
+    "write_snapshot",
+]

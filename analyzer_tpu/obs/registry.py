@@ -1,0 +1,267 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+Design constraints, in order:
+
+  * **stdlib only** — the registry is imported by the scheduler and the
+    lint-adjacent CLI paths, which must work without jax/numpy;
+  * **cheap on the hot path** — a counter add is one lock acquire and one
+    float add; a histogram observation appends to a bounded deterministic
+    reservoir (no RNG, no allocation churn);
+  * **one process-wide instance** — instruments are identified by
+    ``name{label=value,...}`` exactly like Prometheus series, so two call
+    sites asking for the same (name, labels) share one instrument, and a
+    scraper or a ``--metrics-out`` snapshot sees the whole process.
+
+The registry pre-declares the operator-facing schema (worker gauges,
+dead-letter counters, retrace counters — :data:`STANDARD_COUNTERS` /
+:data:`STANDARD_GAUGES`) so every snapshot carries the full key set even
+before the first event: a dashboard reading ``worker.dead_letters_total``
+gets 0, not a missing series that is indistinguishable from a broken
+scrape.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+def _series_key(name: str, labels: dict | None) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    """Monotonic counter. ``rate()`` is anchored at the FIRST sample, not
+    construction — a long-lived process whose counter starts moving late
+    reports the rate over its active window (the Counters.rate bug this
+    replaces measured decaying rates on long-lived workers)."""
+
+    __slots__ = ("_lock", "_value", "_first_at", "_last_at")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._first_at: float | None = None
+        self._last_at: float | None = None
+
+    def add(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter increment must be >= 0, got {n}")
+        now = time.perf_counter()
+        with self._lock:
+            if self._first_at is None:
+                self._first_at = now
+            self._last_at = now
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def rate(self) -> float:
+        """Events per second over the first-sample -> now window."""
+        with self._lock:
+            if self._first_at is None:
+                return 0.0
+            dt = time.perf_counter() - self._first_at
+            return self._value / dt if dt > 0 else 0.0
+
+
+class Gauge:
+    """Last-write-wins scalar. Values may be bool/int/float/None; the
+    snapshot passes them through, the Prometheus exposition coerces
+    (True -> 1, None -> skipped)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self, initial=0) -> None:
+        self._lock = threading.Lock()
+        self._value = initial
+
+    def set(self, value) -> None:
+        with self._lock:
+            self._value = value
+
+    def add(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value = (self._value or 0) + n
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Streaming distribution with count/sum/min/max and quantiles from a
+    DETERMINISTIC decimating reservoir: every ``stride``-th observation is
+    kept; when the reservoir hits ``max_samples`` it is halved (even
+    indices survive) and the stride doubles. The kept set is an evenly
+    spaced subsample of the stream — quantiles are exact for short runs
+    and an unbiased-in-time sketch for long ones — with no RNG (results
+    are reproducible) and bounded memory."""
+
+    __slots__ = ("_lock", "count", "sum", "min", "max",
+                 "_samples", "_stride", "_skip", "_max_samples")
+
+    def __init__(self, max_samples: int = 512) -> None:
+        self._lock = threading.Lock()
+        self.count = 0
+        self.sum = 0.0
+        self.min: float | None = None
+        self.max: float | None = None
+        self._samples: list[float] = []
+        self._stride = 1
+        self._skip = 0
+        self._max_samples = max_samples
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            self._skip += 1
+            if self._skip >= self._stride:
+                self._skip = 0
+                self._samples.append(v)
+                if len(self._samples) >= self._max_samples:
+                    self._samples = self._samples[::2]
+                    self._stride *= 2
+
+    def quantile(self, q: float) -> float | None:
+        with self._lock:
+            if not self._samples:
+                return None
+            s = sorted(self._samples)
+            i = min(len(s) - 1, max(0, round(q * (len(s) - 1))))
+            return s[i]
+
+    def summary(self) -> dict:
+        """JSON-ready: count/sum/mean/min/max + p50/p90/p99."""
+        with self._lock:
+            samples = sorted(self._samples)
+            count, total = self.count, self.sum
+            lo, hi = self.min, self.max
+
+        def pick(q):
+            if not samples:
+                return None
+            return samples[min(len(samples) - 1, max(0, round(q * (len(samples) - 1))))]
+
+        return {
+            "count": count,
+            "sum": round(total, 6),
+            "mean": round(total / count, 6) if count else None,
+            "min": lo,
+            "max": hi,
+            "p50": pick(0.50),
+            "p90": pick(0.90),
+            "p99": pick(0.99),
+        }
+
+
+#: Operator-facing series every snapshot must carry, observed or not —
+#: the metric name catalog's "always present" column (docs/observability.md).
+STANDARD_COUNTERS = (
+    "worker.matches_rated_total",
+    "worker.batches_ok_total",
+    "worker.batches_failed_total",
+    "worker.dead_letters_total",
+    "worker.acks_total",
+    "worker.pipeline_degradations_total",
+    "worker.pipeline_engine_failures_total",
+    "sched.pad_steps_total",
+    "sched.pad_slots_total",
+    "mesh.put_bytes_total",
+    "mesh.puts_total",
+    "jax.retraces_total",
+    "jax.backend_compiles_total",
+)
+STANDARD_GAUGES = (
+    "worker.pipeline_lag",
+    "worker.pipeline_degraded",
+    "worker.pipeline_inflight",
+    "worker.matches_per_sec",
+    "sched.occupancy",
+)
+
+
+class MetricsRegistry:
+    """get-or-create instrument store keyed by ``name{labels}``."""
+
+    def __init__(self, declare_standard: bool = True) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+        if declare_standard:
+            for name in STANDARD_COUNTERS:
+                self.counter(name)
+            for name in STANDARD_GAUGES:
+                self.gauge(name)
+
+    def counter(self, name: str, **labels) -> Counter:
+        key = _series_key(name, labels)
+        with self._lock:
+            c = self._counters.get(key)
+            if c is None:
+                c = self._counters[key] = Counter()
+            return c
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        key = _series_key(name, labels)
+        with self._lock:
+            g = self._gauges.get(key)
+            if g is None:
+                g = self._gauges[key] = Gauge()
+            return g
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        key = _series_key(name, labels)
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None:
+                h = self._histograms[key] = Histogram()
+            return h
+
+    def snapshot(self) -> dict:
+        """JSON-ready view of every series: counter values, gauge values,
+        histogram summaries."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        return {
+            "counters": {k: c.value for k, c in sorted(counters.items())},
+            "gauges": {k: g.value for k, g in sorted(gauges.items())},
+            "histograms": {
+                k: h.summary() for k, h in sorted(histograms.items())
+            },
+        }
+
+
+_registry_lock = threading.Lock()
+_registry: MetricsRegistry | None = None
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry (created on first use)."""
+    global _registry
+    with _registry_lock:
+        if _registry is None:
+            _registry = MetricsRegistry()
+        return _registry
+
+
+def reset_registry() -> MetricsRegistry:
+    """Replaces the process-wide registry with a fresh one (tests)."""
+    global _registry
+    with _registry_lock:
+        _registry = MetricsRegistry()
+        return _registry

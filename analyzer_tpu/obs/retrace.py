@@ -1,0 +1,120 @@
+"""Retrace accounting: ``jax.monitoring`` hooks + tracked jitted
+entrypoints.
+
+graftlint's GL004/GL007 flag the retrace *hazards* statically; this
+module measures the *events*. Two complementary sources:
+
+  * **Global compile events** — ``install_jax_hooks`` registers a
+    ``jax.monitoring`` duration listener. Every jaxpr trace bumps
+    ``jax.retraces_total`` (a retrace IS a fresh trace of some jitted
+    function past its first), every XLA backend compile bumps
+    ``jax.backend_compiles_total`` with the duration histogrammed — so a
+    service worker that starts recompiling mid-flight shows a moving
+    counter, not just a latency regression.
+  * **Per-entrypoint cache sizes** — hot jitted functions register
+    themselves via :func:`track_jit` (e.g. ``sched._scan_chunk`` at
+    module import). :func:`retrace_counts` reads each function's live
+    ``_cache_size()``: the number of distinct (shape, dtype, static-arg)
+    variants it compiled. A dtype flip on a warmed entrypoint shows up as
+    that entry incrementing — the measurable form of the GL004 hazard,
+    and exactly what ``tests/test_service.py::TestCompileChurn`` asserts
+    by hand today.
+
+jax is imported lazily (inside the install/count calls): the obs package
+stays importable in jax-free contexts (lint tooling, ``cli metrics`` on a
+saved snapshot).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from analyzer_tpu.obs.registry import get_registry
+
+_lock = threading.Lock()
+_installed = False
+_tracked: dict[str, object] = {}
+
+
+def track_jit(name: str, fn):
+    """Registers a jitted callable under ``name`` for per-entrypoint
+    retrace accounting; returns ``fn`` so call sites can wrap in place:
+
+        _scan_chunk = track_jit("sched._scan_chunk", jax.jit(...))
+
+    Re-registering a name replaces the previous function (module
+    reloads)."""
+    with _lock:
+        _tracked[name] = fn
+    return fn
+
+
+def tracked_names() -> list[str]:
+    with _lock:
+        return sorted(_tracked)
+
+
+def retrace_counts() -> dict[str, int]:
+    """``{entrypoint: compiled-variant count}`` for every tracked jitted
+    function. The count is the live jit cache size — baseline 1 after
+    warmup; anything above the warmed ladder's size is a retrace. A
+    function that does not expose ``_cache_size`` (older jax, plain
+    callables) reports -1 rather than lying with 0."""
+    with _lock:
+        items = list(_tracked.items())
+    out: dict[str, int] = {}
+    for name, fn in items:
+        size = getattr(fn, "_cache_size", None)
+        try:
+            out[name] = int(size()) if callable(size) else -1
+        except Exception:  # noqa: BLE001 — accounting must not raise
+            out[name] = -1
+    return out
+
+
+# jax._src.dispatch.{JAXPR_TRACE_EVENT, BACKEND_COMPILE_EVENT} as
+# literals: the listener fires on every compile event and must not pay a
+# module lookup there; tests/test_obs.py pins these against the live jax
+# so a rename fails loudly instead of silently counting nothing.
+JAXPR_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _on_event_duration(event: str, duration: float, **_kwargs) -> None:
+    reg = get_registry()
+    if event == JAXPR_TRACE_EVENT:
+        reg.counter("jax.retraces_total").add(1)
+        reg.histogram("jax.trace_seconds").observe(duration)
+    elif event == BACKEND_COMPILE_EVENT:
+        reg.counter("jax.backend_compiles_total").add(1)
+        reg.histogram("jax.backend_compile_seconds").observe(duration)
+
+
+def install_jax_hooks() -> bool:
+    """Registers the ``jax.monitoring`` listeners into the process-wide
+    registry. Idempotent; returns True when the hooks are (now)
+    installed, False when jax is unavailable.
+
+    Note jax keeps listeners for the life of the process (there is no
+    public unregister), so the hook writes through :func:`get_registry`
+    at event time — a test that swaps the registry keeps counting into
+    the fresh one."""
+    global _installed
+    with _lock:
+        if _installed:
+            return True
+    try:
+        from jax import monitoring
+    except ImportError:
+        return False
+    with _lock:
+        if _installed:  # lost the race to another installer
+            return True
+        monitoring.register_event_duration_secs_listener(_on_event_duration)
+        _installed = True
+    return True
+
+
+def jax_hooks_installed() -> bool:
+    with _lock:
+        return _installed

@@ -1,0 +1,172 @@
+"""Snapshot exposition: one JSON artifact, Prometheus text, summaries.
+
+The snapshot is the ``--metrics-out`` contract: everything the process
+measured — counter/gauge values, histogram quantile summaries, retrace
+counts per tracked jitted entrypoint, and the tracer's span ring — in one
+JSON object a bench artifact can embed and ``cli metrics`` can re-render.
+
+Prometheus text exposition follows the text format conventions (names
+sanitized to ``[a-zA-Z0-9_:]``, histograms as summaries with quantile
+labels) so a node exporter textfile collector or a debug scrape can lift
+the same numbers without the JSON shape.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+
+from analyzer_tpu.obs.registry import MetricsRegistry, get_registry
+from analyzer_tpu.obs.retrace import retrace_counts
+from analyzer_tpu.obs.tracer import Tracer, get_tracer
+
+SNAPSHOT_VERSION = 1
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+_SERIES_RE = re.compile(r"^(?P<name>[^{]+)(\{(?P<labels>.*)\})?$")
+
+
+def snapshot(
+    registry: MetricsRegistry | None = None,
+    tracer: Tracer | None = None,
+    max_spans: int | None = None,
+) -> dict:
+    """The full JSON-ready telemetry snapshot of this process."""
+    registry = registry or get_registry()
+    tracer = tracer or get_tracer()
+    spans = tracer.events()
+    if max_spans is not None and len(spans) > max_spans:
+        spans = spans[-max_spans:]
+    return {
+        "version": SNAPSHOT_VERSION,
+        "ts": time.time(),
+        "trace_epoch_wall": tracer.epoch_wall,
+        **registry.snapshot(),
+        "retraces": retrace_counts(),
+        "spans": spans,
+        "spans_dropped": tracer.dropped,
+    }
+
+
+def write_snapshot(path: str, **kwargs) -> dict:
+    """Writes :func:`snapshot` as JSON; returns the snapshot."""
+    snap = snapshot(**kwargs)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(snap, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return snap
+
+
+def write_chrome_trace(path: str, tracer: Tracer | None = None) -> int:
+    """Exports the span ring as Chrome trace-event JSONL (Perfetto-
+    loadable); returns the event count."""
+    return (tracer or get_tracer()).export_chrome(path)
+
+
+def _split_series(key: str) -> tuple[str, str]:
+    """``name{a=b,c=d}`` -> (sanitized_name, prometheus label body)."""
+    m = _SERIES_RE.match(key)
+    name = _NAME_RE.sub("_", (m.group("name") if m else key))
+    labels = (m.group("labels") if m else None) or ""
+    if labels:
+        parts = []
+        for pair in labels.split(","):
+            k, _, v = pair.partition("=")
+            parts.append(f'{_NAME_RE.sub("_", k)}="{v}"')
+        labels = ",".join(parts)
+    return name, labels
+
+
+def _coerce(value) -> float | None:
+    if value is None:
+        return None
+    if isinstance(value, bool):
+        return 1.0 if value else 0.0
+    return float(value)
+
+
+def prometheus_text(snap: dict | None = None) -> str:
+    """Prometheus text-format exposition of a snapshot (or of the live
+    process when ``snap`` is None). Retrace counts surface as
+    ``jax_jit_cache_size{entrypoint="..."}``."""
+    snap = snap if snap is not None else snapshot(max_spans=0)
+    lines: list[str] = []
+    typed: set[str] = set()
+
+    def emit(key: str, value, mtype: str, extra_labels: str = "") -> None:
+        v = _coerce(value)
+        if v is None:
+            return
+        name, labels = _split_series(key)
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} {mtype}")
+        body = ",".join(x for x in (labels, extra_labels) if x)
+        series = f"{name}{{{body}}}" if body else name
+        lines.append(f"{series} {v:g}")
+
+    for key, value in snap.get("counters", {}).items():
+        emit(key, value, "counter")
+    for key, value in snap.get("gauges", {}).items():
+        emit(key, value, "gauge")
+    for key, summ in snap.get("histograms", {}).items():
+        name, labels = _split_series(key)
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} summary")
+        prefix = f"{{{labels}," if labels else "{"
+        for q in ("p50", "p90", "p99"):
+            if summ.get(q) is not None:
+                lines.append(
+                    f'{name}{prefix}quantile="0.{q[1:]}"}} {summ[q]:g}'
+                )
+        body = f"{{{labels}}}" if labels else ""
+        lines.append(f"{name}_sum{body} {summ['sum']:g}")
+        lines.append(f"{name}_count{body} {summ['count']:g}")
+    for entry, count in snap.get("retraces", {}).items():
+        emit(
+            "jax.jit_cache_size", count, "gauge",
+            extra_labels=f'entrypoint="{entry}"',
+        )
+    return "\n".join(lines) + "\n"
+
+
+def render_summary(snap: dict) -> str:
+    """A short human-facing digest of a snapshot (``cli metrics``):
+    non-zero counters, set gauges, histogram p50/p99, retraces, span
+    count."""
+    out: list[str] = []
+    counters = {
+        k: v for k, v in snap.get("counters", {}).items() if v
+    }
+    if counters:
+        out.append("counters:")
+        out.extend(f"  {k} = {v:g}" for k, v in counters.items())
+    gauges = {
+        k: v for k, v in snap.get("gauges", {}).items() if v not in (None, 0)
+    }
+    if gauges:
+        out.append("gauges:")
+        out.extend(f"  {k} = {v}" for k, v in gauges.items())
+    hists = {
+        k: s for k, s in snap.get("histograms", {}).items() if s.get("count")
+    }
+    if hists:
+        out.append("histograms:")
+        for k, s in hists.items():
+            out.append(
+                f"  {k}: n={s['count']} mean={s['mean']:.6g}"
+                f" p50={s['p50']:.6g} p99={s['p99']:.6g} max={s['max']:.6g}"
+            )
+    retraces = snap.get("retraces", {})
+    if retraces:
+        out.append("jit cache sizes (compiled variants per entrypoint):")
+        out.extend(f"  {k} = {v}" for k, v in sorted(retraces.items()))
+    spans = snap.get("spans", [])
+    out.append(
+        f"spans: {len(spans)} buffered"
+        + (f" ({snap['spans_dropped']} dropped)" if snap.get("spans_dropped")
+           else "")
+    )
+    return "\n".join(out) + "\n"

@@ -1,0 +1,129 @@
+"""Span tracer: bounded in-memory ring, Chrome trace-event JSONL export.
+
+Spans are complete events (``ph: "X"``) in the Chrome trace-event format,
+so the export opens directly in Perfetto / ``chrome://tracing`` — next to
+the XLA traces ``utils.trace`` captures, which use the same timeline UI.
+Timestamps are microseconds on a per-tracer monotonic epoch
+(``perf_counter``-based), with the wall-clock epoch recorded once in the
+tracer so a snapshot consumer can reconstruct absolute times.
+
+The ring is bounded (default 20k events) and lock-guarded: the pipeline
+writer thread and the consumer thread both emit spans. Emission cost is
+two ``perf_counter`` calls, one dict, one deque append — cheap enough for
+per-batch and per-chunk granularity, NOT for per-match use.
+
+Export is JSONL: one complete JSON trace event per line. Perfetto's JSON
+importer accepts this (the trace-event "JSON array format" is tolerant of
+a missing enclosing array), and line-oriented output means a crashed run
+still leaves a loadable prefix.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import deque
+
+
+class Tracer:
+    def __init__(self, maxlen: int = 20_000) -> None:
+        self._lock = threading.Lock()
+        self._events: deque[dict] = deque(maxlen=maxlen)
+        self.epoch_wall = time.time()
+        self.epoch_perf = time.perf_counter()
+        self.dropped = 0
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self.epoch_perf) * 1e6
+
+    def _append(self, event: dict) -> None:
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1
+            self._events.append(event)
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "app", **args):
+        """Times a block as one complete trace event. ``args`` must be
+        JSON-serializable scalars (they land in the event's ``args``)."""
+        t0 = self._now_us()
+        try:
+            yield
+        finally:
+            t1 = self._now_us()
+            self._append({
+                "name": name,
+                "cat": cat,
+                "ph": "X",
+                "ts": round(t0, 1),
+                "dur": round(t1 - t0, 1),
+                "pid": os.getpid(),
+                "tid": threading.get_ident() % 1_000_000,
+                "args": args,
+            })
+
+    def instant(self, name: str, cat: str = "app", **args) -> None:
+        """A zero-duration marker (``ph: "i"``) — dead-letters, engine
+        degradations, retraces."""
+        self._append({
+            "name": name,
+            "cat": cat,
+            "ph": "i",
+            "s": "t",  # thread-scoped instant
+            "ts": round(self._now_us(), 1),
+            "pid": os.getpid(),
+            "tid": threading.get_ident() % 1_000_000,
+            "args": args,
+        })
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+
+    def export_chrome(self, path: str) -> int:
+        """Writes the ring as Chrome trace-event JSONL; returns the event
+        count."""
+        events = self.events()
+        with open(path, "w", encoding="utf-8") as f:
+            for event in events:
+                f.write(json.dumps(event) + "\n")
+        return len(events)
+
+
+_tracer_lock = threading.Lock()
+_tracer: Tracer | None = None
+
+
+def get_tracer() -> Tracer:
+    """The process-wide tracer (created on first use)."""
+    global _tracer
+    with _tracer_lock:
+        if _tracer is None:
+            _tracer = Tracer()
+        return _tracer
+
+
+def reset_tracer() -> Tracer:
+    """Replaces the process-wide tracer with a fresh one (tests)."""
+    global _tracer
+    with _tracer_lock:
+        _tracer = Tracer()
+        return _tracer
+
+
+def span(name: str, cat: str = "app", **args):
+    """Module-level convenience: a span on the process-wide tracer."""
+    return get_tracer().span(name, cat=cat, **args)
+
+
+def instant(name: str, cat: str = "app", **args) -> None:
+    """Module-level convenience: an instant on the process-wide tracer."""
+    get_tracer().instant(name, cat=cat, **args)

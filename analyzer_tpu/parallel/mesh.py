@@ -81,6 +81,7 @@ from analyzer_tpu.config import RatingConfig
 from analyzer_tpu.core.state import MatchBatch, PlayerState
 from analyzer_tpu.core.update import rate_gathered
 from analyzer_tpu.logging_utils import get_logger
+from analyzer_tpu.obs import get_registry
 from analyzer_tpu.sched.superstep import PackedSchedule
 
 logger = get_logger(__name__)
@@ -244,6 +245,15 @@ def _put_global(arr, sharding: NamedSharding):
     ``make_array_from_callback`` invokes the callback just for local
     shard indices, which is the per-process slice of the feed
     (``multihost.process_slice`` semantics, done per device)."""
+    nbytes = getattr(arr, "nbytes", None)
+    if nbytes is not None:
+        # Host->device transfer accounting: the windowed mesh feed's
+        # per-chunk uploads are the feed-logistics constant BASELINE.md's
+        # D=1 ablation pinned — the counters make that tax visible per
+        # run instead of per-investigation (docs/observability.md).
+        reg = get_registry()
+        reg.counter("mesh.put_bytes_total").add(int(nbytes))
+        reg.counter("mesh.puts_total").add(1)
     if jax.process_count() == 1:
         return jax.device_put(arr, sharding)
     arr = np.asarray(arr)

@@ -25,6 +25,7 @@ import numpy as np
 from analyzer_tpu.config import RatingConfig
 from analyzer_tpu.core.state import MatchBatch, PlayerState
 from analyzer_tpu.core.update import rate_and_apply
+from analyzer_tpu.obs import get_registry, get_tracer, track_jit
 from analyzer_tpu.sched.superstep import (
     PackedSchedule,
     compact_device_window,
@@ -98,6 +99,13 @@ def _scan_chunk(
     return jax.lax.scan(step, state, arrays)
 
 
+# Retrace accounting (obs.retrace): the service worker's warmup compiles
+# this entrypoint's whole shape ladder, so its jit-cache size moving
+# AFTER warmup is a retrace — the runtime form of graftlint's GL004/GL007
+# hazards, surfaced per entrypoint in every --metrics-out snapshot.
+track_jit("sched._scan_chunk", _scan_chunk)
+
+
 def rate_history(
     state: PlayerState,
     sched: PackedSchedule,
@@ -131,26 +139,41 @@ def rate_history(
     # state stays valid (the table is small — tens of MB at 10M players).
     state = jax.tree.map(jnp.copy, state)
     outs = [] if collect else None
+    tracer = get_tracer()
+    reg = get_registry()
+    reg.gauge("sched.occupancy").set(round(sched.occupancy, 4))
+    reg.counter("sched.steps_total").add(max(0, n_steps - start_step))
     # Double-buffered feed: the [S',B,...] slab for chunk k+1 is put on
     # device while chunk k's scan runs. jax dispatch is async, so the only
     # host blocking in the loop is the staging copy of the NEXT slab —
-    # which overlaps the device executing the CURRENT chunk.
+    # which overlaps the device executing the CURRENT chunk. The spans
+    # mirror that split: batch.compute is ENQUEUE cost, batch.transfer is
+    # the (overlapped) slab staging, batch.fetch is where device time
+    # actually surfaces on the host.
     starts = list(range(start_step, n_steps, steps_per_chunk))
-    arrays = (
-        sched.device_arrays(starts[0], min(starts[0] + steps_per_chunk, n_steps))
-        if starts
-        else None
-    )
+    with tracer.span("batch.transfer", cat="sched", start=start_step):
+        arrays = (
+            sched.device_arrays(
+                starts[0], min(starts[0] + steps_per_chunk, n_steps)
+            )
+            if starts
+            else None
+        )
     pending = None  # chunk k-1's outputs: fetched AFTER dispatching k
     for i, start in enumerate(starts):
-        state, ys = _scan_chunk(
-            state, arrays, cfg, collect, sched.pad_row
-        )  # async dispatch
+        with tracer.span("batch.compute", cat="sched", start=start):
+            state, ys = _scan_chunk(
+                state, arrays, cfg, collect, sched.pad_row
+            )  # async dispatch
         arrays = None  # let the consumed slab free as soon as the scan is done
         if i + 1 < len(starts):  # stage k+1's slab while k executes
-            arrays = sched.device_arrays(
-                starts[i + 1], min(starts[i + 1] + steps_per_chunk, n_steps)
-            )
+            with tracer.span(
+                "batch.transfer", cat="sched", start=starts[i + 1]
+            ):
+                arrays = sched.device_arrays(
+                    starts[i + 1],
+                    min(starts[i + 1] + steps_per_chunk, n_steps),
+                )
         if collect:
             # One-chunk-deep fetch pipelining: start k's D2H stream now
             # and materialize k-1's (whose transfer has been in flight a
@@ -162,14 +185,16 @@ def rate_history(
             except AttributeError:  # pragma: no cover — older jax arrays
                 pass
             if pending is not None:
-                outs.append(fetch_tree(pending))
+                with tracer.span("batch.fetch", cat="sched", start=start):
+                    outs.append(fetch_tree(pending))
             pending = ys
         if on_chunk is not None:
             on_chunk(state, min(start + steps_per_chunk, n_steps))
     if not collect:
         return state, None
     if pending is not None:
-        outs.append(fetch_tree(pending))
+        with tracer.span("batch.fetch", cat="sched", start=n_steps):
+            outs.append(fetch_tree(pending))
 
     flat_idx = sched.match_idx[start_step:n_steps].reshape(-1)
     return state, _gather_outputs(
@@ -339,7 +364,9 @@ def rate_stream(
             f"but the player table only has rows 0..{pad_row - 1}"
         )
 
-    t_choose = _time.perf_counter()
+    # The batch-size choice is reported through stats_out (a CLI stats
+    # contract), not a phase histogram — a raw clock is the right tool.
+    t_choose = _time.perf_counter()  # graftlint: disable=GL023
     if run is not None:
         import math
 
@@ -360,7 +387,7 @@ def rate_stream(
             b = batch_size
     else:
         b = batch_size or choose_batch_size_streamed(stream)
-    t_choose = _time.perf_counter() - t_choose
+    t_choose = _time.perf_counter() - t_choose  # graftlint: disable=GL023
     spc = steps_per_chunk or min(8192, max(256, -(-n // b) // 8 or 1))
 
     sentinel = np.iinfo(np.int64).min
@@ -432,6 +459,8 @@ def rate_stream(
                 watermark += 1
         done_m = p
 
+    tracer = get_tracer()
+
     def emit(e1: int) -> None:
         """Dispatches steps [emitted, e1), backfilling fillers into the
         window's free slots (stream order — deterministic)."""
@@ -445,16 +474,22 @@ def rate_stream(
                 win[free[:take]] = fillers[n_fill : n_fill + take].astype(np.int32)
                 n_fill += take
         mi = win.reshape(e1 - e0, b)
-        pidx, mask = materialize_gather_window(stream, mi, pad_row, team)
-        winner, mode_id, afk = materialize_scalar_window(stream, mi)
+        with tracer.span("batch.transfer", cat="sched", start=e0):
+            pidx, mask = materialize_gather_window(stream, mi, pad_row, team)
+            winner, mode_id, afk = materialize_scalar_window(stream, mi)
         if run is not None:
-            run.dispatch(pidx, mask, winner, mode_id, afk)
+            with tracer.span("batch.compute", cat="sched", start=e0):
+                run.dispatch(pidx, mask, winner, mode_id, afk)
         else:
-            arrays = compact_device_window(pidx, winner, mode_id, afk)
-            new_state, ys = _scan_chunk(state, arrays, cfg, collect, pad_row)
+            with tracer.span("batch.compute", cat="sched", start=e0):
+                arrays = compact_device_window(pidx, winner, mode_id, afk)
+                new_state, ys = _scan_chunk(
+                    state, arrays, cfg, collect, pad_row
+                )
             state = new_state
             if collect:
-                outs.append(fetch_tree(ys))
+                with tracer.span("batch.fetch", cat="sched", start=e0):
+                    outs.append(fetch_tree(ys))
         emitted = e1
 
     while worker.is_alive():
@@ -488,9 +523,13 @@ def rate_stream(
     while emitted < s_total:
         emit(min(emitted + spc, s_total))
 
+    occupancy = n / (s_total * b)
+    reg = get_registry()
+    reg.gauge("sched.occupancy").set(round(occupancy, 4))
+    reg.counter("sched.steps_total").add(s_total)
     if stats_out is not None:
         stats_out.update(
-            n_steps=s_total, batch_size=b, occupancy=n / (s_total * b),
+            n_steps=s_total, batch_size=b, occupancy=occupancy,
             choose_batch_size_s=t_choose,
         )
     if run is not None:

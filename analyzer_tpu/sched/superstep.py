@@ -33,6 +33,7 @@ import numpy as np
 
 from analyzer_tpu.core import constants
 from analyzer_tpu.core.state import MAX_TEAM_SIZE, MatchBatch
+from analyzer_tpu.obs import get_registry as _obs_registry
 
 import jax.numpy as jnp
 
@@ -252,6 +253,12 @@ class PackedSchedule(_ScheduleBase):
         if extra == 0:
             return self
         b = self.batch_size
+        # Step-bucketing waste: whole inert supersteps appended so the
+        # compiled scan shape is reused — visible padding tax in the
+        # metrics snapshot (sched.pad_steps_total / sched.pad_slots_total).
+        reg = _obs_registry()
+        reg.counter("sched.pad_steps_total").add(extra)
+        reg.counter("sched.pad_slots_total").add(extra * b)
         pad_idx = np.full((extra, b), -1, np.int32)
         pad_gather = np.full(
             (extra, b, 2, self.team_size), self.pad_row, np.int32
@@ -727,5 +734,14 @@ def pack_schedule(
         match_idx=match_idx,
         pad_row=pad_row,
         team_size=team_size,
+    )
+    # Bucket-occupancy accounting (obs): padding slots burn identical
+    # FLOPs, so the waste IS a device-time tax — the histogram shows the
+    # distribution across service batches, the counter the cumulative
+    # slots burned. pad_to_steps adds its step-bucketing waste on top.
+    reg = _obs_registry()
+    reg.histogram("sched.pack_occupancy").observe(round(ws.occupancy, 4))
+    reg.counter("sched.pad_slots_total").add(
+        int(s_total * batch_size - n)
     )
     return ws if windowed else ws.materialize()

@@ -82,6 +82,7 @@ import numpy as np
 
 from analyzer_tpu.core.state import MU_LO, SIGMA_HI
 from analyzer_tpu.logging_utils import get_logger
+from analyzer_tpu.obs import get_registry, get_tracer
 from analyzer_tpu.sched.runner import _gather_outputs, _scan_chunk
 from analyzer_tpu.service.columnar import finalize
 from analyzer_tpu.utils.host import fetch_tree
@@ -331,8 +332,18 @@ class _Writer(threading.Thread):
                 job.status = "aborted"
             else:
                 try:
-                    outs = job.fetch.result()
-                    finalize(self.store, job.enc, outs)
+                    # Two spans, not one: fetch materializes the async D2H
+                    # stream (tunnel-bound), write_back+commit is store
+                    # work — the split is exactly the balance the lag
+                    # auto-tuner reasons about (choose_pipeline_lag).
+                    with get_tracer().span(
+                        "batch.fetch", cat="pipeline", seq=job.seq
+                    ):
+                        outs = job.fetch.result()
+                    with get_tracer().span(
+                        "batch.write_back", cat="pipeline", seq=job.seq
+                    ):
+                        finalize(self.store, job.enc, outs)
                     job.status = "ok"
                 except BaseException as err:  # noqa: BLE001 — policy boundary
                     job.status = "failed"
@@ -371,6 +382,7 @@ class PipelineEngine:
         if lag is None:
             lag = worker.resolved_pipeline_lag()
         self.lag = max(1, int(lag))
+        get_registry().gauge("worker.pipeline_lag").set(self.lag)
         store = worker.store
         clone = getattr(store, "clone", None)
         if clone is not None:
@@ -436,43 +448,56 @@ class PipelineEngine:
         if not n:
             self._enqueue(msgs, _EmptyBatch(), _done_future(None))
             return
-        sched = w._bucketed_schedule(enc.stream, enc.state.pad_row)
+        tracer = get_tracer()
+        with tracer.span("batch.pack", cat="pipeline", matches=n):
+            sched = w._bucketed_schedule(enc.stream, enc.state.pad_row)
 
         state = enc.state
         if self.chain:
-            pairs = chain_pairs(
-                self.chain, self.lag, enc.row_of, enc.state.pad_row,
-                self._canon_rows, self._pair_dtype,
-            )
-            state = dataclasses.replace(
-                state,
-                table=_chain_patch_pairs(
-                    state.table, self._ring, jax.numpy.asarray(pairs)
-                ),
-            )
+            with tracer.span(
+                "batch.chain", cat="pipeline", depth=len(self.chain)
+            ):
+                pairs = chain_pairs(
+                    self.chain, self.lag, enc.row_of, enc.state.pad_row,
+                    self._canon_rows, self._pair_dtype,
+                )
+                state = dataclasses.replace(
+                    state,
+                    table=_chain_patch_pairs(
+                        state.table, self._ring, jax.numpy.asarray(pairs)
+                    ),
+                )
         # Chunked dispatch at the fixed service step shape (the schedule
         # is padded to a SERVICE_STEP_CHUNK multiple): any chain depth
-        # reuses the one warmed compile per row bucket.
+        # reuses the one warmed compile per row bucket. The span measures
+        # ENQUEUE cost only — jax dispatch is async by design; device
+        # completion lands in the writer's batch.fetch span.
+        dispatch_span = tracer.span(
+            "batch.dispatch", cat="pipeline", seq=self.seq, matches=n,
+            steps=sched.n_steps,
+        )
         chunk = w._step_chunk
         ys_chunks = []
-        for s0 in range(0, sched.n_steps, chunk):
-            arrays = sched.device_arrays(s0, s0 + chunk)
-            state, ys = _scan_chunk(state, arrays, w.rating_config, True,
-                                    sched.pad_row)
-            try:
-                # Start the D2H stream NOW (enqueued behind the scan): by
-                # the time the writer needs the outputs, the transfer has
-                # been in flight for ~lag batch periods instead of
-                # starting cold — measured on the tunneled v5e, this is
-                # what actually pipelines the per-batch RTT. The writer
-                # then materializes the already-streamed bytes; a fetch
-                # THREAD POOL measured strictly worse here (3 threads x
-                # np.asarray contending on the tunnel + GIL ping-pong
-                # with encode/write_back).
-                ys.copy_to_host_async()
-            except AttributeError:  # pragma: no cover — older jax arrays
-                pass
-            ys_chunks.append(ys)
+        with dispatch_span:
+            for s0 in range(0, sched.n_steps, chunk):
+                arrays = sched.device_arrays(s0, s0 + chunk)
+                state, ys = _scan_chunk(state, arrays, w.rating_config, True,
+                                        sched.pad_row)
+                try:
+                    # Start the D2H stream NOW (enqueued behind the scan):
+                    # by the time the writer needs the outputs, the
+                    # transfer has been in flight for ~lag batch periods
+                    # instead of starting cold — measured on the tunneled
+                    # v5e, this is what actually pipelines the per-batch
+                    # RTT. The writer then materializes the already-
+                    # streamed bytes; a fetch THREAD POOL measured
+                    # strictly worse here (3 threads x np.asarray
+                    # contending on the tunnel + GIL ping-pong with
+                    # encode/write_back).
+                    ys.copy_to_host_async()
+                except AttributeError:  # pragma: no cover — older jax arrays
+                    pass
+                ys_chunks.append(ys)
         final = state
         flat_idx = sched.match_idx.reshape(-1)
         fetch = _LazyFetch(
@@ -510,7 +535,10 @@ class PipelineEngine:
         rollback runs even when encode raises (poison) — the retry path
         must reload from a fresh snapshot too."""
         try:
-            return self.worker._encode_batch(ids)
+            with get_tracer().span(
+                "batch.encode", cat="pipeline", ids=len(ids)
+            ):
+                return self.worker._encode_batch(ids)
         finally:
             rollback = getattr(self.worker.store, "rollback", None)
             if rollback is not None:
@@ -519,6 +547,16 @@ class PipelineEngine:
     def _enqueue(self, msgs: list, enc, fetch: Future) -> None:
         self.writer.submit(_Job(seq=self.seq, msgs=msgs, enc=enc, fetch=fetch))
         self.seq += 1
+        self._update_inflight()
+
+    def _update_inflight(self) -> None:
+        """Pipeline-depth gauge: submitted batches not yet past the
+        writer (the lag the chain ring is hiding right now)."""
+        with self.writer.cv:
+            left = self.writer.left_seq
+        get_registry().gauge("worker.pipeline_inflight").set(
+            max(0, self.seq - 1 - left)
+        )
 
     # -- completion -------------------------------------------------------
     def harvest(self) -> None:
@@ -544,6 +582,7 @@ class PipelineEngine:
         for job in jobs:
             if job.status == "ok":
                 w.matches_rated += len(job.enc.matches)
+                w.batches_ok += 1
                 w._ack_batch(job.msgs)
             elif job.status == "failed":
                 logger.error("pipelined batch failed: %s", job.error)
@@ -560,6 +599,7 @@ class PipelineEngine:
                 reprocess.append(job)
         for job in sorted(reprocess, key=lambda j: j.seq):
             w._process_batch_sequential(job.msgs)
+        self._update_inflight()
 
     def _pop_done(self) -> list:
         with self.writer.cv:

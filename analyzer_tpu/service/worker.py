@@ -31,11 +31,33 @@ import time
 
 from analyzer_tpu.config import RatingConfig, ServiceConfig
 from analyzer_tpu.logging_utils import get_logger
+from analyzer_tpu.obs import get_registry, get_tracer
 from analyzer_tpu.sched import pack_schedule, rate_history
 from analyzer_tpu.service.broker import Broker, Message
 from analyzer_tpu.service.encode import EncodedBatch
 
 logger = get_logger(__name__)
+
+
+def _mirrored_counter(attr: str, series: str):
+    """A per-worker integer attribute whose positive deltas mirror into
+    the process-wide registry counter ``series`` — so ``w.matches_rated
+    += n`` (the call sites, including the pipeline engine's harvest)
+    keeps working while every increment also lands on the metrics
+    surface. The attribute stays per-worker (two competing consumers
+    report their own numbers); the registry series is process-wide, like
+    any Prometheus counter."""
+
+    def fget(self):
+        return getattr(self, "_" + attr, 0)
+
+    def fset(self, value):
+        delta = value - getattr(self, "_" + attr, 0)
+        if delta > 0:
+            get_registry().counter(series).add(delta)
+        setattr(self, "_" + attr, value)
+
+    return property(fget, fset)
 
 # The service scan's step dimension is FIXED: schedules pad to a multiple
 # of this and the scan runs in chunks of exactly this many supersteps.
@@ -48,6 +70,22 @@ SERVICE_STEP_CHUNK = 8
 
 
 class Worker:
+    # Operator counters: per-worker values whose increments mirror into
+    # the process-wide registry (docs/observability.md catalog).
+    matches_rated = _mirrored_counter(
+        "matches_rated", "worker.matches_rated_total"
+    )
+    batches_failed = _mirrored_counter(
+        "batches_failed", "worker.batches_failed_total"
+    )
+    batches_ok = _mirrored_counter("batches_ok", "worker.batches_ok_total")
+    dead_letters = _mirrored_counter(
+        "dead_letters", "worker.dead_letters_total"
+    )
+    pipeline_engine_failures = _mirrored_counter(
+        "pipeline_engine_failures", "worker.pipeline_engine_failures_total"
+    )
+
     def __init__(
         self,
         broker: Broker,
@@ -66,6 +104,8 @@ class Worker:
         self._first_message_at: float | None = None
         self.matches_rated = 0
         self.batches_failed = 0
+        self.batches_ok = 0
+        self.dead_letters = 0
         self._started_at = clock()
         self._stop_requested = False
         # Pipelined consume loop (service/pipeline.py): overlap the next
@@ -433,6 +473,10 @@ class Worker:
         for msg in messages:
             self.broker.publish(self.config.failed_queue, msg.body, msg.headers)
             self.broker.nack(msg.delivery_tag, requeue=False)
+        self.dead_letters += len(messages)
+        get_tracer().instant(
+            "worker.dead_letter", cat="worker", messages=len(messages)
+        )
 
     def try_process(self) -> None:
         """Routes the flushed batch: the sequential reference-shaped path
@@ -442,10 +486,17 @@ class Worker:
         batch = self.queue
         self.queue = []
         self._first_message_at = None
-        if self.pipeline_enabled:
-            self._try_process_pipelined(batch)
-        else:
-            self._process_batch_sequential(batch)
+        mode = "pipelined" if self.pipeline_enabled else "sequential"
+        # The batch lifecycle span: flush -> (encode/rate/commit or
+        # dead-letter). In pipelined mode this covers submission only —
+        # commit + ack land in a later harvest (their own spans).
+        with get_tracer().span(
+            "batch.lifecycle", cat="worker", messages=len(batch), mode=mode
+        ):
+            if self.pipeline_enabled:
+                self._try_process_pipelined(batch)
+            else:
+                self._process_batch_sequential(batch)
 
     def _ensure_engine(self):
         """Returns the pipelined engine, constructing it on first use, or
@@ -494,6 +545,11 @@ class Worker:
         starving healthy competing consumers on the same queue."""
         self.pipeline_enabled = False
         self._engine = None
+        get_registry().counter("worker.pipeline_degradations_total").add(1)
+        get_registry().gauge("worker.pipeline_degraded").set(True)
+        get_tracer().instant(
+            "worker.pipeline_degraded", cat="worker", reason=reason
+        )
         logger.warning(
             "pipelined mode disabled (%s); using the sequential loop",
             reason,
@@ -602,6 +658,7 @@ class Worker:
         (``worker.py:122-166``). Always on the consumer thread — the
         broker is not thread-safe."""
         logger.info("acking batch")
+        get_registry().counter("worker.acks_total").add(len(batch))
         for msg in batch:
             self.broker.ack(msg.delivery_tag)
             notify = (msg.headers or {}).get("notify")
@@ -655,26 +712,34 @@ class Worker:
         an exception anywhere leaves objects and state untouched."""
         from analyzer_tpu.service.columnar import finalize
 
+        tracer = get_tracer()
         # bucket_rows + pinned width + power-of-two step bucket: the three
         # shapes in the compiled scan's signature (table rows, batch
         # width, step count) all land on a few fixed sizes, so
         # consecutive batches of any size reuse one compiled scan.
-        enc = self._encode_batch(ids)
+        with tracer.span("batch.encode", cat="worker", ids=len(ids)):
+            enc = self._encode_batch(ids)
         n = len(enc.matches) if enc is not None else 0
         logger.info("processing batch of %s matches", n)
         if not n:
             return []
-        sched = self._bucketed_schedule(enc.stream, enc.state.pad_row)
-        _, outs = rate_history(
-            enc.state, sched, self.rating_config, collect=True,
-            steps_per_chunk=self._step_chunk,
-        )
+        with tracer.span("batch.pack", cat="worker", matches=n):
+            sched = self._bucketed_schedule(enc.stream, enc.state.pad_row)
+        with tracer.span(
+            "batch.compute", cat="worker", matches=n, steps=sched.n_steps
+        ):
+            _, outs = rate_history(
+                enc.state, sched, self.rating_config, collect=True,
+                steps_per_chunk=self._step_chunk,
+            )
         # Transactional stores (SqlStore) flush in one commit, rolling
         # back internally on error (worker.py:194-199); the in-memory
         # store's objects ARE the store, nothing to flush beyond
         # write_back's mutations.
-        finalize(self.store, enc, outs)
+        with tracer.span("batch.commit", cat="worker", matches=n):
+            finalize(self.store, enc, outs)
         self.matches_rated += n
+        self.batches_ok += 1
         logger.info(
             "batch rated: %d matches (%.1f matches/s since start)",
             n, self.matches_per_sec,
@@ -694,22 +759,37 @@ class Worker:
         never had (SURVEY.md section 5.5: its only observability was
         debug logs): throughput, failure counts, and the pipelined
         lane's health — ready for a metrics scraper or a periodic log
-        line."""
+        line. Since the obs subsystem landed this is a VIEW over the
+        registry-mirrored counters (the counting sites moved there); it
+        also pushes the worker's current gauges, so a snapshot taken
+        right after ``stats()`` carries the same picture.
+        ``tests/test_service.py::TestStats`` pins the key schema — a
+        dropped key here silently breaks a metrics scraper."""
+        # The engine is built lazily at the first flush, but the lag is
+        # already resolved (warmup probe / pinned config) — report it
+        # whenever pipelined mode is on, None only when it's off.
+        lag = (
+            self._engine.lag if self._engine is not None
+            else (self.resolved_pipeline_lag()
+                  if self.pipeline_enabled else None)
+        )
+        reg = get_registry()
+        reg.gauge("worker.pipeline_lag").set(lag)
+        reg.gauge("worker.pipeline_degraded").set(self.pipeline_degraded)
+        reg.gauge("worker.matches_per_sec").set(round(self.matches_per_sec, 1))
         return {
             "matches_rated": self.matches_rated,
+            "batches_ok": self.batches_ok,
             "batches_failed": self.batches_failed,
+            "dead_letters": self.dead_letters,
             "matches_per_sec": round(self.matches_per_sec, 1),
             "pipeline_enabled": self.pipeline_enabled,
             "pipeline_degraded": self.pipeline_degraded,
             "pipeline_engine_failures": self.pipeline_engine_failures,
-            # The engine is built lazily at the first flush, but the lag
-            # is already resolved (warmup probe / pinned config) — report
-            # it whenever pipelined mode is on, None only when it's off.
-            "pipeline_lag": (
-                self._engine.lag if self._engine is not None
-                else (self.resolved_pipeline_lag()
-                      if self.pipeline_enabled else None)
-            ),
+            "pipeline_lag": lag,
+            # The same number under the name the engine resolves it by —
+            # operators correlate this against PIPELINE_LAG/probe logs.
+            "resolved_pipeline_lag": lag,
             "measured_rtt_ms": (
                 round(self.measured_rtt_s * 1e3, 1)
                 if self.measured_rtt_s is not None else None

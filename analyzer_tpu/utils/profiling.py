@@ -7,7 +7,15 @@ transfer, device compute — are first-class measurements, because on TPU
 the balance between them IS the performance model (host packing and
 transfer overlap device compute in a well-fed pipeline).
 
-``trace`` wraps ``jax.profiler.trace`` so a full XLA trace (viewable in
+Since the obs subsystem landed (``analyzer_tpu/obs``), these classes are
+THIN VIEWS over the process-wide registry/tracer: ``PhaseTimer.phase``
+keeps its local totals (the CLI stats lines read them) and ALSO records a
+``phase_seconds{phase=...}`` histogram observation plus a ``phase.<name>``
+span, so a ``--metrics-out`` snapshot carries the same numbers without
+any caller changing. ``Counters.add`` mirrors into registry counters the
+same way.
+
+``trace`` wraps ``jax.profiler`` so a full XLA trace (viewable in
 TensorBoard / Perfetto) can be captured around any history run with one
 line; it no-ops gracefully where the backend can't profile.
 """
@@ -18,6 +26,8 @@ import contextlib
 import dataclasses
 import time
 from collections import defaultdict
+
+from analyzer_tpu.obs import get_registry, get_tracer
 
 
 @dataclasses.dataclass
@@ -36,11 +46,16 @@ class PhaseTimer:
     @contextlib.contextmanager
     def phase(self, name: str):
         t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.totals[name] += time.perf_counter() - t0
-            self.counts[name] += 1
+        with get_tracer().span(f"phase.{name}", cat="phase"):
+            try:
+                yield
+            finally:
+                dt = time.perf_counter() - t0
+                self.totals[name] += dt
+                self.counts[name] += 1
+                get_registry().histogram(
+                    "phase_seconds", phase=name
+                ).observe(dt)
 
     def report(self) -> dict[str, float]:
         return dict(self.totals)
@@ -57,17 +72,38 @@ class PhaseTimer:
 @dataclasses.dataclass
 class Counters:
     """Monotonic counters with rate computation — the matches/sec/chip
-    number BASELINE.json tracks, generalized."""
+    number BASELINE.json tracks, generalized. Mirrors every add into the
+    process-wide registry (``app.<name>_total``).
+
+    ``rate`` is anchored at the FIRST ``add`` of each counter, not at
+    object construction: a long-lived worker whose counter starts moving
+    an hour in reports the rate over its active window, not a number
+    decaying toward zero from a stale epoch. ``reset`` re-arms the
+    anchors."""
 
     values: dict = dataclasses.field(default_factory=lambda: defaultdict(int))
-    _t0: float = dataclasses.field(default_factory=time.perf_counter)
+    _first_at: dict = dataclasses.field(
+        default_factory=dict, repr=False
+    )
 
     def add(self, name: str, n: int = 1) -> None:
+        if name not in self._first_at:
+            self._first_at[name] = time.perf_counter()
         self.values[name] += n
+        get_registry().counter(f"app.{name}_total").add(n)
 
     def rate(self, name: str) -> float:
-        dt = time.perf_counter() - self._t0
+        t0 = self._first_at.get(name)
+        if t0 is None:
+            return 0.0
+        dt = time.perf_counter() - t0
         return self.values[name] / dt if dt > 0 else 0.0
+
+    def reset(self) -> None:
+        """Clears values and rate anchors (a new measurement window).
+        The registry mirrors are monotonic by contract and keep running."""
+        self.values.clear()
+        self._first_at.clear()
 
     def report(self) -> dict[str, int]:
         return dict(self.values)
@@ -75,15 +111,28 @@ class Counters:
 
 @contextlib.contextmanager
 def trace(log_dir: str | None):
-    """XLA profiler trace around a block; None disables, and backends that
-    can't profile degrade to a no-op instead of failing the run."""
+    """XLA profiler trace around a block; None disables, and backends
+    that can't profile degrade to a no-op instead of failing the run.
+
+    Only the profiler start/stop are guarded: an exception raised by the
+    BODY always propagates. (The old form re-``yield``ed inside an
+    ``except Exception:`` around the whole ``with`` — so a body error
+    surfaced as ``RuntimeError: generator didn't stop after throw()``,
+    masking the real traceback.)"""
     if not log_dir:
         yield
         return
     import jax
 
     try:
-        with jax.profiler.trace(log_dir):
-            yield
+        jax.profiler.start_trace(log_dir)
     except Exception:  # noqa: BLE001 — observability must not kill the run
         yield
+        return
+    try:
+        yield
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001 — ditto; never mask the body error
+            pass

@@ -99,7 +99,8 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> None:
+def main(metrics_out: str | None = None) -> None:
+    metrics_out = metrics_out or os.environ.get("BENCH_METRICS_OUT") or None
     n_matches = int(os.environ.get("BENCH_MATCHES", 500_000))
     n_players = int(os.environ.get("BENCH_PLAYERS", max(n_matches // 3, 100)))
     batch = int(os.environ.get("BENCH_BATCH", 0)) or None
@@ -115,14 +116,22 @@ def main() -> None:
     from analyzer_tpu.config import RatingConfig
     from analyzer_tpu.core.state import PlayerState
     from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
+    from analyzer_tpu.obs import install_jax_hooks
     from analyzer_tpu.sched import pack_schedule
     from analyzer_tpu.sched.runner import _scan_chunk
+
+    # Count compiles/retraces from the very first jit call: the BENCH
+    # artifact embeds the breakdown (obs_breakdown) so a slow capture
+    # explains itself — e.g. a repeat that recompiled mid-window.
+    install_jax_hooks()
 
     n_mesh = int(os.environ.get("BENCH_MESH", 0))
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind}), "
         f"{n_matches} matches / {n_players} players, batch={batch}"
         + (f", mesh={n_mesh}" if n_mesh else ""))
+    if metrics_out:
+        log(f"metrics snapshot will be written to {metrics_out}")
 
     cfg = RatingConfig()
     t0 = time.perf_counter()
@@ -143,7 +152,10 @@ def main() -> None:
     )
 
     if n_mesh >= 1:  # 1 = the sharded runner's single-device control
-        return bench_mesh(n_mesh, stream, state0, cfg, batch, repeats, t_gen)
+        return bench_mesh(
+            n_mesh, stream, state0, cfg, batch, repeats, t_gen,
+            metrics_out=metrics_out,
+        )
 
     t0 = time.perf_counter()
     sched = pack_schedule(
@@ -226,6 +238,14 @@ def main() -> None:
         rate,
         capture_stats(times, (probe_ms, probe_after), stable, predicted),
         streamed,
+        telemetry=obs_breakdown({
+            "generate_s": t_gen,
+            "pack_s": t_pack,
+            "device_best_s": best,
+            "e2e_rate_history_s": t_e2e,
+            "e2e_rate_stream_s": t_stream,
+        }),
+        metrics_out=metrics_out,
     )
 
 
@@ -374,8 +394,41 @@ def streamed_stats(times: list, stable: bool, device_best: float) -> dict:
     }
 
 
+def obs_breakdown(phases: dict) -> dict:
+    """The telemetry block BENCH_*.json artifacts embed: bench phase wall
+    times, the retrace count per tracked jitted entrypoint (jit cache
+    sizes — obs.retrace), global compile counters from the jax.monitoring
+    hooks, and the scheduler's padding-waste/occupancy tax. A degraded
+    capture now carries the WHY candidates (mid-window recompiles, pad
+    waste) next to the throughput number."""
+    from analyzer_tpu.obs import snapshot
+
+    snap = snapshot(max_spans=0)
+    counters = snap["counters"]
+    compile_s = snap["histograms"].get("jax.backend_compile_seconds", {})
+    return {
+        "phases": {k: round(v, 3) for k, v in phases.items()},
+        "retraces": snap["retraces"],
+        "jax_compile": {
+            "retraces_total": counters.get("jax.retraces_total", 0),
+            "backend_compiles_total": counters.get(
+                "jax.backend_compiles_total", 0
+            ),
+            "backend_compile_seconds": round(compile_s.get("sum") or 0.0, 3),
+        },
+        "sched": {
+            "occupancy": snap["gauges"].get("sched.occupancy"),
+            "pad_steps_total": counters.get("sched.pad_steps_total", 0),
+            "pad_slots_total": counters.get("sched.pad_slots_total", 0),
+        },
+        "mesh_put_bytes_total": counters.get("mesh.put_bytes_total", 0),
+    }
+
+
 def emit_metric(rate, capture: dict | None = None,
-                streamed: dict | None = None):
+                streamed: dict | None = None,
+                telemetry: dict | None = None,
+                metrics_out: str | None = None):
     line = {
         "metric": "matches_per_sec_per_chip",
         "value": round(rate, 1),
@@ -389,10 +442,18 @@ def emit_metric(rate, capture: dict | None = None,
         line["capture"] = capture
     if streamed is not None:
         line["streamed"] = streamed
+    if telemetry is not None:
+        line["telemetry"] = telemetry
+    if metrics_out:
+        from analyzer_tpu.obs import write_snapshot
+
+        write_snapshot(metrics_out)
+        log(f"wrote metrics snapshot to {metrics_out}")
     print(json.dumps(line))
 
 
-def bench_mesh(n_mesh, stream, state0, cfg, batch, repeats, t_gen):
+def bench_mesh(n_mesh, stream, state0, cfg, batch, repeats, t_gen,
+               metrics_out: str | None = None):
     """Pod-scale variant: data-parallel sharded-table runner over the
     first BENCH_MESH real devices (parallel/mesh.py), fed the way a pod
     run actually feeds — a WINDOWED schedule whose gather tensors and
@@ -470,7 +531,14 @@ def bench_mesh(n_mesh, stream, state0, cfg, batch, repeats, t_gen):
     # single-chip constant (feed logistics, BASELINE.md round 4) sits
     # outside the plain-scan calibration.
     emit_metric(
-        rate, capture_stats(times, (probe_ms, probe_after), stable), streamed
+        rate, capture_stats(times, (probe_ms, probe_after), stable), streamed,
+        telemetry=obs_breakdown({
+            "generate_s": t_gen,
+            "pack_s": t_pack,
+            "windowed_best_s": best,
+            "e2e_rate_stream_s": t_stream,
+        }),
+        metrics_out=metrics_out,
     )
 
 

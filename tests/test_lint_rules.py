@@ -692,3 +692,72 @@ class TestCli:
         )
         assert proc.returncode == 0, proc.stderr
         assert "clean" in proc.stdout
+
+
+class TestGL023RawClock:
+    """GL023 is path-scoped: raw perf_counter timing only flags inside
+    analyzer_tpu/service/ and analyzer_tpu/sched/ — the layers whose
+    timing belongs on the obs registry/tracer."""
+
+    SRC = """
+    import time
+
+    def f():
+        t0 = time.perf_counter()
+        return time.perf_counter() - t0
+    """
+
+    def test_fires_in_service_and_sched(self):
+        assert rules_of(
+            self.SRC, "analyzer_tpu/service/worker.py"
+        ) == ["GL023", "GL023"]
+        assert rules_of(
+            self.SRC, "analyzer_tpu/sched/runner.py"
+        ) == ["GL023", "GL023"]
+
+    def test_silent_elsewhere(self):
+        for path in (
+            "analyzer_tpu/obs/registry.py",   # the obs layer owns clocks
+            "analyzer_tpu/utils/profiling.py",
+            "bench.py",
+            "snippet.py",
+        ):
+            assert rules_of(self.SRC, path) == []
+
+    def test_bare_imported_name_fires_too(self):
+        src = """
+        from time import perf_counter
+
+        def f():
+            return perf_counter()
+        """
+        assert rules_of(src, "analyzer_tpu/service/pipeline.py") == ["GL023"]
+
+    def test_monotonic_clock_is_fine(self):
+        src = """
+        import time
+
+        def f(clock=time.monotonic):
+            return clock()
+        """
+        assert rules_of(src, "analyzer_tpu/service/worker.py") == []
+
+    def test_disable_escape(self):
+        src = """
+        import time
+
+        def f():
+            t0 = time.perf_counter()  # graftlint: disable=GL023
+            return t0
+        """
+        assert rules_of(src, "analyzer_tpu/sched/runner.py") == []
+
+    def test_windows_separators_normalized(self):
+        assert "GL023" in rules_of(
+            self.SRC, "analyzer_tpu\\service\\worker.py"
+        )
+
+    def test_catalog_has_gl023(self):
+        from analyzer_tpu.lint.findings import RULES
+
+        assert "GL023" in RULES

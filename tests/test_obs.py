@@ -1,0 +1,427 @@
+"""Runtime telemetry: registry, tracer, retrace accounting, exposition.
+
+Covers the obs subsystem's contracts end to end: instrument semantics
+(counter rate anchoring, deterministic histogram quantiles), the Chrome
+trace-event export, the jax.monitoring retrace hooks (a forced dtype
+flip must increment the counter — GL004's hazard as a runtime number),
+the legacy views (PhaseTimer/Counters), the profiler-trace exception
+fix, structured logging, and the CLI surface
+(``rate --metrics-out/--trace-events``, ``metrics``).
+"""
+
+import json
+import logging
+
+import numpy as np
+import pytest
+
+from analyzer_tpu.obs import (
+    get_registry,
+    get_tracer,
+    install_jax_hooks,
+    prometheus_text,
+    render_summary,
+    reset_registry,
+    retrace_counts,
+    snapshot,
+    track_jit,
+)
+from analyzer_tpu.obs.registry import Counter, Histogram
+from analyzer_tpu.obs.tracer import reset_tracer
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    reset_registry()
+    reset_tracer()
+    yield
+    reset_registry()
+    reset_tracer()
+
+
+class TestRegistry:
+    def test_counter_gauge_histogram_roundtrip(self):
+        reg = get_registry()
+        reg.counter("worker.acks_total").add(3)
+        reg.counter("worker.acks_total").add(2)
+        reg.gauge("worker.pipeline_lag").set(6)
+        reg.histogram("phase_seconds", phase="pack").observe(0.5)
+        snap = reg.snapshot()
+        assert snap["counters"]["worker.acks_total"] == 5
+        assert snap["gauges"]["worker.pipeline_lag"] == 6
+        assert snap["histograms"]["phase_seconds{phase=pack}"]["count"] == 1
+
+    def test_standard_schema_predeclared(self):
+        # A fresh registry already carries the operator schema: a
+        # dashboard reading dead_letters gets 0, not a missing series.
+        snap = get_registry().snapshot()
+        for name in (
+            "worker.dead_letters_total",
+            "worker.batches_failed_total",
+            "jax.retraces_total",
+            "mesh.put_bytes_total",
+        ):
+            assert snap["counters"][name] == 0
+        for name in ("worker.pipeline_lag", "worker.pipeline_degraded",
+                     "sched.occupancy"):
+            assert name in snap["gauges"]
+
+    def test_same_series_shares_instrument(self):
+        reg = get_registry()
+        assert reg.counter("x", a="1") is reg.counter("x", a="1")
+        assert reg.counter("x", a="1") is not reg.counter("x", a="2")
+
+    def test_counter_rejects_negative(self):
+        with pytest.raises(ValueError):
+            Counter().add(-1)
+
+    def test_counter_rate_anchors_on_first_sample(self, monkeypatch):
+        # The Counters.rate bug this subsystem fixed: a counter created
+        # long before its first event must not report a decayed rate.
+        import analyzer_tpu.obs.registry as regmod
+
+        now = [1000.0]
+        monkeypatch.setattr(regmod.time, "perf_counter", lambda: now[0])
+        c = Counter()
+        now[0] = 2000.0  # 1000 s of idle before the first sample
+        c.add(10)
+        now[0] = 2001.0  # 1 s of activity
+        assert c.rate() == pytest.approx(10.0)
+
+    def test_histogram_quantiles_deterministic(self):
+        h = Histogram(max_samples=64)
+        for i in range(10_000):
+            h.observe(i / 10_000)
+        s = h.summary()
+        assert s["count"] == 10_000
+        assert s["min"] == 0.0 and s["max"] == pytest.approx(0.9999)
+        assert s["p50"] == pytest.approx(0.5, abs=0.1)
+        assert s["p99"] == pytest.approx(0.99, abs=0.05)
+        # Same stream -> identical sketch (no RNG).
+        h2 = Histogram(max_samples=64)
+        for i in range(10_000):
+            h2.observe(i / 10_000)
+        assert h2.summary() == s
+
+
+class TestTracer:
+    def test_span_and_instant_events(self):
+        tr = get_tracer()
+        with tr.span("batch.compute", cat="sched", steps=8):
+            pass
+        tr.instant("worker.dead_letter", messages=3)
+        events = tr.events()
+        assert [e["ph"] for e in events] == ["X", "i"]
+        x = events[0]
+        assert x["name"] == "batch.compute" and x["args"] == {"steps": 8}
+        assert x["dur"] >= 0 and "ts" in x and "pid" in x and "tid" in x
+
+    def test_span_records_even_when_body_raises(self):
+        tr = get_tracer()
+        with pytest.raises(RuntimeError):
+            with tr.span("boom"):
+                raise RuntimeError("x")
+        assert [e["name"] for e in tr.events()] == ["boom"]
+
+    def test_ring_bounded_and_dropped_counted(self):
+        from analyzer_tpu.obs.tracer import Tracer
+
+        tr = Tracer(maxlen=4)
+        for i in range(6):
+            tr.instant(f"e{i}")
+        assert len(tr.events()) == 4
+        assert tr.dropped == 2
+
+    def test_chrome_export_is_valid_jsonl(self, tmp_path):
+        tr = get_tracer()
+        with tr.span("a", k="v"):
+            pass
+        tr.instant("b")
+        path = tmp_path / "trace.jsonl"
+        n = tr.export_chrome(str(path))
+        lines = path.read_text().splitlines()
+        assert n == len(lines) == 2
+        for line in lines:
+            e = json.loads(line)  # every line is one complete JSON event
+            assert {"name", "ph", "ts", "pid", "tid"} <= set(e)
+
+
+class TestRetrace:
+    def test_event_names_match_live_jax(self):
+        # The listener compares literal event names; a silent rename in
+        # jax would make retraces uncountable — fail loudly here instead.
+        from jax._src import dispatch
+
+        from analyzer_tpu.obs import retrace
+
+        assert retrace.JAXPR_TRACE_EVENT == dispatch.JAXPR_TRACE_EVENT
+        assert retrace.BACKEND_COMPILE_EVENT == dispatch.BACKEND_COMPILE_EVENT
+
+    def test_dtype_flip_increments_retrace_counter(self):
+        import jax
+        import jax.numpy as jnp
+
+        assert install_jax_hooks()
+        fn = track_jit("test.flip", jax.jit(lambda x: x * 2))
+        fn(jnp.ones(4, jnp.float32))
+        reg = get_registry()
+        base_cache = retrace_counts()["test.flip"]
+        base_traces = reg.counter("jax.retraces_total").value
+        fn(jnp.ones(4, jnp.float32))  # warm call: no new variant
+        assert retrace_counts()["test.flip"] == base_cache
+        fn(jnp.ones(4, jnp.int32))  # dtype flip: forced retrace
+        assert retrace_counts()["test.flip"] == base_cache + 1
+        assert reg.counter("jax.retraces_total").value > base_traces
+        assert reg.counter("jax.backend_compiles_total").value > 0
+
+    def test_scan_chunk_is_tracked(self):
+        from analyzer_tpu.obs.retrace import tracked_names
+
+        import analyzer_tpu.sched.runner  # noqa: F401 — registers on import
+
+        assert "sched._scan_chunk" in tracked_names()
+        assert "sched._scan_chunk" in snapshot()["retraces"]
+
+    def test_untrackable_callable_reports_minus_one(self):
+        track_jit("test.plain", lambda x: x)
+        assert retrace_counts()["test.plain"] == -1
+
+
+class TestExposition:
+    def test_snapshot_shape(self):
+        reg = get_registry()
+        reg.counter("c").add(1)
+        with get_tracer().span("s"):
+            pass
+        snap = snapshot()
+        assert snap["version"] == 1
+        assert {"ts", "counters", "gauges", "histograms", "retraces",
+                "spans", "spans_dropped"} <= set(snap)
+        assert json.loads(json.dumps(snap)) == snap  # JSON-clean
+
+    def test_prometheus_text(self):
+        reg = get_registry()
+        reg.counter("worker.acks_total").add(5)
+        reg.gauge("worker.pipeline_degraded").set(True)
+        reg.histogram("phase_seconds", phase="pack").observe(0.25)
+        txt = prometheus_text(snapshot(max_spans=0))
+        assert "# TYPE worker_acks_total counter" in txt
+        assert "worker_acks_total 5" in txt
+        assert "worker_pipeline_degraded 1" in txt
+        assert 'phase_seconds{phase="pack",quantile="0.50"} 0.25' in txt
+        assert 'phase_seconds_count{phase="pack"} 1' in txt
+
+    def test_render_summary_mentions_active_series(self):
+        reg = get_registry()
+        reg.counter("worker.acks_total").add(2)
+        out = render_summary(snapshot())
+        assert "worker.acks_total" in out and "spans:" in out
+
+
+class TestLegacyViews:
+    def test_phase_timer_mirrors_registry_and_tracer(self):
+        from analyzer_tpu.utils import PhaseTimer
+
+        t = PhaseTimer()
+        with t.phase("pack"):
+            pass
+        with t.phase("pack"):
+            pass
+        assert t.counts["pack"] == 2  # the pre-obs local surface
+        hist = get_registry().snapshot()["histograms"]
+        assert hist["phase_seconds{phase=pack}"]["count"] == 2
+        assert [e["name"] for e in get_tracer().events()] == [
+            "phase.pack", "phase.pack"
+        ]
+
+    def test_counters_rate_anchors_on_first_add(self, monkeypatch):
+        import analyzer_tpu.utils.profiling as prof
+
+        now = [0.0]
+        monkeypatch.setattr(prof.time, "perf_counter", lambda: now[0])
+        c = prof.Counters()
+        now[0] = 500.0  # long idle after construction
+        c.add("matches", 100)
+        now[0] = 510.0  # 10 s of activity
+        assert c.rate("matches") == pytest.approx(10.0)
+        assert c.rate("never_added") == 0.0
+        c.reset()
+        assert c.report() == {}
+        now[0] = 600.0
+        c.add("matches", 5)
+        now[0] = 601.0
+        assert c.rate("matches") == pytest.approx(5.0)
+
+    def test_counters_mirror_into_registry(self):
+        from analyzer_tpu.utils import Counters
+
+        c = Counters()
+        c.add("matches", 7)
+        assert (
+            get_registry().snapshot()["counters"]["app.matches_total"] == 7
+        )
+
+
+class TestProfilerTrace:
+    def test_body_exception_propagates(self, tmp_path):
+        # The old guard re-yielded inside `except Exception:` around the
+        # whole with-block, so a body error surfaced as RuntimeError
+        # ("generator didn't stop after throw()") masking the real one.
+        from analyzer_tpu.utils import trace
+
+        with pytest.raises(ValueError, match="the real error"):
+            with trace(str(tmp_path / "xla")):
+                raise ValueError("the real error")
+
+    def test_disabled_trace_propagates_too(self):
+        from analyzer_tpu.utils import trace
+
+        with pytest.raises(ValueError):
+            with trace(None):
+                raise ValueError("x")
+
+    def test_profiler_start_failure_degrades_to_noop(self, monkeypatch):
+        import jax
+
+        from analyzer_tpu.utils import trace
+
+        def boom(*_a, **_k):
+            raise RuntimeError("backend can't profile")
+
+        monkeypatch.setattr(jax.profiler, "start_trace", boom)
+        ran = []
+        with trace("/tmp/ignored"):
+            ran.append(True)  # body still runs; no exception escapes
+        assert ran == [True]
+
+
+class TestStructuredLogging:
+    def test_kv_line_quotes_awkward_values(self):
+        from analyzer_tpu.logging_utils import kv_line
+
+        line = kv_line(a=1, msg='has "quotes" and spaces', empty="")
+        assert line.startswith("a=1 msg=")
+        assert '"has \\"quotes\\" and spaces"' in line
+        assert 'empty=""' in line
+
+    def test_formatter_emits_single_kv_line(self):
+        from analyzer_tpu.logging_utils import KVFormatter
+
+        rec = logging.LogRecord(
+            "analyzer_tpu.test", logging.INFO, __file__, 1,
+            "rated %d matches", (5,), None,
+        )
+        out = KVFormatter().format(rec)
+        assert "\n" not in out
+        assert "level=INFO" in out
+        assert "logger=analyzer_tpu.test" in out
+        assert 'msg="rated 5 matches"' in out
+        assert out.startswith("ts=")
+
+    def test_env_var_sets_logger_level(self, monkeypatch):
+        from analyzer_tpu.logging_utils import get_logger
+
+        monkeypatch.setenv("ANALYZER_TPU_LOG_LEVEL", "DEBUG")
+        assert get_logger("analyzer_tpu.obs_test_a").level == logging.DEBUG
+        monkeypatch.setenv("ANALYZER_TPU_LOG_LEVEL", "WARNING")
+        assert get_logger("analyzer_tpu.obs_test_b").level == logging.WARNING
+        monkeypatch.setenv("ANALYZER_TPU_LOG_LEVEL", "not-a-level")
+        assert get_logger("analyzer_tpu.obs_test_c").level == logging.INFO
+
+
+class TestCliSurface:
+    def _synth(self, tmp_path, n=300):
+        from analyzer_tpu.cli import main
+
+        csv = str(tmp_path / "h.csv")
+        assert main([
+            "synth", "--matches", str(n), "--players", "90", "--out", csv,
+        ]) == 0
+        return csv
+
+    def test_rate_metrics_out_and_trace_events(self, tmp_path, capsys):
+        # The acceptance contract: the snapshot carries batch spans,
+        # phase histograms, a retrace count per jitted entrypoint, and
+        # the pipeline-lag/dead-letter series; the trace JSONL loads as
+        # Chrome trace events.
+        from analyzer_tpu.cli import main
+
+        csv = self._synth(tmp_path)
+        m = str(tmp_path / "m.json")
+        t = str(tmp_path / "t.jsonl")
+        assert main([
+            "rate", "--csv", csv, "--metrics-out", m, "--trace-events", t,
+        ]) == 0
+        snap = json.load(open(m))
+        names = {e["name"] for e in snap["spans"]}
+        assert any(n.startswith("batch.") for n in names)
+        assert any(k.startswith("phase_seconds") for k in snap["histograms"])
+        assert snap["retraces"]["sched._scan_chunk"] >= 1
+        assert "worker.pipeline_lag" in snap["gauges"]
+        assert "worker.dead_letters_total" in snap["counters"]
+        assert snap["counters"]["jax.retraces_total"] > 0
+        for line in open(t):
+            e = json.loads(line)
+            assert {"name", "ph", "ts", "pid", "tid"} <= set(e)
+
+    def test_metrics_subcommand_renders_snapshot(self, tmp_path, capsys):
+        from analyzer_tpu.cli import main
+
+        get_registry().counter("worker.acks_total").add(3)
+        m = str(tmp_path / "m.json")
+        from analyzer_tpu.obs import write_snapshot
+
+        write_snapshot(m)
+        assert main(["metrics", m]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["counters"]["worker.acks_total"] == 3
+        assert main(["metrics", m, "--format", "prom"]) == 0
+        assert "worker_acks_total 3" in capsys.readouterr().out
+        assert main(["metrics", m, "--format", "summary"]) == 0
+        assert "worker.acks_total" in capsys.readouterr().out
+
+    def test_metrics_subcommand_live_and_missing_file(self, capsys):
+        from analyzer_tpu.cli import main
+
+        assert main(["metrics"]) == 0  # live registry: the catalog
+        out = json.loads(capsys.readouterr().out)
+        assert "worker.dead_letters_total" in out["counters"]
+        assert main(["metrics", "/nonexistent/x.json"]) == 2
+
+
+class TestLayerMetrics:
+    def test_pack_schedule_records_occupancy_and_padding(self):
+        from analyzer_tpu.sched import pack_schedule
+        from analyzer_tpu.sched.superstep import MatchStream
+
+        idx = np.arange(40, dtype=np.int32).reshape(4, 2, 5)
+        stream = MatchStream(
+            player_idx=idx,
+            winner=np.zeros(4, np.int32),
+            mode_id=np.ones(4, np.int32),
+            afk=np.zeros(4, bool),
+        )
+        sched = pack_schedule(stream, pad_row=40)
+        snap = get_registry().snapshot()
+        occ = snap["histograms"]["sched.pack_occupancy"]
+        assert occ["count"] == 1
+        padded = sched.pad_to_steps(sched.n_steps + 3)
+        assert padded.n_steps == sched.n_steps + 3
+        snap = get_registry().snapshot()
+        assert snap["counters"]["sched.pad_steps_total"] == 3
+        assert (
+            snap["counters"]["sched.pad_slots_total"]
+            >= 3 * sched.batch_size
+        )
+
+    def test_mesh_put_counts_bytes(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from analyzer_tpu.parallel.mesh import _put_global, make_mesh
+
+        mesh = make_mesh(1)
+        arr = np.zeros((8, 4), np.float32)
+        _put_global(arr, NamedSharding(mesh, P()))
+        snap = get_registry().snapshot()
+        assert snap["counters"]["mesh.put_bytes_total"] == arr.nbytes
+        assert snap["counters"]["mesh.puts_total"] == 1

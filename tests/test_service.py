@@ -624,3 +624,102 @@ class TestPipelineConfig:
                     ServiceConfig(batch_size=2, idle_timeout=0.0,
                                   pipeline=True), pipeline=False)
         assert w2.pipeline_enabled is False  # explicit arg wins
+
+
+class TestStats:
+    """Worker.stats() is a metrics-scraper contract: the key schema is
+    pinned so a refactor can't silently drop a field a dashboard reads
+    (the obs snapshot's gauges are set from these same values)."""
+
+    STATS_SCHEMA = {
+        "matches_rated",
+        "batches_ok",
+        "batches_failed",
+        "dead_letters",
+        "matches_per_sec",
+        "pipeline_enabled",
+        "pipeline_degraded",
+        "pipeline_engine_failures",
+        "pipeline_lag",
+        "resolved_pipeline_lag",
+        "measured_rtt_ms",
+        "measured_host_ms",
+    }
+
+    def test_stats_key_schema_exact(self, rig):
+        broker, store, worker = rig
+        assert set(worker.stats()) == self.STATS_SCHEMA
+
+    def test_stats_after_work_and_failure(self, rig):
+        broker, store, worker = rig
+        for i in range(4):
+            store.add_match(mk_match(f"s{i}", created_at=i))
+            broker.publish("analyze", f"s{i}".encode())
+        assert worker.poll()
+        s = worker.stats()
+        assert set(s) == self.STATS_SCHEMA
+        assert s["matches_rated"] == 4
+        assert s["batches_ok"] == 1
+        assert s["batches_failed"] == 0
+        assert s["dead_letters"] == 0
+        assert s["matches_per_sec"] >= 0
+        # Sequential-by-default rig: the pipelined lane reports None/False.
+        assert s["pipeline_enabled"] is False
+        assert s["pipeline_degraded"] is False
+        assert s["pipeline_lag"] is None
+        assert s["resolved_pipeline_lag"] is None
+
+    def test_stats_resolved_lag_reported_pre_engine(self):
+        # Pipelined config + pinned lag: the lag must be visible BEFORE
+        # the first flush builds the engine (ops need it at startup).
+        broker = InMemoryBroker()
+        w = Worker(
+            broker, InMemoryStore(),
+            ServiceConfig(batch_size=2, idle_timeout=0.0, pipeline=True,
+                          pipeline_lag=3),
+        )
+        s = w.stats()
+        assert s["pipeline_lag"] == 3
+        assert s["resolved_pipeline_lag"] == 3
+        assert s["pipeline_enabled"] is True
+
+    def test_dead_letter_counter_moves(self, rig):
+        from analyzer_tpu.obs import get_registry
+
+        broker, store, worker = rig
+        before = worker.dead_letters
+        reg_before = get_registry().counter(
+            "worker.dead_letters_total"
+        ).value
+        broker.declare_queue("analyze")
+        for i in range(3):
+            broker.publish("analyze", f"d{i}".encode())
+        msgs = broker.get("analyze", 3)
+        worker._dead_letter(msgs)
+        assert worker.dead_letters == before + 3
+        assert (
+            get_registry().counter("worker.dead_letters_total").value
+            == reg_before + 3
+        )
+
+    def test_degradation_counter_moves(self):
+        from analyzer_tpu.obs import get_registry
+
+        broker = InMemoryBroker()
+        w = Worker(
+            broker, InMemoryStore(),
+            ServiceConfig(batch_size=2, idle_timeout=0.0, pipeline=True),
+        )
+        before = get_registry().counter(
+            "worker.pipeline_degradations_total"
+        ).value
+        w._disable_pipeline("test reason")
+        assert w.pipeline_enabled is False
+        assert w.pipeline_degraded is True
+        assert (
+            get_registry().counter(
+                "worker.pipeline_degradations_total"
+            ).value
+            == before + 1
+        )
+        assert get_registry().gauge("worker.pipeline_degraded").value is True
