@@ -13,10 +13,16 @@ Subcommands:
   train   win-probability heads (logistic/MLP) on leak-free rating features
   elo     Elo re-rate of a stream + prediction accuracy
   bench   the headline throughput benchmark (one JSON line)
+  benchdiff  per-config throughput delta between two BENCH_*.json
+          artifacts; non-zero exit past --regress-pct (CI trajectory gate)
   worker  the broker-consuming service loop (needs pika)
   lint    graftlint static analysis (JAX hazards + native ABI, docs/lint.md)
   metrics runtime telemetry snapshots (docs/observability.md): render a
           --metrics-out artifact (or this process) as JSON/Prometheus/text
+
+Live introspection: rate/bench/worker take ``--obs-port`` (obsd —
+/metrics, /healthz, /readyz, /statusz, /debug/snapshot on localhost);
+the worker also takes ``--flight-dir`` to arm flight-recorder dumps.
 """
 
 from __future__ import annotations
@@ -271,12 +277,30 @@ def _half_credit_accuracy(p: np.ndarray, team0_won: np.ndarray) -> float:
 
 def _obs_begin(args) -> None:
     """Arms the telemetry surface for a ``--metrics-out``/``--trace-events``
-    run: the jax.monitoring compile listeners make retraces countable from
-    the first compile."""
-    if getattr(args, "metrics_out", None) or getattr(args, "trace_events", None):
+    /``--obs-port`` run: the jax.monitoring compile listeners make
+    retraces countable from the first compile."""
+    if (
+        getattr(args, "metrics_out", None)
+        or getattr(args, "trace_events", None)
+        or getattr(args, "obs_port", None) is not None
+    ):
         from analyzer_tpu.obs import install_jax_hooks
 
         install_jax_hooks()
+
+
+def _obs_serve(args):
+    """Starts obsd for the duration of a CLI run when ``--obs-port`` was
+    given (0 = ephemeral; the bound port prints to stderr). Returns the
+    server (caller closes) or None."""
+    port = getattr(args, "obs_port", None)
+    if port is None:
+        return None
+    from analyzer_tpu.obs.server import ObsServer
+
+    server = ObsServer(port=port)
+    print(f"obsd listening on {server.url}", file=sys.stderr)
+    return server
 
 
 def _obs_write(args) -> None:
@@ -298,9 +322,14 @@ def _obs_write(args) -> None:
 
 def cmd_rate(args) -> int:
     _obs_begin(args)
-    rc = _cmd_rate_impl(args)
-    if rc == 0:
-        _obs_write(args)
+    server = _obs_serve(args)
+    try:
+        rc = _cmd_rate_impl(args)
+        if rc == 0:
+            _obs_write(args)
+    finally:
+        if server is not None:
+            server.close()
     return rc
 
 
@@ -786,7 +815,67 @@ def cmd_bench(args) -> int:
     spec = importlib.util.spec_from_file_location("bench", path)
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
-    bench.main(metrics_out=getattr(args, "metrics_out", None))
+    bench.main(
+        metrics_out=getattr(args, "metrics_out", None),
+        obs_port=getattr(args, "obs_port", None),
+    )
+    return 0
+
+
+def cmd_benchdiff(args) -> int:
+    """Bench trajectory gate: per-config deltas between two BENCH_*.json
+    artifacts; non-zero exit past ``--regress-pct`` (obs/benchdiff.py)."""
+    from analyzer_tpu.obs.benchdiff import (
+        bench_configs,
+        diff_configs,
+        find_bench_artifacts,
+        latest_artifact,
+        load_bench,
+        render_diff,
+    )
+
+    paths = args.artifacts
+    if args.against_latest:
+        if len(paths) > 1:
+            print(
+                "error: --against-latest takes at most one artifact (the "
+                "candidate)", file=sys.stderr,
+            )
+            return 2
+        if paths:
+            b_path = paths[0]
+            a_path = latest_artifact(args.dir, exclude=b_path)
+        else:
+            arts = find_bench_artifacts(args.dir)
+            a_path, b_path = (arts[-2], arts[-1]) if len(arts) >= 2 else (None, None)
+        if a_path is None or b_path is None:
+            print(
+                f"error: not enough BENCH_*.json artifacts under {args.dir}",
+                file=sys.stderr,
+            )
+            return 2
+    elif len(paths) == 2:
+        a_path, b_path = paths
+    else:
+        print(
+            "error: benchdiff needs two artifacts (baseline candidate) or "
+            "--against-latest", file=sys.stderr,
+        )
+        return 2
+    try:
+        a = bench_configs(load_bench(a_path))
+        b = bench_configs(load_bench(b_path))
+    except (OSError, ValueError) as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 2
+    rows = diff_configs(a, b, args.regress_pct)
+    sys.stdout.write(render_diff(a_path, b_path, rows))
+    if any(r.regressed and r.gated for r in rows):
+        print(
+            f"error: throughput regressed more than {args.regress_pct:g}%",
+            file=sys.stderr,
+        )
+        return 1
     return 0
 
 
@@ -852,7 +941,7 @@ def cmd_worker(args) -> int:
         return 0
     from analyzer_tpu.service.worker import main as worker_main
 
-    worker_main()
+    worker_main(obs_port=args.obs_port, flight_dir=args.flight_dir)
     return 0
 
 
@@ -934,6 +1023,12 @@ def main(argv=None) -> int:
         "all (global under jax.distributed — set COORDINATOR_ADDRESS/"
         "NUM_PROCESSES/PROCESS_ID and run on every host)",
     )
+    s.add_argument(
+        "--obs-port", type=int, metavar="PORT",
+        help="serve live introspection endpoints (/metrics /healthz "
+        "/readyz /statusz /debug/snapshot) on localhost:PORT for the "
+        "duration of the run (0 = ephemeral; docs/observability.md)",
+    )
     s.set_defaults(fn=cmd_rate)
 
     s = sub.add_parser(
@@ -983,7 +1078,39 @@ def main(argv=None) -> int:
         help="also write the full telemetry snapshot as JSON (the BENCH "
         "line embeds the phase/retrace breakdown either way)",
     )
+    s.add_argument(
+        "--obs-port", type=int, metavar="PORT",
+        help="serve the live introspection endpoints while the benchmark "
+        "runs (watch /metrics mid-capture; 0 = ephemeral)",
+    )
     s.set_defaults(fn=cmd_bench)
+
+    s = sub.add_parser(
+        "benchdiff",
+        help="diff two BENCH_*.json artifacts; non-zero exit on a "
+        "throughput regression past --regress-pct",
+    )
+    s.add_argument(
+        "artifacts", nargs="*",
+        help="baseline and candidate artifacts (raw bench lines or the "
+        "driver's {parsed: ...} captures); with --against-latest, at most "
+        "the candidate",
+    )
+    s.add_argument(
+        "--against-latest", action="store_true",
+        help="compare the candidate (or the newest artifact) against the "
+        "latest other BENCH_*.json under --dir",
+    )
+    s.add_argument(
+        "--dir", default=".",
+        help="directory scanned for BENCH_*.json (default: .)",
+    )
+    s.add_argument(
+        "--regress-pct", type=float, default=5.0, metavar="PCT",
+        help="fail (exit 1) when a non-degraded config is worse by more "
+        "than PCT percent (default: 5)",
+    )
+    s.set_defaults(fn=cmd_benchdiff)
 
     s = sub.add_parser(
         "lint",
@@ -1021,6 +1148,18 @@ def main(argv=None) -> int:
         "--requeue-failed", action="store_true",
         help="redrive <QUEUE>_failed back onto the main queue and exit "
         "(run after fixing what dead-lettered them)",
+    )
+    s.add_argument(
+        "--obs-port", type=int, metavar="PORT",
+        help="obsd: /metrics /healthz /readyz /statusz /debug/snapshot on "
+        "localhost:PORT (also ANALYZER_TPU_OBS_PORT); /readyz 503s while "
+        "the pipelined lane is degraded",
+    )
+    s.add_argument(
+        "--flight-dir", metavar="DIR",
+        help="arm flight-recorder dumps into DIR (also "
+        "ANALYZER_TPU_FLIGHT_DIR): dead-letters, pipeline degradation "
+        "and SIGUSR1 leave a timestamped artifact directory",
     )
     s.set_defaults(fn=cmd_worker)
 

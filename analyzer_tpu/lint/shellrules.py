@@ -1,5 +1,5 @@
-"""Service-shell rules (GL020-GL023): exception hygiene, mutable
-defaults, and raw-clock timing.
+"""Service-shell rules (GL020-GL024): exception hygiene, mutable
+defaults, raw-clock timing, and network-surface containment.
 
 GL020-GL022 target the worker/pipeline layer's failure-policy code, where
 a too-broad catch silently converts "the native extension is broken" into
@@ -12,6 +12,13 @@ ad-hoc clocks there produced exactly the numbers-nobody-can-find state
 this repo's telemetry PR replaced. The few legitimate uses (a stats
 contract that must not ride the global registry) carry a line-scoped
 ``# graftlint: disable=GL023`` with a reason, like every other escape.
+
+GL024 keeps the package's network surface in ONE place:
+``http.server``/``socketserver`` imports (a listening socket) belong in
+``analyzer_tpu/obs/`` — the obsd plane — and nowhere else; and a bare
+``"0.0.0.0"`` literal is flagged EVERYWHERE, obs included, because the
+introspection endpoints must default to loopback (an all-interfaces bind
+is an operator's explicit runtime decision, never a code default).
 """
 
 from __future__ import annotations
@@ -22,6 +29,10 @@ from analyzer_tpu.lint.findings import Finding
 
 #: Directories where GL023 applies (normalized path fragments).
 _GL023_DIRS = ("analyzer_tpu/service/", "analyzer_tpu/sched/")
+
+#: The one sanctioned home for a listening socket (GL024).
+_GL024_OBS_DIR = "analyzer_tpu/obs/"
+_SERVER_MODULES = ("http.server", "socketserver")
 
 _BROAD = {"Exception", "BaseException"}
 _MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
@@ -63,6 +74,7 @@ class ShellRules:
 
     def run(self) -> list[Finding]:
         timed_layer = self._in_timed_layer()
+        obs_layer = self._in_obs_layer()
         for node in ast.walk(self.tree):
             if isinstance(node, ast.Try):
                 self._check_try(node)
@@ -70,11 +82,51 @@ class ShellRules:
                 self._check_defaults(node)
             elif timed_layer and isinstance(node, ast.Call):
                 self._check_raw_clock(node)
+            elif not obs_layer and isinstance(
+                node, (ast.Import, ast.ImportFrom)
+            ):
+                self._check_server_import(node)
+            elif (
+                # graftlint: disable=GL024 — the rule's own needle
+                isinstance(node, ast.Constant) and node.value == "0.0.0.0"
+            ):
+                self._flag(
+                    "GL024", node,
+                    'bare "0.0.0.0" bind — the introspection plane must '
+                    "default to localhost; widening to all interfaces is "
+                    "an operator's explicit runtime choice, not a code "
+                    "default",
+                )
         return self.findings
 
     def _in_timed_layer(self) -> bool:
         path = self.path.replace("\\", "/")
         return any(frag in path for frag in _GL023_DIRS)
+
+    def _in_obs_layer(self) -> bool:
+        return _GL024_OBS_DIR in self.path.replace("\\", "/")
+
+    def _check_server_import(self, node) -> None:
+        """GL024: a listening-socket module imported outside
+        ``analyzer_tpu/obs/`` — the obsd server (``obs/server.py``) is
+        the one sanctioned network surface; a second ad-hoc endpoint
+        fragments auth/bind policy and the operator's mental model."""
+        if isinstance(node, ast.Import):
+            names = [a.name for a in node.names]
+        else:  # ImportFrom
+            names = [node.module] if node.module else []
+        for name in names:
+            if any(
+                name == mod or name.startswith(mod + ".")
+                for mod in _SERVER_MODULES
+            ):
+                self._flag(
+                    "GL024", node,
+                    f"`{name}` imported outside analyzer_tpu/obs/ — "
+                    "listening sockets live in the obsd plane "
+                    "(obs/server.py); register an endpoint there instead "
+                    "of opening a second server",
+                )
 
     def _check_raw_clock(self, node: ast.Call) -> None:
         """GL023: ``time.perf_counter()`` (or a bare imported

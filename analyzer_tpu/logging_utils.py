@@ -72,6 +72,27 @@ def _env_level() -> int:
 
 
 _configured: set[str] = set()
+_shared_handlers: list[logging.Handler] = []
+
+
+def add_shared_handler(handler: logging.Handler) -> None:
+    """Attaches ``handler`` to every logger this module configured and to
+    all future ones. The loggers here deliberately do not propagate (the
+    stream handlers would double-print under a configured root), so a
+    root-level handler sees nothing — this is the sanctioned tap for
+    whole-package capture (the flight recorder's event ring)."""
+    if handler in _shared_handlers:
+        return
+    _shared_handlers.append(handler)
+    for name in _configured:
+        logging.getLogger(name).addHandler(handler)
+
+
+def remove_shared_handler(handler: logging.Handler) -> None:
+    if handler in _shared_handlers:
+        _shared_handlers.remove(handler)
+    for name in _configured:
+        logging.getLogger(name).removeHandler(handler)
 
 
 def get_logger(name: str) -> logging.Logger:
@@ -87,6 +108,8 @@ def get_logger(name: str) -> logging.Logger:
         h2.setLevel(logging.WARNING)
         h2.setFormatter(formatter)
         logger.addHandler(h2)
+        for shared in _shared_handlers:
+            logger.addHandler(shared)
         logger.propagate = False
         _configured.add(name)
     logger.setLevel(_env_level())

@@ -25,9 +25,31 @@ Plus one jax-aware module, :mod:`~analyzer_tpu.obs.retrace`, hooking
 via their ``_cache_size()`` — GL004's retrace hazard as a measurable
 runtime counter.
 
+The LIVE half (this PR's obsd plane — everything above is post-hoc):
+
+  * :mod:`~analyzer_tpu.obs.server` — stdlib HTTP endpoints on a thread
+    (``/metrics`` ``/healthz`` ``/readyz`` ``/statusz``
+    ``/debug/snapshot``) with a pluggable :class:`HealthChecks` registry;
+  * :mod:`~analyzer_tpu.obs.flight` — the flight recorder: a bounded ring
+    of recent events dumped as a timestamped artifact directory on
+    dead-letter / degradation / SIGUSR1;
+  * :mod:`~analyzer_tpu.obs.devicemem` — HBM-occupancy and live-buffer
+    gauges sampled at batch boundaries (jax-aware, lazy import);
+  * :mod:`~analyzer_tpu.obs.benchdiff` — the BENCH_*.json trajectory
+    diff behind ``cli benchdiff``.
+
 Metric name catalog: docs/observability.md.
 """
 
+from analyzer_tpu.obs.devicemem import (
+    maybe_sample as maybe_sample_device_memory,
+    sample_device_memory,
+)
+from analyzer_tpu.obs.flight import (
+    FlightRecorder,
+    get_flight_recorder,
+    reset_flight_recorder,
+)
 from analyzer_tpu.obs.registry import (
     MetricsRegistry,
     get_registry,
@@ -46,20 +68,29 @@ from analyzer_tpu.obs.snapshot import (
     write_chrome_trace,
     write_snapshot,
 )
+from analyzer_tpu.obs.server import HealthChecks, ObsServer, connectivity_probe
 from analyzer_tpu.obs.tracer import Tracer, get_tracer, instant, span
 
 __all__ = [
+    "FlightRecorder",
+    "HealthChecks",
     "MetricsRegistry",
+    "ObsServer",
     "Tracer",
+    "connectivity_probe",
+    "get_flight_recorder",
     "get_registry",
     "get_tracer",
     "install_jax_hooks",
     "instant",
     "jax_hooks_installed",
+    "maybe_sample_device_memory",
     "prometheus_text",
     "render_summary",
+    "reset_flight_recorder",
     "reset_registry",
     "retrace_counts",
+    "sample_device_memory",
     "snapshot",
     "span",
     "track_jit",

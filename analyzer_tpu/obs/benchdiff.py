@@ -1,0 +1,155 @@
+"""Bench trajectory monitoring: diff two BENCH_*.json artifacts.
+
+The repo accumulates one benchmark artifact per round (``BENCH_rNN.json``)
+but nothing ever LOOKED at the sequence — a 20% throughput regression
+would ride along unnoticed until a human happened to eyeball two files.
+``cli benchdiff`` turns the trajectory into a gate:
+
+  * loads two artifacts (either the raw one-line JSON ``bench.py``
+    prints, or the driver's wrapper with the line under ``"parsed"``);
+  * prints a per-config delta table (headline device throughput, the
+    streamed end-to-end minimum, capture health);
+  * exits non-zero when any non-degraded config regressed past
+    ``--regress-pct``.
+
+Degraded captures (``capture.degraded`` — a bad tunnel window, an
+unconverged repeat set) are REPORTED but excluded from the gate: failing
+CI on a known-bad measurement teaches people to ignore the gate.
+
+Stdlib-only, like the rest of the exposition layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+
+
+@dataclasses.dataclass(frozen=True)
+class BenchConfig:
+    """One measured configuration inside a bench artifact."""
+
+    name: str
+    value: float
+    higher_is_better: bool
+    degraded: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class DiffRow:
+    name: str
+    a: float
+    b: float
+    delta_pct: float
+    regressed: bool
+    gated: bool  # False when a degraded capture excluded it from the gate
+
+
+def load_bench(path: str) -> dict:
+    """One bench artifact as the raw metric line, unwrapping the driver's
+    ``{"parsed": {...}}`` capture format. Raises ValueError when neither
+    shape fits — a truncated artifact must not diff as zeros."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if "metric" not in data and isinstance(data.get("parsed"), dict):
+        data = data["parsed"]
+    if "metric" not in data or "value" not in data:
+        raise ValueError(
+            f"{path}: not a bench artifact (no metric/value, and no "
+            "parsed block)"
+        )
+    return data
+
+
+def bench_configs(data: dict) -> list[BenchConfig]:
+    """The comparable configs inside one artifact: the headline
+    throughput (higher is better) and, when present, the streamed
+    end-to-end minimum (seconds — lower is better)."""
+    degraded = bool((data.get("capture") or {}).get("degraded"))
+    out = [
+        BenchConfig(
+            name=str(data["metric"]),
+            value=float(data["value"]),
+            higher_is_better=True,
+            degraded=degraded,
+        )
+    ]
+    streamed = data.get("streamed") or {}
+    if streamed.get("min_s") is not None:
+        out.append(
+            BenchConfig(
+                name="streamed.min_s",
+                value=float(streamed["min_s"]),
+                higher_is_better=False,
+                degraded=degraded or not streamed.get("stable", True),
+            )
+        )
+    return out
+
+
+def diff_configs(
+    a: list[BenchConfig], b: list[BenchConfig], regress_pct: float
+) -> list[DiffRow]:
+    """Per-config deltas for configs present on BOTH sides (a new config
+    has no baseline; a dropped one has no candidate — neither can gate)."""
+    a_by = {c.name: c for c in a}
+    rows: list[DiffRow] = []
+    for cb in b:
+        ca = a_by.get(cb.name)
+        if ca is None or ca.value == 0:
+            continue
+        delta_pct = (cb.value - ca.value) / abs(ca.value) * 100.0
+        worse = -delta_pct if ca.higher_is_better else delta_pct
+        regressed = worse > regress_pct
+        gated = not (ca.degraded or cb.degraded)
+        rows.append(
+            DiffRow(
+                name=cb.name,
+                a=ca.value,
+                b=cb.value,
+                delta_pct=delta_pct,
+                regressed=regressed,
+                gated=gated,
+            )
+        )
+    return rows
+
+
+def find_bench_artifacts(directory: str) -> list[str]:
+    """``BENCH_*.json`` under ``directory``, name-sorted (the round
+    numbering ``r01..rNN`` sorts chronologically by construction)."""
+    return sorted(glob.glob(os.path.join(directory, "BENCH_*.json")))
+
+
+def latest_artifact(directory: str, exclude: str | None = None) -> str | None:
+    """The newest artifact by name order, skipping ``exclude`` (the
+    candidate itself, when it already sits in the scanned directory)."""
+    paths = find_bench_artifacts(directory)
+    if exclude is not None:
+        ex = os.path.abspath(exclude)
+        paths = [p for p in paths if os.path.abspath(p) != ex]
+    return paths[-1] if paths else None
+
+
+def render_diff(
+    a_path: str, b_path: str, rows: list[DiffRow]
+) -> str:
+    """The human table. One line per config: old -> new, signed percent,
+    and the gate disposition."""
+    out = [f"benchdiff: {os.path.basename(a_path)} -> "
+           f"{os.path.basename(b_path)}"]
+    if not rows:
+        out.append("  (no comparable configs)")
+    for r in rows:
+        status = "ok"
+        if r.regressed:
+            status = "REGRESSION" if r.gated else "regression (degraded capture, not gated)"
+        elif not r.gated:
+            status = "degraded capture, not gated"
+        out.append(
+            f"  {r.name}: {r.a:g} -> {r.b:g} "
+            f"({r.delta_pct:+.1f}%) {status}"
+        )
+    return "\n".join(out) + "\n"

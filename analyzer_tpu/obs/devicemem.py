@@ -1,0 +1,115 @@
+"""Device-memory telemetry: HBM occupancy and live-buffer gauges.
+
+The 10M-match streaming re-rate (BASELINE.json) carries a multi-GB
+working set on device — the player table, the in-flight schedule slabs,
+the pipeline's chain ring. Nothing surfaced how close a run sits to the
+HBM ceiling until it OOMs. This module samples per-device memory into
+the registry at batch boundaries (``sched/runner.py``) and on demand
+(bench ``telemetry`` block, ``/metrics``):
+
+  ``device.hbm_bytes_in_use{device=...}``  allocator bytes in use
+                                           (``device.memory_stats()``);
+  ``device.hbm_bytes_limit{device=...}``   allocator limit when reported;
+  ``device.live_buffers{device=...}``      live jax arrays on the device;
+  ``device.live_buffers``                  process total.
+
+CPU fallback (tier-1 runs on the CPU backend, where ``memory_stats()``
+returns None): bytes-in-use is reconstructed from ``jax.live_arrays()``
+nbytes, attributed per device (a sharded array splits evenly across its
+device set). The sampler throttles itself (``maybe_sample``) because
+``live_arrays`` walks every live buffer — fine per batch, wasteful per
+chunk on a deep schedule.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from analyzer_tpu.obs.registry import get_registry
+
+#: Minimum seconds between throttled samples (maybe_sample).
+MIN_SAMPLE_INTERVAL_S = 1.0
+
+_lock = threading.Lock()
+_last_sample_at: float | None = None
+
+
+def sample_device_memory(registry=None) -> dict:
+    """Samples every jax device's memory state into gauges; returns
+    ``{device_label: {"bytes_in_use", "bytes_limit", "live_buffers",
+    "source"}}``. Imports jax lazily — the obs package stays importable
+    without an accelerator stack."""
+    import jax
+
+    reg = registry or get_registry()
+    per_dev_count: dict = {}
+    per_dev_bytes: dict = {}
+    live = jax.live_arrays()
+    for arr in live:
+        try:
+            devs = arr.devices()
+            nbytes = arr.nbytes
+        except Exception:  # noqa: BLE001 — deleted/donated buffers race the walk
+            continue
+        share = nbytes / max(1, len(devs))
+        for d in devs:
+            per_dev_count[d] = per_dev_count.get(d, 0) + 1
+            per_dev_bytes[d] = per_dev_bytes.get(d, 0.0) + share
+    out: dict = {}
+    for dev in jax.devices():
+        label = f"{dev.platform}:{dev.id}"
+        stats = None
+        try:
+            stats = dev.memory_stats()
+        except Exception:  # noqa: BLE001 — backends without allocator stats
+            stats = None
+        if stats and stats.get("bytes_in_use") is not None:
+            in_use = int(stats["bytes_in_use"])
+            limit = stats.get("bytes_limit")
+            source = "memory_stats"
+        else:
+            in_use = int(per_dev_bytes.get(dev, 0))
+            limit = None
+            source = "live_arrays"
+        count = per_dev_count.get(dev, 0)
+        reg.gauge("device.hbm_bytes_in_use", device=label).set(in_use)
+        if limit is not None:
+            reg.gauge("device.hbm_bytes_limit", device=label).set(int(limit))
+        reg.gauge("device.live_buffers", device=label).set(count)
+        out[label] = {
+            "bytes_in_use": in_use,
+            "bytes_limit": int(limit) if limit is not None else None,
+            "live_buffers": count,
+            "source": source,
+        }
+    reg.gauge("device.live_buffers").set(len(live))
+    return out
+
+
+def maybe_sample(min_interval_s: float = MIN_SAMPLE_INTERVAL_S) -> bool:
+    """Throttled :func:`sample_device_memory` for batch-boundary call
+    sites: the first call always samples, later calls only after
+    ``min_interval_s``. Returns whether a sample ran. Never raises — a
+    gauge must not take down a rating loop."""
+    global _last_sample_at
+    now = time.monotonic()
+    with _lock:
+        if (
+            _last_sample_at is not None
+            and now - _last_sample_at < min_interval_s
+        ):
+            return False
+        _last_sample_at = now
+    try:
+        sample_device_memory()
+    except Exception:  # noqa: BLE001 — telemetry stays off the failure path
+        return False
+    return True
+
+
+def reset_sampler() -> None:
+    """Clears the throttle window (tests)."""
+    global _last_sample_at
+    with _lock:
+        _last_sample_at = None

@@ -182,6 +182,7 @@ STANDARD_COUNTERS = (
     "mesh.puts_total",
     "jax.retraces_total",
     "jax.backend_compiles_total",
+    "obs.flight_dumps_total",
 )
 STANDARD_GAUGES = (
     "worker.pipeline_lag",
@@ -189,6 +190,9 @@ STANDARD_GAUGES = (
     "worker.pipeline_inflight",
     "worker.matches_per_sec",
     "sched.occupancy",
+    # Per-device series (device.hbm_bytes_in_use{device=...}) appear on
+    # first sample; the process total is pre-declared.
+    "device.live_buffers",
 )
 
 

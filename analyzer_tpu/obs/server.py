@@ -1,0 +1,226 @@
+"""obsd: the live introspection plane — stdlib HTTP endpoints on a thread.
+
+Everything the snapshot artifact exposes post-hoc (``--metrics-out``,
+``cli metrics``) becomes scrapeable while the process runs:
+
+  ``GET /healthz``         liveness — 200 as long as the thread serves;
+  ``GET /readyz``          readiness — 200 when every registered
+                           :class:`HealthChecks` probe passes, 503 with
+                           one ``fail <name>: <detail>`` line per failing
+                           probe otherwise (a worker registers pipeline/
+                           broker/store probes, ``service/worker.py``);
+  ``GET /metrics``         Prometheus text exposition (``prometheus_text``);
+  ``GET /statusz``         human summary: ``render_summary`` plus the
+                           owner's ``status_provider()`` dict (worker
+                           ``stats()``);
+  ``GET /debug/snapshot``  the full JSON snapshot, spans included.
+
+Served by ``http.server.ThreadingHTTPServer`` on a daemon thread — no
+framework, no dependency, good enough for a scrape every few seconds and
+an operator's curl. This module is the ONE sanctioned home for a listening
+socket in the package: graftlint GL024 flags ``http.server`` imports
+anywhere else, and flags a bare ``0.0.0.0`` default bind even here — obsd
+binds localhost unless an operator explicitly widens it (``docs/
+observability.md``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from analyzer_tpu.logging_utils import get_logger
+from analyzer_tpu.obs.snapshot import (
+    prometheus_text,
+    render_summary,
+    snapshot,
+)
+
+logger = get_logger(__name__)
+
+#: Loopback by default: the introspection plane carries operational detail
+#: (queue names, env capture pointers) and must be opted ONTO a network
+#: interface, never discovered on one.
+DEFAULT_HOST = "127.0.0.1"
+
+
+class HealthChecks:
+    """Pluggable readiness registry: ``register(name, probe)`` where
+    ``probe()`` returns ``True``/``False`` or ``(ok, detail)``. A probe
+    that raises is a failing probe (the exception is the detail) — a
+    readiness endpoint that crashes on the condition it exists to report
+    would be worse than useless."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._checks: dict[str, object] = {}
+
+    def register(self, name: str, probe) -> None:
+        with self._lock:
+            self._checks[name] = probe
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._checks.pop(name, None)
+
+    def run(self) -> dict[str, tuple[bool, str]]:
+        """name -> (ok, detail) for every registered probe."""
+        with self._lock:
+            checks = dict(self._checks)
+        out: dict[str, tuple[bool, str]] = {}
+        for name, probe in checks.items():
+            try:
+                result = probe()
+            except Exception as err:  # noqa: BLE001 — a raising probe is a failing probe
+                out[name] = (False, f"probe raised: {err!r}")
+                continue
+            if isinstance(result, tuple):
+                ok, detail = result
+                out[name] = (bool(ok), str(detail))
+            else:
+                out[name] = (bool(result), "ok" if result else "failed")
+        return out
+
+    @property
+    def ready(self) -> bool:
+        return all(ok for ok, _ in self.run().values())
+
+
+class ObsServer:
+    """The obsd thread. ``port=0`` binds an ephemeral port (tests); the
+    bound port is readable at :attr:`port`. ``status_provider()`` (a dict,
+    e.g. ``Worker.stats``) enriches ``/statusz``. Stop with
+    :meth:`close` — the worker's shutdown path owns that call."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = DEFAULT_HOST,
+        status_provider=None,
+        health: HealthChecks | None = None,
+        max_statusz_spans: int = 200,
+    ) -> None:
+        self.health = health if health is not None else HealthChecks()
+        self.status_provider = status_provider
+        self._max_statusz_spans = max_statusz_spans
+        obsd = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # One obsd per process is the norm; route table lives here so
+            # the handler closes over the server object, not globals.
+            def log_message(self, fmt, *args):  # quiet: curl spam is DEBUG
+                logger.debug("obsd: " + fmt, *args)
+
+            def _send(self, code: int, body: str, ctype: str) -> None:
+                data = body.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype + "; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802 — http.server contract
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/healthz":
+                        self._send(200, "ok\n", "text/plain")
+                    elif path == "/readyz":
+                        self._send(*obsd._readyz(), "text/plain")
+                    elif path == "/metrics":
+                        self._send(200, prometheus_text(), "text/plain")
+                    elif path == "/statusz":
+                        self._send(200, obsd._statusz(), "text/plain")
+                    elif path == "/debug/snapshot":
+                        body = json.dumps(
+                            snapshot(max_spans=None), indent=1, sort_keys=True
+                        )
+                        self._send(200, body + "\n", "application/json")
+                    else:
+                        self._send(404, "not found\n", "text/plain")
+                except Exception:  # noqa: BLE001 — a broken renderer must
+                    # surface as a 500 response, not kill the serving thread.
+                    logger.exception("obsd handler failed for %s", path)
+                    self._send(500, "internal error\n", "text/plain")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="analyzer-obsd",
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info("obsd listening on http://%s:%d", self.host, self.port)
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def _readyz(self) -> tuple[int, str]:
+        results = self.health.run()
+        failing = {n: d for n, (ok, d) in results.items() if not ok}
+        lines = [
+            (f"fail {n}: {results[n][1]}" if n in failing else f"ok {n}")
+            for n in sorted(results)
+        ]
+        if not lines:
+            lines = ["ok (no checks registered)"]
+        return (503 if failing else 200), "\n".join(lines) + "\n"
+
+    def _statusz(self) -> str:
+        snap = snapshot(max_spans=self._max_statusz_spans)
+        out = [render_summary(snap)]
+        if self.status_provider is not None:
+            try:
+                status = self.status_provider()
+            except Exception as err:  # noqa: BLE001 — statusz must render
+                # during the incident it exists to explain
+                status = {"status_provider_error": repr(err)}
+            out.append("status:")
+            out.extend(f"  {k} = {v}" for k, v in sorted(status.items()))
+        ready = self.health.run()
+        if ready:
+            out.append("readiness:")
+            out.extend(
+                f"  {'ok ' if ok else 'FAIL'} {n}: {d}"
+                for n, (ok, d) in sorted(ready.items())
+            )
+        return "\n".join(out) + "\n"
+
+    def close(self) -> None:
+        """Stops serving and joins the thread. Idempotent."""
+        httpd, self._httpd = self._httpd, None
+        if httpd is None:
+            return
+        httpd.shutdown()
+        httpd.server_close()
+        self._thread.join(timeout=5)
+        logger.info("obsd stopped")
+
+
+def connectivity_probe(obj, what: str):
+    """A HealthChecks probe over a duck-typed broker/store: consults
+    ``is_connected``/``is_open`` (attr or nullary method) or ``ping()``
+    when the object offers one; objects exposing none of these (the
+    in-memory fakes) are healthy by construction."""
+
+    def probe() -> tuple[bool, str]:
+        for attr in ("is_connected", "is_open"):
+            flag = getattr(obj, attr, None)
+            if flag is None:
+                continue
+            ok = bool(flag() if callable(flag) else flag)
+            return ok, f"{what}.{attr}={ok}"
+        ping = getattr(obj, "ping", None)
+        if callable(ping):
+            ping()  # raises on a dead connection -> failing probe
+            return True, f"{what}.ping ok"
+        return True, f"{what}: no connectivity probe exposed"
+
+    return probe

@@ -24,7 +24,9 @@ from analyzer_tpu.obs.tracer import Tracer, get_tracer
 SNAPSHOT_VERSION = 1
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
-_SERIES_RE = re.compile(r"^(?P<name>[^{]+)(\{(?P<labels>.*)\})?$")
+# DOTALL: a label value carrying a newline (an exception string) must
+# still parse as a label body, then escape as \n in the exposition.
+_SERIES_RE = re.compile(r"^(?P<name>[^{]+)(\{(?P<labels>.*)\})?$", re.DOTALL)
 
 
 def snapshot(
@@ -64,6 +66,18 @@ def write_chrome_trace(path: str, tracer: Tracer | None = None) -> int:
     return (tracer or get_tracer()).export_chrome(path)
 
 
+def escape_label_value(value: str) -> str:
+    """Prometheus text-format label escaping: backslash, double quote and
+    newline must be escaped or the scrape line is corrupt (a player id or
+    an exception string with a quote in it would break the whole page)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _split_series(key: str) -> tuple[str, str]:
     """``name{a=b,c=d}`` -> (sanitized_name, prometheus label body)."""
     m = _SERIES_RE.match(key)
@@ -73,7 +87,7 @@ def _split_series(key: str) -> tuple[str, str]:
         parts = []
         for pair in labels.split(","):
             k, _, v = pair.partition("=")
-            parts.append(f'{_NAME_RE.sub("_", k)}="{v}"')
+            parts.append(f'{_NAME_RE.sub("_", k)}="{escape_label_value(v)}"')
         labels = ",".join(parts)
     return name, labels
 
@@ -127,7 +141,7 @@ def prometheus_text(snap: dict | None = None) -> str:
     for entry, count in snap.get("retraces", {}).items():
         emit(
             "jax.jit_cache_size", count, "gauge",
-            extra_labels=f'entrypoint="{entry}"',
+            extra_labels=f'entrypoint="{escape_label_value(entry)}"',
         )
     return "\n".join(lines) + "\n"
 

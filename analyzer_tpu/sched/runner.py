@@ -25,7 +25,12 @@ import numpy as np
 from analyzer_tpu.config import RatingConfig
 from analyzer_tpu.core.state import MatchBatch, PlayerState
 from analyzer_tpu.core.update import rate_and_apply
-from analyzer_tpu.obs import get_registry, get_tracer, track_jit
+from analyzer_tpu.obs import (
+    get_registry,
+    get_tracer,
+    maybe_sample_device_memory,
+    track_jit,
+)
 from analyzer_tpu.sched.superstep import (
     PackedSchedule,
     compact_device_window,
@@ -190,6 +195,11 @@ def rate_history(
             pending = ys
         if on_chunk is not None:
             on_chunk(state, min(start + steps_per_chunk, n_steps))
+        # HBM-occupancy gauges at chunk boundaries (throttled inside —
+        # device.hbm_bytes_in_use / device.live_buffers, obs/devicemem.py):
+        # a run creeping toward the HBM ceiling shows up in /metrics and
+        # the bench telemetry block BEFORE it OOMs.
+        maybe_sample_device_memory()
     if not collect:
         return state, None
     if pending is not None:
@@ -491,6 +501,7 @@ def rate_stream(
                 with tracer.span("batch.fetch", cat="sched", start=e0):
                     outs.append(fetch_tree(ys))
         emitted = e1
+        maybe_sample_device_memory()  # batch-boundary HBM gauges (throttled)
 
     while worker.is_alive():
         scatter_new(int(progress[0]))

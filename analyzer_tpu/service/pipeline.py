@@ -82,7 +82,7 @@ import numpy as np
 
 from analyzer_tpu.core.state import MU_LO, SIGMA_HI
 from analyzer_tpu.logging_utils import get_logger
-from analyzer_tpu.obs import get_registry, get_tracer
+from analyzer_tpu.obs import get_flight_recorder, get_registry, get_tracer
 from analyzer_tpu.sched.runner import _gather_outputs, _scan_chunk
 from analyzer_tpu.service.columnar import finalize
 from analyzer_tpu.utils.host import fetch_tree
@@ -315,6 +315,8 @@ class _Writer(threading.Thread):
             # A dead writer must not hang every gate wait: poison the
             # stream so submit falls back to the sequential loop.
             logger.exception("pipeline writer store unavailable")
+            get_flight_recorder().note("pipeline.writer_dead",
+                                       why="store factory failed")
             with self.cv:
                 self.poisoned = True
                 self.cv.notify_all()
@@ -348,6 +350,14 @@ class _Writer(threading.Thread):
                 except BaseException as err:  # noqa: BLE001 — policy boundary
                     job.status = "failed"
                     job.error = err
+                    # Breadcrumb BEFORE the worker's harvest dumps the
+                    # flight artifact: the writer thread is where the
+                    # failure actually happened, and events.log should
+                    # carry its seq + error next to the fetch spans.
+                    get_flight_recorder().note(
+                        "pipeline.writer_failure",
+                        seq=job.seq, error=repr(err),
+                    )
                     rollback = getattr(self.store, "rollback", None)
                     if rollback is not None:
                         try:

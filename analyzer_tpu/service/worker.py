@@ -27,11 +27,13 @@ vectorized rating path swapped in:
 
 from __future__ import annotations
 
+import dataclasses
+import os
 import time
 
 from analyzer_tpu.config import RatingConfig, ServiceConfig
 from analyzer_tpu.logging_utils import get_logger
-from analyzer_tpu.obs import get_registry, get_tracer
+from analyzer_tpu.obs import get_flight_recorder, get_registry, get_tracer
 from analyzer_tpu.sched import pack_schedule, rate_history
 from analyzer_tpu.service.broker import Broker, Message
 from analyzer_tpu.service.encode import EncodedBatch
@@ -94,6 +96,9 @@ class Worker:
         rating_config: RatingConfig | None = None,
         clock=time.monotonic,
         pipeline: bool | None = None,
+        obs_port: int | None = None,
+        obs_host: str | None = None,
+        flight_dir: str | None = None,
     ) -> None:
         self.broker = broker
         self.store = store
@@ -161,6 +166,37 @@ class Worker:
         broker.declare_queue(c.crunch_queue)
         broker.declare_queue(c.telesuck_queue)
 
+        # Flight recorder: the ring is always on (process-wide, shared
+        # with the pipeline writer's breadcrumbs); artifact dumps engage
+        # once a directory is configured (flight_dir here, or
+        # ANALYZER_TPU_FLIGHT_DIR in the environment).
+        self.flight = get_flight_recorder()
+        if flight_dir is not None:
+            self.flight.configure(base_dir=flight_dir)
+        # obsd (obs/server.py): the live introspection plane. Readiness
+        # combines the pipeline lane's health with duck-typed broker/
+        # store connectivity probes — `curl :port/readyz` flips to 503
+        # the moment the worker degrades to the sequential loop.
+        self.obs_server = None
+        if obs_port is not None:
+            from analyzer_tpu.obs.server import (
+                DEFAULT_HOST, ObsServer, connectivity_probe,
+            )
+
+            self.obs_server = ObsServer(
+                port=obs_port,
+                host=obs_host or DEFAULT_HOST,
+                status_provider=self.stats,
+            )
+            health = self.obs_server.health
+            health.register("worker.pipeline", self._pipeline_health)
+            health.register(
+                "service.broker", connectivity_probe(broker, "broker")
+            )
+            health.register(
+                "service.store", connectivity_probe(store, "store")
+            )
+
     # -- micro-batcher ----------------------------------------------------
     def poll(self) -> bool:
         """One consumer iteration: pull what's available, flush when the
@@ -210,7 +246,10 @@ class Worker:
         ``max_wall_s`` bounds a ``max_flushes`` run in wall-clock time so
         a test against a mis-seeded broker fails loudly instead of
         spinning forever. ``install_signal_handlers`` wires SIGTERM and
-        SIGINT to :meth:`request_stop` (main-thread only)."""
+        SIGINT to :meth:`request_stop` (drain in-flight batches, flush a
+        final snapshot, exit cleanly) and SIGUSR1 to a flight-recorder
+        dump + ``stats()`` log line WITHOUT stopping — the operator's
+        "what is this worker doing right now" signal (main-thread only)."""
         # NOT reset here: a stop requested before run() must be honored
         # (it is cleared on the stop exit below so the worker is reusable).
         previous_handlers = {}
@@ -220,6 +259,10 @@ class Worker:
             for sig in (signal.SIGTERM, signal.SIGINT):
                 previous_handlers[sig] = signal.signal(
                     sig, lambda *_: self.request_stop()
+                )
+            if hasattr(signal, "SIGUSR1"):  # not on Windows
+                previous_handlers[signal.SIGUSR1] = signal.signal(
+                    signal.SIGUSR1, self._on_sigusr1
                 )
         try:
             flushes = 0
@@ -242,6 +285,10 @@ class Worker:
                         "stop requested; exiting after %s batches: %s",
                         flushes, self.stats(),
                     )
+                    # TERM contract: everything committed + acked above;
+                    # flush one last snapshot so the shutdown state is
+                    # inspectable after the process is gone.
+                    self._final_snapshot()
                     return
                 if deadline is not None and self.clock() > deadline:
                     target = "" if max_flushes is None else f"/{max_flushes}"
@@ -477,6 +524,12 @@ class Worker:
         get_tracer().instant(
             "worker.dead_letter", cat="worker", messages=len(messages)
         )
+        # The flight recorder freezes the last seconds BEFORE this point
+        # — spans, log tail, batch breadcrumbs — into an artifact dir
+        # (throttled; obs/flight.py). The failure policy above already
+        # completed, so a dump failure costs nothing but the artifact.
+        self.flight.note("dead_letter", messages=len(messages))
+        self._flight_dump("dead_letter")
 
     def try_process(self) -> None:
         """Routes the flushed batch: the sequential reference-shaped path
@@ -554,6 +607,7 @@ class Worker:
             "pipelined mode disabled (%s); using the sequential loop",
             reason,
         )
+        self._flight_dump("pipeline_degraded")
         set_prefetch = getattr(self.broker, "set_prefetch", None)
         if set_prefetch is not None:
             try:
@@ -569,11 +623,15 @@ class Worker:
 
     def close(self) -> None:
         """Releases the pipelined engine (writer thread + its cloned
-        store connection) after draining. A Worker is reusable after
-        close — the next pipelined flush builds a fresh engine."""
+        store connection) after draining, and stops obsd. A Worker is
+        reusable after close — the next pipelined flush builds a fresh
+        engine (obsd is not rebuilt: its lifetime is the process's)."""
         if self._engine is not None:
             self._engine.close()
             self._engine = None
+        if self.obs_server is not None:
+            self.obs_server.close()
+            self.obs_server = None
 
     def _try_process_pipelined(self, batch) -> None:
         from analyzer_tpu.service.pipeline import PipelineFallback
@@ -721,6 +779,9 @@ class Worker:
             enc = self._encode_batch(ids)
         n = len(enc.matches) if enc is not None else 0
         logger.info("processing batch of %s matches", n)
+        self.flight.note_batch(
+            len(ids), n, first_id=ids[0] if ids else None
+        )
         if not n:
             return []
         with tracer.span("batch.pack", cat="worker", matches=n):
@@ -749,6 +810,49 @@ class Worker:
         ]
 
     # -- observability ----------------------------------------------------
+    def _pipeline_health(self) -> tuple[bool, str]:
+        """Readiness probe: a degraded pipelined worker still serves (the
+        sequential loop rates correctly) but at roughly half throughput —
+        a load balancer should stop preferring it, which is exactly what
+        a 503 readiness means."""
+        if self.pipeline_degraded:
+            return False, "pipeline degraded: sequential fallback active"
+        if self.pipeline_enabled:
+            return True, "pipelined"
+        return True, "sequential by config"
+
+    def _flight_dump(self, reason: str, force: bool = False) -> None:
+        """One flight-recorder artifact for a failure path. Never raises
+        (obs/flight.py owns the throttle + error swallowing); the config
+        capture rides along so the artifact explains the worker's knobs."""
+        self.flight.dump(
+            reason, config=dataclasses.asdict(self.config), force=force
+        )
+
+    def _on_sigusr1(self, *_args) -> None:
+        """SIGUSR1: dump + stats WITHOUT stopping. Runs on the main
+        thread between bytecodes (Python signal semantics), so the file
+        IO here cannot interleave with a batch mid-commit."""
+        logger.info("SIGUSR1: %s", self.stats())
+        self._flight_dump("sigusr1", force=True)
+
+    def _final_snapshot(self) -> None:
+        """The graceful-shutdown snapshot: written into the flight
+        recorder's directory (no-op when none is configured — tests and
+        embedded workers must not litter their cwd)."""
+        base = self.flight.base_dir
+        if base is None:
+            return
+        from analyzer_tpu.obs import write_snapshot
+
+        try:
+            os.makedirs(base, exist_ok=True)
+            path = os.path.join(base, f"final-snapshot-{os.getpid()}.json")
+            write_snapshot(path)
+            logger.info("final metrics snapshot written to %s", path)
+        except Exception:  # noqa: BLE001 — shutdown must complete regardless
+            logger.exception("final snapshot failed")
+
     @property
     def matches_per_sec(self) -> float:
         dt = self.clock() - self._started_at
@@ -861,7 +965,11 @@ def requeue_failed(
     return moved
 
 
-def main(max_flushes: int | None = None) -> Worker:
+def main(
+    max_flushes: int | None = None,
+    obs_port: int | None = None,
+    flight_dir: str | None = None,
+) -> Worker:
     """``python -m analyzer_tpu.service.worker`` — the reference's
     ``python3 worker.py`` entry point (``worker.py:219-221``), requiring a
     live RabbitMQ (pika installed) to be useful. Embedded/in-process use
@@ -869,8 +977,14 @@ def main(max_flushes: int | None = None) -> Worker:
     ``max_flushes`` bounds the consume loop (tests; None = forever like
     the reference's ``start_consuming``; bounded runs get a 60 s
     wall-clock deadline so they fail loudly rather than spin). Returns
-    the Worker for inspection after a bounded run."""
+    the Worker for inspection after a bounded run.
+
+    ``obs_port`` (or ``ANALYZER_TPU_OBS_PORT``) starts obsd;
+    ``flight_dir`` (or ``ANALYZER_TPU_FLIGHT_DIR``) arms flight-recorder
+    dumps."""
     config = ServiceConfig.from_env()
+    if obs_port is None and os.environ.get("ANALYZER_TPU_OBS_PORT"):
+        obs_port = int(os.environ["ANALYZER_TPU_OBS_PORT"])
     from analyzer_tpu.service.broker import make_pika_broker
 
     # Sequential mode: prefetch_count=BATCHSIZE bounds in-flight messages
@@ -890,7 +1004,9 @@ def main(max_flushes: int | None = None) -> Worker:
         from analyzer_tpu.service.store import InMemoryStore
 
         store = InMemoryStore()
-    worker = Worker(broker, store, config)
+    worker = Worker(
+        broker, store, config, obs_port=obs_port, flight_dir=flight_dir
+    )
     worker.warmup()  # compile before consuming: no first-batch stall
     try:
         worker.run(

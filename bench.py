@@ -47,7 +47,9 @@ Env knobs: BENCH_MATCHES (default 500000), BENCH_PLAYERS (default
 BENCH_MATCHES//3), BENCH_BATCH (default 0 = auto), BENCH_REPEATS (default
 5), BENCH_CONC (default 0.8), BENCH_MAX_SHARE (default 1e-4; 0 = uncapped),
 BENCH_MESH (default 0 = single device; N = data-parallel over the first N
-real devices via the sharded-table runner, metric still per chip).
+real devices via the sharded-table runner, metric still per chip),
+BENCH_OBS_PORT (serve obsd — /metrics, /statusz — on localhost while the
+capture runs; `cli bench --obs-port` sets the same thing).
 """
 
 from __future__ import annotations
@@ -99,8 +101,26 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main(metrics_out: str | None = None) -> None:
+def main(metrics_out: str | None = None, obs_port: int | None = None) -> None:
     metrics_out = metrics_out or os.environ.get("BENCH_METRICS_OUT") or None
+    if obs_port is None and os.environ.get("BENCH_OBS_PORT"):
+        obs_port = int(os.environ["BENCH_OBS_PORT"])
+    obs_server = None
+    if obs_port is not None:
+        # Live mid-capture introspection: watch /metrics or /statusz
+        # while the repeats run (obsd binds localhost; 0 = ephemeral).
+        from analyzer_tpu.obs.server import ObsServer
+
+        obs_server = ObsServer(port=obs_port)
+        log(f"obsd listening on {obs_server.url}")
+    try:
+        _bench_main(metrics_out)
+    finally:
+        if obs_server is not None:
+            obs_server.close()
+
+
+def _bench_main(metrics_out: str | None) -> None:
     n_matches = int(os.environ.get("BENCH_MATCHES", 500_000))
     n_players = int(os.environ.get("BENCH_PLAYERS", max(n_matches // 3, 100)))
     batch = int(os.environ.get("BENCH_BATCH", 0)) or None
@@ -398,11 +418,17 @@ def obs_breakdown(phases: dict) -> dict:
     """The telemetry block BENCH_*.json artifacts embed: bench phase wall
     times, the retrace count per tracked jitted entrypoint (jit cache
     sizes — obs.retrace), global compile counters from the jax.monitoring
-    hooks, and the scheduler's padding-waste/occupancy tax. A degraded
+    hooks, the scheduler's padding-waste/occupancy tax, and the device
+    memory high-water mark (HBM bytes in use + live buffers per device —
+    obs.devicemem, with the live-arrays fallback on CPU). A degraded
     capture now carries the WHY candidates (mid-window recompiles, pad
-    waste) next to the throughput number."""
-    from analyzer_tpu.obs import snapshot
+    waste, HBM pressure) next to the throughput number."""
+    from analyzer_tpu.obs import sample_device_memory, snapshot
 
+    try:
+        device_memory = sample_device_memory()
+    except Exception as err:  # noqa: BLE001 — telemetry must not fail the bench
+        device_memory = {"error": repr(err)}
     snap = snapshot(max_spans=0)
     counters = snap["counters"]
     compile_s = snap["histograms"].get("jax.backend_compile_seconds", {})
@@ -422,6 +448,7 @@ def obs_breakdown(phases: dict) -> dict:
             "pad_slots_total": counters.get("sched.pad_slots_total", 0),
         },
         "mesh_put_bytes_total": counters.get("mesh.put_bytes_total", 0),
+        "device_memory": device_memory,
     }
 
 

@@ -761,3 +761,71 @@ class TestGL023RawClock:
         from analyzer_tpu.lint.findings import RULES
 
         assert "GL023" in RULES
+
+
+class TestGL024NetworkSurface:
+    """GL024 keeps listening sockets in the obsd plane: http.server/
+    socketserver imports flag outside analyzer_tpu/obs/, and a bare
+    "0.0.0.0" literal flags everywhere (obsd must default to
+    localhost)."""
+
+    SRC = """
+    from http.server import ThreadingHTTPServer
+
+    def serve():
+        return ThreadingHTTPServer(("127.0.0.1", 0), None)
+    """
+
+    def test_server_import_fires_outside_obs(self):
+        for path in (
+            "analyzer_tpu/service/worker.py",
+            "analyzer_tpu/cli.py",
+            "snippet.py",
+        ):
+            assert rules_of(self.SRC, path) == ["GL024"], path
+
+    def test_server_import_sanctioned_inside_obs(self):
+        assert rules_of(self.SRC, "analyzer_tpu/obs/server.py") == []
+
+    def test_plain_import_and_socketserver_fire_too(self):
+        src = """
+        import http.server
+        import socketserver
+        """
+        assert rules_of(src, "analyzer_tpu/service/x.py") == [
+            "GL024", "GL024",
+        ]
+
+    def test_unrelated_http_imports_are_fine(self):
+        src = """
+        import http.client
+        from urllib.request import urlopen
+        """
+        assert rules_of(src, "analyzer_tpu/service/x.py") == []
+
+    def test_bare_all_interfaces_bind_fires_everywhere(self):
+        src = """
+        DEFAULT_HOST = "0.0.0.0"
+        """
+        assert rules_of(src, "analyzer_tpu/obs/server.py") == ["GL024"]
+        assert rules_of(src, "snippet.py") == ["GL024"]
+
+    def test_loopback_default_is_fine(self):
+        src = """
+        DEFAULT_HOST = "127.0.0.1"
+
+        def serve(host=DEFAULT_HOST, port=0):
+            return (host, port)
+        """
+        assert rules_of(src, "analyzer_tpu/obs/server.py") == []
+
+    def test_disable_escape(self):
+        src = """
+        HOST = "0.0.0.0"  # graftlint: disable=GL024
+        """
+        assert rules_of(src, "snippet.py") == []
+
+    def test_catalog_has_gl024(self):
+        from analyzer_tpu.lint.findings import RULES
+
+        assert "GL024" in RULES

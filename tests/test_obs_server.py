@@ -1,0 +1,486 @@
+"""The live introspection plane: obsd endpoints, flight recorder,
+device-memory gauges, benchdiff.
+
+The acceptance contract (ISSUE 3): with a worker running, ``/readyz``
+returns 200, flips to 503 after forced pipeline degradation, and
+recovers; ``/metrics`` is parseable Prometheus text including
+``worker_dead_letters_total`` and histogram ``_sum``/``_count``; an
+injected batch failure leaves a flight-recorder artifact directory with
+snapshot JSON + Chrome trace JSONL; SIGUSR1 dumps without stopping and
+SIGTERM drains, flushes a final snapshot, and exits. Everything runs
+against localhost only.
+"""
+
+import glob
+import json
+import os
+import re
+import signal
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from analyzer_tpu.config import RatingConfig, ServiceConfig
+from analyzer_tpu.obs import (
+    get_registry,
+    prometheus_text,
+    reset_flight_recorder,
+    reset_registry,
+    sample_device_memory,
+)
+from analyzer_tpu.obs.devicemem import maybe_sample, reset_sampler
+from analyzer_tpu.obs.server import HealthChecks, ObsServer, connectivity_probe
+from analyzer_tpu.obs.tracer import reset_tracer
+from analyzer_tpu.service import InMemoryBroker, InMemoryStore, Worker
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    reset_registry()
+    reset_tracer()
+    reset_flight_recorder()
+    reset_sampler()
+    yield
+    reset_registry()
+    reset_tracer()
+    reset_flight_recorder()
+    reset_sampler()
+
+
+def http_get(url: str) -> tuple[int, str]:
+    """(status, body) without raising on 4xx/5xx — readiness tests need
+    the 503 body, not an exception."""
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode("utf-8")
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode("utf-8")
+
+
+# Prometheus text format: every sample line is name{labels} value, where
+# label values are double-quoted with \\ \" \n escapes.
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z0-9_]+="(\\.|[^"\\\n])*"'
+    r'(,[a-zA-Z0-9_]+="(\\.|[^"\\\n])*")*\})?'
+    r' -?[0-9.eE+]+$'
+)
+
+
+def assert_prometheus_parses(text: str) -> list[str]:
+    lines = [l for l in text.splitlines() if l and not l.startswith("#")]
+    for line in lines:
+        assert _SAMPLE_RE.match(line), f"unparseable sample line: {line!r}"
+    return lines
+
+
+def sequential_rig():
+    broker = InMemoryBroker()
+    store = InMemoryStore()
+    cfg = ServiceConfig(batch_size=2, idle_timeout=0.0)
+    return broker, store, Worker(broker, store, cfg, RatingConfig())
+
+
+class TestHealthChecks:
+    def test_register_and_run(self):
+        h = HealthChecks()
+        h.register("a", lambda: True)
+        h.register("b", lambda: (False, "down"))
+        results = h.run()
+        assert results["a"] == (True, "ok")
+        assert results["b"] == (False, "down")
+        assert h.ready is False
+        h.unregister("b")
+        assert h.ready is True
+
+    def test_raising_probe_is_failing(self):
+        h = HealthChecks()
+        h.register("boom", lambda: 1 / 0)
+        ok, detail = h.run()["boom"]
+        assert ok is False and "ZeroDivisionError" in detail
+
+    def test_connectivity_probe_duck_typing(self):
+        class Open:
+            is_open = True
+
+        class Closed:
+            def is_connected(self):
+                return False
+
+        class Pings:
+            def ping(self):
+                return None
+
+        class Plain:
+            pass
+
+        assert connectivity_probe(Open(), "b")()[0] is True
+        assert connectivity_probe(Closed(), "b")()[0] is False
+        assert connectivity_probe(Pings(), "s")()[0] is True
+        assert connectivity_probe(Plain(), "s")()[0] is True
+
+
+class TestObsServer:
+    def test_endpoints(self):
+        server = ObsServer(port=0)
+        try:
+            get_registry().counter("worker.acks_total").add(3)
+            get_registry().histogram("phase_seconds", phase="pack").observe(
+                0.5
+            )
+            assert http_get(f"{server.url}/healthz") == (200, "ok\n")
+            status, body = http_get(f"{server.url}/readyz")
+            assert status == 200
+            status, body = http_get(f"{server.url}/metrics")
+            assert status == 200
+            lines = assert_prometheus_parses(body)
+            assert any(
+                l.startswith("worker_dead_letters_total") for l in lines
+            )
+            assert any(
+                l.startswith("phase_seconds_sum{") for l in lines
+            )
+            assert any(
+                l.startswith("phase_seconds_count{") for l in lines
+            )
+            status, body = http_get(f"{server.url}/debug/snapshot")
+            snap = json.loads(body)
+            assert snap["counters"]["worker.acks_total"] == 3
+            assert http_get(f"{server.url}/nope")[0] == 404
+        finally:
+            server.close()
+
+    def test_readyz_flips_and_recovers(self):
+        server = ObsServer(port=0)
+        try:
+            server.health.register("x", lambda: (True, "fine"))
+            assert http_get(f"{server.url}/readyz")[0] == 200
+            server.health.register("x", lambda: (False, "degraded"))
+            status, body = http_get(f"{server.url}/readyz")
+            assert status == 503 and "fail x: degraded" in body
+            server.health.register("x", lambda: (True, "fine"))
+            assert http_get(f"{server.url}/readyz")[0] == 200
+        finally:
+            server.close()
+
+    def test_statusz_carries_status_provider(self):
+        server = ObsServer(port=0, status_provider=lambda: {"k": 42})
+        try:
+            status, body = http_get(f"{server.url}/statusz")
+            assert status == 200
+            assert "k = 42" in body
+        finally:
+            server.close()
+
+    def test_defaults_to_localhost(self):
+        server = ObsServer(port=0)
+        try:
+            assert server.host == "127.0.0.1"
+        finally:
+            server.close()
+
+
+class TestWorkerObsd:
+    def test_readyz_503_on_forced_degradation_and_recovery(self):
+        broker = InMemoryBroker()
+        worker = Worker(
+            broker, InMemoryStore(),
+            ServiceConfig(batch_size=2, idle_timeout=0.0, pipeline=True,
+                          pipeline_lag=2),
+            RatingConfig(), obs_port=0,
+        )
+        try:
+            url = worker.obs_server.url
+            status, body = http_get(f"{url}/readyz")
+            assert status == 200, body
+            assert "ok worker.pipeline" in body
+            worker._disable_pipeline("forced by test")
+            status, body = http_get(f"{url}/readyz")
+            assert status == 503
+            assert "fail worker.pipeline" in body
+            # Recovery (e.g. ops re-enabled the lane): readiness follows.
+            worker.pipeline_enabled = True
+            status, body = http_get(f"{url}/readyz")
+            assert status == 200, body
+        finally:
+            worker.close()
+
+    def test_metrics_reflect_work_and_statusz_has_stats(self):
+        broker = InMemoryBroker()
+        store = InMemoryStore()
+        worker = Worker(
+            broker, store,
+            ServiceConfig(batch_size=2, idle_timeout=0.0),
+            RatingConfig(), obs_port=0,
+        )
+        try:
+            broker.publish("analyze", b"missing-1")
+            broker.publish("analyze", b"missing-2")
+            assert worker.poll()  # unknown ids: empty batch, acked
+            status, body = http_get(f"{worker.obs_server.url}/metrics")
+            assert status == 200
+            assert_prometheus_parses(body)
+            assert "worker_acks_total 2" in body
+            status, body = http_get(f"{worker.obs_server.url}/statusz")
+            assert "matches_rated" in body
+        finally:
+            worker.close()
+
+    def test_close_stops_the_server(self):
+        worker = sequential_rig()[2]
+        assert worker.obs_server is None  # not started by default
+        broker = InMemoryBroker()
+        worker = Worker(
+            broker, InMemoryStore(),
+            ServiceConfig(batch_size=2, idle_timeout=0.0),
+            RatingConfig(), obs_port=0,
+        )
+        url = worker.obs_server.url
+        worker.close()
+        assert worker.obs_server is None
+        with pytest.raises(urllib.error.URLError):
+            urllib.request.urlopen(f"{url}/healthz", timeout=2)
+
+
+class TestPrometheusExposition:
+    def test_label_values_escaped(self):
+        get_registry().gauge("g", label='a"b\\c\nd').set(1)
+        txt = prometheus_text()
+        assert 'g{label="a\\"b\\\\c\\nd"} 1' in txt
+        assert_prometheus_parses(txt)
+
+    def test_histogram_sum_and_count_alongside_quantiles(self):
+        h = get_registry().histogram("phase_seconds", phase="rate")
+        h.observe(1.0)
+        h.observe(3.0)
+        txt = prometheus_text()
+        assert 'phase_seconds_sum{phase="rate"} 4' in txt
+        assert 'phase_seconds_count{phase="rate"} 2' in txt
+        assert 'phase_seconds{phase="rate",quantile="0.50"}' in txt
+
+    def test_retrace_entrypoint_label_escaped(self):
+        snap = {"retraces": {'weird"name': 2}}
+        txt = prometheus_text(snap)
+        assert 'jax_jit_cache_size{entrypoint="weird\\"name"} 2' in txt
+
+
+class TestFlightRecorder:
+    def test_injected_dead_letter_leaves_artifact(self, tmp_path):
+        reset_flight_recorder(base_dir=str(tmp_path), min_interval_s=0.0)
+        broker, store, worker = sequential_rig()
+
+        def boom(ids):
+            raise RuntimeError("injected batch failure")
+
+        worker.process = boom
+        broker.publish("analyze", b"m1")
+        broker.publish("analyze", b"m2")
+        assert worker.poll()
+        assert worker.dead_letters == 2
+        dirs = glob.glob(str(tmp_path / "flight-*dead_letter*"))
+        assert len(dirs) == 1, dirs
+        art = dirs[0]
+        snap = json.load(open(os.path.join(art, "snapshot.json")))
+        assert snap["counters"]["worker.dead_letters_total"] == 2
+        # The dead-letter instant made it into the frozen ring (the
+        # enclosing batch.lifecycle span is still open at dump time —
+        # complete events land on exit, instants immediately).
+        assert any(
+            e["name"] == "worker.dead_letter" for e in snap["spans"]
+        )
+        for line in open(os.path.join(art, "trace.jsonl")):
+            event = json.loads(line)
+            assert {"name", "ph", "ts"} <= set(event)
+        ctx = json.load(open(os.path.join(art, "context.json")))
+        assert ctx["reason"] == "dead_letter"
+        assert ctx["config"]["batch_size"] == 2
+        assert ctx["config"]["rabbitmq_uri"] == "<redacted>"
+        events = [
+            json.loads(l) for l in open(os.path.join(art, "events.log"))
+        ]
+        kinds = {e["kind"] for e in events}
+        assert "dead_letter" in kinds
+        assert "log" in kinds  # the worker's error log was captured
+
+    def test_dump_throttled_but_forced_bypasses(self, tmp_path):
+        rec = reset_flight_recorder(
+            base_dir=str(tmp_path), min_interval_s=3600.0
+        )
+        assert rec.dump("first") is not None
+        assert rec.dump("second") is None  # inside the throttle window
+        assert rec.dump("third", force=True) is not None
+        kinds = [e["kind"] for e in rec.events()]
+        assert "dump.suppressed" in kinds
+
+    def test_no_base_dir_is_a_noop(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("ANALYZER_TPU_FLIGHT_DIR", raising=False)
+        rec = reset_flight_recorder()
+        assert rec.base_dir is None
+        assert rec.dump("whatever") is None
+        assert rec.events()[-1]["kind"] == "dump.skipped"
+
+    def test_ring_is_bounded(self):
+        rec = reset_flight_recorder(max_events=8)
+        for i in range(20):
+            rec.note("x", i=i)
+        events = rec.events()
+        assert len(events) == 8
+        assert events[-1]["i"] == 19
+
+    def test_pipeline_degradation_dumps(self, tmp_path):
+        reset_flight_recorder(base_dir=str(tmp_path), min_interval_s=0.0)
+        broker = InMemoryBroker()
+        worker = Worker(
+            broker, InMemoryStore(),
+            ServiceConfig(batch_size=2, idle_timeout=0.0, pipeline=True,
+                          pipeline_lag=2),
+            RatingConfig(),
+        )
+        worker._disable_pipeline("forced by test")
+        assert glob.glob(str(tmp_path / "flight-*pipeline_degraded*"))
+
+
+class TestSignals:
+    def test_sigusr1_dumps_without_stopping_and_sigterm_exits(
+        self, tmp_path
+    ):
+        reset_flight_recorder(base_dir=str(tmp_path), min_interval_s=0.0)
+        broker, store, worker = sequential_rig()
+        pid = os.getpid()
+        t1 = threading.Timer(
+            0.2, lambda: os.kill(pid, signal.SIGUSR1)
+        )
+        t2 = threading.Timer(0.7, lambda: os.kill(pid, signal.SIGTERM))
+        t1.start()
+        t2.start()
+        try:
+            worker.run(install_signal_handlers=True, max_wall_s=30)
+        finally:
+            t1.cancel()
+            t2.cancel()
+        # USR1 dumped but did NOT stop the loop (TERM did, 0.5 s later).
+        assert glob.glob(str(tmp_path / "flight-*sigusr1*"))
+        # TERM's contract: a final snapshot flushed on the way out.
+        finals = glob.glob(str(tmp_path / "final-snapshot-*.json"))
+        assert finals
+        snap = json.load(open(finals[0]))
+        assert "counters" in snap
+
+    def test_previous_handlers_restored(self, tmp_path):
+        reset_flight_recorder(base_dir=str(tmp_path))
+        broker, store, worker = sequential_rig()
+        before = signal.getsignal(signal.SIGUSR1)
+        worker.request_stop()
+        worker.run(install_signal_handlers=True, max_wall_s=10)
+        assert signal.getsignal(signal.SIGUSR1) is before
+
+
+class TestDeviceMemory:
+    def test_cpu_fallback_sets_gauges(self):
+        import jax.numpy as jnp
+
+        arrays = [jnp.ones((16, 16)) for _ in range(3)]
+        out = sample_device_memory()
+        assert out, "no devices sampled"
+        label, stats = next(iter(out.items()))
+        assert stats["live_buffers"] >= 3
+        assert stats["bytes_in_use"] > 0
+        snap = get_registry().snapshot()
+        key = f"device.hbm_bytes_in_use{{device={label}}}"
+        assert snap["gauges"][key] == stats["bytes_in_use"]
+        assert snap["gauges"]["device.live_buffers"] >= 3
+        del arrays
+
+    def test_maybe_sample_throttles(self):
+        reset_sampler()
+        assert maybe_sample(min_interval_s=3600.0) is True
+        assert maybe_sample(min_interval_s=3600.0) is False
+        reset_sampler()
+        assert maybe_sample(min_interval_s=3600.0) is True
+
+    def test_metrics_endpoint_carries_device_series(self):
+        sample_device_memory()
+        txt = prometheus_text()
+        assert "device_hbm_bytes_in_use{" in txt
+        assert_prometheus_parses(txt)
+
+
+def _bench_line(value, degraded=False, streamed_min=None, stable=True):
+    line = {
+        "metric": "matches_per_sec_per_chip",
+        "value": value,
+        "unit": "matches/s",
+        "vs_baseline": 1.0,
+        "capture": {"degraded": degraded},
+    }
+    if streamed_min is not None:
+        line["streamed"] = {"min_s": streamed_min, "stable": stable}
+    return line
+
+
+class TestBenchdiff:
+    def _write(self, path, line, wrap=False):
+        payload = {"parsed": line, "rc": 0} if wrap else line
+        path.write_text(json.dumps(payload))
+        return str(path)
+
+    def test_regression_gate(self, tmp_path):
+        from analyzer_tpu.cli import main
+
+        a = self._write(tmp_path / "BENCH_r01.json", _bench_line(1000.0),
+                        wrap=True)
+        b = self._write(tmp_path / "BENCH_r02.json", _bench_line(900.0))
+        assert main(["benchdiff", a, b, "--regress-pct", "5"]) == 1
+        assert main(["benchdiff", a, b, "--regress-pct", "15"]) == 0
+        # Improvement never gates.
+        assert main(["benchdiff", b, a, "--regress-pct", "5"]) == 0
+
+    def test_streamed_config_gates_on_slowdown(self, tmp_path):
+        from analyzer_tpu.cli import main
+
+        a = self._write(
+            tmp_path / "BENCH_r01.json",
+            _bench_line(1000.0, streamed_min=1.0),
+        )
+        b = self._write(
+            tmp_path / "BENCH_r02.json",
+            _bench_line(1000.0, streamed_min=1.5),
+        )
+        assert main(["benchdiff", a, b, "--regress-pct", "10"]) == 1
+
+    def test_degraded_capture_reported_not_gated(self, tmp_path, capsys):
+        from analyzer_tpu.cli import main
+
+        a = self._write(tmp_path / "BENCH_r01.json", _bench_line(1000.0))
+        b = self._write(
+            tmp_path / "BENCH_r02.json", _bench_line(500.0, degraded=True)
+        )
+        assert main(["benchdiff", a, b, "--regress-pct", "5"]) == 0
+        out = capsys.readouterr().out
+        assert "degraded" in out
+
+    def test_against_latest_scans_dir(self, tmp_path):
+        from analyzer_tpu.cli import main
+
+        self._write(tmp_path / "BENCH_r01.json", _bench_line(1000.0))
+        self._write(tmp_path / "BENCH_r02.json", _bench_line(940.0))
+        assert main(
+            ["benchdiff", "--against-latest", "--dir", str(tmp_path),
+             "--regress-pct", "5"]
+        ) == 1
+        assert main(
+            ["benchdiff", "--against-latest", "--dir", str(tmp_path),
+             "--regress-pct", "10"]
+        ) == 0
+
+    def test_usage_and_bad_artifacts(self, tmp_path, capsys):
+        from analyzer_tpu.cli import main
+
+        assert main(["benchdiff"]) == 2
+        bad = tmp_path / "BENCH_bad.json"
+        bad.write_text("{}")
+        good = self._write(tmp_path / "BENCH_r01.json", _bench_line(1.0))
+        assert main(["benchdiff", str(bad), good]) == 2
+        capsys.readouterr()
