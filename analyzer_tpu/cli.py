@@ -16,13 +16,18 @@ Subcommands:
   benchdiff  per-config throughput delta between two BENCH_*.json
           artifacts; non-zero exit past --regress-pct (CI trajectory gate)
   worker  the broker-consuming service loop (needs pika)
+  serve   ratesrv: the standalone query-serving plane over a checkpoint
+          or database table (/v1/ratings /v1/leaderboard /v1/winprob
+          /v1/tiers — docs/serving.md)
+  query   one query against a running serve endpoint (HTTP client)
   lint    graftlint static analysis (JAX hazards + native ABI, docs/lint.md)
   metrics runtime telemetry snapshots (docs/observability.md): render a
           --metrics-out artifact (or this process) as JSON/Prometheus/text
 
 Live introspection: rate/bench/worker take ``--obs-port`` (obsd —
 /metrics, /healthz, /readyz, /statusz, /debug/snapshot on localhost);
-the worker also takes ``--flight-dir`` to arm flight-recorder dumps.
+the worker also takes ``--flight-dir`` to arm flight-recorder dumps and
+``--serve-port`` to co-host the ratesrv read plane.
 """
 
 from __future__ import annotations
@@ -844,13 +849,18 @@ def cmd_benchdiff(args) -> int:
             return 2
         if paths:
             b_path = paths[0]
-            a_path = latest_artifact(args.dir, exclude=b_path)
+            a_path = latest_artifact(
+                args.dir, exclude=b_path, family=args.family
+            )
         else:
-            arts = find_bench_artifacts(args.dir)
+            arts = find_bench_artifacts(args.dir, family=args.family)
             a_path, b_path = (arts[-2], arts[-1]) if len(arts) >= 2 else (None, None)
         if a_path is None or b_path is None:
+            from analyzer_tpu.obs.benchdiff import FAMILIES
+
             print(
-                f"error: not enough BENCH_*.json artifacts under {args.dir}",
+                f"error: not enough {FAMILIES[args.family]}_*.json "
+                f"artifacts under {args.dir}",
                 file=sys.stderr,
             )
             return 2
@@ -920,6 +930,121 @@ def cmd_lint(args) -> int:
     return lint_main(argv)
 
 
+def cmd_serve(args) -> int:
+    """ratesrv standalone: publish a rating table (checkpoint or DB) as
+    version 1 and serve queries against it. The co-hosted flavor — the
+    view tracking a live worker's commits — is ``cli worker
+    --serve-port`` / ``Worker(serve_port=)``; this one is for serving a
+    finished re-rate or a warm standby next to the write plane."""
+    import time
+
+    from analyzer_tpu.config import RatingConfig
+    from analyzer_tpu.serve import QueryEngine, ViewPublisher
+    from analyzer_tpu.serve.server import ServeServer
+
+    if not _require_one_source_serve(args):
+        return 2
+    cfg = RatingConfig.from_env()
+    _obs_begin(args)
+    obs = _obs_serve(args)
+    try:
+        publisher = ViewPublisher()
+        if args.checkpoint:
+            from analyzer_tpu.io.checkpoint import load_checkpoint
+
+            ck = load_checkpoint(args.checkpoint)
+            # Checkpoints carry no id column: rows serve by index.
+            view = publisher.publish_state(ck.state)
+        else:
+            from analyzer_tpu.service.sql_store import SqlStore
+
+            store = SqlStore(args.db)
+            hist = store.load_stream(cfg)
+            view = publisher.publish_state(hist.state, ids=hist.player_ids)
+        engine = QueryEngine(publisher, cfg=cfg, max_batch=args.max_batch)
+        engine.warmup(view)  # no first-query XLA stall
+        engine.start()
+        server = ServeServer(engine, port=args.port)
+        print(json.dumps({
+            "serving": server.url,
+            "players": view.n_players,
+            "version": view.version,
+            "source": args.checkpoint or args.db,
+        }))
+        sys.stdout.flush()
+        try:
+            deadline = (
+                None if args.max_seconds is None
+                else time.monotonic() + args.max_seconds
+            )
+            while deadline is None or time.monotonic() < deadline:
+                time.sleep(0.2)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.close()
+            engine.close()
+    finally:
+        if obs is not None:
+            obs.close()
+    return 0
+
+
+def _require_one_source_serve(args) -> bool:
+    """serve's source xor: exactly one of --checkpoint / --db."""
+    args.checkpoint = getattr(args, "checkpoint", None) or None
+    args.db = getattr(args, "db", None) or None
+    if (args.checkpoint is None) == (args.db is None):
+        print("error: exactly one of --checkpoint / --db is required",
+              file=sys.stderr)
+        return False
+    return True
+
+
+def cmd_query(args) -> int:
+    """One query against a running serve endpoint — the operator's curl
+    with the URL assembly done for them (an HTTP CLIENT: the listening
+    sockets stay in obs/ + serve/, graftlint GL024)."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    params = {}
+    if args.kind == "ratings":
+        if not args.ids:
+            print("error: ratings needs --ids a,b,c", file=sys.stderr)
+            return 2
+        params["ids"] = args.ids
+    elif args.kind == "leaderboard":
+        params["k"] = str(args.k)
+    elif args.kind == "winprob":
+        if not (args.a and args.b):
+            print("error: winprob needs --a ids and --b ids", file=sys.stderr)
+            return 2
+        params["a"] = args.a
+        params["b"] = args.b
+    elif args.kind == "tiers" and args.score is not None:
+        params["score"] = str(args.score)
+    url = (
+        args.url.rstrip("/") + "/v1/" + args.kind
+        + ("?" + urllib.parse.urlencode(params) if params else "")
+    )
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+            body = resp.read().decode("utf-8")
+    except urllib.error.HTTPError as err:
+        print(err.read().decode("utf-8"), end="")
+        print(f"error: {url} -> HTTP {err.code}", file=sys.stderr)
+        return 1
+    except (urllib.error.URLError, ValueError) as err:
+        # URLError: nothing listening; ValueError: a malformed --url
+        reason = getattr(err, "reason", err)
+        print(f"error: {url}: {reason}", file=sys.stderr)
+        return 1
+    print(body, end="")
+    return 0
+
+
 def cmd_worker(args) -> int:
     if args.requeue_failed:
         # Dead-letter redrive: move <QUEUE>_failed back onto the main
@@ -941,7 +1066,10 @@ def cmd_worker(args) -> int:
         return 0
     from analyzer_tpu.service.worker import main as worker_main
 
-    worker_main(obs_port=args.obs_port, flight_dir=args.flight_dir)
+    worker_main(
+        obs_port=args.obs_port, flight_dir=args.flight_dir,
+        serve_port=args.serve_port,
+    )
     return 0
 
 
@@ -1110,6 +1238,13 @@ def main(argv=None) -> int:
         help="fail (exit 1) when a non-degraded config is worse by more "
         "than PCT percent (default: 5)",
     )
+    s.add_argument(
+        "--family", choices=("bench", "serve"), default="bench",
+        help="artifact family for --against-latest scans: bench "
+        "(BENCH_*.json, the write path) or serve (SERVE_BENCH_*.json — "
+        "queries/sec + p99 latency, experiments/serve_bench.py); "
+        "explicit two-path diffs auto-detect from the metric name",
+    )
     s.set_defaults(fn=cmd_benchdiff)
 
     s = sub.add_parser(
@@ -1161,7 +1296,68 @@ def main(argv=None) -> int:
         "ANALYZER_TPU_FLIGHT_DIR): dead-letters, pipeline degradation "
         "and SIGUSR1 leave a timestamped artifact directory",
     )
+    s.add_argument(
+        "--serve-port", type=int, metavar="PORT",
+        help="co-host the ratesrv query plane (/v1/ratings /v1/leaderboard "
+        "/v1/winprob /v1/tiers on localhost:PORT, also "
+        "ANALYZER_TPU_SERVE_PORT): a new view version publishes at every "
+        "batch commit (docs/serving.md)",
+    )
     s.set_defaults(fn=cmd_worker)
+
+    s = sub.add_parser(
+        "serve",
+        help="ratesrv: serve lookups/leaderboards/win-probability over a "
+        "rating table (docs/serving.md)",
+    )
+    s.add_argument("--checkpoint", help="rating-state snapshot (.npz)")
+    s.add_argument(
+        "--db", metavar="URI",
+        help="serve the player table of a reference-schema database "
+        "(sqlite:///... or mysql://...)",
+    )
+    s.add_argument(
+        "--port", type=int, default=0, metavar="PORT",
+        help="bind port (default 0 = ephemeral; the bound URL prints as "
+        "one JSON line on stdout)",
+    )
+    s.add_argument(
+        "--max-batch", type=int, default=256, metavar="N",
+        help="microbatch coalescing cap per tick (default: 256)",
+    )
+    s.add_argument(
+        "--max-seconds", type=float, metavar="S",
+        help="serve for S seconds then exit (default: forever; smoke "
+        "tests and drills)",
+    )
+    s.add_argument(
+        "--obs-port", type=int, metavar="PORT",
+        help="also serve the obsd introspection endpoints (serve.* "
+        "metrics land in /metrics)",
+    )
+    s.set_defaults(fn=cmd_serve)
+
+    s = sub.add_parser(
+        "query",
+        help="one query against a running serve endpoint",
+    )
+    s.add_argument(
+        "kind", choices=("ratings", "leaderboard", "winprob", "tiers"),
+    )
+    s.add_argument(
+        "--url", required=True, metavar="URL",
+        help="serve endpoint base, e.g. http://127.0.0.1:8391",
+    )
+    s.add_argument("--ids", metavar="A,B,C", help="ratings: player ids")
+    s.add_argument("--k", type=int, default=10, help="leaderboard depth")
+    s.add_argument("--a", metavar="IDS", help="winprob: team A ids")
+    s.add_argument("--b", metavar="IDS", help="winprob: team B ids")
+    s.add_argument(
+        "--score", type=float,
+        help="tiers: also report this conservative score's percentile",
+    )
+    s.add_argument("--timeout", type=float, default=10.0)
+    s.set_defaults(fn=cmd_query)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -37,7 +37,10 @@ RULES: dict[str, str] = {
     "GL021": "import fallback caught too broadly (catch ImportError, not Exception)",
     "GL022": "mutable default argument",
     "GL023": "raw time.perf_counter() timing in service/sched code (use analyzer_tpu.obs)",
-    "GL024": "listening socket outside analyzer_tpu/obs/, or a bare 0.0.0.0 bind",
+    "GL024": (
+        "listening socket outside analyzer_tpu/obs/ + analyzer_tpu/serve/, "
+        "or a bare 0.0.0.0 bind"
+    ),
 }
 
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")

@@ -13,12 +13,14 @@ this repo's telemetry PR replaced. The few legitimate uses (a stats
 contract that must not ride the global registry) carry a line-scoped
 ``# graftlint: disable=GL023`` with a reason, like every other escape.
 
-GL024 keeps the package's network surface in ONE place:
+GL024 keeps the package's network surface in KNOWN places:
 ``http.server``/``socketserver`` imports (a listening socket) belong in
-``analyzer_tpu/obs/`` — the obsd plane — and nowhere else; and a bare
-``"0.0.0.0"`` literal is flagged EVERYWHERE, obs included, because the
-introspection endpoints must default to loopback (an all-interfaces bind
-is an operator's explicit runtime decision, never a code default).
+``analyzer_tpu/obs/`` — the obsd plane and its shared ``httpd``
+plumbing — or ``analyzer_tpu/serve/`` — the ratesrv query plane — and
+nowhere else; and a bare ``"0.0.0.0"`` literal is flagged EVERYWHERE,
+those planes included, because every endpoint must default to loopback
+(an all-interfaces bind is an operator's explicit runtime decision,
+never a code default).
 """
 
 from __future__ import annotations
@@ -30,8 +32,10 @@ from analyzer_tpu.lint.findings import Finding
 #: Directories where GL023 applies (normalized path fragments).
 _GL023_DIRS = ("analyzer_tpu/service/", "analyzer_tpu/sched/")
 
-#: The one sanctioned home for a listening socket (GL024).
-_GL024_OBS_DIR = "analyzer_tpu/obs/"
+#: The sanctioned homes for a listening socket (GL024): the obsd
+#: introspection plane (+ its shared httpd plumbing) and the ratesrv
+#: query-serving plane.
+_GL024_SOCKET_DIRS = ("analyzer_tpu/obs/", "analyzer_tpu/serve/")
 _SERVER_MODULES = ("http.server", "socketserver")
 
 _BROAD = {"Exception", "BaseException"}
@@ -104,13 +108,15 @@ class ShellRules:
         return any(frag in path for frag in _GL023_DIRS)
 
     def _in_obs_layer(self) -> bool:
-        return _GL024_OBS_DIR in self.path.replace("\\", "/")
+        path = self.path.replace("\\", "/")
+        return any(frag in path for frag in _GL024_SOCKET_DIRS)
 
     def _check_server_import(self, node) -> None:
         """GL024: a listening-socket module imported outside
-        ``analyzer_tpu/obs/`` — the obsd server (``obs/server.py``) is
-        the one sanctioned network surface; a second ad-hoc endpoint
-        fragments auth/bind policy and the operator's mental model."""
+        ``analyzer_tpu/obs/`` + ``analyzer_tpu/serve/`` — the shared
+        httpd plumbing (``obs/httpd.py``) is the sanctioned network
+        surface; a second ad-hoc endpoint fragments auth/bind policy
+        and the operator's mental model."""
         if isinstance(node, ast.Import):
             names = [a.name for a in node.names]
         else:  # ImportFrom
@@ -122,10 +128,11 @@ class ShellRules:
             ):
                 self._flag(
                     "GL024", node,
-                    f"`{name}` imported outside analyzer_tpu/obs/ — "
-                    "listening sockets live in the obsd plane "
-                    "(obs/server.py); register an endpoint there instead "
-                    "of opening a second server",
+                    f"`{name}` imported outside analyzer_tpu/obs/ and "
+                    "analyzer_tpu/serve/ — listening sockets live in "
+                    "the obsd/ratesrv planes (obs/httpd.py); build on "
+                    "the shared plumbing instead of opening an ad-hoc "
+                    "server",
                 )
 
     def _check_raw_clock(self, node: ast.Call) -> None:

@@ -27,6 +27,9 @@ runtime counter.
 
 The LIVE half (this PR's obsd plane — everything above is post-hoc):
 
+  * :mod:`~analyzer_tpu.obs.httpd` — the shared route-table HTTP
+    plumbing (daemon ``ThreadingHTTPServer``, loopback default) backing
+    both obsd and the ratesrv query plane (``analyzer_tpu/serve``);
   * :mod:`~analyzer_tpu.obs.server` — stdlib HTTP endpoints on a thread
     (``/metrics`` ``/healthz`` ``/readyz`` ``/statusz``
     ``/debug/snapshot``) with a pluggable :class:`HealthChecks` registry;

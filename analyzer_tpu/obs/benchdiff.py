@@ -1,14 +1,18 @@
-"""Bench trajectory monitoring: diff two BENCH_*.json artifacts.
+"""Bench trajectory monitoring: diff two bench JSON artifacts.
 
-The repo accumulates one benchmark artifact per round (``BENCH_rNN.json``)
-but nothing ever LOOKED at the sequence — a 20% throughput regression
-would ride along unnoticed until a human happened to eyeball two files.
-``cli benchdiff`` turns the trajectory into a gate:
+The repo accumulates one benchmark artifact per round (``BENCH_rNN.json``
+for the write path, ``SERVE_BENCH_rNN.json`` for the read path) but
+nothing ever LOOKED at the sequences — a 20% regression would ride along
+unnoticed until a human happened to eyeball two files. ``cli benchdiff``
+turns each trajectory into a gate:
 
-  * loads two artifacts (either the raw one-line JSON ``bench.py``
-    prints, or the driver's wrapper with the line under ``"parsed"``);
-  * prints a per-config delta table (headline device throughput, the
-    streamed end-to-end minimum, capture health);
+  * loads two artifacts (either the raw one-line JSON ``bench.py`` /
+    ``experiments/serve_bench.py`` print, or the driver's wrapper with
+    the line under ``"parsed"``);
+  * prints a per-config delta table — for the write family the headline
+    device throughput + the streamed end-to-end minimum, for the serve
+    family coalesced queries/sec (higher is better) + p99 latency
+    (lower is better);
   * exits non-zero when any non-degraded config regressed past
     ``--regress-pct``.
 
@@ -64,9 +68,13 @@ def load_bench(path: str) -> dict:
 
 
 def bench_configs(data: dict) -> list[BenchConfig]:
-    """The comparable configs inside one artifact: the headline
-    throughput (higher is better) and, when present, the streamed
-    end-to-end minimum (seconds — lower is better)."""
+    """The comparable configs inside one artifact.
+
+    Write family (``BENCH_*``): the headline throughput (higher is
+    better) and, when present, the streamed end-to-end minimum (seconds
+    — lower is better). Serve family (``SERVE_BENCH_*``, metric
+    ``serve.*``): coalesced queries/sec (higher) and the client-observed
+    p99 latency in ms (lower) from the ``latency_ms`` block."""
     degraded = bool((data.get("capture") or {}).get("degraded"))
     out = [
         BenchConfig(
@@ -76,6 +84,18 @@ def bench_configs(data: dict) -> list[BenchConfig]:
             degraded=degraded,
         )
     ]
+    if str(data["metric"]).startswith("serve."):
+        latency = data.get("latency_ms") or {}
+        if latency.get("p99") is not None:
+            out.append(
+                BenchConfig(
+                    name="serve.p99_ms",
+                    value=float(latency["p99"]),
+                    higher_is_better=False,
+                    degraded=degraded,
+                )
+            )
+        return out
     streamed = data.get("streamed") or {}
     if streamed.get("min_s") is not None:
         out.append(
@@ -117,16 +137,30 @@ def diff_configs(
     return rows
 
 
-def find_bench_artifacts(directory: str) -> list[str]:
-    """``BENCH_*.json`` under ``directory``, name-sorted (the round
-    numbering ``r01..rNN`` sorts chronologically by construction)."""
-    return sorted(glob.glob(os.path.join(directory, "BENCH_*.json")))
+#: Artifact family name -> filename prefix (``cli benchdiff --family``).
+FAMILIES = {"bench": "BENCH", "serve": "SERVE_BENCH"}
 
 
-def latest_artifact(directory: str, exclude: str | None = None) -> str | None:
+def find_bench_artifacts(directory: str, family: str = "bench") -> list[str]:
+    """``<PREFIX>_*.json`` under ``directory``, name-sorted (the round
+    numbering ``r01..rNN`` sorts chronologically by construction). The
+    write family's glob must not swallow the serve family's files —
+    ``BENCH_*`` would match ``SERVE_BENCH_*`` as a substring only with
+    a sloppier pattern, so both globs anchor on the full prefix."""
+    prefix = FAMILIES[family]
+    return [
+        p
+        for p in sorted(glob.glob(os.path.join(directory, prefix + "_*.json")))
+        if os.path.basename(p).startswith(prefix + "_")
+    ]
+
+
+def latest_artifact(
+    directory: str, exclude: str | None = None, family: str = "bench"
+) -> str | None:
     """The newest artifact by name order, skipping ``exclude`` (the
     candidate itself, when it already sits in the scanned directory)."""
-    paths = find_bench_artifacts(directory)
+    paths = find_bench_artifacts(directory, family=family)
     if exclude is not None:
         ex = os.path.abspath(exclude)
         paths = [p for p in paths if os.path.abspath(p) != ex]

@@ -1,0 +1,146 @@
+"""Shared stdlib HTTP plumbing for the package's listening planes.
+
+Two subsystems serve HTTP: obsd (``obs/server.py`` — the introspection
+plane) and ratesrv (``serve/server.py`` — the query-serving plane). Both
+used to need the same dozen lines of ``BaseHTTPRequestHandler`` ritual:
+route dispatch, query-string parsing, content-type + length headers, the
+500-on-renderer-crash guard, the daemon serving thread, the idempotent
+close. This module is that ritual, written once:
+
+  * :class:`RoutedHTTPServer` — a ``ThreadingHTTPServer`` on a daemon
+    thread whose GET handler dispatches on the *path* to a route table of
+    ``fn(params) -> (status, body, content_type)`` callables (``params``
+    is the parsed query string, last-value-wins);
+  * :class:`HttpError` — raise from a route to return a clean non-200
+    (bad query params, unknown player ids) instead of a 500;
+  * :func:`json_body` / :func:`text_body` — response tuple helpers.
+
+Bind policy lives here too: ``DEFAULT_HOST`` is loopback, and widening to
+a real interface is an operator's explicit runtime choice — never a code
+default (graftlint GL024 enforces both halves: listening-socket imports
+stay inside ``analyzer_tpu/obs/`` + ``analyzer_tpu/serve/``, and a bare
+``0.0.0.0`` literal is banned everywhere).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from analyzer_tpu.logging_utils import get_logger
+
+logger = get_logger(__name__)
+
+#: Loopback by default: both planes carry operational detail and must be
+#: opted ONTO a network interface, never discovered on one.
+DEFAULT_HOST = "127.0.0.1"
+
+
+class HttpError(Exception):
+    """A route's clean failure: rendered as ``status`` with a one-line
+    plain-text (or JSON, for ``/v1/`` routes) body instead of the 500 the
+    crash guard would produce."""
+
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def json_body(obj, status: int = 200) -> tuple[int, str, str]:
+    """A JSON response tuple (sorted keys — curl diffs must be stable)."""
+    return status, json.dumps(obj, sort_keys=True) + "\n", "application/json"
+
+
+def text_body(body: str, status: int = 200) -> tuple[int, str, str]:
+    return status, body, "text/plain"
+
+
+class RoutedHTTPServer:
+    """A route-table HTTP server on a daemon thread.
+
+    ``routes`` maps an exact path (``"/healthz"``) to
+    ``fn(params: dict[str, str]) -> (status, body, content_type)``.
+    ``port=0`` binds an ephemeral port (tests); the bound port is
+    readable at :attr:`port`. Stop with :meth:`close` (idempotent) —
+    whoever started the plane owns that call.
+    """
+
+    def __init__(
+        self,
+        routes: dict,
+        port: int = 0,
+        host: str = DEFAULT_HOST,
+        name: str = "analyzer-httpd",
+        json_errors: bool = False,
+    ) -> None:
+        self._routes = dict(routes)
+        self._json_errors = json_errors
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # The handler closes over the server object, not globals —
+            # two planes in one process must not share route tables.
+            def log_message(self, fmt, *args):  # quiet: curl spam is DEBUG
+                logger.debug("%s: " + fmt, name, *args)
+
+            def _send(self, code: int, body: str, ctype: str) -> None:
+                data = body.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype + "; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802 — http.server contract
+                parsed = urllib.parse.urlsplit(self.path)
+                path = parsed.path
+                fn = server._routes.get(path)
+                if fn is None:
+                    self._send(*server._error(404, "not found"))
+                    return
+                params = {
+                    k: v[-1]
+                    for k, v in urllib.parse.parse_qs(parsed.query).items()
+                }
+                try:
+                    self._send(*fn(params))
+                except HttpError as err:
+                    self._send(*server._error(err.status, err.message))
+                except Exception:  # noqa: BLE001 — a broken route must
+                    # surface as a 500 response, not kill the serving
+                    # thread the other routes still need.
+                    logger.exception("%s route failed for %s", name, path)
+                    self._send(*server._error(500, "internal error"))
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name=name, daemon=True
+        )
+        self._thread.start()
+
+    def _error(self, status: int, message: str) -> tuple[int, str, str]:
+        if self._json_errors:
+            return json_body({"error": message}, status)
+        return text_body(message + "\n", status)
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        """Stops serving and joins the thread. Idempotent."""
+        httpd, self._httpd = self._httpd, None
+        if httpd is None:
+            return
+        httpd.shutdown()
+        httpd.server_close()
+        self._thread.join(timeout=5)

@@ -183,6 +183,8 @@ STANDARD_COUNTERS = (
     "jax.retraces_total",
     "jax.backend_compiles_total",
     "obs.flight_dumps_total",
+    "serve.queries_total",
+    "serve.view_publishes_total",
 )
 STANDARD_GAUGES = (
     "worker.pipeline_lag",
@@ -193,6 +195,10 @@ STANDARD_GAUGES = (
     # Per-device series (device.hbm_bytes_in_use{device=...}) appear on
     # first sample; the process total is pre-declared.
     "device.live_buffers",
+    # The serving plane (serve/view.py, serve/engine.py): 0 until the
+    # first publish — a scraper can tell "no read plane" from "broken".
+    "serve.view_version",
+    "serve.view_age_seconds",
 )
 
 

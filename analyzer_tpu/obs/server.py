@@ -8,29 +8,32 @@ Everything the snapshot artifact exposes post-hoc (``--metrics-out``,
                            :class:`HealthChecks` probe passes, 503 with
                            one ``fail <name>: <detail>`` line per failing
                            probe otherwise (a worker registers pipeline/
-                           broker/store probes, ``service/worker.py``);
+                           broker/store probes — and a ``serve.view``
+                           probe when the query-serving plane is on,
+                           ``service/worker.py``);
   ``GET /metrics``         Prometheus text exposition (``prometheus_text``);
   ``GET /statusz``         human summary: ``render_summary`` plus the
                            owner's ``status_provider()`` dict (worker
                            ``stats()``);
   ``GET /debug/snapshot``  the full JSON snapshot, spans included.
 
-Served by ``http.server.ThreadingHTTPServer`` on a daemon thread — no
-framework, no dependency, good enough for a scrape every few seconds and
-an operator's curl. This module is the ONE sanctioned home for a listening
-socket in the package: graftlint GL024 flags ``http.server`` imports
-anywhere else, and flags a bare ``0.0.0.0`` default bind even here — obsd
-binds localhost unless an operator explicitly widens it (``docs/
-observability.md``).
+Served through the shared :mod:`analyzer_tpu.obs.httpd` plumbing (route
+table + daemon ``ThreadingHTTPServer``) — no framework, no dependency,
+good enough for a scrape every few seconds and an operator's curl. The
+listening-socket machinery lives in ``obs/httpd.py``; graftlint GL024
+flags ``http.server`` imports outside ``analyzer_tpu/obs/`` +
+``analyzer_tpu/serve/``, and flags a bare ``0.0.0.0`` default bind
+anywhere — every plane binds localhost unless an operator explicitly
+widens it (``docs/observability.md``).
 """
 
 from __future__ import annotations
 
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from analyzer_tpu.logging_utils import get_logger
+from analyzer_tpu.obs.httpd import DEFAULT_HOST, RoutedHTTPServer, text_body
 from analyzer_tpu.obs.snapshot import (
     prometheus_text,
     render_summary,
@@ -39,10 +42,9 @@ from analyzer_tpu.obs.snapshot import (
 
 logger = get_logger(__name__)
 
-#: Loopback by default: the introspection plane carries operational detail
-#: (queue names, env capture pointers) and must be opted ONTO a network
-#: interface, never discovered on one.
-DEFAULT_HOST = "127.0.0.1"
+__all__ = [
+    "DEFAULT_HOST", "HealthChecks", "ObsServer", "connectivity_probe",
+]
 
 
 class HealthChecks:
@@ -104,63 +106,36 @@ class ObsServer:
         self.health = health if health is not None else HealthChecks()
         self.status_provider = status_provider
         self._max_statusz_spans = max_statusz_spans
-        obsd = self
-
-        class Handler(BaseHTTPRequestHandler):
-            # One obsd per process is the norm; route table lives here so
-            # the handler closes over the server object, not globals.
-            def log_message(self, fmt, *args):  # quiet: curl spam is DEBUG
-                logger.debug("obsd: " + fmt, *args)
-
-            def _send(self, code: int, body: str, ctype: str) -> None:
-                data = body.encode("utf-8")
-                self.send_response(code)
-                self.send_header("Content-Type", ctype + "; charset=utf-8")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-            def do_GET(self):  # noqa: N802 — http.server contract
-                path = self.path.split("?", 1)[0]
-                try:
-                    if path == "/healthz":
-                        self._send(200, "ok\n", "text/plain")
-                    elif path == "/readyz":
-                        self._send(*obsd._readyz(), "text/plain")
-                    elif path == "/metrics":
-                        self._send(200, prometheus_text(), "text/plain")
-                    elif path == "/statusz":
-                        self._send(200, obsd._statusz(), "text/plain")
-                    elif path == "/debug/snapshot":
-                        body = json.dumps(
-                            snapshot(max_spans=None), indent=1, sort_keys=True
-                        )
-                        self._send(200, body + "\n", "application/json")
-                    else:
-                        self._send(404, "not found\n", "text/plain")
-                except Exception:  # noqa: BLE001 — a broken renderer must
-                    # surface as a 500 response, not kill the serving thread.
-                    logger.exception("obsd handler failed for %s", path)
-                    self._send(500, "internal error\n", "text/plain")
-
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self._httpd.daemon_threads = True
-        self.host = host
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever,
+        self._httpd = RoutedHTTPServer(
+            routes={
+                "/healthz": lambda params: text_body("ok\n"),
+                "/readyz": self._route_readyz,
+                "/metrics": lambda params: text_body(prometheus_text()),
+                "/statusz": lambda params: text_body(self._statusz()),
+                "/debug/snapshot": self._route_snapshot,
+            },
+            port=port,
+            host=host,
             name="analyzer-obsd",
-            daemon=True,
         )
-        self._thread.start()
+        self.host = host
         logger.info("obsd listening on http://%s:%d", self.host, self.port)
 
     @property
     def port(self) -> int:
-        return self._httpd.server_address[1]
+        return self._httpd.port
 
     @property
     def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        return self._httpd.url
+
+    def _route_readyz(self, params) -> tuple[int, str, str]:
+        code, body = self._readyz()
+        return text_body(body, code)
+
+    def _route_snapshot(self, params) -> tuple[int, str, str]:
+        body = json.dumps(snapshot(max_spans=None), indent=1, sort_keys=True)
+        return 200, body + "\n", "application/json"
 
     def _readyz(self) -> tuple[int, str]:
         results = self.health.run()
@@ -195,12 +170,7 @@ class ObsServer:
 
     def close(self) -> None:
         """Stops serving and joins the thread. Idempotent."""
-        httpd, self._httpd = self._httpd, None
-        if httpd is None:
-            return
-        httpd.shutdown()
-        httpd.server_close()
-        self._thread.join(timeout=5)
+        self._httpd.close()
         logger.info("obsd stopped")
 
 

@@ -120,6 +120,7 @@ def rate_history(
     start_step: int = 0,
     stop_after: int | None = None,
     on_chunk=None,
+    view_publisher=None,
 ) -> tuple[PlayerState, HistoryOutputs | None]:
     """Rates a packed history. Returns the final state and, when
     ``collect``, per-match outputs reordered back to stream order.
@@ -132,6 +133,13 @@ def rate_history(
     at — the periodic-checkpoint hook (io/checkpoint.py); fetching the
     state there costs one device sync, the price of a bounded crash blast
     radius (the reference pays per 500-match commit, worker.py:194).
+
+    ``view_publisher`` (a :class:`analyzer_tpu.serve.view.ViewPublisher`)
+    makes a long re-rate LIVE-SERVABLE: a throttled snapshot of the
+    carried table publishes at chunk boundaries (rows addressed by
+    index) plus one forced publish of the final state — same device-sync
+    cost profile as the checkpoint hook, governed by the publisher's
+    ``min_publish_interval_s``.
     """
     n_steps = sched.n_steps if stop_after is None else min(stop_after, sched.n_steps)
     if steps_per_chunk is None:
@@ -195,11 +203,18 @@ def rate_history(
             pending = ys
         if on_chunk is not None:
             on_chunk(state, min(start + steps_per_chunk, n_steps))
+        if view_publisher is not None:
+            # Throttled view publish BEFORE the next chunk dispatches:
+            # the carry buffer is about to be donated, so the publisher
+            # fetches its host copy here or not at all.
+            view_publisher.maybe_publish_state(state)
         # HBM-occupancy gauges at chunk boundaries (throttled inside —
         # device.hbm_bytes_in_use / device.live_buffers, obs/devicemem.py):
         # a run creeping toward the HBM ceiling shows up in /metrics and
         # the bench telemetry block BEFORE it OOMs.
         maybe_sample_device_memory()
+    if view_publisher is not None:
+        view_publisher.publish_state(state)  # final table, unthrottled
     if not collect:
         return state, None
     if pending is not None:
@@ -273,6 +288,7 @@ def rate_stream(
     team_size: int | None = None,
     stats_out: dict | None = None,
     mesh=None,
+    view_publisher=None,
 ) -> tuple[PlayerState, HistoryOutputs | None]:
     """Rates a raw MatchStream with the schedule built CONCURRENTLY with
     the device scan — the fully-streamed feed. ``stats_out`` (optional
@@ -288,6 +304,12 @@ def rate_stream(
     ``batch_size`` must already be one); ``collect`` is not supported on
     the mesh path (the sharded scan carries only the table — use
     ``rate_history(collect=True)`` for per-match outputs).
+
+    ``view_publisher`` publishes throttled index-addressed view
+    snapshots at window boundaries (plus the final table), exactly like
+    ``rate_history``'s hook — the streamed feed stays live-servable. On
+    the mesh path only the final (gathered) table publishes: a mid-run
+    shard gather would serialize the very overlap this feed exists for.
 
     ``rate_history`` overlaps window *materialization* with the scan but
     still pays the whole first-fit assignment as a sequential prefix
@@ -500,6 +522,8 @@ def rate_stream(
             if collect:
                 with tracer.span("batch.fetch", cat="sched", start=e0):
                     outs.append(fetch_tree(ys))
+            if view_publisher is not None:
+                view_publisher.maybe_publish_state(state)
         emitted = e1
         maybe_sample_device_memory()  # batch-boundary HBM gauges (throttled)
 
@@ -544,7 +568,12 @@ def rate_stream(
             choose_batch_size_s=t_choose,
         )
     if run is not None:
-        return run.finish(), None
+        state = run.finish()
+        if view_publisher is not None:
+            view_publisher.publish_state(state)
+        return state, None
+    if view_publisher is not None:
+        view_publisher.publish_state(state)  # final table, unthrottled
     if not collect:
         return state, None
     flat_idx = slot_map[: s_total * b]

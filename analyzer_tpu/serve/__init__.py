@@ -1,0 +1,49 @@
+"""ratesrv: the snapshot-consistent TPU query-serving plane.
+
+The write plane (``service/worker.py``, ``sched/runner.py``) rates
+matches into an HBM-resident rating table; this package is the READ
+plane that serves queries against it — player lookups, leaderboards,
+tier histograms, and win-probability — Clipper-style (Crankshaw et al.,
+NSDI '17): many tiny concurrent queries coalesce into one fixed-shape
+jitted device call per tick, the same whole-batch trick the rating
+kernel itself exploits.
+
+Three layers:
+
+  * :mod:`~analyzer_tpu.serve.view` — :class:`RatingsView`, an immutable
+    published snapshot of the rating table + id-to-row mapping,
+    double-buffered by a :class:`ViewPublisher` so the rater publishes at
+    commit boundaries and readers never observe torn mid-commit state;
+  * :mod:`~analyzer_tpu.serve.engine` — :class:`QueryEngine`, the
+    microbatching executor (pad-to-bucket shapes, zero steady-state
+    retraces, version-keyed leaderboard cache);
+  * :mod:`~analyzer_tpu.serve.server` — the ``/v1/*`` HTTP endpoints on
+    the shared :mod:`analyzer_tpu.obs.httpd` plumbing, started via
+    ``Worker(serve_port=)`` or ``cli serve``.
+
+``serve/oracle.py`` is the pure-Python reference the parity tests pin
+bit-for-bit results against; it is never imported by the serving path.
+
+Consistency model and operational notes: ``docs/serving.md``.
+"""
+
+from analyzer_tpu.serve.engine import QueryEngine, UnknownPlayerError
+from analyzer_tpu.serve.view import RatingsView, ViewPublisher
+
+__all__ = [
+    "QueryEngine",
+    "RatingsView",
+    "ServeServer",
+    "UnknownPlayerError",
+    "ViewPublisher",
+]
+
+
+def __getattr__(name):
+    # ServeServer pulls in the HTTP layer; keep it lazy so embedded
+    # engine users (tests, bench) don't pay for it.
+    if name == "ServeServer":
+        from analyzer_tpu.serve.server import ServeServer
+
+        return ServeServer
+    raise AttributeError(name)

@@ -1,0 +1,285 @@
+"""Versioned, snapshot-consistent views of the live rating table.
+
+The write plane commits batches continuously; readers must never observe
+a half-committed table. The mechanism is double-buffering at the publish
+boundary:
+
+  * the publisher owns a HOST staging table (numpy float32, the same
+    ``[P+1, 16]`` packed layout as :mod:`analyzer_tpu.core.state`) that
+    only the writer thread mutates;
+  * ``publish_*`` materializes a NEW immutable device table from the
+    staging buffer (an incremental ``.at[rows].set`` patch of the
+    previous view's table when the row bucket is unchanged — one small
+    H2D transfer + device scatter — or a full rebuild when the table
+    grew a bucket) and swaps the current-view reference in one atomic
+    assignment;
+  * a reader grabs :meth:`ViewPublisher.current` ONCE per request tick
+    and computes everything against that :class:`RatingsView`. The view
+    object is frozen: its device table is a jax array nothing donates or
+    mutates, its id list and row map only ever APPEND (guarded by the
+    view's own ``n_players``), so a view taken at version ``v`` answers
+    exactly as the table stood at ``v`` forever, no matter how far the
+    writer has advanced.
+
+Publishing never blocks readers and readers never block publishing —
+the only lock is writer-side, serializing concurrent publishers.
+
+Row sizing rides the same power-of-two bucket ladder as the write path
+(``service.encode.row_bucket``), so the serving kernels see a handful of
+table shapes, not one per player-count — the serve half of the package's
+zero-steady-state-retrace discipline (``docs/serving.md``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analyzer_tpu.core.state import TABLE_WIDTH
+from analyzer_tpu.logging_utils import get_logger
+from analyzer_tpu.obs import get_registry
+from analyzer_tpu.obs.retrace import track_jit
+from analyzer_tpu.service.encode import row_bucket
+
+logger = get_logger(__name__)
+
+#: Pad bucket ladder floor for the patch scatter's row-count axis.
+PATCH_BUCKET_FLOOR = 64
+
+
+def _pow2_bucket(n: int, floor: int) -> int:
+    return max(floor, 1 << max(n - 1, 0).bit_length())
+
+
+@jax.jit
+def _patch_rows(table, idx, rows):
+    """New table with ``rows[i]`` written at row ``idx[i]``. Pad entries
+    point at the padding row and carry NaN — rewriting the NaN pad row
+    with NaN keeps the invariant. NOT donated: the previous view keeps
+    serving from its buffer."""
+    return table.at[idx].set(rows)
+
+
+track_jit("serve._patch_rows", _patch_rows)
+
+
+class RatingsView:
+    """One immutable published snapshot: a device rating table plus the
+    id mapping frozen at ``n_players``.
+
+    ``table`` is ``[alloc+1, 16]`` float32 in the packed
+    :mod:`core.state` layout; rows ``n_players..alloc-1`` are NaN ghost
+    rows and row ``alloc`` is the padding row kernels aim masked slots
+    at. ``_ids``/``_row_of`` may be shared append-only structures — the
+    ``n_players`` guard is what freezes them for this version."""
+
+    __slots__ = (
+        "version", "table", "n_players", "published_at", "_row_of",
+        "_ids", "_host",
+    )
+
+    def __init__(self, version, table, n_players, row_of, ids) -> None:
+        self.version = version
+        self.table = table
+        self.n_players = n_players
+        self.published_at = time.monotonic()
+        self._row_of = row_of
+        self._ids = ids
+        self._host = None
+
+    @property
+    def pad_row(self) -> int:
+        return self.table.shape[0] - 1
+
+    @property
+    def age_s(self) -> float:
+        return time.monotonic() - self.published_at
+
+    def resolve(self, player_id: str) -> int | None:
+        """Row for ``player_id`` at THIS version, or None when the player
+        was not yet published (including players added in later
+        versions — the shared map may know them, this table does not)."""
+        if self._row_of is None:  # identity mode: ids ARE row indices
+            try:
+                row = int(player_id)
+            except (TypeError, ValueError):
+                return None
+        else:
+            row = self._row_of.get(player_id)
+            if row is None:
+                return None
+        return row if 0 <= row < self.n_players else None
+
+    def id_of(self, row: int) -> str:
+        """The player id published at ``row`` (< ``n_players``)."""
+        if self._ids is None:
+            return str(row)
+        return self._ids[row]
+
+    def host_table(self) -> np.ndarray:
+        """The table as host float32 (fetched once, cached) — the oracle
+        and debug surfaces read this; the serving path never does."""
+        if self._host is None:
+            self._host = np.asarray(self.table)
+        return self._host
+
+
+class ViewPublisher:
+    """The write side: merges committed rating rows and publishes
+    immutable :class:`RatingsView` versions.
+
+    Two modes, fixed by the first publish:
+
+      * **merge mode** (:meth:`publish_rows` — the service worker):
+        per-batch posterior rows keyed by player api id accumulate into
+        the staging table; unknown ids append new rows;
+      * **table mode** (:meth:`publish_state` — ``cli serve``, the sched
+        runners): a whole ``PlayerState`` table replaces the staging
+        buffer, with an optional id list (None = rows are addressed by
+        index).
+
+    Thread contract: any single thread may publish at a time (writer
+    lock); :meth:`current` is safe from any thread, lock-free.
+    """
+
+    def __init__(self, min_publish_interval_s: float = 2.0) -> None:
+        self._lock = threading.Lock()
+        self._row_of: dict[str, int] | None = {}
+        self._ids: list[str] | None = []
+        self._staging = np.full(
+            (PATCH_BUCKET_FLOOR + 1, TABLE_WIDTH), np.nan, np.float32
+        )
+        self._view: RatingsView | None = None
+        self._version = 0
+        self.min_publish_interval_s = min_publish_interval_s
+        self._last_publish: float | None = None
+
+    # -- read side --------------------------------------------------------
+    def current(self) -> RatingsView | None:
+        """The latest published view (None before the first publish).
+        One atomic reference read — never blocks, never tears."""
+        return self._view
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def view_age_s(self) -> float | None:
+        view = self._view
+        return None if view is None else view.age_s
+
+    # -- write side -------------------------------------------------------
+    def publish_rows(self, ids, rows) -> RatingsView:
+        """Merges ``rows`` (``[n, 16]`` float32, packed layout) for the
+        players named by ``ids`` and publishes a new version. New ids
+        append; existing ids overwrite their row. The worker calls this
+        at each batch commit boundary with the batch's posterior table."""
+        rows = np.asarray(rows, np.float32)
+        if rows.ndim != 2 or rows.shape[1] != TABLE_WIDTH or len(ids) != rows.shape[0]:
+            raise ValueError(
+                f"publish_rows wants [n, {TABLE_WIDTH}] rows matching ids; "
+                f"got {rows.shape} for {len(ids)} ids"
+            )
+        with self._lock:
+            if self._row_of is None:
+                raise ValueError(
+                    "publisher is in table mode (publish_state with "
+                    "index-addressed rows); per-id merges need id-mapped "
+                    "publishes from the start"
+                )
+            prev = self._view
+            touched = np.empty(len(ids), np.int64)
+            for i, pid in enumerate(ids):
+                row = self._row_of.get(pid)
+                if row is None:
+                    row = len(self._ids)
+                    self._row_of[pid] = row
+                    self._ids.append(pid)
+                touched[i] = row
+            p = len(self._ids)
+            alloc = row_bucket(p)
+            self._grow(alloc)
+            self._staging[touched] = rows
+            if prev is not None and prev.table.shape[0] == alloc + 1:
+                # Incremental path: patch only the touched rows into the
+                # previous version's device table (copy-on-write scatter).
+                nb = _pow2_bucket(len(ids), PATCH_BUCKET_FLOOR)
+                idx = np.full(nb, alloc, np.int32)
+                idx[: len(ids)] = touched
+                pad_rows = np.full((nb, TABLE_WIDTH), np.nan, np.float32)
+                pad_rows[: len(ids)] = rows
+                table = _patch_rows(prev.table, jnp.asarray(idx),
+                                    jnp.asarray(pad_rows))
+            else:
+                # jnp.array, NOT asarray: the CPU backend's asarray can
+                # alias the numpy buffer zero-copy, and an aliased view
+                # would mutate under later staging merges — the exact
+                # torn-read class this double buffer exists to kill.
+                table = jnp.array(self._staging[: alloc + 1])
+            return self._swap(table, p)
+
+    def publish_state(self, state, ids=None) -> RatingsView:
+        """Publishes a whole rating table: ``state`` is a ``PlayerState``
+        (or a raw ``[P+1, 16]`` array — the last row being the padding
+        row either way). ``ids`` maps rows to player ids; None serves
+        rows by index (full-history re-rates, checkpoints). The table is
+        fetched to host FIRST — the caller's device buffer may be
+        donated into the next scan chunk right after this returns."""
+        table = getattr(state, "table", state)
+        host = np.asarray(table, np.float32)
+        p = host.shape[0] - 1
+        if ids is not None and len(ids) != p:
+            raise ValueError(f"{len(ids)} ids for a {p}-player table")
+        with self._lock:
+            alloc = row_bucket(p)
+            if ids is None:
+                self._row_of = None
+                self._ids = None
+            else:
+                self._row_of = {pid: i for i, pid in enumerate(ids)}
+                self._ids = list(ids)
+            self._staging = np.full(
+                (alloc + 1, TABLE_WIDTH), np.nan, np.float32
+            )
+            self._staging[:p] = host[:p]
+            # jnp.array (owning copy) — see publish_rows on aliasing.
+            return self._swap(jnp.array(self._staging), p)
+
+    def maybe_publish_state(self, state, ids=None) -> RatingsView | None:
+        """Throttled :meth:`publish_state` — the sched runners call this
+        at chunk boundaries, where an unthrottled publish would pay a
+        device fetch per chunk. The first call always publishes."""
+        now = time.monotonic()
+        if (
+            self._last_publish is not None
+            and now - self._last_publish < self.min_publish_interval_s
+        ):
+            return None
+        return self.publish_state(state, ids=ids)
+
+    def _grow(self, alloc: int) -> None:
+        if alloc + 1 <= self._staging.shape[0]:
+            return
+        bigger = np.full((alloc + 1, TABLE_WIDTH), np.nan, np.float32)
+        bigger[: self._staging.shape[0] - 1] = self._staging[:-1]
+        self._staging = bigger
+
+    def _swap(self, table, n_players: int) -> RatingsView:
+        """Builds the next version and swaps the reference (the one
+        atomic publication point). Caller holds the writer lock."""
+        self._version += 1
+        view = RatingsView(
+            self._version, table, n_players, self._row_of, self._ids
+        )
+        self._view = view
+        self._last_publish = time.monotonic()
+        reg = get_registry()
+        reg.gauge("serve.view_version").set(self._version)
+        reg.gauge("serve.view_age_seconds").set(0.0)
+        reg.counter("serve.view_publishes_total").add(1)
+        return view

@@ -245,6 +245,11 @@ class _Job:
     fetch: Future  # -> HistoryOutputs (or None for _EmptyBatch)
     status: str = "inflight"  # -> ok | failed | aborted
     error: BaseException | None = None
+    # The batch's FINAL device table (serve-plane publish source), held
+    # only when the worker runs a ratings view. Published at harvest —
+    # strictly AFTER the writer committed — so readers never see a
+    # posterior the store might still roll back.
+    view_table: object = None
 
 
 class _Writer(threading.Thread):
@@ -513,6 +518,9 @@ class PipelineEngine:
         fetch = _LazyFetch(
             ys_chunks, flat_idx, sched.n_matches, sched.team_size
         )
+        view_table = (
+            final.table if w.view_publisher is not None else None
+        )
         rows = int(final.table.shape[0])
         if rows <= self._canon_rows:
             if self._ring is None:
@@ -522,14 +530,14 @@ class PipelineEngine:
                 _canonical_rows(final.table, self._canon_rows),
             )
             self.chain.append((self.seq, enc.row_of))
-            self._enqueue(msgs, enc, fetch)
+            self._enqueue(msgs, enc, fetch, view_table)
         else:
             # Defensive only — canon_rows is sized for the largest batch
             # the config can produce, so an over-bucket batch means the
             # sizing contract broke. It cannot ride the fixed-shape
             # ring; enqueue, then DRAIN so no later batch needs to chain
             # off it (one sequentialized batch, correctness intact).
-            self._enqueue(msgs, enc, fetch)
+            self._enqueue(msgs, enc, fetch, view_table)
             self.drain()
 
     def _encode_fresh(self, ids: list):
@@ -554,8 +562,13 @@ class PipelineEngine:
             if rollback is not None:
                 rollback()
 
-    def _enqueue(self, msgs: list, enc, fetch: Future) -> None:
-        self.writer.submit(_Job(seq=self.seq, msgs=msgs, enc=enc, fetch=fetch))
+    def _enqueue(
+        self, msgs: list, enc, fetch: Future, view_table=None
+    ) -> None:
+        self.writer.submit(_Job(
+            seq=self.seq, msgs=msgs, enc=enc, fetch=fetch,
+            view_table=view_table,
+        ))
         self.seq += 1
         self._update_inflight()
 
@@ -593,6 +606,12 @@ class PipelineEngine:
             if job.status == "ok":
                 w.matches_rated += len(job.enc.matches)
                 w.batches_ok += 1
+                if job.view_table is not None:
+                    # Commit is durable (the writer finished this job):
+                    # publish the batch's posteriors to the read plane
+                    # before acking, mirroring the sequential lane's
+                    # commit -> publish -> ack order.
+                    w._publish_view(job.enc, job.view_table)
                 w._ack_batch(job.msgs)
             elif job.status == "failed":
                 logger.error("pipelined batch failed: %s", job.error)

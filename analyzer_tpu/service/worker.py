@@ -99,6 +99,8 @@ class Worker:
         obs_port: int | None = None,
         obs_host: str | None = None,
         flight_dir: str | None = None,
+        serve_port: int | None = None,
+        serve_host: str | None = None,
     ) -> None:
         self.broker = broker
         self.store = store
@@ -196,6 +198,35 @@ class Worker:
             health.register(
                 "service.store", connectivity_probe(store, "store")
             )
+        # ratesrv (serve/): the query-serving read plane. The worker
+        # publishes a new immutable view version at every batch commit
+        # boundary (_publish_view — sequential process() and the
+        # pipelined harvest both route through it), so readers see
+        # exactly the committed table, never a mid-commit one.
+        self.view_publisher = None
+        self.query_engine = None
+        self.serve_server = None
+        if serve_port is not None:
+            from analyzer_tpu.obs.httpd import DEFAULT_HOST as LOOPBACK
+            from analyzer_tpu.serve import QueryEngine, ViewPublisher
+            from analyzer_tpu.serve.server import ServeServer
+
+            self.view_publisher = ViewPublisher()
+            self.query_engine = QueryEngine(
+                self.view_publisher, cfg=self.rating_config
+            ).start()
+            self.serve_server = ServeServer(
+                self.query_engine,
+                port=serve_port,
+                host=serve_host or LOOPBACK,
+            )
+            if self.obs_server is not None:
+                # /readyz flips green only after the first commit
+                # publishes version 1 — a balancer must not route reads
+                # at a worker still warming its view.
+                self.obs_server.health.register(
+                    "serve.view", self._serve_view_health
+                )
 
     # -- micro-batcher ----------------------------------------------------
     def poll(self) -> bool:
@@ -623,12 +654,19 @@ class Worker:
 
     def close(self) -> None:
         """Releases the pipelined engine (writer thread + its cloned
-        store connection) after draining, and stops obsd. A Worker is
-        reusable after close — the next pipelined flush builds a fresh
-        engine (obsd is not rebuilt: its lifetime is the process's)."""
+        store connection) after draining, and stops obsd + ratesrv. A
+        Worker is reusable after close — the next pipelined flush builds
+        a fresh engine (obsd/ratesrv are not rebuilt: their lifetime is
+        the process's)."""
         if self._engine is not None:
             self._engine.close()
             self._engine = None
+        if self.serve_server is not None:
+            self.serve_server.close()
+            self.serve_server = None
+        if self.query_engine is not None:
+            self.query_engine.close()
+            self.query_engine = None
         if self.obs_server is not None:
             self.obs_server.close()
             self.obs_server = None
@@ -789,7 +827,7 @@ class Worker:
         with tracer.span(
             "batch.compute", cat="worker", matches=n, steps=sched.n_steps
         ):
-            _, outs = rate_history(
+            final_state, outs = rate_history(
                 enc.state, sched, self.rating_config, collect=True,
                 steps_per_chunk=self._step_chunk,
             )
@@ -799,6 +837,10 @@ class Worker:
         # write_back's mutations.
         with tracer.span("batch.commit", cat="worker", matches=n):
             finalize(self.store, enc, outs)
+        # The commit boundary IS the view publish boundary: readers of
+        # the serving plane see this batch's posteriors only once the
+        # store does (no-op without serve_port).
+        self._publish_view(enc, final_state.table)
         self.matches_rated += n
         self.batches_ok += 1
         logger.info(
@@ -808,6 +850,43 @@ class Worker:
         return [
             m if isinstance(m, str) else m.api_id for m in enc.matches
         ]
+
+    # -- serving plane ----------------------------------------------------
+    def _publish_view(self, enc, table) -> None:
+        """Publishes one committed batch's posterior rows into the
+        serving plane's view (serve/view.py). ``enc`` supplies the
+        api-id -> row map (EncodedBatch and ColumnarBatch both expose
+        ``row_of``); ``table`` is the FINAL device table returned by the
+        rating scan. No-op when serving is off or the batch carried no
+        players (_EmptyBatch). Never raises: a read-plane publish
+        failure must not dead-letter a successfully committed batch."""
+        if self.view_publisher is None:
+            return
+        row_of = getattr(enc, "row_of", None)
+        if not row_of:
+            return
+        import numpy as np
+
+        try:
+            ids = [None] * len(row_of)
+            for pid, row in row_of.items():
+                ids[row] = pid
+            rows = np.asarray(table)[: len(ids)]
+            view = self.view_publisher.publish_rows(ids, rows)
+            logger.debug(
+                "published ratings view v%d (%d players)",
+                view.version, view.n_players,
+            )
+        except Exception:  # noqa: BLE001 — the write plane must not fail
+            # because the read plane could not take the update.
+            logger.exception("ratings view publish failed")
+
+    def _serve_view_health(self) -> tuple[bool, str]:
+        """obsd readiness probe: green once a view has been published."""
+        view = self.view_publisher.current()
+        if view is None:
+            return False, "no ratings view published yet"
+        return True, f"view v{view.version} ({view.n_players} players)"
 
     # -- observability ----------------------------------------------------
     def _pipeline_health(self) -> tuple[bool, str]:
@@ -902,6 +981,12 @@ class Worker:
                 round(self.measured_host_s * 1e3, 1)
                 if self.measured_host_s is not None else None
             ),
+            # The serving plane's keys ride along even when serving is
+            # off (None) — scrapers key on presence, not worker flavor.
+            "serve": (
+                self.query_engine.stats()
+                if self.query_engine is not None else None
+            ),
         }
 
     @property
@@ -969,6 +1054,7 @@ def main(
     max_flushes: int | None = None,
     obs_port: int | None = None,
     flight_dir: str | None = None,
+    serve_port: int | None = None,
 ) -> Worker:
     """``python -m analyzer_tpu.service.worker`` — the reference's
     ``python3 worker.py`` entry point (``worker.py:219-221``), requiring a
@@ -981,10 +1067,13 @@ def main(
 
     ``obs_port`` (or ``ANALYZER_TPU_OBS_PORT``) starts obsd;
     ``flight_dir`` (or ``ANALYZER_TPU_FLIGHT_DIR``) arms flight-recorder
-    dumps."""
+    dumps; ``serve_port`` (or ``ANALYZER_TPU_SERVE_PORT``) starts the
+    ratesrv query-serving plane (docs/serving.md)."""
     config = ServiceConfig.from_env()
     if obs_port is None and os.environ.get("ANALYZER_TPU_OBS_PORT"):
         obs_port = int(os.environ["ANALYZER_TPU_OBS_PORT"])
+    if serve_port is None and os.environ.get("ANALYZER_TPU_SERVE_PORT"):
+        serve_port = int(os.environ["ANALYZER_TPU_SERVE_PORT"])
     from analyzer_tpu.service.broker import make_pika_broker
 
     # Sequential mode: prefetch_count=BATCHSIZE bounds in-flight messages
@@ -1005,7 +1094,8 @@ def main(
 
         store = InMemoryStore()
     worker = Worker(
-        broker, store, config, obs_port=obs_port, flight_dir=flight_dir
+        broker, store, config, obs_port=obs_port, flight_dir=flight_dir,
+        serve_port=serve_port,
     )
     worker.warmup()  # compile before consuming: no first-batch stall
     try:

@@ -1,0 +1,194 @@
+"""Read-plane throughput: microbatched serving vs one-dispatch-per-query.
+
+The write path has a bench trajectory (``bench.py`` -> ``BENCH_rNN.json``
+-> ``cli benchdiff``); this gives the serving plane (ISSUE 4) the same
+treatment. It builds a rated table, publishes one view, warms the
+engine's kernel ladder, then measures win-probability queries two ways:
+
+  * **naive** — ``QueryEngine.query_now``: one padded kernel dispatch
+    per query, the cost model of every request opening its own device
+    call;
+  * **batched** — async submissions drained by the tick thread into
+    ``max_batch``-deep microbatches: each query pays ~1/occupancy of a
+    dispatch (Clipper, NSDI '17).
+
+The acceptance bar (ISSUE 4): batched queries/sec >= 5x naive on the
+same table, with ``jax.retraces_total`` FLAT across the steady-state
+batched phase — both pinned in the emitted telemetry block, sourced
+from the obs retrace counters (``obs/retrace.py`` hooks installed
+before the first compile).
+
+Output: one JSON line on stdout (the ``SERVE_BENCH`` artifact;
+``--out`` also writes it to a file for ``cli benchdiff --family
+serve``).
+
+Usage:
+    python experiments/serve_bench.py [--players 100000]
+        [--queries 5000] [--out SERVE_BENCH_rNN.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.core.state import MU_LO, SIGMA_LO, PlayerState
+from analyzer_tpu.obs import get_registry, install_jax_hooks
+from analyzer_tpu.serve import QueryEngine, ViewPublisher
+
+
+def build_view(publisher: ViewPublisher, n_players: int, seed: int):
+    """A fully-rated synthetic table published as version 1."""
+    rng = np.random.default_rng(seed)
+    cfg = RatingConfig()
+    state = PlayerState.create(
+        n_players, skill_tier=rng.integers(1, 29, n_players), cfg=cfg
+    )
+    table = np.asarray(state.table).copy()
+    table[:n_players, MU_LO] = rng.normal(1500.0, 400.0, n_players).astype(
+        np.float32
+    )
+    table[:n_players, SIGMA_LO] = rng.uniform(
+        60.0, 600.0, n_players
+    ).astype(np.float32)
+    ids = [f"p{i}" for i in range(n_players)]
+    return publisher.publish_rows(ids, table[:n_players]), cfg
+
+
+def gen_matchups(n_players: int, count: int, seed: int):
+    """``count`` random 3v3 matchups as id-tuple payloads."""
+    rng = np.random.default_rng(seed)
+    draws = rng.integers(0, n_players, (count, 6))
+    return [
+        (
+            tuple(f"p{i}" for i in row[:3]),
+            tuple(f"p{i}" for i in row[3:]),
+        )
+        for row in draws
+    ]
+
+
+def quantile(xs, q: float):
+    xs = sorted(xs)
+    if not xs:
+        return None
+    return xs[min(len(xs) - 1, max(0, round(q * (len(xs) - 1))))]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--players", type=int, default=100_000)
+    ap.add_argument("--queries", type=int, default=5_000,
+                    help="batched-phase winprob queries")
+    ap.add_argument("--naive-queries", type=int, default=300,
+                    help="naive-baseline winprob queries")
+    ap.add_argument("--max-batch", type=int, default=256)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--out", help="also write the artifact to this path")
+    args = ap.parse_args()
+
+    # Retrace accounting MUST hook in before the first compile, or the
+    # flatness claim below would be vacuously true.
+    install_jax_hooks()
+    reg = get_registry()
+
+    publisher = ViewPublisher()
+    t0 = time.perf_counter()
+    view, cfg = build_view(publisher, args.players, args.seed)
+    t_build = time.perf_counter() - t0
+    engine = QueryEngine(publisher, cfg=cfg, max_batch=args.max_batch)
+
+    t0 = time.perf_counter()
+    shapes = engine.warmup(view)
+    t_warm = time.perf_counter() - t0
+
+    # -- naive baseline: one dispatch per query --------------------------
+    naive_q = gen_matchups(args.players, args.naive_queries, args.seed + 1)
+    t0 = time.perf_counter()
+    for a, b in naive_q:
+        engine.query_now("winprob", (a, b))
+    t_naive = time.perf_counter() - t0
+    naive_qps = args.naive_queries / t_naive if t_naive > 0 else 0.0
+
+    # -- batched steady state: async flood through the tick thread ------
+    batched_q = gen_matchups(args.players, args.queries, args.seed + 2)
+    retraces_before = reg.counter("jax.retraces_total").value
+    compiles_before = reg.counter("jax.backend_compiles_total").value
+    engine.start()
+    t0 = time.perf_counter()
+    pendings = [engine.submit("winprob", p) for p in batched_q]
+    for p in pendings:
+        p.result(timeout=120.0)
+    t_batched = time.perf_counter() - t0
+    engine.close()
+    qps = args.queries / t_batched if t_batched > 0 else 0.0
+    retraces_after = reg.counter("jax.retraces_total").value
+    compiles_after = reg.counter("jax.backend_compiles_total").value
+
+    latencies_ms = [
+        p.latency_s * 1e3 for p in pendings if p.latency_s is not None
+    ]
+    occ = reg.histogram(
+        "serve.microbatch_occupancy", kind="winprob"
+    ).summary()
+
+    steady_retraces = retraces_after - retraces_before
+    speedup = qps / naive_qps if naive_qps > 0 else None
+    line = {
+        "metric": "serve.queries_per_sec",
+        "value": round(qps, 1),
+        "latency_ms": {
+            "p50": round(quantile(latencies_ms, 0.50), 3),
+            "p99": round(quantile(latencies_ms, 0.99), 3),
+        },
+        "naive": {
+            "queries_per_sec": round(naive_qps, 1),
+            "queries": args.naive_queries,
+        },
+        "speedup_vs_naive": round(speedup, 2) if speedup else None,
+        "players": args.players,
+        "queries": args.queries,
+        "max_batch": args.max_batch,
+        "occupancy": {
+            "mean": occ["mean"], "p50": occ["p50"], "p99": occ["p99"],
+        },
+        "phases": {
+            "build_s": round(t_build, 3),
+            "warmup_s": round(t_warm, 3),
+            "naive_s": round(t_naive, 3),
+            "batched_s": round(t_batched, 3),
+        },
+        "telemetry": {
+            "warmup_shapes": shapes,
+            "retraces_total": retraces_after,
+            "steady_retraces": steady_retraces,
+            "backend_compiles_total": compiles_after,
+            "steady_backend_compiles": compiles_after - compiles_before,
+        },
+        "capture": {
+            # The 5x bar and the flat-retrace bar are the artifact's
+            # health: a capture missing either is reported degraded and
+            # benchdiff will not gate on it.
+            "degraded": bool(
+                steady_retraces != 0 or (speedup is not None and speedup < 5.0)
+            ),
+        },
+    }
+    print(json.dumps(line))
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(line, f, indent=1, sort_keys=True)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -764,10 +764,11 @@ class TestGL023RawClock:
 
 
 class TestGL024NetworkSurface:
-    """GL024 keeps listening sockets in the obsd plane: http.server/
-    socketserver imports flag outside analyzer_tpu/obs/, and a bare
-    "0.0.0.0" literal flags everywhere (obsd must default to
-    localhost)."""
+    """GL024 keeps listening sockets in the sanctioned planes:
+    http.server/socketserver imports flag outside analyzer_tpu/obs/
+    (obsd + the shared httpd plumbing) and analyzer_tpu/serve/
+    (ratesrv), and a bare "0.0.0.0" literal flags everywhere (every
+    plane must default to localhost)."""
 
     SRC = """
     from http.server import ThreadingHTTPServer
@@ -776,7 +777,7 @@ class TestGL024NetworkSurface:
         return ThreadingHTTPServer(("127.0.0.1", 0), None)
     """
 
-    def test_server_import_fires_outside_obs(self):
+    def test_server_import_fires_outside_sanctioned_dirs(self):
         for path in (
             "analyzer_tpu/service/worker.py",
             "analyzer_tpu/cli.py",
@@ -786,6 +787,12 @@ class TestGL024NetworkSurface:
 
     def test_server_import_sanctioned_inside_obs(self):
         assert rules_of(self.SRC, "analyzer_tpu/obs/server.py") == []
+        assert rules_of(self.SRC, "analyzer_tpu/obs/httpd.py") == []
+
+    def test_server_import_sanctioned_inside_serve(self):
+        # The ratesrv plane (ISSUE 4) is the second sanctioned home.
+        assert rules_of(self.SRC, "analyzer_tpu/serve/server.py") == []
+        assert rules_of(self.SRC, "analyzer_tpu/serve/engine.py") == []
 
     def test_plain_import_and_socketserver_fire_too(self):
         src = """
@@ -808,6 +815,9 @@ class TestGL024NetworkSurface:
         DEFAULT_HOST = "0.0.0.0"
         """
         assert rules_of(src, "analyzer_tpu/obs/server.py") == ["GL024"]
+        # The serve allowlist covers the IMPORT half only — the bind
+        # ban stays global, ratesrv included.
+        assert rules_of(src, "analyzer_tpu/serve/server.py") == ["GL024"]
         assert rules_of(src, "snippet.py") == ["GL024"]
 
     def test_loopback_default_is_fine(self):

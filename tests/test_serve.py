@@ -1,0 +1,801 @@
+"""ratesrv: the snapshot-consistent query-serving plane (ISSUE 4).
+
+Acceptance contract: leaderboard, tier histogram, percentile, win
+probability and quality must match the pure-Python oracle
+(``serve/oracle.py``) BIT-FOR-BIT on the test table — including at every
+published version while a publisher thread commits batches under
+concurrent reader fire (no torn reads: every response is internally
+consistent with exactly one version). Plus: microbatch coalescing with
+zero steady-state retraces, the shared httpd plumbing, the worker
+integration (publish at commit, stats serve keys, ``serve.view``
+readiness), and the benchdiff SERVE_BENCH family.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analyzer_tpu.config import RatingConfig, ServiceConfig
+from analyzer_tpu.core.state import MU_LO, SIGMA_LO, PlayerState
+from analyzer_tpu.obs import get_registry, reset_registry
+from analyzer_tpu.obs.retrace import retrace_counts
+from analyzer_tpu.serve import (
+    QueryEngine,
+    UnknownPlayerError,
+    ViewPublisher,
+)
+from analyzer_tpu.serve import oracle
+from analyzer_tpu.serve.server import ServeServer
+from analyzer_tpu.service import InMemoryBroker, InMemoryStore, Worker
+
+CFG = RatingConfig()
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    reset_registry()
+    yield
+    reset_registry()
+
+
+def rated_table(n_players: int, n_rated: int, seed: int = 0) -> np.ndarray:
+    """[n_players, 16] float32 rows: first ``n_rated`` rows rated with
+    varied (mu, sigma), the rest unrated (NaN) with baked seeds."""
+    rng = np.random.default_rng(seed)
+    state = PlayerState.create(
+        n_players, skill_tier=rng.integers(1, 29, n_players), cfg=CFG
+    )
+    table = np.asarray(state.table).copy()
+    table[:n_rated, MU_LO] = rng.normal(1500, 400, n_rated).astype(np.float32)
+    table[:n_rated, SIGMA_LO] = rng.uniform(50, 600, n_rated).astype(
+        np.float32
+    )
+    return table[:n_players]
+
+
+def publish(n_players=60, n_rated=45, seed=0):
+    pub = ViewPublisher()
+    ids = [f"p{i}" for i in range(n_players)]
+    view = pub.publish_rows(ids, rated_table(n_players, n_rated, seed))
+    return pub, view
+
+
+def http_get(url: str):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read())
+
+
+class TestRatingsView:
+    def test_publish_versions_and_resolve(self):
+        pub, view = publish()
+        assert view.version == 1 and pub.version == 1
+        assert view.resolve("p3") == 3
+        assert view.resolve("ghost") is None
+        assert view.id_of(3) == "p3"
+        assert pub.view_age_s() >= 0.0
+
+    def test_views_are_immutable_snapshots(self):
+        pub, v1 = publish()
+        before = v1.host_table().copy()
+        rows = rated_table(60, 45, seed=9)
+        v2 = pub.publish_rows([f"p{i}" for i in range(60)], rows)
+        assert v2.version == 2
+        # v1 answers exactly as published, forever.
+        assert np.array_equal(v1.host_table(), before, equal_nan=True)
+        assert not np.array_equal(
+            np.asarray(v2.table), before, equal_nan=True
+        )
+
+    def test_incremental_patch_equals_rebuild(self):
+        pub, v1 = publish()
+        new_rows = rated_table(60, 45, seed=7)[10:13]
+        v2 = pub.publish_rows(["p10", "p11", "p12"], new_rows)
+        # The device-patched table must equal the staging table (the
+        # would-be full rebuild) bit-for-bit.
+        assert np.array_equal(
+            np.asarray(v2.table),
+            pub._staging[: v2.table.shape[0]],
+            equal_nan=True,
+        )
+
+    def test_new_players_append_and_old_views_guard(self):
+        pub, v1 = publish(n_players=60)
+        v2 = pub.publish_rows(["extra"], rated_table(1, 1, seed=3))
+        assert v2.resolve("extra") == 60
+        # v1 must NOT know the player added after its publish, even
+        # though the underlying map is shared append-only.
+        assert v1.resolve("extra") is None
+
+    def test_row_bucket_growth_rebuilds(self):
+        pub, v1 = publish(n_players=60)  # row_bucket(60) = 64
+        rows = rated_table(40, 40, seed=4)
+        v2 = pub.publish_rows([f"g{i}" for i in range(40)], rows)
+        assert v2.table.shape[0] == 129  # bucket 128 + pad row
+        assert v2.resolve("g39") == 99
+        assert v1.table.shape[0] == 65  # old bucket untouched
+        assert np.array_equal(
+            np.asarray(v2.table)[:60], v1.host_table()[:60], equal_nan=True
+        )
+
+    def test_publish_state_identity_mode(self):
+        pub = ViewPublisher()
+        state = PlayerState.create(10, cfg=CFG)
+        view = pub.publish_state(state)
+        assert view.n_players == 10
+        assert view.resolve("7") == 7
+        assert view.resolve("11") is None  # beyond table
+        assert view.id_of(7) == "7"
+        with pytest.raises(ValueError):
+            pub.publish_rows(["a"], rated_table(1, 1))
+
+    def test_publish_rows_shape_validation(self):
+        pub = ViewPublisher()
+        with pytest.raises(ValueError):
+            pub.publish_rows(["a", "b"], np.zeros((1, 16), np.float32))
+        with pytest.raises(ValueError):
+            pub.publish_rows(["a"], np.zeros((1, 7), np.float32))
+
+
+class TestOracleParity:
+    """Bit-for-bit equality with the pure-Python oracle."""
+
+    def test_leaderboard_bitexact(self):
+        pub, view = publish()
+        eng = QueryEngine(pub, cfg=CFG)
+        host = view.host_table()
+        for k in (1, 5, 44, 45, 60):  # including k > rated count
+            resp = eng.leaderboard(k)
+            exp = oracle.leaderboard(host, view.n_players, k)
+            assert len(resp["leaders"]) == len(exp)
+            for lead, (row, score) in zip(resp["leaders"], exp):
+                assert lead["id"] == view.id_of(row)
+                assert np.float32(lead["conservative"]) == score
+                assert np.float32(lead["mu"]) == np.float32(host[row, MU_LO])
+
+    def test_leaderboard_tie_breaks_toward_lower_row(self):
+        # Pins jax.lax.top_k's stability, which the oracle's stable
+        # sort replicates — a silent change here would re-order equal
+        # players between engine and oracle.
+        pub = ViewPublisher()
+        rows = rated_table(8, 0)
+        rows[:, MU_LO] = 1500.0
+        rows[:, SIGMA_LO] = 100.0
+        view = pub.publish_rows([f"t{i}" for i in range(8)], rows)
+        eng = QueryEngine(pub, cfg=CFG)
+        resp = eng.leaderboard(8)
+        assert [e["id"] for e in resp["leaders"]] == [
+            f"t{i}" for i in range(8)
+        ]
+        exp = oracle.leaderboard(view.host_table(), 8, 8)
+        assert [view.id_of(r) for r, _ in exp] == [
+            e["id"] for e in resp["leaders"]
+        ]
+
+    def test_tier_histogram_bitexact(self):
+        pub, view = publish()
+        eng = QueryEngine(pub, cfg=CFG)
+        resp = eng.tier_histogram()
+        counts, rated = oracle.tier_histogram(
+            view.host_table(), view.n_players, eng.tier_edges
+        )
+        assert resp["counts"] == counts
+        assert resp["rated"] == rated == 45
+        assert sum(resp["counts"]) == rated
+
+    def test_percentile_bitexact(self):
+        pub, view = publish()
+        eng = QueryEngine(pub, cfg=CFG)
+        host = view.host_table()
+        for score in (-3000.0, -500.0, 0.0, 612.25, 5000.0):
+            resp = eng.percentile(score)
+            below, rated = oracle.percentile(host, view.n_players, score)
+            assert resp["below"] == below and resp["rated"] == rated
+            assert resp["percentile"] == below / rated
+
+    def test_winprob_and_quality_bitexact(self):
+        pub, view = publish()
+        eng = QueryEngine(pub, cfg=CFG)
+        host = view.host_table()
+        rng = np.random.default_rng(1)
+        for _ in range(25):
+            # Uneven teams and unrated (seed-resolved) players included.
+            na, nb = rng.integers(1, 6), rng.integers(1, 6)
+            picks = rng.choice(view.n_players, na + nb, replace=False)
+            a = [f"p{i}" for i in picks[:na]]
+            b = [f"p{i}" for i in picks[na:]]
+            resp = eng.win_probability(a, b)
+            rows_a = [view.resolve(x) for x in a]
+            rows_b = [view.resolve(x) for x in b]
+            assert np.float32(resp["p_a"]) == oracle.win_probability(
+                host, rows_a, rows_b, CFG.beta2
+            )
+            assert np.float32(resp["quality"]) == oracle.quality(
+                host, rows_a, rows_b, CFG.beta2
+            )
+
+    def test_winprob_complement_and_ops_crosscheck(self):
+        import jax.numpy as jnp
+
+        from analyzer_tpu.core.state import MAX_TEAM_SIZE
+        from analyzer_tpu.ops import trueskill as ts
+
+        pub, view = publish()
+        eng = QueryEngine(pub, cfg=CFG)
+        host = view.host_table()
+        a, b = ["p0", "p1", "p2"], ["p3", "p4", "p5"]
+        p_ab = eng.win_probability(a, b)["p_a"]
+        p_ba = eng.win_probability(b, a)["p_a"]
+        assert abs(p_ab + p_ba - 1.0) < 1e-6
+        # The host float64 finish must agree with the pure-device
+        # ops.trueskill composition to float32 noise.
+        mu = np.zeros((2, MAX_TEAM_SIZE), np.float32)
+        sg = np.zeros((2, MAX_TEAM_SIZE), np.float32)
+        mask = np.zeros((2, MAX_TEAM_SIZE), bool)
+        for t, ids in enumerate((a, b)):
+            for s, pid in enumerate(ids):
+                mu[t, s], sg[t, s] = oracle.resolve_prior(
+                    host, view.resolve(pid)
+                )
+                mask[t, s] = True
+        p_dev = float(ts.win_probability(
+            jnp.asarray(mu), jnp.asarray(sg), jnp.asarray(mask), CFG
+        ))
+        q_dev = float(ts.quality(
+            jnp.asarray(mu), jnp.asarray(sg), jnp.asarray(mask), CFG
+        ))
+        assert abs(p_dev - p_ab) < 1e-5
+        assert abs(q_dev - eng.win_probability(a, b)["quality"]) < 1e-5
+
+    def test_ratings_values_and_seeds(self):
+        pub, view = publish()
+        eng = QueryEngine(pub, cfg=CFG)
+        host = view.host_table()
+        resp = eng.get_ratings(["p2", "p50", "ghost"])
+        assert resp["unknown"] == ["ghost"]
+        rated, unrated = resp["ratings"]
+        assert np.float32(rated["mu"]) == np.float32(host[2, MU_LO])
+        assert np.float32(rated["conservative"]) == oracle.conservative_score(
+            host, 2
+        )
+        assert unrated["rated"] is False and unrated["mu"] is None
+        seed_mu, seed_sg = oracle.resolve_prior(host, 50)
+        assert np.float32(unrated["seed_mu"]) == seed_mu
+        assert np.float32(unrated["seed_sigma"]) == seed_sg
+
+
+class TestCoalescing:
+    def test_tick_coalesces_and_reports_one_version(self):
+        pub, view = publish()
+        eng = QueryEngine(pub, cfg=CFG)
+        reqs = [eng.submit("winprob", (("p0", "p1"), ("p2", "p3")))
+                for _ in range(12)]
+        reqs += [eng.submit("ratings", ("p0", "p5"))]
+        served = eng.tick()
+        assert served == 13
+        assert {r.result(timeout=0)["version"] for r in reqs} == {1}
+        # One winprob dispatch for 12 requests: occupancy 12/16 observed.
+        h = get_registry().histogram(
+            "serve.microbatch_occupancy", kind="winprob"
+        ).summary()
+        assert h["count"] == 1
+        assert h["max"] == pytest.approx(12 / 16)
+        assert get_registry().counter("serve.queries_total").value == 13
+
+    def test_unknown_id_fails_only_its_request(self):
+        pub, view = publish()
+        eng = QueryEngine(pub, cfg=CFG)
+        good = eng.submit("winprob", (("p0",), ("p1",)))
+        bad = eng.submit("winprob", (("p0",), ("ghost",)))
+        eng.tick()
+        assert good.result(timeout=0)["version"] == 1
+        with pytest.raises(UnknownPlayerError):
+            bad.result(timeout=0)
+
+    def test_overflow_defers_to_next_tick(self):
+        pub, view = publish()
+        eng = QueryEngine(pub, cfg=CFG, max_batch=8)
+        reqs = [eng.submit("percentile", float(i)) for i in range(11)]
+        assert eng.tick() == 8
+        assert eng.tick() == 3
+        assert all(r.result(timeout=0)["version"] == 1 for r in reqs)
+
+    def test_leaderboard_cache_version_keyed(self):
+        pub, view = publish()
+        eng = QueryEngine(pub, cfg=CFG)
+        eng.leaderboard(5)
+        hits = get_registry().counter("serve.leaderboard_cache_hits_total")
+        assert hits.value == 0
+        r1 = eng.leaderboard(5)
+        assert hits.value == 1
+        pub.publish_rows(["p0"], rated_table(1, 1, seed=11))
+        r2 = eng.leaderboard(5)
+        assert hits.value == 1  # new version -> recompute
+        assert r2["version"] == 2 and r1["version"] == 1
+
+    def test_no_view_fails_cleanly(self):
+        eng = QueryEngine(ViewPublisher(), cfg=CFG)
+        with pytest.raises(RuntimeError, match="no ratings view"):
+            eng.leaderboard(3)
+
+    def test_threaded_concurrent_callers(self):
+        pub, view = publish()
+        eng = QueryEngine(pub, cfg=CFG).start()
+        try:
+            results = []
+            errs = []
+
+            def hammer():
+                try:
+                    for _ in range(5):
+                        results.append(
+                            eng.win_probability(("p0", "p1"), ("p2",))
+                            ["version"]
+                        )
+                except BaseException as e:  # pragma: no cover
+                    errs.append(e)
+
+            threads = [threading.Thread(target=hammer) for _ in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errs
+            assert results == [1] * 30
+        finally:
+            eng.close()
+
+    def test_close_fails_stranded_requests(self):
+        pub, view = publish()
+        eng = QueryEngine(pub, cfg=CFG)
+        req = eng.submit("leaderboard", 3)  # never ticked
+        eng._thread = threading.Thread(target=lambda: None)  # fake running
+        eng._thread.start()
+        eng.close()
+        with pytest.raises(RuntimeError, match="engine closed"):
+            req.result(timeout=0)
+
+
+class TestRetraceDiscipline:
+    def test_steady_state_compiles_nothing_after_warmup(self):
+        pub, view = publish(n_players=60)
+        eng = QueryEngine(pub, cfg=CFG, max_batch=32)
+        eng.warmup(view)
+        # One incremental publish first: the patch kernel's single
+        # compile is part of the warmed set, like every other rung.
+        pub.publish_rows(["p1"], rated_table(1, 1, seed=2))
+        baseline = {
+            k: v for k, v in retrace_counts().items()
+            if k.startswith("serve.")
+        }
+        rng = np.random.default_rng(0)
+        # Mixed query-count traffic across the bucket ladder + fresh
+        # same-bucket publishes: everything reuses warmed shapes.
+        pub.publish_rows(["p2"], rated_table(1, 1, seed=3))
+        for count in (1, 3, 8, 17, 32):
+            for _ in range(2):
+                reqs = [
+                    eng.submit("winprob", (("p0", "p1"), ("p2",)))
+                    for _ in range(count)
+                ]
+                reqs.append(eng.submit("ratings", ("p0", "p4", "p9")))
+                reqs.append(eng.submit("percentile", 100.0))
+                reqs.append(eng.submit("leaderboard", int(rng.integers(1, 30))))
+                reqs.append(eng.submit("tiers"))
+                while eng.tick():
+                    pass
+                for r in reqs:
+                    r.result(timeout=0)
+        after = {
+            k: v for k, v in retrace_counts().items()
+            if k.startswith("serve.")
+        }
+        assert after == baseline, "steady-state traffic retraced a kernel"
+
+
+class TestSnapshotConsistency:
+    """The acceptance stress: a publisher thread commits versions while
+    reader threads hammer every query kind. Every response must match
+    the pure-Python oracle's answer for EXACTLY the version it reports
+    — bit-for-bit — and be internally consistent (no torn reads)."""
+
+    N_PLAYERS = 40
+    N_VERSIONS = 12
+
+    @staticmethod
+    def _version_rows(version: int) -> np.ndarray:
+        """mu encodes (version, row) so any cross-version tear in a
+        response is detectable: mu = 1000*v + row, sigma = 100 + row."""
+        rows = np.asarray(
+            PlayerState.create(
+                TestSnapshotConsistency.N_PLAYERS, cfg=CFG
+            ).table
+        ).copy()[: TestSnapshotConsistency.N_PLAYERS]
+        n = rows.shape[0]
+        rows[:, MU_LO] = (1000.0 * version + np.arange(n)).astype(np.float32)
+        rows[:, SIGMA_LO] = (100.0 + np.arange(n)).astype(np.float32)
+        return rows
+
+    def test_concurrent_publish_and_read(self):
+        n = self.N_PLAYERS
+        ids = [f"p{i}" for i in range(n)]
+        matchup = (("p3", "p7", "p11"), ("p2", "p20", "p33"))
+        rows_a = [3, 7, 11]
+        rows_b = [2, 20, 33]
+        pub = ViewPublisher()
+        eng = QueryEngine(pub, cfg=CFG)
+
+        expected = {}
+
+        def publish_version(v: int):
+            rows = self._version_rows(v)
+            view = pub.publish_rows(ids, rows)
+            host = view.host_table()
+            expected[view.version] = {
+                "leaderboard": [
+                    (view.id_of(r), float(s))
+                    for r, s in oracle.leaderboard(host, n, 5)
+                ],
+                "winprob": float(
+                    oracle.win_probability(host, rows_a, rows_b, CFG.beta2)
+                ),
+                "quality": float(
+                    oracle.quality(host, rows_a, rows_b, CFG.beta2)
+                ),
+                "tiers": oracle.tier_histogram(host, n, eng.tier_edges)[0],
+            }
+
+        publish_version(1)
+        eng.start()
+        stop = threading.Event()
+        failures: list = []
+
+        def publisher_thread():
+            for v in range(2, self.N_VERSIONS + 1):
+                publish_version(v)
+            stop.set()
+
+        def reader_thread(seed: int):
+            rng = np.random.default_rng(seed)
+            try:
+                iters = 0
+                # Hammer while the publisher runs, then a tail of
+                # post-stop queries so every reader checks the final
+                # version too (and the loop is bounded either way).
+                while iters < 400 and (not stop.is_set() or iters < 12):
+                    iters += 1
+                    kind = rng.integers(0, 4)
+                    if kind == 0:
+                        resp = eng.get_ratings(
+                            [f"p{i}" for i in rng.choice(n, 4, replace=False)]
+                        )
+                        v = resp["version"]
+                        for r in resp["ratings"]:
+                            row = int(r["id"][1:])
+                            # The torn-read detector: every mu in ONE
+                            # response must decode to the SAME version.
+                            assert r["mu"] == 1000.0 * v + row, (
+                                "torn read", v, r
+                            )
+                    elif kind == 1:
+                        resp = eng.leaderboard(5)
+                        got = [
+                            (e["id"], float(np.float32(e["conservative"])))
+                            for e in resp["leaders"]
+                        ]
+                        assert got == expected[resp["version"]][
+                            "leaderboard"
+                        ], ("leaderboard mismatch", resp["version"])
+                    elif kind == 2:
+                        resp = eng.win_probability(*matchup)
+                        exp = expected[resp["version"]]
+                        assert resp["p_a"] == exp["winprob"]
+                        assert resp["quality"] == exp["quality"]
+                    else:
+                        resp = eng.tier_histogram()
+                        assert resp["counts"] == expected[resp["version"]][
+                            "tiers"
+                        ]
+            except BaseException as err:  # noqa: BLE001 — surfaced below
+                failures.append(err)
+
+        readers = [
+            threading.Thread(target=reader_thread, args=(s,))
+            for s in range(4)
+        ]
+        pub_t = threading.Thread(target=publisher_thread)
+        for t in readers:
+            t.start()
+        pub_t.start()
+        pub_t.join(timeout=60)
+        for t in readers:
+            t.join(timeout=60)
+        eng.close()
+        assert not failures, failures[0]
+        assert pub.version == self.N_VERSIONS
+
+
+class TestServeServer:
+    @pytest.fixture()
+    def served(self):
+        pub, view = publish()
+        eng = QueryEngine(pub, cfg=CFG).start()
+        srv = ServeServer(eng, port=0)
+        yield pub, view, eng, srv
+        srv.close()
+        eng.close()
+
+    def test_endpoints_round_trip(self, served):
+        pub, view, eng, srv = served
+        host = view.host_table()
+        code, body = http_get(srv.url + "/v1/ratings?ids=p0,p1,ghost")
+        assert code == 200
+        assert body["unknown"] == ["ghost"] and body["version"] == 1
+        code, body = http_get(srv.url + "/v1/leaderboard?k=3")
+        assert code == 200
+        exp = oracle.leaderboard(host, view.n_players, 3)
+        assert [e["id"] for e in body["leaders"]] == [
+            view.id_of(r) for r, _ in exp
+        ]
+        code, body = http_get(srv.url + "/v1/winprob?a=p0,p1&b=p2")
+        assert code == 200
+        assert np.float32(body["p_a"]) == oracle.win_probability(
+            host, [0, 1], [2], CFG.beta2
+        )
+        code, body = http_get(srv.url + "/v1/tiers?score=250")
+        assert code == 200
+        below, rated = oracle.percentile(host, view.n_players, 250.0)
+        assert body["below"] == below and body["rated"] == rated
+
+    def test_error_codes(self, served):
+        pub, view, eng, srv = served
+        assert http_get(srv.url + "/v1/ratings")[0] == 400
+        assert http_get(srv.url + "/v1/leaderboard?k=zero")[0] == 400
+        assert http_get(srv.url + "/v1/leaderboard?k=0")[0] == 400
+        assert http_get(srv.url + "/v1/winprob?a=p0")[0] == 400
+        code, body = http_get(srv.url + "/v1/winprob?a=p0&b=ghost")
+        assert code == 404 and "ghost" in body["error"]
+        assert http_get(srv.url + "/v1/winprob?a=p0,p1,p2,p3,p4,p5&b=p6")[0] == 400
+        assert http_get(srv.url + "/nope")[0] == 404
+
+    def test_unpublished_view_is_503(self):
+        eng = QueryEngine(ViewPublisher(), cfg=CFG).start()
+        srv = ServeServer(eng, port=0)
+        try:
+            code, body = http_get(srv.url + "/v1/leaderboard")
+            assert code == 503
+            assert "no ratings view" in body["error"]
+        finally:
+            srv.close()
+            eng.close()
+
+    def test_queries_total_counter_moves(self, served):
+        pub, view, eng, srv = served
+        before = get_registry().counter("serve.queries_total").value
+        http_get(srv.url + "/v1/leaderboard?k=2")
+        assert get_registry().counter("serve.queries_total").value > before
+
+
+def mk_match(api_id: str, created_at=0, tier=10):
+    from tests.fakes import (
+        fake_items, fake_match, fake_participant, fake_player, fake_roster,
+    )
+
+    players = [fake_player(skill_tier=tier) for _ in range(6)]
+    for i, p in enumerate(players):
+        p.api_id = f"{api_id}_pl{i}"
+    rosters = []
+    for t in range(2):
+        parts = [
+            fake_participant(
+                player=players[t * 3 + s], items=fake_items(),
+                skill_tier=tier,
+            )
+            for s in range(3)
+        ]
+        rosters.append(fake_roster(winner=int(t == 0), participants=parts))
+    m = fake_match("ranked", rosters, api_id=api_id)
+    m.created_at = created_at
+    return m
+
+
+class TestWorkerIntegration:
+    def _rig(self, **kw):
+        broker = InMemoryBroker()
+        store = InMemoryStore()
+        cfg = ServiceConfig(batch_size=4, idle_timeout=0.0)
+        worker = Worker(broker, store, cfg, serve_port=0, **kw)
+        return broker, store, worker
+
+    def _feed(self, broker, store, prefix: str, n=4, t0=0):
+        for i in range(n):
+            mid = f"{prefix}{i}"
+            store.add_match(mk_match(mid, created_at=t0 + i))
+            broker.publish("analyze", mid.encode())
+
+    def test_commit_publishes_and_serves_store_truth(self):
+        broker, store, worker = self._rig()
+        try:
+            assert worker.stats()["serve"]["view_version"] is None
+            self._feed(broker, store, "a")
+            assert worker.poll()
+            s = worker.stats()["serve"]
+            assert s["view_version"] == 1
+            pid = "a0_pl0"
+            code, body = http_get(
+                worker.serve_server.url + f"/v1/ratings?ids={pid}"
+            )
+            assert code == 200
+            player = next(
+                p for m in store.matches.values() for r in m.rosters
+                for part in r.participants for p in part.player
+                if p.api_id == pid
+            )
+            assert np.float32(body["ratings"][0]["mu"]) == np.float32(
+                player.trueskill_mu
+            )
+            # A second commit publishes version 2.
+            self._feed(broker, store, "b", t0=10)
+            assert worker.poll()
+            assert worker.stats()["serve"]["view_version"] == 2
+        finally:
+            worker.close()
+
+    def test_readyz_serve_view_flip(self):
+        broker = InMemoryBroker()
+        store = InMemoryStore()
+        cfg = ServiceConfig(batch_size=2, idle_timeout=0.0)
+        worker = Worker(broker, store, cfg, obs_port=0, serve_port=0)
+        try:
+            health = worker.obs_server.health.run()
+            assert health["serve.view"][0] is False
+            self._feed(broker, store, "r", n=2)
+            assert worker.poll()
+            ok, detail = worker.obs_server.health.run()["serve.view"]
+            assert ok and "v1" in detail
+        finally:
+            worker.close()
+
+    def test_pipelined_commit_publishes_after_harvest(self):
+        from tests.test_pipeline import build_mem_store, consume_all
+
+        store, ids = build_mem_store(48, 14, seed=3)
+        broker = InMemoryBroker()
+        cfg = ServiceConfig(batch_size=8, idle_timeout=0.0)
+        worker = Worker(
+            broker, store, cfg, RatingConfig(), pipeline=True, serve_port=0,
+        )
+        publisher = worker.view_publisher
+        engine = worker.query_engine
+        url = worker.serve_server.url
+        consume_all(worker, broker, cfg, ids)  # closes the worker
+        assert publisher.version >= 6  # one publish per committed batch
+        view = publisher.current()
+        # The served values equal the store's committed truth for every
+        # player the view knows.
+        host = view.host_table()
+        for pid, player in store.players.items():
+            row = view.resolve(pid)
+            if row is None or player.trueskill_mu is None:
+                continue
+            assert np.float32(host[row, MU_LO]) == np.float32(
+                player.trueskill_mu
+            )
+            assert np.float32(host[row, SIGMA_LO]) == np.float32(
+                player.trueskill_sigma
+            )
+
+
+class TestSchedViewPublisher:
+    def _stream(self, n_matches=40, n_players=30):
+        from analyzer_tpu.io.synthetic import (
+            synthetic_players, synthetic_stream,
+        )
+
+        players = synthetic_players(n_players, seed=0)
+        return synthetic_stream(n_matches, players, seed=0), n_players
+
+    def test_rate_history_publishes_final_state(self):
+        from analyzer_tpu.sched import pack_schedule, rate_history
+
+        stream, n_players = self._stream()
+        state = PlayerState.create(n_players, cfg=CFG)
+        sched = pack_schedule(stream, pad_row=state.pad_row)
+        pub = ViewPublisher(min_publish_interval_s=0.0)
+        final, _ = rate_history(
+            state, sched, CFG, view_publisher=pub
+        )
+        view = pub.current()
+        assert view is not None
+        # Player rows only: the pad row carries scatter garbage by
+        # design and the publisher normalizes it to NaN.
+        assert np.array_equal(
+            view.host_table()[:n_players],
+            np.asarray(final.table)[:n_players],
+            equal_nan=True,
+        )
+        assert view.resolve(str(n_players - 1)) == n_players - 1
+
+    def test_rate_stream_publishes_final_state(self):
+        from analyzer_tpu.sched import rate_stream
+
+        stream, n_players = self._stream()
+        state = PlayerState.create(n_players, cfg=CFG)
+        pub = ViewPublisher()
+        final, _ = rate_stream(state, stream, CFG, view_publisher=pub)
+        view = pub.current()
+        assert view is not None
+        assert np.array_equal(
+            view.host_table()[:n_players],
+            np.asarray(final.table)[:n_players],
+            equal_nan=True,
+        )
+
+
+class TestServeBenchdiffFamily:
+    def _artifact(self, qps: float, p99: float, degraded=False) -> dict:
+        return {
+            "metric": "serve.queries_per_sec", "value": qps,
+            "latency_ms": {"p50": p99 / 2, "p99": p99},
+            "capture": {"degraded": degraded},
+        }
+
+    def test_serve_configs_gate_both_axes(self):
+        from analyzer_tpu.obs.benchdiff import bench_configs, diff_configs
+
+        a = bench_configs(self._artifact(10000.0, 20.0))
+        assert [(c.name, c.higher_is_better) for c in a] == [
+            ("serve.queries_per_sec", True), ("serve.p99_ms", False),
+        ]
+        # qps regression gates; p99 regression (latency UP) gates.
+        b = bench_configs(self._artifact(8000.0, 30.0))
+        rows = diff_configs(a, b, regress_pct=5.0)
+        assert all(r.regressed and r.gated for r in rows)
+        # Improvement on both axes passes.
+        b = bench_configs(self._artifact(20000.0, 10.0))
+        assert not any(r.regressed for r in diff_configs(a, b, 5.0))
+
+    def test_family_scan_and_cli_gate(self, tmp_path, capsys):
+        from analyzer_tpu import cli
+        from analyzer_tpu.obs.benchdiff import find_bench_artifacts
+
+        (tmp_path / "BENCH_r01.json").write_text(
+            json.dumps({"metric": "x", "value": 1.0})
+        )
+        for name, qps, p99 in (
+            ("SERVE_BENCH_r01.json", 10000.0, 20.0),
+            ("SERVE_BENCH_r02.json", 5000.0, 60.0),
+        ):
+            (tmp_path / name).write_text(
+                json.dumps(self._artifact(qps, p99))
+            )
+        assert [p.split("/")[-1] for p in
+                find_bench_artifacts(str(tmp_path), family="serve")] == [
+            "SERVE_BENCH_r01.json", "SERVE_BENCH_r02.json",
+        ]
+        assert [p.split("/")[-1] for p in
+                find_bench_artifacts(str(tmp_path))] == ["BENCH_r01.json"]
+        rc = cli.main([
+            "benchdiff", "--against-latest", "--family", "serve",
+            "--dir", str(tmp_path),
+        ])
+        out = capsys.readouterr().out
+        assert rc == 1  # r02 halved qps + tripled p99: gated regression
+        assert "serve.queries_per_sec" in out and "serve.p99_ms" in out
+
+
+class TestStatsServeKeys:
+    def test_engine_stats_schema(self):
+        pub, view = publish()
+        eng = QueryEngine(pub, cfg=CFG)
+        s = eng.stats()
+        assert set(s) == {"view_version", "view_age_s", "queries_total"}
+        assert s["view_version"] == 1 and s["queries_total"] == 0
+        eng.leaderboard(2)
+        assert eng.stats()["queries_total"] == 1

@@ -644,7 +644,11 @@ class TestStats:
         "resolved_pipeline_lag",
         "measured_rtt_ms",
         "measured_host_ms",
+        "serve",
     }
+
+    #: The serving plane's nested keys when serve_port is on (ISSUE 4).
+    SERVE_SCHEMA = {"view_version", "view_age_s", "queries_total"}
 
     def test_stats_key_schema_exact(self, rig):
         broker, store, worker = rig
@@ -668,6 +672,24 @@ class TestStats:
         assert s["pipeline_degraded"] is False
         assert s["pipeline_lag"] is None
         assert s["resolved_pipeline_lag"] is None
+        # No serving plane in this rig: the key is present, value None.
+        assert s["serve"] is None
+
+    def test_stats_serve_keys_when_serving(self):
+        broker = InMemoryBroker()
+        w = Worker(
+            broker, InMemoryStore(),
+            ServiceConfig(batch_size=2, idle_timeout=0.0),
+            serve_port=0,
+        )
+        try:
+            s = w.stats()
+            assert set(s) == self.STATS_SCHEMA
+            assert set(s["serve"]) == self.SERVE_SCHEMA
+            assert s["serve"]["view_version"] is None  # nothing committed
+            assert s["serve"]["queries_total"] == 0
+        finally:
+            w.close()
 
     def test_stats_resolved_lag_reported_pre_engine(self):
         # Pipelined config + pinned lag: the lag must be visible BEFORE
