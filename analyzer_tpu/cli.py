@@ -211,6 +211,7 @@ def _rate_streamed(
         state, _ = rate_stream(
             state, stream.slice(cursor, stream.n_matches), cfg,
             stats_out=stats, mesh=mesh,
+            prefetch_depth=getattr(args, "prefetch_depth", None),
         )
         np.asarray(state.table[:1])  # force completion for honest timing
     if finalize is not None:
@@ -349,7 +350,7 @@ def _cmd_rate_impl(args) -> int:
     if args.resume and not args.checkpoint:
         print("error: --resume requires --checkpoint", file=sys.stderr)
         return 2
-    for flag in ("checkpoint_every", "stop_after_steps"):
+    for flag in ("checkpoint_every", "stop_after_steps", "prefetch_depth"):
         val = getattr(args, flag)
         if val is not None and val <= 0:
             print(f"error: --{flag.replace('_', '-')} must be positive",
@@ -442,6 +443,7 @@ def _cmd_rate_impl(args) -> int:
                     min(8192, args.checkpoint_every) if args.checkpoint_every else None
                 ),
                 on_chunk=on_chunk,
+                prefetch_depth=args.prefetch_depth,
             )
             np.asarray(state.table[:1])  # force completion for honest timing
     finally:
@@ -563,6 +565,7 @@ def _rate_mesh(args, cfg, timer) -> int:
                 steps_per_chunk=(
                     min(1024, args.checkpoint_every) if args.checkpoint_every else 1024
                 ),
+                prefetch_depth=args.prefetch_depth,
             )
             np.asarray(state.table[:1])
     finally:
@@ -1156,6 +1159,13 @@ def main(argv=None) -> int:
         help="serve live introspection endpoints (/metrics /healthz "
         "/readyz /statusz /debug/snapshot) on localhost:PORT for the "
         "duration of the run (0 = ephemeral; docs/observability.md)",
+    )
+    s.add_argument(
+        "--prefetch-depth", type=int, metavar="N",
+        help="device-feed slab ring depth (default 2): how many windows "
+        "ahead the feed thread materializes + transfers while the scan "
+        "runs; results are depth-invariant, HBM cost is N slabs "
+        "(docs/observability.md, 'Prefetching device feed')",
     )
     s.set_defaults(fn=cmd_rate)
 

@@ -41,6 +41,10 @@ RULES: dict[str, str] = {
         "listening socket outside analyzer_tpu/obs/ + analyzer_tpu/serve/, "
         "or a bare 0.0.0.0 bind"
     ),
+    "GL025": (
+        "blocking device sync (np.asarray on a device array / "
+        ".block_until_ready()) in the sched feed hot path"
+    ),
 }
 
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")

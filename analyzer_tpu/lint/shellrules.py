@@ -1,5 +1,6 @@
-"""Service-shell rules (GL020-GL024): exception hygiene, mutable
-defaults, raw-clock timing, and network-surface containment.
+"""Service-shell rules (GL020-GL025): exception hygiene, mutable
+defaults, raw-clock timing, network-surface containment, and
+feed-serializing host syncs.
 
 GL020-GL022 target the worker/pipeline layer's failure-policy code, where
 a too-broad catch silently converts "the native extension is broken" into
@@ -21,6 +22,19 @@ nowhere else; and a bare ``"0.0.0.0"`` literal is flagged EVERYWHERE,
 those planes included, because every endpoint must default to loopback
 (an all-interfaces bind is an operator's explicit runtime decision,
 never a code default).
+
+GL025 is PATH-SCOPED to ``analyzer_tpu/sched/``, the prefetched device
+feed's hot path (``docs/observability.md``): a blocking
+``np.asarray(<device array>)`` or ``.block_until_ready()`` there
+serializes the very overlap the feed exists for — the consumer stalls
+on one chunk's result instead of dispatching the next. Chunk-boundary
+syncs that are INTENTIONAL (the final fetch, a checkpoint hook's
+snapshot) route through ``utils.host.fetch_tree`` /
+``copy_to_host_async`` or carry a line-scoped
+``# graftlint: disable=GL025`` with a reason. The linter cannot prove
+an argument is a device array, so literal arguments (tuples, constants)
+are exempt and everything else in the scoped layer flags —
+conservative in exactly the direction the hot path wants.
 """
 
 from __future__ import annotations
@@ -28,9 +42,17 @@ from __future__ import annotations
 import ast
 
 from analyzer_tpu.lint.findings import Finding
+from analyzer_tpu.lint.jaxrules import _Imports
 
 #: Directories where GL023 applies (normalized path fragments).
 _GL023_DIRS = ("analyzer_tpu/service/", "analyzer_tpu/sched/")
+
+#: Directories where GL025 applies: the scan runners + feed hot path.
+_GL025_DIRS = ("analyzer_tpu/sched/",)
+
+#: Literal argument forms GL025 exempts — a host-built literal can never
+#: be a device array (e.g. the fingerprint's np.asarray((a, b), int64)).
+_LITERAL_ARGS = (ast.Constant, ast.Tuple, ast.List, ast.Dict, ast.Set)
 
 #: The sanctioned homes for a listening socket (GL024): the obsd
 #: introspection plane (+ its shared httpd plumbing) and the ratesrv
@@ -69,6 +91,7 @@ class ShellRules:
     def __init__(self, path: str, tree: ast.Module):
         self.path = path
         self.tree = tree
+        self.imports = _Imports(tree)
         self.findings: list[Finding] = []
 
     def _flag(self, rule: str, node: ast.AST, msg: str) -> None:
@@ -79,13 +102,17 @@ class ShellRules:
     def run(self) -> list[Finding]:
         timed_layer = self._in_timed_layer()
         obs_layer = self._in_obs_layer()
+        feed_layer = self._in_feed_layer()
         for node in ast.walk(self.tree):
             if isinstance(node, ast.Try):
                 self._check_try(node)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._check_defaults(node)
-            elif timed_layer and isinstance(node, ast.Call):
-                self._check_raw_clock(node)
+            elif isinstance(node, ast.Call):
+                if timed_layer:
+                    self._check_raw_clock(node)
+                if feed_layer:
+                    self._check_device_sync(node)
             elif not obs_layer and isinstance(
                 node, (ast.Import, ast.ImportFrom)
             ):
@@ -110,6 +137,10 @@ class ShellRules:
     def _in_obs_layer(self) -> bool:
         path = self.path.replace("\\", "/")
         return any(frag in path for frag in _GL024_SOCKET_DIRS)
+
+    def _in_feed_layer(self) -> bool:
+        path = self.path.replace("\\", "/")
+        return any(frag in path for frag in _GL025_DIRS)
 
     def _check_server_import(self, node) -> None:
         """GL024: a listening-socket module imported outside
@@ -152,6 +183,44 @@ class ShellRules:
                 "analyzer_tpu.obs (PhaseTimer / tracer spans), or "
                 "disable with a reason if the clock feeds a non-metrics "
                 "contract",
+            )
+
+    def _check_device_sync(self, node: ast.Call) -> None:
+        """GL025: a blocking host sync in the sched feed/runner hot path.
+
+        ``x.block_until_ready()`` always flags; ``np.asarray``/
+        ``np.array`` (resolved through the module's imports) flags when
+        the first argument is not an obvious host literal — in this
+        layer the non-literal argument is a (potential) device array and
+        the call a serializing D2H fetch. The sanctioned patterns are
+        ``utils.host.fetch_tree`` (async-started tree fetch) and
+        ``copy_to_host_async`` at chunk boundaries; a deliberate sync
+        carries a line-scoped disable with a reason."""
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "block_until_ready":
+            self._flag(
+                "GL025", node,
+                ".block_until_ready() in the sched hot path serializes "
+                "the prefetched feed (the consumer stalls instead of "
+                "dispatching the next chunk); let the data dependency "
+                "synchronize, or disable with a reason at an intentional "
+                "chunk-boundary sync",
+            )
+            return
+        resolved = self.imports.resolve(f)
+        if (
+            resolved in ("numpy.asarray", "numpy.array")
+            and node.args
+            and not isinstance(node.args[0], _LITERAL_ARGS)
+        ):
+            self._flag(
+                "GL025", node,
+                "np.asarray/np.array on a (potential) device array in "
+                "the sched hot path is a blocking D2H fetch that "
+                "serializes the prefetched feed; use "
+                "utils.host.fetch_tree / copy_to_host_async at chunk "
+                "boundaries, or disable with a reason for an "
+                "intentional sync",
             )
 
     def _check_try(self, node: ast.Try) -> None:

@@ -72,7 +72,8 @@ def bench_configs(data: dict) -> list[BenchConfig]:
 
     Write family (``BENCH_*``): the headline throughput (higher is
     better) and, when present, the streamed end-to-end minimum (seconds
-    — lower is better). Serve family (``SERVE_BENCH_*``, metric
+    — lower is better) plus the streamed ``min_over_device`` ratio
+    (lower is better; the feed-overlap gate). Serve family (``SERVE_BENCH_*``, metric
     ``serve.*``): coalesced queries/sec (higher) and the client-observed
     p99 latency in ms (lower) from the ``latency_ms`` block."""
     degraded = bool((data.get("capture") or {}).get("degraded"))
@@ -102,6 +103,20 @@ def bench_configs(data: dict) -> list[BenchConfig]:
             BenchConfig(
                 name="streamed.min_s",
                 value=float(streamed["min_s"]),
+                higher_is_better=False,
+                degraded=degraded or not streamed.get("stable", True),
+            )
+        )
+    if streamed.get("min_over_device") is not None:
+        # The streamed-feed overlap ratio (end-to-end min / device-only
+        # min, lower is better; 1.0 = the feed fully hides behind the
+        # device scan). Gated alongside the absolute seconds: a future
+        # change that re-serializes the feed moves this ratio even when
+        # a faster kernel or a quieter tunnel masks the absolute time.
+        out.append(
+            BenchConfig(
+                name="streamed.min_over_device",
+                value=float(streamed["min_over_device"]),
                 higher_is_better=False,
                 degraded=degraded or not streamed.get("stable", True),
             )

@@ -178,6 +178,12 @@ STANDARD_COUNTERS = (
     "worker.pipeline_engine_failures_total",
     "sched.pad_steps_total",
     "sched.pad_slots_total",
+    # The prefetching device feed (sched/feed.py): starved = the consumer
+    # outran the feed (host-bound), backpressure = the feed outran the
+    # device (healthy). Pre-declared so "feed never starved" reads as 0,
+    # not as a missing series.
+    "feed.starved_total",
+    "feed.backpressure_total",
     "mesh.put_bytes_total",
     "mesh.puts_total",
     "jax.retraces_total",
@@ -192,6 +198,9 @@ STANDARD_GAUGES = (
     "worker.pipeline_inflight",
     "worker.matches_per_sec",
     "sched.occupancy",
+    # Slab-ring occupancy of the prefetching device feed after the last
+    # put/get (sched/feed.py): steady 0 on a busy run = host-bound.
+    "feed.depth",
     # Per-device series (device.hbm_bytes_in_use{device=...}) appear on
     # first sample; the process total is pre-declared.
     "device.live_buffers",

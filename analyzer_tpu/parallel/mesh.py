@@ -81,7 +81,7 @@ from analyzer_tpu.config import RatingConfig
 from analyzer_tpu.core.state import MatchBatch, PlayerState
 from analyzer_tpu.core.update import rate_gathered
 from analyzer_tpu.logging_utils import get_logger
-from analyzer_tpu.obs import get_registry
+from analyzer_tpu.obs import get_registry, get_tracer
 from analyzer_tpu.sched.superstep import PackedSchedule
 
 logger = get_logger(__name__)
@@ -446,6 +446,41 @@ class ShardedRun:
             dst = np.concatenate([dst, pad + self.rps], axis=2)
         return sel, dst
 
+    def stage(
+        self,
+        pidx: np.ndarray,
+        mask: np.ndarray,
+        winner: np.ndarray,
+        mode_id: np.ndarray,
+        afk: np.ndarray,
+        sel: np.ndarray | None = None,
+        dst: np.ndarray | None = None,
+    ) -> tuple:
+        """The HOST half of :meth:`dispatch`: routes (unless precomputed
+        sel/dst are given) and device-commits one window's arrays
+        without running it. Touches neither the table nor the step fn,
+        so a prefetch thread (``sched.feed``) can stage window k+1 while
+        the consumer thread executes window k. ``mask`` is consumed
+        host-side (routing) only — the device derives it from
+        ``pidx != pad_row``, and winner/mode cross the link as int8
+        (the step fn widens them)."""
+        if sel is None:
+            sel, dst = self._route_window(pidx, mask, mode_id, afk)
+        return (
+            _put_global(pidx, self._batch_sh),
+            _put_global(winner.astype(np.int8), self._batch_sh),
+            _put_global(mode_id.astype(np.int8), self._batch_sh),
+            _put_global(afk, self._batch_sh),
+            _put_global(sel, self._route_sh),
+            _put_global(dst, self._route_sh),
+        )
+
+    def dispatch_staged(self, staged: tuple) -> None:
+        """Runs one staged window (donates and replaces the carried
+        table). Consumer-thread only — the donation chain on the table
+        is what serializes windows."""
+        self._table = self._step_fn(self._table, *staged)
+
     def dispatch(
         self,
         pidx: np.ndarray,
@@ -456,22 +491,11 @@ class ShardedRun:
         sel: np.ndarray | None = None,
         dst: np.ndarray | None = None,
     ) -> None:
-        """Routes (unless precomputed sel/dst are given) and runs one
-        window. Async — returns at dispatch, so the caller's next window
-        materialization overlaps this window's device execution.
-        ``mask`` is consumed host-side (routing) only — the device
-        derives it from ``pidx != pad_row``, and winner/mode cross the
-        link as int8 (the step fn widens them)."""
-        if sel is None:
-            sel, dst = self._route_window(pidx, mask, mode_id, afk)
-        self._table = self._step_fn(
-            self._table,
-            _put_global(pidx, self._batch_sh),
-            _put_global(winner.astype(np.int8), self._batch_sh),
-            _put_global(mode_id.astype(np.int8), self._batch_sh),
-            _put_global(afk, self._batch_sh),
-            _put_global(sel, self._route_sh),
-            _put_global(dst, self._route_sh),
+        """Stage + run one window in one call. Async — returns at
+        dispatch, so the caller's next window materialization overlaps
+        this window's device execution."""
+        self.dispatch_staged(
+            self.stage(pidx, mask, winner, mode_id, afk, sel, dst)
         )
 
     def call_hook(self, on_chunk, next_step: int) -> None:
@@ -515,6 +539,7 @@ def rate_history_sharded(
     on_chunk=None,
     routing: Routing | None = None,
     routing_capacity: int | None = None,
+    prefetch_depth: int | None = None,
 ) -> PlayerState:
     """Full-history re-rate, data-parallel over the mesh. Returns final state.
 
@@ -531,6 +556,14 @@ def rate_history_sharded(
     runs on the same eager schedule); it is validated against the mesh and
     table shape. ``routing_capacity`` presets the per-window routing
     bucket (K) so a resumed run compiles the same shapes up front.
+
+    The feed rides the bounded prefetcher (``sched.feed``,
+    ``prefetch_depth`` default 2): window materialization, routing, and
+    the sharded ``device_put``s run on a producer thread up to depth
+    windows ahead of the in-flight sharded step — the feed-logistics
+    constant BASELINE.md's D=1 ablation pinned now overlaps device time
+    instead of preceding it. Chunk order, hook boundaries, and results
+    are depth-invariant.
     """
     mesh = mesh or make_mesh()
     n_dev = mesh.devices.size
@@ -569,18 +602,33 @@ def rate_history_sharded(
             f"schedule {sched.n_steps} steps"
         )
 
+    from analyzer_tpu.sched.feed import DEFAULT_DEPTH, Prefetcher
+
     run = ShardedRun(state, cfg, mesh, routing_capacity=routing_capacity)
     n_steps = sched.n_steps if stop_after is None else min(stop_after, sched.n_steps)
-    for start in range(start_step, n_steps, steps_per_chunk):
-        stop = min(start + steps_per_chunk, n_steps)
-        pidx, mask, winner, mode_id, afk = sched.host_window(start, stop)
-        if routing is not None:
-            run.dispatch(
-                pidx, mask, winner, mode_id, afk,
-                sel=routing.sel[start:stop], dst=routing.dst[start:stop],
-            )
-        else:
-            run.dispatch(pidx, mask, winner, mode_id, afk)
-        if on_chunk is not None:
-            run.call_hook(on_chunk, stop)
+    tracer = get_tracer()
+
+    def produce(put) -> None:
+        for start in range(start_step, n_steps, steps_per_chunk):
+            stop = min(start + steps_per_chunk, n_steps)
+            with tracer.span("feed.materialize", cat="mesh", start=start):
+                pidx, mask, winner, mode_id, afk = sched.host_window(
+                    start, stop
+                )
+            with tracer.span("feed.transfer", cat="mesh", start=start):
+                staged = run.stage(
+                    pidx, mask, winner, mode_id, afk,
+                    sel=routing.sel[start:stop] if routing is not None else None,
+                    dst=routing.dst[start:stop] if routing is not None else None,
+                )
+            put((stop, staged))
+
+    with Prefetcher(
+        produce, depth=prefetch_depth or DEFAULT_DEPTH, name="mesh-feed"
+    ) as pf:
+        for stop, staged in pf:
+            run.dispatch_staged(staged)
+            del staged
+            if on_chunk is not None:
+                run.call_hook(on_chunk, stop)
     return run.finish()

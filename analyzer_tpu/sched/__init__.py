@@ -20,11 +20,14 @@ from analyzer_tpu.sched.superstep import (
     choose_batch_size_streamed,
     pack_schedule,
 )
+from analyzer_tpu.sched.feed import DeviceFeed, Prefetcher
 from analyzer_tpu.sched.runner import HistoryOutputs, rate_history, rate_stream
 
 __all__ = [
+    "DeviceFeed",
     "MatchStream",
     "PackedSchedule",
+    "Prefetcher",
     "WindowedSchedule",
     "assign_batches",
     "assign_supersteps",

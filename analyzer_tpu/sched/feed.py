@@ -1,0 +1,188 @@
+"""Bounded-depth prefetching device feed: the host pipeline that keeps
+the scan runners compute-bound.
+
+BENCH_r05 put the fully-streamed feed (``rate_stream``) at **1.75x**
+device-only time while the windowed ``rate_history`` ran at 1.07x — the
+difference being that ``rate_stream``'s emit loop did window
+materialization (numpy fancy-index gather), the H2D transfer
+(``compact_device_window``), and the ``_scan_chunk`` dispatch
+*synchronously, per window, on one thread*. This module is the tf.data
+prefetch idiom (Murray et al.) applied to that loop:
+
+  * a **producer thread** materializes the next window and issues the
+    (async) ``jax.device_put`` of its slab while the current window's
+    scan is still in flight on the device;
+  * a **bounded ring** (:class:`DeviceFeed`, depth 2-3) holds the
+    committed device slabs, so at most ``depth`` windows of HBM are
+    resident beyond the carry — the backpressure bound;
+  * the **consumer** (the runner's dispatch loop) pops committed slabs
+    and only ever blocks when the ring is empty — i.e. when the feed,
+    not the device, is the bottleneck. That event is *starvation* and it
+    is counted, not guessed at.
+
+Determinism: the producer stages windows strictly in order on one
+thread, so the emitted schedule — and with it the final state and the
+collected outputs — is exactly the synchronous loop's, bit for bit, at
+every depth (pinned by tests/test_feed.py). The ring changes *when*
+work happens, never *what* work happens.
+
+Telemetry (the PR-2 registry; catalog in docs/observability.md):
+
+  * ``feed.depth`` gauge — ring occupancy after the last put/get; a
+    steady 0 with a busy device means the feed can't keep up, a steady
+    ``depth`` means the device is the bottleneck (healthy);
+  * ``feed.starved_total`` — consumer found the ring empty and had to
+    wait. A handful per run is pipeline fill; growing counts on a busy
+    run mean host-bound — raise depth or look at ``feed.materialize``
+    spans;
+  * ``feed.backpressure_total`` — producer found the ring full and had
+    to wait: the healthy steady state (device-bound);
+  * ``feed.materialize`` / ``feed.transfer`` spans — per-window host
+    materialization vs H2D staging cost, on the producer thread.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from analyzer_tpu.obs import get_registry, get_tracer
+
+#: Default ring depth: one slab in flight on the device, one committed
+#: behind it. Depth 3 buys jitter tolerance on hosts where
+#: materialization time varies window to window, at one more slab of HBM.
+DEFAULT_DEPTH = 2
+
+
+class FeedClosedError(RuntimeError):
+    """``put()`` on a feed the consumer already closed (abort path: the
+    consumer raised and tore the run down; the producer must stop)."""
+
+
+class DeviceFeed:
+    """Thread-safe bounded ring of committed window slabs.
+
+    One producer, one consumer. ``put`` blocks while the ring is full
+    (backpressure — the device is behind, which is the healthy state);
+    ``get`` blocks while it is empty (starvation — the feed is behind).
+    ``close()`` ends the stream: a closed-and-drained ``get`` returns
+    ``None``, or raises the error ``close(error=...)`` recorded — the
+    producer's exception surfaces on the consumer thread.
+    """
+
+    def __init__(self, depth: int = DEFAULT_DEPTH) -> None:
+        if depth < 1:
+            raise ValueError(f"feed depth must be >= 1, got {depth}")
+        self.depth = depth
+        self._cond = threading.Condition()
+        self._items: deque = deque()
+        self._closed = False
+        self._error: BaseException | None = None
+        reg = get_registry()
+        self._depth_gauge = reg.gauge("feed.depth")
+        self._starved = reg.counter("feed.starved_total")
+        self._backpressure = reg.counter("feed.backpressure_total")
+
+    def put(self, item) -> None:
+        """Commits one slab; blocks while the ring is at depth."""
+        with self._cond:
+            if len(self._items) >= self.depth and not self._closed:
+                self._backpressure.add(1)
+                while len(self._items) >= self.depth and not self._closed:
+                    self._cond.wait()
+            if self._closed:
+                raise FeedClosedError("feed closed by the consumer")
+            self._items.append(item)
+            self._depth_gauge.set(len(self._items))
+            self._cond.notify_all()
+
+    def get(self):
+        """Next committed slab; ``None`` once closed and drained."""
+        with self._cond:
+            if not self._items and not self._closed:
+                self._starved.add(1)
+                while not self._items and not self._closed:
+                    self._cond.wait()
+            if self._items:
+                item = self._items.popleft()
+                self._depth_gauge.set(len(self._items))
+                self._cond.notify_all()
+                return item
+            if self._error is not None:
+                raise self._error
+            return None
+
+    def close(self, error: BaseException | None = None) -> None:
+        """Ends the stream (idempotent). The first recorded ``error``
+        wins and is raised by the consumer's ``get`` after the drain."""
+        with self._cond:
+            if error is not None and self._error is None:
+                self._error = error
+            self._closed = True
+            self._cond.notify_all()
+
+
+class Prefetcher:
+    """Runs ``producer(put)`` on a worker thread feeding a
+    :class:`DeviceFeed`; iterate the instance to consume.
+
+    ``producer`` is called with the feed's ``put`` and is expected to
+    stage windows in order — materialize on this (worker) thread, issue
+    the async device transfer, then ``put`` the committed slab. When it
+    returns, the feed closes; if it raises, the exception is re-raised
+    from the consumer's iteration. Use as a context manager: ``__exit__``
+    closes the feed (unblocking a producer mid-``put``) and joins the
+    thread, so an abandoned iteration — a consumer exception — cannot
+    leak the producer.
+    """
+
+    def __init__(
+        self, producer, depth: int = DEFAULT_DEPTH, name: str = "sched-feed"
+    ) -> None:
+        self.feed = DeviceFeed(depth)
+        self._thread = threading.Thread(
+            target=self._run, args=(producer,), name=name, daemon=True
+        )
+        self._thread.start()
+
+    def _run(self, producer) -> None:
+        try:
+            producer(self.feed.put)
+        except FeedClosedError:
+            pass  # consumer aborted first; its exception is the story
+        except BaseException as e:  # noqa: BLE001 — re-raised on the consumer
+            self.feed.close(error=e)
+        else:
+            self.feed.close()
+
+    def __iter__(self):
+        while True:
+            item = self.feed.get()
+            if item is None:
+                return
+            yield item
+
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.feed.close()
+        self._thread.join()
+        return False
+
+
+def stage_chunk(sched, start: int, stop: int):
+    """Producer-side staging of one schedule window: host materialization
+    (``feed.materialize`` span) then the async H2D commit of the compact
+    slab (``feed.transfer`` span). Hand-built eager schedules get the
+    same compact-feed invariant check ``device_arrays`` would apply."""
+    from analyzer_tpu.sched.superstep import compact_device_window
+
+    check = getattr(sched, "check_compact_invariant", None)
+    if check is not None:
+        check(start, stop)
+    tracer = get_tracer()
+    with tracer.span("feed.materialize", cat="sched", start=start):
+        pidx, _mask, winner, mode_id, afk = sched.host_window(start, stop)
+    with tracer.span("feed.transfer", cat="sched", start=start):
+        return compact_device_window(pidx, winner, mode_id, afk)

@@ -31,6 +31,7 @@ from analyzer_tpu.obs import (
     maybe_sample_device_memory,
     track_jit,
 )
+from analyzer_tpu.sched.feed import DEFAULT_DEPTH, Prefetcher, stage_chunk
 from analyzer_tpu.sched.superstep import (
     PackedSchedule,
     compact_device_window,
@@ -121,6 +122,7 @@ def rate_history(
     stop_after: int | None = None,
     on_chunk=None,
     view_publisher=None,
+    prefetch_depth: int | None = None,
 ) -> tuple[PlayerState, HistoryOutputs | None]:
     """Rates a packed history. Returns the final state and, when
     ``collect``, per-match outputs reordered back to stream order.
@@ -140,6 +142,13 @@ def rate_history(
     index) plus one forced publish of the final state — same device-sync
     cost profile as the checkpoint hook, governed by the publisher's
     ``min_publish_interval_s``.
+
+    ``prefetch_depth`` sizes the device feed's slab ring
+    (:mod:`analyzer_tpu.sched.feed`, default 2): window materialization
+    and the H2D transfer run on a producer thread up to ``depth``
+    windows ahead of the in-flight scan. Depth changes overlap only —
+    the chunk sequence, hook boundaries, and results are identical at
+    every depth.
     """
     n_steps = sched.n_steps if stop_after is None else min(stop_after, sched.n_steps)
     if steps_per_chunk is None:
@@ -156,63 +165,56 @@ def rate_history(
     reg = get_registry()
     reg.gauge("sched.occupancy").set(round(sched.occupancy, 4))
     reg.counter("sched.steps_total").add(max(0, n_steps - start_step))
-    # Double-buffered feed: the [S',B,...] slab for chunk k+1 is put on
-    # device while chunk k's scan runs. jax dispatch is async, so the only
-    # host blocking in the loop is the staging copy of the NEXT slab —
-    # which overlaps the device executing the CURRENT chunk. The spans
-    # mirror that split: batch.compute is ENQUEUE cost, batch.transfer is
-    # the (overlapped) slab staging, batch.fetch is where device time
-    # actually surfaces on the host.
+    # Prefetched feed (sched/feed.py): a producer thread materializes
+    # window k+j (j <= depth) and issues its async device_put while the
+    # device executes chunk k, a bounded ring holding the committed
+    # slabs. The consumer loop below only dispatches, fetches, and runs
+    # hooks; the spans mirror that split — feed.materialize/feed.transfer
+    # on the producer thread, batch.compute is ENQUEUE cost, batch.fetch
+    # is where device time actually surfaces on the host.
     starts = list(range(start_step, n_steps, steps_per_chunk))
-    with tracer.span("batch.transfer", cat="sched", start=start_step):
-        arrays = (
-            sched.device_arrays(
-                starts[0], min(starts[0] + steps_per_chunk, n_steps)
-            )
-            if starts
-            else None
-        )
+
+    def produce(put) -> None:
+        for start in starts:
+            stop = min(start + steps_per_chunk, n_steps)
+            put((start, stop, stage_chunk(sched, start, stop)))
+
     pending = None  # chunk k-1's outputs: fetched AFTER dispatching k
-    for i, start in enumerate(starts):
-        with tracer.span("batch.compute", cat="sched", start=start):
-            state, ys = _scan_chunk(
-                state, arrays, cfg, collect, sched.pad_row
-            )  # async dispatch
-        arrays = None  # let the consumed slab free as soon as the scan is done
-        if i + 1 < len(starts):  # stage k+1's slab while k executes
-            with tracer.span(
-                "batch.transfer", cat="sched", start=starts[i + 1]
-            ):
-                arrays = sched.device_arrays(
-                    starts[i + 1],
-                    min(starts[i + 1] + steps_per_chunk, n_steps),
-                )
-        if collect:
-            # One-chunk-deep fetch pipelining: start k's D2H stream now
-            # and materialize k-1's (whose transfer has been in flight a
-            # whole chunk) — without this every chunk pays a cold ~100 ms
-            # tunnel round trip SERIALLY, which the service path's fixed
-            # 8-step chunks turned into ceil(steps/8) RTTs per deep batch.
-            try:
-                ys.copy_to_host_async()
-            except AttributeError:  # pragma: no cover — older jax arrays
-                pass
-            if pending is not None:
-                with tracer.span("batch.fetch", cat="sched", start=start):
-                    outs.append(fetch_tree(pending))
-            pending = ys
-        if on_chunk is not None:
-            on_chunk(state, min(start + steps_per_chunk, n_steps))
-        if view_publisher is not None:
-            # Throttled view publish BEFORE the next chunk dispatches:
-            # the carry buffer is about to be donated, so the publisher
-            # fetches its host copy here or not at all.
-            view_publisher.maybe_publish_state(state)
-        # HBM-occupancy gauges at chunk boundaries (throttled inside —
-        # device.hbm_bytes_in_use / device.live_buffers, obs/devicemem.py):
-        # a run creeping toward the HBM ceiling shows up in /metrics and
-        # the bench telemetry block BEFORE it OOMs.
-        maybe_sample_device_memory()
+    with Prefetcher(produce, depth=prefetch_depth or DEFAULT_DEPTH) as pf:
+        for start, stop, arrays in pf:
+            with tracer.span("batch.compute", cat="sched", start=start):
+                state, ys = _scan_chunk(
+                    state, arrays, cfg, collect, sched.pad_row
+                )  # async dispatch
+            del arrays  # let the consumed slab free when the scan is done
+            if collect:
+                # One-chunk-deep fetch pipelining: start k's D2H stream
+                # now and materialize k-1's (whose transfer has been in
+                # flight a whole chunk) — without this every chunk pays a
+                # cold ~100 ms tunnel round trip SERIALLY, which the
+                # service path's fixed 8-step chunks turned into
+                # ceil(steps/8) RTTs per deep batch.
+                try:
+                    ys.copy_to_host_async()
+                except AttributeError:  # pragma: no cover — older jax arrays
+                    pass
+                if pending is not None:
+                    with tracer.span("batch.fetch", cat="sched", start=start):
+                        outs.append(fetch_tree(pending))
+                pending = ys
+            if on_chunk is not None:
+                on_chunk(state, stop)
+            if view_publisher is not None:
+                # Throttled view publish BEFORE the next chunk dispatches:
+                # the carry buffer is about to be donated, so the publisher
+                # fetches its host copy here or not at all.
+                view_publisher.maybe_publish_state(state)
+            # HBM-occupancy gauges at chunk boundaries (throttled inside —
+            # device.hbm_bytes_in_use / device.live_buffers,
+            # obs/devicemem.py): a run creeping toward the HBM ceiling
+            # shows up in /metrics and the bench telemetry block BEFORE
+            # it OOMs.
+            maybe_sample_device_memory()
     if view_publisher is not None:
         view_publisher.publish_state(state)  # final table, unthrottled
     if not collect:
@@ -289,6 +291,8 @@ def rate_stream(
     stats_out: dict | None = None,
     mesh=None,
     view_publisher=None,
+    on_chunk=None,
+    prefetch_depth: int | None = None,
 ) -> tuple[PlayerState, HistoryOutputs | None]:
     """Rates a raw MatchStream with the schedule built CONCURRENTLY with
     the device scan — the fully-streamed feed. ``stats_out`` (optional
@@ -314,12 +318,24 @@ def rate_stream(
     ``rate_history`` overlaps window *materialization* with the scan but
     still pays the whole first-fit assignment as a sequential prefix
     (~2 s of a 10M-match run). Here the assignment runs on a worker
-    thread (ctypes releases the GIL for the native loop); this consumer
-    scatters newly assigned slots into the slot->match map, backfills
-    non-ratable fillers into each window's padding slots as it goes (same
-    occupancy as the offline packer), and dispatches every complete
-    window while the assigner is still running. End-to-end wall time
-    approaches ``choose_batch_size + max(assign, device scan)``.
+    thread (ctypes releases the GIL for the native loop); a FEED thread
+    (:mod:`analyzer_tpu.sched.feed`) scatters newly assigned slots into
+    the slot->match map, backfills non-ratable fillers into each
+    window's padding slots as it goes (same occupancy as the offline
+    packer), materializes each complete window and issues its async
+    device transfer up to ``prefetch_depth`` (default 2) windows ahead
+    — all while the assigner is still running and the device executes
+    the previous chunk. The consumer loop below only dispatches the
+    committed slabs (and, with ``collect``, overlaps each chunk's D2H
+    fetch with the next chunk's compute). End-to-end wall time
+    approaches ``choose_batch_size + max(assign, materialize, device
+    scan)`` — BENCH_r05's 1.75x-device serialization was exactly the
+    sum this turns into a max.
+
+    ``on_chunk(state, next_step)`` mirrors ``rate_history``'s
+    checkpoint-hook surface at window boundaries; on the mesh path the
+    hook receives the snapshot THUNK protocol of
+    :meth:`analyzer_tpu.parallel.mesh.ShardedRun.call_hook`.
 
     Cross-thread protocol (portable — no acquire/release pairing with
     the C loop is assumed): the output buffers are prefilled with a
@@ -334,6 +350,12 @@ def rate_stream(
     both watermarks equal the length of the full-batch prefix and differ
     only by publish granularity. ``Thread.join`` is the one trusted
     synchronization point, after which the buffers are read plainly.
+    Wakeups ride a condition variable: the pure-python assigner
+    signals it at every progress publish and both assigner paths signal
+    completion, so the feed reacts immediately instead of sleeping out a
+    poll interval; the native loop runs with the GIL released and cannot
+    call back into Python, so ``poll_interval`` survives as the wait
+    timeout — the poll fallback — for exactly that path.
 
     Occupancy caveat to the wall-time claim: batches become final only
     by FILLING, so on a chain-bound (low-occupancy) schedule whose early
@@ -428,11 +450,30 @@ def rate_stream(
     out_s = np.full(n, sentinel, np.int64)
     worker_err: list[BaseException] = []
 
+    # Assigner -> feed handshake: the python fallback notifies at every
+    # progress publish and both paths notify completion (the `finally`),
+    # so chain-bound schedules — where nothing is emittable until the
+    # assigner finishes — don't pay up to poll_interval of dead time at
+    # the handoff. The native loop publishes with the GIL released and
+    # cannot notify, so the feed's wait keeps poll_interval as timeout.
+    cv = threading.Condition()
+    assigner_done = [False]
+
+    def notify_progress():
+        with cv:
+            cv.notify_all()
+
     def work():
         try:
-            assign_batches(stream, b, progress, out_b, out_s)
+            assign_batches(
+                stream, b, progress, out_b, out_s, on_progress=notify_progress
+            )
         except BaseException as e:  # noqa: BLE001 — re-raised on the consumer
             worker_err.append(e)
+        finally:
+            with cv:
+                assigner_done[0] = True
+                cv.notify_all()
 
     worker = threading.Thread(target=work, daemon=True)
     worker.start()
@@ -493,11 +534,13 @@ def rate_stream(
 
     tracer = get_tracer()
 
-    def emit(e1: int) -> None:
-        """Dispatches steps [emitted, e1), backfilling fillers into the
-        window's free slots (stream order — deterministic)."""
-        nonlocal state, emitted, n_fill
-        e0 = emitted
+    def stage(e0: int, e1: int):
+        """Feed-thread staging of steps [e0, e1): backfills fillers into
+        the window's free slots (stream order — deterministic),
+        materializes the window, and issues its async device transfer.
+        Returns the committed slab (single-device: the compact arrays;
+        mesh: the routed, device-put tuple for ``dispatch_staged``)."""
+        nonlocal n_fill
         win = slot_map[e0 * b : e1 * b]  # view: backfill lands in slot_map
         if n_fill < fillers.size:
             free = np.flatnonzero(win < 0)
@@ -506,58 +549,104 @@ def rate_stream(
                 win[free[:take]] = fillers[n_fill : n_fill + take].astype(np.int32)
                 n_fill += take
         mi = win.reshape(e1 - e0, b)
-        with tracer.span("batch.transfer", cat="sched", start=e0):
+        with tracer.span("feed.materialize", cat="sched", start=e0):
             pidx, mask = materialize_gather_window(stream, mi, pad_row, team)
             winner, mode_id, afk = materialize_scalar_window(stream, mi)
-        if run is not None:
-            with tracer.span("batch.compute", cat="sched", start=e0):
-                run.dispatch(pidx, mask, winner, mode_id, afk)
+        with tracer.span("feed.transfer", cat="sched", start=e0):
+            if run is not None:
+                return run.stage(pidx, mask, winner, mode_id, afk)
+            return compact_device_window(pidx, winner, mode_id, afk)
+
+    result: dict = {}
+
+    def produce(put) -> None:
+        """Feed-thread body: consume the assigner's output, emit every
+        complete window, then the deterministic tail. Window boundaries
+        are fixed multiples of ``spc`` regardless of when the data
+        became visible, so depth and thread timing never change what is
+        emitted — only how far ahead it is staged."""
+        nonlocal emitted, watermark
+        while True:
+            done = assigner_done[0]  # read BEFORE consuming progress
+            scatter_new(int(progress[0]))
+            advanced = False
+            while watermark - emitted >= spc:
+                put((emitted, emitted + spc, stage(emitted, emitted + spc)))
+                emitted += spc
+                advanced = True
+            if done:
+                break
+            if not advanced:
+                with cv:
+                    # Re-check under the lock: a completion or progress
+                    # notify between our reads and this wait must not be
+                    # lost to a full poll_interval of sleep.
+                    if not assigner_done[0] and done_m == int(progress[0]):
+                        cv.wait(poll_interval)
+        worker.join()
+        if worker_err:
+            raise RuntimeError("schedule assignment failed") from worker_err[0]
+        scatter_new(n)
+        assert done_m == n  # join() synchronizes; every entry visible
+        ratable_b = out_b[out_b >= 0]
+        total_b = int(ratable_b.max()) + 1 if ratable_b.size else 0
+
+        # Tail: remaining fillers overflow into extra all-filler batches
+        # after the assigner's final batch (same rule as pack_schedule's
+        # fallback).
+        left = fillers.size - n_fill
+        if left:
+            free_rest = int(
+                (slot_map[emitted * b : total_b * b] < 0).sum()
+            ) if total_b > emitted else 0
+            extra = max(0, -(-(left - free_rest) // b))
         else:
-            with tracer.span("batch.compute", cat="sched", start=e0):
-                arrays = compact_device_window(pidx, winner, mode_id, afk)
-                new_state, ys = _scan_chunk(
-                    state, arrays, cfg, collect, pad_row
-                )
-            state = new_state
-            if collect:
-                with tracer.span("batch.fetch", cat="sched", start=e0):
-                    outs.append(fetch_tree(ys))
-            if view_publisher is not None:
-                view_publisher.maybe_publish_state(state)
-        emitted = e1
-        maybe_sample_device_memory()  # batch-boundary HBM gauges (throttled)
+            extra = 0
+        s_total = max(total_b + extra, emitted, 1)
+        grow(s_total)
+        while emitted < s_total:
+            e1 = min(emitted + spc, s_total)
+            put((emitted, e1, stage(emitted, e1)))
+            emitted = e1
+        result["s_total"] = s_total
 
-    while worker.is_alive():
-        scatter_new(int(progress[0]))
-        advanced = False
-        while watermark - emitted >= spc:
-            emit(emitted + spc)
-            advanced = True
-        if not advanced:
-            _time.sleep(poll_interval)
-    worker.join()
-    if worker_err:
-        raise RuntimeError("schedule assignment failed") from worker_err[0]
-    scatter_new(n)
-    assert done_m == n  # join() synchronizes; every entry must be visible
-    ratable_b = out_b[out_b >= 0]
-    total_b = int(ratable_b.max()) + 1 if ratable_b.size else 0
+    # Consumer: dispatch committed slabs; with ``collect``, overlap each
+    # chunk's D2H fetch with the next chunk's compute (one-chunk-deep
+    # fetch pipelining, same protocol as rate_history).
+    pending = None
+    with Prefetcher(produce, depth=prefetch_depth or DEFAULT_DEPTH) as pf:
+        for e0, e1, staged in pf:
+            if run is not None:
+                with tracer.span("batch.compute", cat="sched", start=e0):
+                    run.dispatch_staged(staged)
+            else:
+                with tracer.span("batch.compute", cat="sched", start=e0):
+                    state, ys = _scan_chunk(
+                        state, staged, cfg, collect, pad_row
+                    )
+                if collect:
+                    try:
+                        ys.copy_to_host_async()
+                    except AttributeError:  # pragma: no cover — older jax
+                        pass
+                    if pending is not None:
+                        with tracer.span("batch.fetch", cat="sched", start=e0):
+                            outs.append(fetch_tree(pending))
+                    pending = ys
+                if view_publisher is not None:
+                    view_publisher.maybe_publish_state(state)
+            del staged  # let the consumed slab free behind the dispatch
+            if on_chunk is not None:
+                if run is not None:
+                    run.call_hook(on_chunk, e1)
+                else:
+                    on_chunk(state, e1)
+            maybe_sample_device_memory()  # batch-boundary HBM gauges
+    if pending is not None:
+        with tracer.span("batch.fetch", cat="sched", start=result["s_total"]):
+            outs.append(fetch_tree(pending))
 
-    # Tail: remaining fillers overflow into extra all-filler batches after
-    # the assigner's final batch (same rule as pack_schedule's fallback).
-    left = fillers.size - n_fill
-    if left:
-        free_rest = int(
-            (slot_map[emitted * b : total_b * b] < 0).sum()
-        ) if total_b > emitted else 0
-        extra = max(0, -(-(left - free_rest) // b))
-    else:
-        extra = 0
-    s_total = max(total_b + extra, emitted, 1)
-    grow(s_total)
-    while emitted < s_total:
-        emit(min(emitted + spc, s_total))
-
+    s_total = result["s_total"]
     occupancy = n / (s_total * b)
     reg = get_registry()
     reg.gauge("sched.occupancy").set(round(occupancy, 4))

@@ -390,18 +390,28 @@ def materialize_gather_window(
     if stream.n_matches == 0:  # all-padding (inert) schedule
         shape = match_idx.shape + (2, team_size)
         return np.full(shape, pad_row, np.int32), np.zeros(shape, bool)
-    valid = match_idx >= 0
-    rows = np.clip(match_idx, 0, None)
-    pidx = stream.player_idx[rows]  # [W, B, 2, t_in]
-    mask = (pidx >= 0) & valid[..., None, None]
-    pidx = np.where(mask, pidx, pad_row).astype(np.int32)
+    # Preallocate + in-place: the gather/where/astype/concatenate chain
+    # allocated every [W, B, 2, T] tensor twice per window (the fancy-
+    # index temp plus the where+astype copy) on the feed's hot path.
+    # np.take(out=) gathers straight into the output, the mask derives
+    # in place, and padding overwrites via copyto — one allocation per
+    # output, which is the floor.
     t_in = stream.team_size
-    if t_in < team_size:
-        shape = match_idx.shape + (2, team_size - t_in)
-        pidx = np.concatenate(
-            [pidx, np.full(shape, pad_row, np.int32)], axis=-1
-        )
-        mask = np.concatenate([mask, np.zeros(shape, bool)], axis=-1)
+    shape = match_idx.shape + (2, team_size)
+    pidx = np.empty(shape, np.int32)
+    mask = np.zeros(shape, bool)
+    if t_in < team_size:  # 3-wide stream packed at 5: inert team tail
+        pidx[..., t_in:] = pad_row
+    sub_p = pidx[..., :t_in]
+    sub_m = mask[..., :t_in]
+    rows = np.clip(match_idx, 0, None)
+    if t_in == team_size:  # contiguous out — the common case
+        np.take(stream.player_idx, rows, axis=0, out=sub_p)
+    else:
+        sub_p[...] = stream.player_idx[rows]
+    np.greater_equal(sub_p, 0, out=sub_m)
+    sub_m &= (match_idx >= 0)[..., None, None]
+    np.copyto(sub_p, pad_row, where=~sub_m)
     return pidx, mask
 
 
@@ -419,15 +429,21 @@ def materialize_scalar_window(
             np.full(match_idx.shape, constants.UNSUPPORTED_MODE_ID, np.int32),
             np.zeros(match_idx.shape, bool),
         )
-    real = match_idx >= 0
+    # Same preallocate + in-place discipline as the gather materializer:
+    # take(out=) then overwrite the padding slots, instead of a
+    # gather temp + where copy per array.
+    pad = ~(match_idx >= 0)
     rows = np.clip(match_idx, 0, None)
-    return (
-        np.where(real, stream.winner[rows], 0).astype(np.int32),
-        np.where(
-            real, stream.mode_id[rows], constants.UNSUPPORTED_MODE_ID
-        ).astype(np.int32),
-        np.where(real, stream.afk[rows], False),
-    )
+    winner = np.empty(match_idx.shape, np.int32)
+    mode_id = np.empty(match_idx.shape, np.int32)
+    afk = np.empty(match_idx.shape, bool)
+    np.take(stream.winner, rows, out=winner)
+    np.take(stream.mode_id, rows, out=mode_id)
+    np.take(stream.afk, rows, out=afk)
+    np.copyto(winner, 0, where=pad)
+    np.copyto(mode_id, constants.UNSUPPORTED_MODE_ID, where=pad)
+    np.copyto(afk, False, where=pad)
+    return winner, mode_id, afk
 
 
 def assign_supersteps(stream: MatchStream) -> np.ndarray:
@@ -468,6 +484,7 @@ def assign_batches(
     progress: np.ndarray | None = None,
     out: np.ndarray | None = None,
     out_slot: np.ndarray | None = None,
+    on_progress=None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Capacity-aware first-fit batch index per match (levelized schedule).
 
@@ -486,6 +503,14 @@ def assign_batches(
     order), so ``batch * capacity + slot`` is a collision-free flat slot
     map with no sort needed. ``progress`` see
     :func:`_native.assign_batches_first_fit`.
+
+    ``on_progress`` (optional zero-arg callable) is invoked by the PURE
+    PYTHON loop at every periodic ``progress`` publish — the streamed
+    feed's condition-variable handshake (``sched.runner.rate_stream``).
+    The native loop runs with the GIL released and cannot call back into
+    Python, so it ignores the callback and its consumers keep the poll
+    fallback; completion is signaled by the caller around the call
+    either way.
     """
     try:
         from analyzer_tpu.sched import _native
@@ -495,8 +520,14 @@ def assign_batches(
         )
     except ImportError:
         return _assign_batches_first_fit_py(
-            stream, capacity, progress, out, out_slot
+            stream, capacity, progress, out, out_slot, on_progress
         )
+
+
+#: Periodic-progress publish interval of the python first-fit loop
+#: (matches). A power of two so the check is one mask; small enough that
+#: a streamed consumer sees fresh entries every few hundred microseconds.
+_PY_PROGRESS_EVERY = 2048
 
 
 def _assign_batches_first_fit_py(
@@ -505,6 +536,7 @@ def _assign_batches_first_fit_py(
     progress: np.ndarray | None = None,
     out: np.ndarray | None = None,
     out_slot: np.ndarray | None = None,
+    on_progress=None,
 ) -> tuple[np.ndarray, np.ndarray]:
     n = stream.n_matches
     if out is None:
@@ -544,6 +576,13 @@ def _assign_batches_first_fit_py(
     ratable = stream.ratable
     idx = stream.player_idx
     for i in range(n):
+        if progress is not None and i and not (i & (_PY_PROGRESS_EVERY - 1)):
+            # Entries [0, i) are final; publish + wake a streamed
+            # consumer (the GIL orders the buffer writes before this
+            # store, mirroring the C loop's release publish).
+            progress[0] = i
+            if on_progress is not None:
+                on_progress()
         if not ratable[i]:
             continue
         players = idx[i].ravel()

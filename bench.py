@@ -48,8 +48,11 @@ BENCH_MATCHES//3), BENCH_BATCH (default 0 = auto), BENCH_REPEATS (default
 5), BENCH_CONC (default 0.8), BENCH_MAX_SHARE (default 1e-4; 0 = uncapped),
 BENCH_MESH (default 0 = single device; N = data-parallel over the first N
 real devices via the sharded-table runner, metric still per chip),
-BENCH_OBS_PORT (serve obsd — /metrics, /statusz — on localhost while the
-capture runs; `cli bench --obs-port` sets the same thing).
+BENCH_FEED_DEPTH (default 0 = the feed's default ring depth; N sizes the
+prefetcher's committed-slab ring for the end-to-end lines — results are
+depth-invariant, only overlap changes), BENCH_OBS_PORT (serve obsd —
+/metrics, /statusz — on localhost while the capture runs;
+`cli bench --obs-port` sets the same thing).
 """
 
 from __future__ import annotations
@@ -219,9 +222,12 @@ def _bench_main(metrics_out: str | None) -> None:
     from analyzer_tpu.sched import rate_history
 
     state_dev = jax.device_put(jax.tree.map(np.asarray, state0))
+    feed_depth = int(os.environ.get("BENCH_FEED_DEPTH", 0)) or None
 
     def run_e2e():
-        e2e_state, _ = rate_history(state_dev, cfg=cfg, sched=sched)
+        e2e_state, _ = rate_history(
+            state_dev, cfg=cfg, sched=sched, prefetch_depth=feed_depth
+        )
         np.asarray(e2e_state.table[:1])
         return e2e_state
 
@@ -239,7 +245,9 @@ def _bench_main(metrics_out: str | None) -> None:
     from analyzer_tpu.sched import rate_stream
 
     def run_stream():
-        s_state, _ = rate_stream(state_dev, stream, cfg)
+        s_state, _ = rate_stream(
+            state_dev, stream, cfg, prefetch_depth=feed_depth
+        )
         np.asarray(s_state.table[:1])
         return s_state
 
@@ -447,6 +455,14 @@ def obs_breakdown(phases: dict) -> dict:
             "pad_steps_total": counters.get("sched.pad_steps_total", 0),
             "pad_slots_total": counters.get("sched.pad_slots_total", 0),
         },
+        # The prefetched device feed's verdict on WHERE the streamed gap
+        # lives: starved ~ windows means host-bound (raise depth / look
+        # at feed.materialize spans), backpressure-heavy means the scan
+        # dominated and the feed fully hid behind it.
+        "feed": {
+            "starved_total": counters.get("feed.starved_total", 0),
+            "backpressure_total": counters.get("feed.backpressure_total", 0),
+        },
         "mesh_put_bytes_total": counters.get("mesh.put_bytes_total", 0),
         "device_memory": device_memory,
     }
@@ -522,8 +538,12 @@ def bench_mesh(n_mesh, stream, state0, cfg, batch, repeats, t_gen,
 
     # Fully-streamed: first-fit assignment on a worker thread feeding the
     # sharded runner (the round-3 composition).
+    feed_depth = int(os.environ.get("BENCH_FEED_DEPTH", 0)) or None
+
     def run_stream():
-        s_state, _ = rate_stream(state0, stream, cfg, mesh=mesh)
+        s_state, _ = rate_stream(
+            state0, stream, cfg, mesh=mesh, prefetch_depth=feed_depth
+        )
         np.asarray(s_state.table[:1])
         return s_state
 

@@ -1,0 +1,497 @@
+"""The prefetching double-buffered device feed (sched/feed.py).
+
+The load-bearing property is DEPTH-INVARIANCE: the bounded slab ring
+changes *when* windows are staged, never *what* is staged — so the final
+state, the collected per-match outputs, and every hook boundary must be
+bit-identical across prefetch depths 1/2/3, for the windowed runner, the
+fully-streamed runner (chain-bound/starved schedules included), and the
+mesh composition. The unit half pins the ring's blocking semantics and
+the starvation/backpressure accounting the /statusz runbook relies on.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.core.state import PlayerState
+from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
+from analyzer_tpu.obs import get_registry, reset_registry, retrace_counts
+from analyzer_tpu.sched import (
+    DeviceFeed,
+    MatchStream,
+    Prefetcher,
+    pack_schedule,
+    rate_history,
+    rate_stream,
+)
+from analyzer_tpu.sched.feed import FeedClosedError
+
+CFG = RatingConfig()
+
+_NO_SHARD_MAP = not hasattr(jax, "shard_map")
+
+
+def small_stream(n_matches=300, n_players=60, seed=11, **kw):
+    players = synthetic_players(n_players, seed=seed)
+    stream = synthetic_stream(n_matches, players, seed=seed, **kw)
+    state = PlayerState.create(
+        n_players,
+        rank_points_ranked=players.rank_points_ranked,
+        rank_points_blitz=players.rank_points_blitz,
+        skill_tier=players.skill_tier,
+    )
+    return stream, state
+
+
+def chain_stream(n=80):
+    """Player 0 in every match: depth == n, batches never FILL, so the
+    streamed feed cannot emit until the assigner finishes — the starved
+    worst case the watermark protocol degrades to."""
+    idx = np.zeros((n, 2, 3), np.int32)
+    idx[:, 0] = [0, 1, 2]
+    idx[:, 1, :] = np.arange(3, 3 * n + 3).reshape(n, 3) % 37 + 3
+    stream = MatchStream(
+        player_idx=idx,
+        winner=(np.arange(n) % 2).astype(np.int32),
+        mode_id=np.zeros(n, np.int32),
+        afk=np.zeros(n, bool),
+    )
+    state = PlayerState.create(40)
+    return stream, state
+
+
+class RecordingPublisher:
+    """Duck-typed stand-in for serve.view.ViewPublisher: records the
+    boundary sequence instead of building views."""
+
+    def __init__(self):
+        self.maybe = 0
+        self.final = 0
+
+    def maybe_publish_state(self, state):
+        self.maybe += 1
+
+    def publish_state(self, state):
+        self.final += 1
+
+
+class TestDeviceFeed:
+    def test_depth_validation(self):
+        with pytest.raises(ValueError, match="depth"):
+            DeviceFeed(0)
+
+    def test_fifo_and_close_drain(self):
+        feed = DeviceFeed(2)
+        feed.put(1)
+        feed.put(2)
+        feed.close()
+        assert feed.get() == 1
+        assert feed.get() == 2
+        assert feed.get() is None  # closed + drained
+
+    def test_put_blocks_at_depth_and_counts_backpressure(self):
+        reset_registry()
+        feed = DeviceFeed(1)
+        feed.put(1)
+        done = []
+
+        def producer():
+            feed.put(2)  # blocks until the consumer pops
+            done.append(True)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        assert not done  # still blocked: ring is at depth
+        assert feed.get() == 1
+        t.join(timeout=5)
+        assert done
+        assert feed.get() == 2
+        reg = get_registry()
+        assert reg.counter("feed.backpressure_total").value >= 1
+
+    def test_get_blocks_until_put_and_counts_starvation(self):
+        reset_registry()
+        feed = DeviceFeed(2)
+        got = []
+
+        def consumer():
+            got.append(feed.get())
+
+        t = threading.Thread(target=consumer, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        assert not got  # starved: ring empty
+        feed.put("x")
+        t.join(timeout=5)
+        assert got == ["x"]
+        assert get_registry().counter("feed.starved_total").value >= 1
+
+    def test_depth_gauge_tracks_occupancy(self):
+        reset_registry()
+        feed = DeviceFeed(3)
+        g = get_registry().gauge("feed.depth")
+        feed.put(1)
+        feed.put(2)
+        assert g.value == 2
+        feed.get()
+        assert g.value == 1
+
+    def test_error_surfaces_after_drain(self):
+        feed = DeviceFeed(2)
+        feed.put(1)
+        feed.close(error=RuntimeError("boom"))
+        assert feed.get() == 1  # buffered work drains first
+        with pytest.raises(RuntimeError, match="boom"):
+            feed.get()
+
+    def test_put_after_close_raises(self):
+        feed = DeviceFeed(2)
+        feed.close()
+        with pytest.raises(FeedClosedError):
+            feed.put(1)
+
+    def test_put_blocked_at_depth_unblocks_on_close(self):
+        feed = DeviceFeed(1)
+        feed.put(1)
+        raised = []
+
+        def producer():
+            try:
+                feed.put(2)
+            except FeedClosedError:
+                raised.append(True)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        feed.close()
+        t.join(timeout=5)
+        assert raised
+
+
+class TestPrefetcher:
+    def test_iterates_in_order(self):
+        def produce(put):
+            for i in range(10):
+                put(i)
+
+        with Prefetcher(produce, depth=2) as pf:
+            assert list(pf) == list(range(10))
+
+    def test_producer_error_raises_on_consumer(self):
+        def produce(put):
+            put(1)
+            raise ValueError("producer died")
+
+        with pytest.raises(ValueError, match="producer died"):
+            with Prefetcher(produce, depth=2) as pf:
+                for _ in pf:
+                    pass
+
+    def test_consumer_abort_joins_producer(self):
+        started = threading.Event()
+
+        def produce(put):
+            i = 0
+            while True:  # unbounded: only the consumer's abort stops it
+                put(i)
+                started.set()
+                i += 1
+
+        pf = Prefetcher(produce, depth=2)
+        with pf:
+            started.wait(timeout=5)
+        # __exit__ closed the feed and joined; the producer thread died
+        # on FeedClosedError instead of leaking.
+        assert not pf._thread.is_alive()
+
+
+class TestDepthParity:
+    """Bit-identity across ring depths — the ring reorders time, not
+    work."""
+
+    def test_rate_history_depths_identical(self):
+        stream, state = small_stream(n_matches=300, n_players=60, seed=21)
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=16)
+        base, base_outs = rate_history(
+            state, sched, CFG, collect=True, steps_per_chunk=5,
+            prefetch_depth=1,
+        )
+        for depth in (2, 3):
+            got, outs = rate_history(
+                state, sched, CFG, collect=True, steps_per_chunk=5,
+                prefetch_depth=depth,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(base.table), np.asarray(got.table),
+                err_msg=f"depth={depth}",
+            )
+            for field in ("quality", "shared_mu", "shared_sigma", "delta",
+                          "mode_mu", "mode_sigma", "any_afk", "updated"):
+                np.testing.assert_array_equal(
+                    getattr(base_outs, field), getattr(outs, field),
+                    err_msg=f"depth={depth} field={field}",
+                )
+
+    def test_rate_stream_depths_match_offline_packer(self):
+        stream, state = small_stream(n_matches=400, n_players=60, seed=23)
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=16)
+        base, base_outs = rate_history(state, sched, CFG, collect=True)
+        for depth in (1, 2, 3):
+            got, outs = rate_stream(
+                state, stream, CFG, collect=True, batch_size=16,
+                steps_per_chunk=7, prefetch_depth=depth,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(base.table)[:-1], np.asarray(got.table)[:-1],
+                err_msg=f"depth={depth}",
+            )
+            np.testing.assert_array_equal(base_outs.updated, outs.updated)
+            np.testing.assert_array_equal(base_outs.quality, outs.quality)
+            np.testing.assert_array_equal(
+                base_outs.shared_mu, outs.shared_mu
+            )
+
+    def test_chain_bound_starved_schedule(self):
+        # Batches only become final by FILLING; a pure chain never fills
+        # one, so the feed serializes behind the assigner — the overlap
+        # floor. Results must still be bit-identical at every depth.
+        stream, state = chain_stream(80)
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=8)
+        base, _ = rate_history(state, sched, CFG)
+        for depth in (1, 3):
+            got, _ = rate_stream(
+                state, stream, CFG, batch_size=8, steps_per_chunk=4,
+                prefetch_depth=depth,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(base.table)[:-1], np.asarray(got.table)[:-1],
+                err_msg=f"depth={depth}",
+            )
+
+    def test_filler_heavy_stream_depths(self):
+        stream, state = small_stream(
+            n_matches=200, n_players=40, seed=29, afk_rate=0.6
+        )
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=8)
+        base, base_outs = rate_history(state, sched, CFG, collect=True)
+        for depth in (1, 3):
+            got, outs = rate_stream(
+                state, stream, CFG, collect=True, batch_size=8,
+                steps_per_chunk=5, prefetch_depth=depth,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(base.table)[:-1], np.asarray(got.table)[:-1]
+            )
+            np.testing.assert_array_equal(base_outs.updated, outs.updated)
+            np.testing.assert_array_equal(base_outs.any_afk, outs.any_afk)
+
+    @pytest.mark.skipif(
+        _NO_SHARD_MAP, reason="jax.shard_map unavailable in this build"
+    )
+    @pytest.mark.parametrize("n_dev", [2, 4])
+    def test_mesh_dry_run_composition(self, n_dev):
+        # The streamed feed staging into ShardedRun from the producer
+        # thread (stage on the feed thread, dispatch_staged on the
+        # consumer) must equal the single-device runner on the virtual
+        # CPU mesh, at multiple depths.
+        from analyzer_tpu.parallel import make_mesh
+
+        stream, state = small_stream(n_matches=200, n_players=50, seed=31)
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=8)
+        base, _ = rate_history(state, sched, CFG)
+        p = state.n_players
+        for depth in (1, 2):
+            got, _ = rate_stream(
+                state, stream, CFG, batch_size=8, steps_per_chunk=6,
+                mesh=make_mesh(n_dev), prefetch_depth=depth,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(base.table)[:p], np.asarray(got.table)[:p],
+                err_msg=f"n_dev={n_dev} depth={depth}",
+            )
+
+
+class TestHookBoundaries:
+    """Checkpoint + publisher hooks must fire at the SAME chunk
+    boundaries at every depth — the feed must not shift, merge, or drop
+    a boundary."""
+
+    def test_rate_history_on_chunk_boundaries_depth_invariant(self):
+        stream, state = small_stream(n_matches=240, n_players=50, seed=7)
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=16)
+        per_depth = {}
+        for depth in (1, 2, 3):
+            seen = []
+            rate_history(
+                state, sched, CFG, steps_per_chunk=4,
+                on_chunk=lambda st, step: seen.append(step),
+                prefetch_depth=depth,
+            )
+            per_depth[depth] = seen
+        expect = list(range(4, sched.n_steps, 4)) + [sched.n_steps]
+        expect = sorted(set(min(s, sched.n_steps) for s in expect))
+        assert per_depth[1] == expect
+        assert per_depth[1] == per_depth[2] == per_depth[3]
+
+    def test_rate_history_publisher_fires_per_chunk_plus_final(self):
+        stream, state = small_stream(n_matches=160, n_players=40, seed=9)
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=16)
+        counts = set()
+        for depth in (1, 3):
+            pub = RecordingPublisher()
+            rate_history(
+                state, sched, CFG, steps_per_chunk=3,
+                view_publisher=pub, prefetch_depth=depth,
+            )
+            assert pub.final == 1
+            counts.add(pub.maybe)
+        assert len(counts) == 1  # same boundary count at every depth
+        assert counts.pop() == -(-sched.n_steps // 3)
+
+    def test_rate_stream_on_chunk_and_publisher(self):
+        stream, state = small_stream(n_matches=200, n_players=40, seed=13)
+        stats: dict = {}
+        per_depth = {}
+        for depth in (1, 2):
+            pub = RecordingPublisher()
+            seen = []
+            rate_stream(
+                state, stream, CFG, batch_size=8, steps_per_chunk=5,
+                on_chunk=lambda st, step: seen.append(step),
+                view_publisher=pub, stats_out=stats, prefetch_depth=depth,
+            )
+            assert pub.final == 1
+            assert pub.maybe == len(seen)  # one publish per window
+            per_depth[depth] = seen
+        s_total = stats["n_steps"]
+        # Window boundaries are fixed multiples of steps_per_chunk ending
+        # at the tail — thread timing must not change them.
+        assert per_depth[1][-1] == s_total
+        assert all(s % 5 == 0 for s in per_depth[1][:-1])
+        assert per_depth[1] == per_depth[2]
+
+
+class TestSteadyStateRetraces:
+    def test_repeat_runs_do_not_retrace(self):
+        # The feed must keep emitting the same slab shapes: after a warm
+        # run, a second identical run adds ZERO entries to the scan's
+        # jit cache (the bench acceptance criterion, measurable here via
+        # track_jit's cache-size accounting).
+        stream, state = small_stream(n_matches=300, n_players=60, seed=17)
+        run = lambda: rate_stream(
+            state, stream, CFG, batch_size=16, steps_per_chunk=6,
+            prefetch_depth=2,
+        )
+        run()  # warm the shape ladder
+        warm = retrace_counts()["sched._scan_chunk"]
+        run()
+        assert retrace_counts()["sched._scan_chunk"] == warm
+
+
+class TestAssignerHandshake:
+    def test_python_fallback_publishes_periodically_and_notifies(self):
+        from analyzer_tpu.sched.superstep import (
+            _PY_PROGRESS_EVERY,
+            _assign_batches_first_fit_py,
+        )
+
+        n = 2 * _PY_PROGRESS_EVERY + 100
+        players = synthetic_players(500, seed=3)
+        stream = synthetic_stream(n, players, seed=3)
+        progress = np.zeros(2, np.int64)
+        seen: list[int] = []
+        _assign_batches_first_fit_py(
+            stream, 16, progress,
+            on_progress=lambda: seen.append(int(progress[0])),
+        )
+        # Two periodic publishes before the final (n, batches) store,
+        # each wired through the condition-variable callback.
+        assert seen == [_PY_PROGRESS_EVERY, 2 * _PY_PROGRESS_EVERY]
+        assert progress[0] == n
+
+    def test_chain_bound_stream_no_poll_latency_dependence(self):
+        # With the completion handshake, a huge poll_interval must not
+        # slow the chain-bound handoff (pre-CV it cost up to
+        # poll_interval per window). 0.5 s x ~20 windows would blow this
+        # timeout loudly if the wait ever regressed to a sleep.
+        stream, state = chain_stream(80)
+        t0 = time.monotonic()
+        rate_stream(
+            state, stream, CFG, batch_size=8, steps_per_chunk=4,
+            poll_interval=0.5,
+        )
+        assert time.monotonic() - t0 < 8.0
+
+
+class TestMaterializerParity:
+    """The preallocate/in-place materializers must reproduce the old
+    gather/where/concatenate chain bit for bit (the windowed-equals-eager
+    suite covers the common case; this pins the edge shapes)."""
+
+    def _reference_gather(self, stream, match_idx, pad_row, team_size):
+        valid = match_idx >= 0
+        rows = np.clip(match_idx, 0, None)
+        pidx = stream.player_idx[rows]
+        mask = (pidx >= 0) & valid[..., None, None]
+        pidx = np.where(mask, pidx, pad_row).astype(np.int32)
+        t_in = stream.team_size
+        if t_in < team_size:
+            shape = match_idx.shape + (2, team_size - t_in)
+            pidx = np.concatenate(
+                [pidx, np.full(shape, pad_row, np.int32)], axis=-1
+            )
+            mask = np.concatenate([mask, np.zeros(shape, bool)], axis=-1)
+        return pidx, mask
+
+    @pytest.mark.parametrize("team_size", [3, 5])
+    def test_gather_window_matches_reference(self, team_size):
+        from analyzer_tpu.sched.superstep import materialize_gather_window
+
+        idx = np.arange(36, dtype=np.int32).reshape(6, 2, 3)
+        idx[2, 1, 2] = -1  # an empty roster slot
+        stream = MatchStream(
+            player_idx=idx,
+            winner=np.zeros(6, np.int32),
+            mode_id=np.array([1, -1, 1, 1, 1, 1], np.int32),
+            afk=np.zeros(6, bool),
+        )
+        match_idx = np.array([[0, 2, -1], [5, -1, 3]], np.int32)
+        got = materialize_gather_window(stream, match_idx, 50, team_size)
+        want = self._reference_gather(stream, match_idx, 50, team_size)
+        np.testing.assert_array_equal(got[0], want[0])
+        np.testing.assert_array_equal(got[1], want[1])
+        assert got[0].dtype == np.int32 and got[1].dtype == bool
+
+    def test_scalar_window_matches_reference(self):
+        from analyzer_tpu.core import constants
+        from analyzer_tpu.sched.superstep import materialize_scalar_window
+
+        stream, _ = small_stream(
+            n_matches=40, n_players=20, seed=5, afk_rate=0.3,
+            unsupported_rate=0.2,
+        )
+        match_idx = np.array([[0, 7, -1, 12], [-1, 3, 39, -1]], np.int32)
+        winner, mode_id, afk = materialize_scalar_window(stream, match_idx)
+        real = match_idx >= 0
+        rows = np.clip(match_idx, 0, None)
+        np.testing.assert_array_equal(
+            winner, np.where(real, stream.winner[rows], 0).astype(np.int32)
+        )
+        np.testing.assert_array_equal(
+            mode_id,
+            np.where(
+                real, stream.mode_id[rows], constants.UNSUPPORTED_MODE_ID
+            ).astype(np.int32),
+        )
+        np.testing.assert_array_equal(
+            afk, np.where(real, stream.afk[rows], False)
+        )
+        assert winner.dtype == np.int32 and mode_id.dtype == np.int32
+        assert afk.dtype == bool

@@ -839,3 +839,87 @@ class TestGL024NetworkSurface:
         from analyzer_tpu.lint.findings import RULES
 
         assert "GL024" in RULES
+
+
+class TestGL025FeedSync:
+    """GL025 is path-scoped to analyzer_tpu/sched/: a blocking
+    np.asarray(<non-literal>) or .block_until_ready() there serializes
+    the prefetched device feed. Literal arguments (the fingerprint's
+    np.asarray((a, b), int64)) are exempt — a host literal can never be
+    a device array."""
+
+    SRC = """
+    import numpy as np
+
+    def f(state):
+        np.asarray(state.table)
+        state.table.block_until_ready()
+        return np.array(state.table)
+    """
+
+    def test_fires_in_sched_only(self):
+        assert rules_of(self.SRC, "analyzer_tpu/sched/runner.py") == [
+            "GL025", "GL025", "GL025",
+        ]
+        assert rules_of(self.SRC, "analyzer_tpu/sched/feed.py") == [
+            "GL025", "GL025", "GL025",
+        ]
+
+    def test_silent_elsewhere(self):
+        for path in (
+            "analyzer_tpu/service/worker.py",
+            "analyzer_tpu/utils/host.py",  # fetch_tree's sanctioned home
+            "bench.py",
+            "snippet.py",
+        ):
+            assert rules_of(self.SRC, path) == [], path
+
+    def test_literal_args_exempt(self):
+        src = """
+        import numpy as np
+
+        def fingerprint(self):
+            return np.asarray(
+                (self.n_steps, self.batch_size), np.int64
+            ).tobytes()
+        """
+        assert rules_of(src, "analyzer_tpu/sched/superstep.py") == []
+
+    def test_jnp_asarray_is_fine(self):
+        # jnp.asarray is the H2D transfer direction — the feed's job,
+        # not a blocking fetch.
+        src = """
+        import jax.numpy as jnp
+
+        def stage(pidx):
+            return jnp.asarray(pidx)
+        """
+        assert rules_of(src, "analyzer_tpu/sched/superstep.py") == []
+
+    def test_numpy_alias_resolves(self):
+        src = """
+        import numpy
+
+        def f(ys):
+            return numpy.asarray(ys)
+        """
+        assert rules_of(src, "analyzer_tpu/sched/runner.py") == ["GL025"]
+
+    def test_disable_escape(self):
+        src = """
+        import numpy as np
+
+        def f(ys):
+            return np.asarray(ys)  # graftlint: disable=GL025 — final chunk-boundary sync
+        """
+        assert rules_of(src, "analyzer_tpu/sched/runner.py") == []
+
+    def test_windows_separators_normalized(self):
+        assert "GL025" in rules_of(
+            self.SRC, "analyzer_tpu\\sched\\runner.py"
+        )
+
+    def test_catalog_has_gl025(self):
+        from analyzer_tpu.lint.findings import RULES
+
+        assert "GL025" in RULES

@@ -407,7 +407,8 @@ class TestDeviceMemory:
         assert_prometheus_parses(txt)
 
 
-def _bench_line(value, degraded=False, streamed_min=None, stable=True):
+def _bench_line(value, degraded=False, streamed_min=None, stable=True,
+                min_over_device=None):
     line = {
         "metric": "matches_per_sec_per_chip",
         "value": value,
@@ -415,8 +416,12 @@ def _bench_line(value, degraded=False, streamed_min=None, stable=True):
         "vs_baseline": 1.0,
         "capture": {"degraded": degraded},
     }
-    if streamed_min is not None:
-        line["streamed"] = {"min_s": streamed_min, "stable": stable}
+    if streamed_min is not None or min_over_device is not None:
+        line["streamed"] = {"stable": stable}
+        if streamed_min is not None:
+            line["streamed"]["min_s"] = streamed_min
+        if min_over_device is not None:
+            line["streamed"]["min_over_device"] = min_over_device
     return line
 
 
@@ -449,6 +454,35 @@ class TestBenchdiff:
             _bench_line(1000.0, streamed_min=1.5),
         )
         assert main(["benchdiff", a, b, "--regress-pct", "10"]) == 1
+
+    def test_streamed_ratio_gates_on_feed_reserialization(self, tmp_path):
+        # streamed.min_over_device (lower-better): a change that
+        # re-serializes the feed moves the ratio even when absolute
+        # seconds hide behind a faster kernel — 1.1x -> 1.7x must fail
+        # the same gate as matches/sec, and the ratio must ride the
+        # artifact as its own comparable config.
+        from analyzer_tpu.cli import main
+        from analyzer_tpu.obs.benchdiff import bench_configs
+
+        line = _bench_line(1000.0, streamed_min=1.0, min_over_device=1.1)
+        names = [c.name for c in bench_configs(line)]
+        assert "streamed.min_over_device" in names
+        a = self._write(tmp_path / "BENCH_r01.json", line)
+        b = self._write(
+            tmp_path / "BENCH_r02.json",
+            _bench_line(1000.0, streamed_min=1.0, min_over_device=1.7),
+        )
+        assert main(["benchdiff", a, b, "--regress-pct", "10"]) == 1
+        # An improving ratio never gates.
+        assert main(["benchdiff", b, a, "--regress-pct", "10"]) == 0
+        # An unstable streamed capture is reported, not gated.
+        c = self._write(
+            tmp_path / "BENCH_r03.json",
+            _bench_line(
+                1000.0, streamed_min=1.0, min_over_device=1.7, stable=False
+            ),
+        )
+        assert main(["benchdiff", a, c, "--regress-pct", "10"]) == 0
 
     def test_degraded_capture_reported_not_gated(self, tmp_path, capsys):
         from analyzer_tpu.cli import main
