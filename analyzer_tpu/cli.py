@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 import numpy as np
@@ -212,6 +213,9 @@ def _rate_streamed(
             state, stream.slice(cursor, stream.n_matches), cfg,
             stats_out=stats, mesh=mesh,
             prefetch_depth=getattr(args, "prefetch_depth", None),
+            kernel=getattr(args, "kernel", "reference") if mesh is None
+            else "reference",
+            fuse_window=getattr(args, "fuse_window", None),
         )
         np.asarray(state.table[:1])  # force completion for honest timing
     if finalize is not None:
@@ -365,6 +369,19 @@ def _cmd_rate_impl(args) -> int:
     if args.mesh is not None and args.mesh < 0:
         print("error: --mesh must be >= 0 (0 = all devices)", file=sys.stderr)
         return 2
+    if args.mesh is not None and args.kernel == "fused":
+        # The sharded scatter is already per-shard compacted; a per-shard
+        # fused working set is future work (parallel/mesh.py tracks its
+        # value via mesh.writebacks_avoidable_total). Refuse rather than
+        # silently rating with a different kernel than asked.
+        print(
+            "error: --kernel fused is not supported with --mesh yet; "
+            "drop --mesh or use --kernel reference", file=sys.stderr,
+        )
+        return 2
+    if args.fuse_window is not None and args.fuse_window <= 0:
+        print("error: --fuse-window must be positive", file=sys.stderr)
+        return 2
     if not _require_one_source(args):
         return 2
     if args.db_write and not args.db:
@@ -444,6 +461,8 @@ def _cmd_rate_impl(args) -> int:
                 ),
                 on_chunk=on_chunk,
                 prefetch_depth=args.prefetch_depth,
+                kernel=args.kernel,
+                fuse_window=args.fuse_window,
             )
             np.asarray(state.table[:1])  # force completion for honest timing
     finally:
@@ -823,6 +842,13 @@ def cmd_bench(args) -> int:
     spec = importlib.util.spec_from_file_location("bench", path)
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
+    # The kernel knobs ride the env (bench.py's config surface) so
+    # `cli bench --kernel ...` and a bare BENCH_KERNEL=... bench.py run
+    # stay one code path.
+    if getattr(args, "kernel", None):
+        os.environ["BENCH_KERNEL"] = args.kernel
+    if getattr(args, "fuse_window", None):
+        os.environ["BENCH_FUSE_WINDOW"] = str(args.fuse_window)
     bench.main(
         metrics_out=getattr(args, "metrics_out", None),
         obs_port=getattr(args, "obs_port", None),
@@ -1167,6 +1193,24 @@ def main(argv=None) -> int:
         "runs; results are depth-invariant, HBM cost is N slabs "
         "(docs/observability.md, 'Prefetching device feed')",
     )
+    s.add_argument(
+        "--kernel", choices=("reference", "fused"),
+        default=os.environ.get("BENCH_KERNEL", "reference"),
+        help="device kernel: 'reference' = per-superstep gather/update/"
+        "scatter scan; 'fused' = VMEM-resident window kernel (each "
+        "touched player row gathered once and written back once per "
+        "--fuse-window supersteps; bit-identical results — "
+        "docs/kernels.md). Default from BENCH_KERNEL, else reference. "
+        "Not composable with --mesh yet",
+    )
+    s.add_argument(
+        "--fuse-window", type=int, metavar="K",
+        default=int(os.environ.get("BENCH_FUSE_WINDOW", 0)) or None,
+        help="supersteps per fused window dispatch (default 16; env "
+        "BENCH_FUSE_WINDOW). Larger K amortizes the per-window gather/"
+        "writeback further but grows the VMEM working set; overflow "
+        "splits the window (a counted spill)",
+    )
     s.set_defaults(fn=cmd_rate)
 
     s = sub.add_parser(
@@ -1220,6 +1264,16 @@ def main(argv=None) -> int:
         "--obs-port", type=int, metavar="PORT",
         help="serve the live introspection endpoints while the benchmark "
         "runs (watch /metrics mid-capture; 0 = ephemeral)",
+    )
+    s.add_argument(
+        "--kernel", choices=("reference", "fused"),
+        help="headline kernel (default: BENCH_KERNEL env, else fused). "
+        "'fused' times BOTH kernels and embeds a `fused` telemetry "
+        "block with min_over_reference in the BENCH line",
+    )
+    s.add_argument(
+        "--fuse-window", type=int, metavar="K",
+        help="fused window size (default: BENCH_FUSE_WINDOW env, else 16)",
     )
     s.set_defaults(fn=cmd_bench)
 

@@ -1,0 +1,271 @@
+"""Fused multi-superstep rating kernel: the VMEM-resident row chain.
+
+:mod:`analyzer_tpu.core.update` established the per-superstep cost split
+on v5e: gather + all closed-form compute ~35 us, the whole-row scatter
+~370 us at B=512 — and BASELINE.md's "Scatter floor" study showed no
+isolated scatter variant beats the ~72 ns/row serialization. The
+remaining headroom is therefore not a better scatter but FEWER scatters:
+this module executes a *window* of K conflict-free supersteps per
+dispatch against a working set of the window's touched player rows —
+
+  1. ONE gather pulls every touched row from the HBM table into the
+     working set (``table[slot_rows]``, [n_slots, 16]);
+  2. the K supersteps run entirely against the working set: each step
+     gathers its batch rows by *slot* index, applies the unchanged
+     closed-form TrueSkill update (:func:`~analyzer_tpu.core.update.
+     rate_gathered` — the same traced ops as the reference kernel), and
+     commits the posteriors back into the working set;
+  3. ONE scatter writes the working set back to HBM.
+
+A row that appears in ``r`` steps of the window pays the scatter floor
+once instead of ``r`` times — and active players recur constantly (the
+whole reason the scheduler needs conflict-free supersteps). The host
+side already knows every window's touched rows, so the residency plan
+(row -> slot map, :mod:`analyzer_tpu.sched.residency`) is computed
+alongside schedule packing and shipped with the slab; the device never
+sees player row ids inside the window, only slot ids.
+
+Backends (``backend=`` on every entry point):
+
+  * ``"scan"`` — a fused ``lax.scan`` body over the working set. The
+    portable default: bit-identical semantics on every JAX backend, and
+    already removes the per-step HBM round trip (XLA keeps the small
+    carry hot; the scatter serialization now runs against an
+    [n_slots, 16] buffer instead of the [P+1, 16] table).
+  * ``"pallas"`` — the Pallas TPU kernel: the working set lives in a
+    VMEM scratch buffer that persists across the sequential grid (one
+    grid step per superstep), so the whole chain runs on-chip and HBM
+    sees exactly one gather and one writeback per window.
+  * ``"interpret"`` — the same Pallas kernel under ``interpret=True``:
+    the CPU tier-1 path, exercising the kernel's structure without a
+    TPU (tests/test_fused.py).
+
+Numeric contract: the fused body reuses ``rate_gathered`` verbatim —
+the IEEE-exact-op discipline of ``serve/oracle.py`` (fixed-order team
+reductions, no FMA-contractible reassociation) survives fusion because
+the fused path adds no arithmetic, only different routing of the same
+values. Together with the pinned padding slot (slot 0 is a fixed point,
+mirroring ``scatter_rows``'s pinned padding row) this makes the fused
+window BIT-IDENTICAL to K applications of ``rate_and_apply`` for every
+window size — pinned by tests/test_fused.py, not hoped for.
+
+The padding-slot convention is load-bearing: slot 0 always holds the
+padding row (``sched.residency`` guarantees it), masked/no-write slots
+route their working-set writes to slot 0, and slot 0 is re-pinned after
+every step — so the slot mask is derivable on device as
+``slot_idx != 0`` and no slab ships it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.core.state import MatchBatch
+from analyzer_tpu.core.update import pack_outputs, rate_gathered
+
+#: The working-set slot every masked / non-ratable write routes to, and
+#: every padding team slot gathers from. Residency plans put the player
+#: table's padding row here unconditionally.
+PAD_SLOT = 0
+
+BACKENDS = ("scan", "pallas", "interpret")
+
+
+def _window_step(ws, xs, cfg: RatingConfig, collect: bool):
+    """One superstep against the working set ``ws`` [n_slots, 16].
+
+    ``xs`` is one step of the window slab: slot_idx [B, 2, T] int32,
+    winner/mode_id int (any width — widened here like ``expand_step``),
+    afk bool. Returns (new_ws, packed outputs | None). This function IS
+    the shared math of the scan and Pallas backends — both trace exactly
+    these ops, which is what makes them bit-identical to each other and
+    (via ``rate_gathered``) to the reference kernel."""
+    sidx, winner, mode_id, afk = xs
+    mask = sidx != PAD_SLOT
+    batch = MatchBatch(
+        player_idx=sidx,
+        slot_mask=mask,
+        winner=winner.astype(jnp.int32),
+        mode_id=mode_id.astype(jnp.int32),
+        afk=afk,
+    )
+    rows = ws[sidx]  # the in-window gather: slots, not player rows
+    out = rate_gathered(rows, batch, cfg)
+    do = out.updated[:, None, None] & mask
+    idx = jnp.where(do, sidx, PAD_SLOT)
+    new_ws = ws.at[idx].set(out.new_rows)
+    # Pin the pad slot (mirrors scatter_rows's pinned padding row): the
+    # routed no-write values above are junk, and later steps' masked
+    # slots gather slot 0 — it must stay the pristine padding row.
+    new_ws = new_ws.at[PAD_SLOT].set(ws[PAD_SLOT])
+    return new_ws, (pack_outputs(out) if collect else None)
+
+
+def _scan_window(ws, slot_idx, winner, mode_id, afk, cfg, collect):
+    """The portable fused window: ``lax.scan`` of the shared step body
+    over the K-step slab, carrying the working set."""
+
+    def step(carry, xs):
+        return _window_step(carry, xs, cfg, collect)
+
+    return jax.lax.scan(step, ws, (slot_idx, winner, mode_id, afk))
+
+
+def pallas_available() -> bool:
+    """Whether the Pallas backends can run in this build."""
+    try:  # pragma: no cover - trivially true or false per environment
+        from jax.experimental import pallas as pl  # noqa: F401
+        from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    except ImportError:
+        return False
+    return True
+
+
+def _pallas_window(ws, slot_idx, winner, mode_id, afk, cfg, collect, interpret):
+    """The Pallas fused window: grid = one program per superstep (TPU
+    executes the grid sequentially on a core), working set in a VMEM
+    scratch buffer that persists across grid steps. HBM -> VMEM happens
+    once (step 0 copies the gathered working set in), VMEM -> HBM once
+    (the last step copies it out); everything between is on-chip.
+
+    int8/bool slab scalars are widened to int32 *outside* the kernel —
+    sub-word blocks hit Mosaic tiling constraints — and the values are
+    unchanged, so the traced step math stays bit-identical to the scan
+    backend (which widens inside ``_window_step``)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    k, b, _, t = slot_idx.shape
+    ns, w = ws.shape
+    cw = 3 + 10 * t  # pack_outputs width
+
+    def kernel(ws_init, sidx_ref, win_ref, mode_ref, afk_ref, *rest):
+        if collect:
+            ws_out, ys_ref, scratch = rest
+        else:
+            (ws_out, scratch) = rest
+        s = pl.program_id(0)
+
+        @pl.when(s == 0)
+        def _():
+            scratch[...] = ws_init[...]
+
+        xs = (sidx_ref[0], win_ref[0], mode_ref[0], afk_ref[0] != 0)
+        new_ws, ys = _window_step(scratch[...], xs, cfg, collect)
+        scratch[...] = new_ws
+        if collect:
+            ys_ref[0] = ys
+
+        @pl.when(s == pl.num_programs(0) - 1)
+        def _():
+            ws_out[...] = scratch[...]
+
+    step_spec = lambda shape: pl.BlockSpec(  # noqa: E731 - local spec maker
+        (1,) + shape, lambda s: (s,) + (0,) * len(shape)
+    )
+    out_shape = [jax.ShapeDtypeStruct((ns, w), ws.dtype)]
+    out_specs = [pl.BlockSpec((ns, w), lambda s: (0, 0))]
+    if collect:
+        out_shape.append(jax.ShapeDtypeStruct((k, b, cw), ws.dtype))
+        out_specs.append(step_spec((b, cw)))
+    res = pl.pallas_call(
+        kernel,
+        grid=(k,),
+        in_specs=[
+            pl.BlockSpec((ns, w), lambda s: (0, 0)),
+            step_spec((b, 2, t)),
+            step_spec((b,)),
+            step_spec((b,)),
+            step_spec((b,)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((ns, w), ws.dtype)],
+        interpret=interpret,
+    )(
+        ws,
+        slot_idx,
+        winner.astype(jnp.int32),
+        mode_id.astype(jnp.int32),
+        afk.astype(jnp.int32),
+    )
+    if collect:
+        return res[0], res[1]
+    return res[0], None
+
+
+def fused_window_table(
+    table, slot_rows, slot_idx, winner, mode_id, afk,
+    cfg: RatingConfig, collect: bool, backend: str,
+):
+    """The fused window on a raw table (traced; jitted wrappers below).
+
+    table      [P+1, 16]      the HBM player table
+    slot_rows  [n_slots]      plan: slot -> player row (slot 0 = pad row,
+                              unused slots = pad row)
+    slot_idx   [K, B, 2, T]   plan: per-step batches in slot ids
+    winner     [K, B] int     mode_id [K, B] int    afk [K, B] bool
+
+    Returns (table, ys): ys is the ``[K, B, 3+10T]`` packed collect
+    tensor (``pack_outputs`` layout) or None. Inert padded steps (all
+    slots 0, unsupported mode) produce ys rows the caller drops via its
+    slot->match map.
+    """
+    if backend not in BACKENDS:
+        raise ValueError(f"unknown fused backend {backend!r}; use {BACKENDS}")
+    ws = table[slot_rows]  # the ONE per-window gather
+    if backend == "scan":
+        ws, ys = _scan_window(ws, slot_idx, winner, mode_id, afk, cfg, collect)
+    else:
+        ws, ys = _pallas_window(
+            ws, slot_idx, winner, mode_id, afk, cfg, collect,
+            interpret=backend == "interpret",
+        )
+    # The ONE per-window writeback. Duplicate indices (unused slots and
+    # slot 0 all map to the padding row) write bit-identical pristine
+    # pad-row values — unused slots are never touched and slot 0 is
+    # pinned — so the duplicate resolution order cannot matter.
+    return table.at[slot_rows].set(ws), ys
+
+
+_fused_window_jit = jax.jit(
+    fused_window_table, static_argnames=("cfg", "collect", "backend")
+)
+
+# Hot-loop variant mirroring update.rate_and_apply_step: donates the
+# table so XLA writes the window back into the existing HBM buffer.
+# ``table = fused_window_step(table, ...)[0]`` loops ONLY.
+fused_window_step = jax.jit(
+    fused_window_table,
+    static_argnames=("cfg", "collect", "backend"),
+    donate_argnums=(0,),
+)
+
+
+@partial(jax.jit, static_argnames=("cfg", "collect", "backend"))
+def _fused_window_state(state, slot_rows, slot_idx, winner, mode_id, afk,
+                        cfg, collect, backend):
+    table, ys = fused_window_table(
+        state.table, slot_rows, slot_idx, winner, mode_id, afk,
+        cfg, collect, backend,
+    )
+    return dataclasses.replace(state, table=table), ys
+
+
+def fused_apply_window(
+    state, slot_rows, slot_idx, winner, mode_id, afk,
+    cfg: RatingConfig, collect: bool = False, backend: str = "scan",
+):
+    """Non-donating PlayerState-level entry point (tests, one-shot use):
+    the caller's state stays valid. The scan runners use the donated
+    table-level :func:`fused_window_step` instead."""
+    return _fused_window_state(
+        state, jnp.asarray(slot_rows), jnp.asarray(slot_idx),
+        jnp.asarray(winner), jnp.asarray(mode_id), jnp.asarray(afk),
+        cfg, collect, backend,
+    )

@@ -36,9 +36,16 @@ floor") measured the lane-aligned alternatives and the production path
 WINS: a [P,128] table costs ~470 ns/row under XLA and ~380-410 ns/row
 under a Pallas per-row DMA ring (8-32 copies in flight — descriptor-issue
 bound, 512B moved per 64B updated), and Mosaic still rejects DMA on the
-native 16-float rows (128-lane alignment). At ~1M matches/s/chip the
-scatter floor is ~230x the BASELINE target; this is the measured bound,
-not a TODO.
+native 16-float rows (128-lane alignment). No isolated scatter beats the
+floor — so :mod:`analyzer_tpu.core.fused` stops paying it per STEP:
+a window of K conflict-free supersteps keeps every touched row resident
+in a working set across the whole window (gathered from the table once,
+written back once), turning the ~72 ns/row-per-step serialization into
+~72 ns/row-per-WINDOW for rows that recur within the window — the
+common case, since active players appear in many consecutive steps
+(docs/kernels.md has the full design and the VMEM budget math). The
+per-step floor below remains the bound for the reference kernel and for
+rows that appear once per window.
 
 Correctness precondition: no player index may appear twice among the ratable
 matches of one batch (the scatters would collide). The scheduler in
@@ -227,10 +234,24 @@ def scatter_rows(
     the padding row, so shapes stay static and no collision can occur as
     long as the batch is conflict-free. (The sharded-table mesh path in
     :mod:`analyzer_tpu.parallel.mesh` instead scatters host-precomputed
-    compacted per-shard row lists — see its ``build_routing``.)"""
+    compacted per-shard row lists — see its ``build_routing``.)
+
+    The padding row is RE-PINNED to its pre-step value after the scatter.
+    Without the pin, every no-write slot dumps its (per-slot, differing)
+    ``new_rows`` into the padding row through the duplicate-index scatter,
+    and XLA's duplicate resolution order is unspecified — so the padding
+    row held nondeterministic junk that later steps' masked slots then
+    GATHERED, leaking into the masked-slot fields of the collected
+    outputs. Pinning makes the padding row a fixed point (its seed
+    columns stay the baked pad seeds forever), which both kills that
+    nondeterminism and is what lets the fused window kernel
+    (:mod:`analyzer_tpu.core.fused`) reproduce the reference bit for bit:
+    its VMEM pad slot is pinned the same way."""
     do = updated[:, None, None] & slot_mask
     idx = jnp.where(do, player_idx, state.pad_row)
-    return dataclasses.replace(state, table=state.table.at[idx].set(new_rows))
+    pad_prev = state.table[state.pad_row]
+    table = state.table.at[idx].set(new_rows).at[state.pad_row].set(pad_prev)
+    return dataclasses.replace(state, table=table)
 
 
 def apply_outputs(
@@ -248,6 +269,33 @@ def rate_and_apply(
     """One superstep: rate a conflict-free batch and commit the posteriors."""
     out = rate_batch(state, batch, cfg)
     return apply_outputs(state, batch, out), out
+
+
+def pack_outputs(out: RateOutputs) -> jnp.ndarray:
+    """Packs the collectable per-match outputs into ONE ``[B, 3 + 10T]``
+    f32 tensor — layout: quality, any_afk, updated, then five ``[2T]``
+    blocks (shared_mu, shared_sigma, delta, mode_mu, mode_sigma). The
+    ``[B,2,T,16]`` new_rows stay out (scatter plumbing that would
+    dominate memory); one tensor = one D2H fetch per chunk. Shared by
+    the reference scan (``sched.runner._scan_chunk``) and the fused
+    window kernel (:mod:`analyzer_tpu.core.fused`) so the collect layout
+    — and its bit pattern — cannot drift between kernels;
+    ``sched.runner._gather_outputs`` unpacks it."""
+    b = out.quality.shape[0]
+    f32 = out.shared_mu.dtype
+    return jnp.concatenate(
+        [
+            out.quality[:, None].astype(f32),
+            out.any_afk[:, None].astype(f32),
+            out.updated[:, None].astype(f32),
+            out.shared_mu.reshape(b, -1),
+            out.shared_sigma.reshape(b, -1),
+            out.delta.reshape(b, -1),
+            out.mode_mu.reshape(b, -1),
+            out.mode_sigma.reshape(b, -1),
+        ],
+        axis=1,
+    )
 
 
 rate_and_apply_jit = jax.jit(rate_and_apply, static_argnames=("cfg",))
@@ -291,6 +339,44 @@ def check_conflict_free(batch: MatchBatch) -> None:
             f"batch is not conflict-free: player rows {dup[:16].tolist()} appear "
             "in multiple ratable matches; scatters would collide"
         )
+
+
+def check_window_conflict_free(
+    player_idx, ratable, pad_row=None, slot_mask=None
+) -> None:
+    """Window-level race detector: :func:`check_conflict_free` validates a
+    SINGLE batch, but a fused window dispatch (:mod:`analyzer_tpu.core.fused`)
+    commits K supersteps in one call — an untrusted window must have every
+    step conflict-free before any of them runs, or the mid-window working
+    set silently rates from a half-written row. ``player_idx`` is the
+    ``[K, B, 2, T]`` window, ``ratable`` the ``[K, B]`` write gate;
+    ``slot_mask`` defaults to the compact-feed invariant
+    ``player_idx != pad_row`` (pass one of the two)."""
+    import numpy as np
+
+    idx = np.asarray(player_idx)
+    ratable = np.asarray(ratable)
+    if slot_mask is None:
+        if pad_row is None:
+            raise TypeError(
+                "check_window_conflict_free needs pad_row or slot_mask to "
+                "tell padding slots from real players"
+            )
+        mask = idx != pad_row
+    else:
+        mask = np.asarray(slot_mask)
+    live = mask & ratable[:, :, None, None]
+    for s in range(idx.shape[0]):
+        flat = idx[s][live[s]]
+        uniq, counts = np.unique(flat, return_counts=True)
+        dup = uniq[counts > 1]
+        if dup.size:
+            raise ValueError(
+                f"window step {s} is not conflict-free: player rows "
+                f"{dup[:16].tolist()} appear in multiple ratable matches "
+                "of one superstep; the fused working-set writes would "
+                "collide"
+            )
 
 
 def check_skill_tiers(state: PlayerState) -> None:

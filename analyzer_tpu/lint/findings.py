@@ -45,6 +45,11 @@ RULES: dict[str, str] = {
         "blocking device sync (np.asarray on a device array / "
         ".block_until_ready()) in the sched feed hot path"
     ),
+    "GL026": (
+        "Pallas containment: pallas/pltpu import outside "
+        "analyzer_tpu/core/, or a literal interpret=True left enabled "
+        "outside tests"
+    ),
 }
 
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")

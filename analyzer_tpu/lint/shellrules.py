@@ -35,6 +35,18 @@ snapshot) route through ``utils.host.fetch_tree`` /
 an argument is a device array, so literal arguments (tuples, constants)
 are exempt and everything else in the scoped layer flags —
 conservative in exactly the direction the hot path wants.
+
+GL026 keeps the Pallas surface in ONE place: ``jax.experimental.pallas``
+/ ``pltpu`` imports belong in ``analyzer_tpu/core/`` — the fused window
+kernel (``core/fused.py``) — and test files; a second ad-hoc kernel
+home would fork the IEEE-exact-op discipline and the Mosaic workarounds
+that make the fused path bit-identical to the reference. Additionally a
+LITERAL ``interpret=True`` on a ``pallas_call`` flags everywhere
+outside tests: interpret mode is the CPU tier-1 harness, and a
+hardcoded literal left enabled ships a silently-interpreted
+(hundredfold slower) kernel to the TPU. Backend selection must flow
+through a variable (``core.fused`` threads ``backend=`` / the
+``ANALYZER_TPU_FUSE_BACKEND`` env).
 """
 
 from __future__ import annotations
@@ -59,6 +71,12 @@ _LITERAL_ARGS = (ast.Constant, ast.Tuple, ast.List, ast.Dict, ast.Set)
 #: query-serving plane.
 _GL024_SOCKET_DIRS = ("analyzer_tpu/obs/", "analyzer_tpu/serve/")
 _SERVER_MODULES = ("http.server", "socketserver")
+
+#: The sanctioned home for Pallas kernels (GL026): the fused window
+#: kernel module and its core/ siblings. Test files are exempt from
+#: both halves of the rule (they drive interpret mode on purpose).
+_GL026_PALLAS_DIRS = ("analyzer_tpu/core/",)
+_PALLAS_MODULES = ("jax.experimental.pallas",)
 
 _BROAD = {"Exception", "BaseException"}
 _MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
@@ -103,6 +121,8 @@ class ShellRules:
         timed_layer = self._in_timed_layer()
         obs_layer = self._in_obs_layer()
         feed_layer = self._in_feed_layer()
+        tests = self._in_tests()
+        pallas_home = self._in_pallas_home()
         for node in ast.walk(self.tree):
             if isinstance(node, ast.Try):
                 self._check_try(node)
@@ -113,10 +133,13 @@ class ShellRules:
                     self._check_raw_clock(node)
                 if feed_layer:
                     self._check_device_sync(node)
-            elif not obs_layer and isinstance(
-                node, (ast.Import, ast.ImportFrom)
-            ):
-                self._check_server_import(node)
+                if not tests:
+                    self._check_interpret_literal(node)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                if not obs_layer:
+                    self._check_server_import(node)
+                if not (tests or pallas_home):
+                    self._check_pallas_import(node)
             elif (
                 # graftlint: disable=GL024 — the rule's own needle
                 isinstance(node, ast.Constant) and node.value == "0.0.0.0"
@@ -142,6 +165,14 @@ class ShellRules:
         path = self.path.replace("\\", "/")
         return any(frag in path for frag in _GL025_DIRS)
 
+    def _in_pallas_home(self) -> bool:
+        path = self.path.replace("\\", "/")
+        return any(frag in path for frag in _GL026_PALLAS_DIRS)
+
+    def _in_tests(self) -> bool:
+        path = self.path.replace("\\", "/")
+        return "tests/" in path or path.rsplit("/", 1)[-1].startswith("test_")
+
     def _check_server_import(self, node) -> None:
         """GL024: a listening-socket module imported outside
         ``analyzer_tpu/obs/`` + ``analyzer_tpu/serve/`` — the shared
@@ -164,6 +195,59 @@ class ShellRules:
                     "the obsd/ratesrv planes (obs/httpd.py); build on "
                     "the shared plumbing instead of opening an ad-hoc "
                     "server",
+                )
+
+    def _check_pallas_import(self, node) -> None:
+        """GL026 (import half): ``jax.experimental.pallas``/``pltpu``
+        imported outside ``analyzer_tpu/core/`` — Pallas kernels live
+        next to the fused window kernel (``core/fused.py``) so the
+        IEEE-exact-op discipline and Mosaic workarounds have one home."""
+        if isinstance(node, ast.Import):
+            names = [a.name for a in node.names]
+        else:  # ImportFrom
+            names = [node.module] if node.module else []
+            if node.module == "jax.experimental":
+                names += [
+                    f"jax.experimental.{a.name}" for a in node.names
+                ]
+        for name in names:
+            if any(
+                name == mod or name.startswith(mod + ".")
+                for mod in _PALLAS_MODULES
+            ):
+                self._flag(
+                    "GL026", node,
+                    f"`{name}` imported outside analyzer_tpu/core/ — "
+                    "Pallas kernels live with the fused window kernel "
+                    "(core/fused.py); a second kernel home forks the "
+                    "bit-identity discipline (docs/kernels.md)",
+                )
+                return
+
+    def _check_interpret_literal(self, node: ast.Call) -> None:
+        """GL026 (interpret half): a LITERAL ``interpret=True`` on a
+        ``pallas_call`` outside tests ships an interpreted (hundredfold
+        slower) kernel to production; backend selection must flow
+        through a variable (``core.fused`` threads ``backend=``)."""
+        f = node.func
+        name = (
+            f.attr if isinstance(f, ast.Attribute)
+            else f.id if isinstance(f, ast.Name) else None
+        )
+        if name != "pallas_call":
+            return
+        for kw in node.keywords:
+            if (
+                kw.arg == "interpret"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+            ):
+                self._flag(
+                    "GL026", kw.value,
+                    "literal interpret=True on a pallas_call outside "
+                    "tests runs the kernel interpreted in production; "
+                    "thread the flag through a variable "
+                    "(core.fused backend=) so only tests pin it",
                 )
 
     def _check_raw_clock(self, node: ast.Call) -> None:

@@ -71,12 +71,20 @@ def bench_configs(data: dict) -> list[BenchConfig]:
     """The comparable configs inside one artifact.
 
     Write family (``BENCH_*``): the headline throughput (higher is
-    better) and, when present, the streamed end-to-end minimum (seconds
-    — lower is better) plus the streamed ``min_over_device`` ratio
-    (lower is better; the feed-overlap gate). Serve family (``SERVE_BENCH_*``, metric
+    better) and, when present, the capture's ``min_over_predicted``
+    ratio against the calibrated cost model (lower is better — a quiet
+    capture drifting above the model is the kernel regressing even when
+    the tunnel masks absolute time), the streamed end-to-end minimum
+    (seconds — lower is better) plus the streamed ``min_over_device``
+    ratio (lower is better; the feed-overlap gate), and the fused
+    kernel's ``min_over_reference`` (lower is better: <1.0 = the
+    VMEM-resident window kernel beats the reference; a regression back
+    toward 1.0 — including a silent fallback to the reference kernel —
+    fails the gate). Serve family (``SERVE_BENCH_*``, metric
     ``serve.*``): coalesced queries/sec (higher) and the client-observed
     p99 latency in ms (lower) from the ``latency_ms`` block."""
-    degraded = bool((data.get("capture") or {}).get("degraded"))
+    capture = data.get("capture") or {}
+    degraded = bool(capture.get("degraded"))
     out = [
         BenchConfig(
             name=str(data["metric"]),
@@ -97,6 +105,25 @@ def bench_configs(data: dict) -> list[BenchConfig]:
                 )
             )
         return out
+    if capture.get("min_over_predicted") is not None:
+        out.append(
+            BenchConfig(
+                name="capture.min_over_predicted",
+                value=float(capture["min_over_predicted"]),
+                higher_is_better=False,
+                degraded=degraded,
+            )
+        )
+    fused = data.get("fused") or {}
+    if fused.get("min_over_reference") is not None:
+        out.append(
+            BenchConfig(
+                name="fused.min_over_reference",
+                value=float(fused["min_over_reference"]),
+                higher_is_better=False,
+                degraded=degraded or not fused.get("stable", True),
+            )
+        )
     streamed = data.get("streamed") or {}
     if streamed.get("min_s") is not None:
         out.append(

@@ -184,8 +184,20 @@ STANDARD_COUNTERS = (
     # not as a missing series.
     "feed.starved_total",
     "feed.backpressure_total",
+    # The fused window kernel's feed (sched/residency.py plans staged by
+    # sched/feed.py): windows dispatched, VMEM-budget window cuts, the
+    # per-step scatter rows fusion eliminated, and the inert padding
+    # steps spills/tails cost. Pre-declared so "never spilled" reads 0.
+    "fused.windows_total",
+    "fused.spills_total",
+    "fused.writebacks_avoided_total",
+    "fused.pad_steps_total",
     "mesh.put_bytes_total",
     "mesh.puts_total",
+    # Residency reuse measured on the mesh feed's per-shard compacted
+    # row lists: scatter rows a per-shard fused working set would have
+    # saved (parallel/mesh.py — accounting now, kernel later).
+    "mesh.writebacks_avoidable_total",
     "jax.retraces_total",
     "jax.backend_compiles_total",
     "obs.flight_dumps_total",
@@ -201,6 +213,9 @@ STANDARD_GAUGES = (
     # Slab-ring occupancy of the prefetching device feed after the last
     # put/get (sched/feed.py): steady 0 on a busy run = host-bound.
     "feed.depth",
+    # Fused working-set high-water mark in table rows (the VMEM budget's
+    # denominator, sched/residency.py).
+    "fused.working_set_rows",
     # Per-device series (device.hbm_bytes_in_use{device=...}) appear on
     # first sample; the process total is pre-declared.
     "device.live_buffers",

@@ -27,6 +27,7 @@ All functions broadcast over arbitrary leading batch dims.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from analyzer_tpu.config import RatingConfig
@@ -78,9 +79,15 @@ def two_team_update(
     v = v_win(t)
     w = w_win(t, v)
 
-    # +1 for every slot on the winning team, -1 on the losing team.
-    team_sign = sign[..., None] * jnp.asarray([1.0, -1.0], dtype)  # [..., 2]
-    mu_new = mu + team_sign[..., None] * (s2 / c[..., None, None]) * v[..., None, None]
+    # +1 for every slot on the winning team, -1 on the losing team. The
+    # +/-1 pair is generated from an iota instead of a captured [1, -1]
+    # literal so the fused Pallas kernel (core/fused.py) can trace this
+    # body — kernels cannot close over array constants. (2, 1) keeps the
+    # iota >= 2-D for Mosaic; the +/-1 products are exact either way, so
+    # the update is bit-identical to the constant form.
+    team_pm = 1.0 - 2.0 * jax.lax.broadcasted_iota(dtype, (2, 1), 0)
+    team_sign = sign[..., None, None] * team_pm  # [..., 2, 1]
+    mu_new = mu + team_sign * (s2 / c[..., None, None]) * v[..., None, None]
     sigma_new = jnp.sqrt(s2 * (1.0 - (s2 / c2[..., None, None]) * w[..., None, None]))
 
     mu_new = jnp.where(mask, mu_new, mu)

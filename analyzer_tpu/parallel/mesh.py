@@ -423,11 +423,28 @@ class ShardedRun:
         self, pidx: np.ndarray, mask: np.ndarray, mode_id: np.ndarray,
         afk: np.ndarray,
     ) -> tuple[np.ndarray, np.ndarray]:
-        """Per-window routing, padded to the capacity bucket."""
+        """Per-window routing, padded to the capacity bucket.
+
+        Alongside the compaction, the window's written-row list feeds the
+        residency reuse accounting shared with the fused kernel's planner
+        (``sched.residency.window_reuse_stats``): each row instance beyond
+        its first is a scatter a per-shard fused working set would have
+        absorbed — the single-chip fused kernel (``core.fused``) already
+        does, and the per-shard variant would reuse exactly these
+        compacted ``dst`` lists for its plan. Until that kernel exists,
+        ``mesh.writebacks_avoidable_total`` quantifies what it is worth
+        per run instead of per investigation."""
+        from analyzer_tpu.sched.residency import window_reuse_stats
+
         ratable = (mode_id >= 0) & ~afk
         valid = mask & ratable[:, :, None, None]
         w = pidx.shape[0]
         idx = pidx.reshape(w, -1).astype(np.int64)
+        uniq, instances = window_reuse_stats(idx[valid.reshape(w, -1)])
+        if instances > uniq:
+            get_registry().counter("mesh.writebacks_avoidable_total").add(
+                instances - uniq
+            )
         sel, dst = _window_routing(
             idx, valid.reshape(w, -1), self.n_dev, self.rps
         )

@@ -21,19 +21,33 @@ from analyzer_tpu.sched.superstep import (
     pack_schedule,
 )
 from analyzer_tpu.sched.feed import DeviceFeed, Prefetcher
+from analyzer_tpu.sched.residency import (
+    FuseSpec,
+    ResidencyPlan,
+    check_plan,
+    plan_windows,
+    rate_window_checked,
+    resolve_fuse,
+)
 from analyzer_tpu.sched.runner import HistoryOutputs, rate_history, rate_stream
 
 __all__ = [
     "DeviceFeed",
+    "FuseSpec",
     "MatchStream",
     "PackedSchedule",
     "Prefetcher",
+    "ResidencyPlan",
     "WindowedSchedule",
     "assign_batches",
     "assign_supersteps",
+    "check_plan",
     "choose_batch_size",
     "choose_batch_size_streamed",
     "pack_schedule",
+    "plan_windows",
+    "rate_window_checked",
+    "resolve_fuse",
     "HistoryOutputs",
     "rate_history",
     "rate_stream",

@@ -186,3 +186,110 @@ def stage_chunk(sched, start: int, stop: int):
         pidx, _mask, winner, mode_id, afk = sched.host_window(start, stop)
     with tracer.span("feed.transfer", cat="sched", start=start):
         return compact_device_window(pidx, winner, mode_id, afk)
+
+
+class FusedChunk:
+    """One chunk staged for the fused window kernel: the residency-
+    planned per-window device slabs (``core.fused`` layout), the padded
+    slot->match map rows for collect reordering (``flat``, or None),
+    and the chunk's planner aggregates for bench telemetry."""
+
+    __slots__ = ("windows", "flat", "stats")
+
+    def __init__(self, windows, flat, stats):
+        self.windows = windows
+        self.flat = flat
+        self.stats = stats
+
+
+def stage_chunk_fused(sched, start: int, stop: int, fuse, collect: bool):
+    """Fused-path sibling of :func:`stage_chunk`: materializes the
+    chunk's gather tensors, residency-plans it into fused windows
+    (``feed.materialize`` span — the plan is host packing work), and
+    commits each window's slab (``feed.transfer`` span)."""
+    check = getattr(sched, "check_compact_invariant", None)
+    if check is not None:
+        check(start, stop)
+    tracer = get_tracer()
+    with tracer.span("feed.materialize", cat="sched", start=start):
+        pidx, _mask, winner, mode_id, afk = sched.host_window(start, stop)
+    return stage_fused_windows(
+        pidx, winner, mode_id, afk, sched.pad_row, fuse,
+        match_idx=sched.match_idx[start:stop] if collect else None,
+        start=start,
+    )
+
+
+def _pad_window_steps(arr, k: int, fill):
+    """Pads a window slab's leading (step) axis to the static window
+    size with an inert fill value."""
+    import numpy as np
+
+    extra = k - arr.shape[0]
+    if extra <= 0:
+        return arr
+    pad = np.full((extra,) + arr.shape[1:], fill, arr.dtype)
+    return np.concatenate([arr, pad])
+
+
+def stage_fused_windows(
+    pidx, winner, mode_id, afk, pad_row: int, fuse,
+    match_idx=None, start: int = 0,
+):
+    """The shared fused staging core (windowed-schedule chunks AND the
+    streamed feed): residency plans, per-window padding to the static
+    window size (inert steps: slot 0, unsupported mode — they read and
+    write only the pinned pad slot), and the async H2D commit of each
+    window's slab. ``match_idx`` (when collecting) yields the padded
+    slot->match rows, -1 on inert steps so ``_gather_outputs`` drops
+    them."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from analyzer_tpu.core import constants
+    from analyzer_tpu.sched.residency import (
+        plan_windows, record_plan_telemetry,
+    )
+
+    ratable = (mode_id >= 0) & ~afk
+    valid = (pidx != pad_row) & ratable[:, :, None, None]
+    plans = plan_windows(pidx, valid, pad_row, fuse.window, fuse.max_rows)
+    record_plan_telemetry(plans, fuse.window)
+    tracer = get_tracer()
+    windows = []
+    flat_parts = [] if match_idx is not None else None
+    k = fuse.window
+    s0 = 0
+    with tracer.span("feed.transfer", cat="sched", start=start):
+        for plan in plans:
+            s1 = s0 + plan.n_steps
+            windows.append((
+                jnp.asarray(plan.slot_rows),
+                jnp.asarray(_pad_window_steps(plan.slot_idx, k, 0)),
+                jnp.asarray(_pad_window_steps(
+                    winner[s0:s1].astype(np.int8), k, 0
+                )),
+                jnp.asarray(_pad_window_steps(
+                    mode_id[s0:s1].astype(np.int8), k,
+                    constants.UNSUPPORTED_MODE_ID,
+                )),
+                jnp.asarray(_pad_window_steps(afk[s0:s1], k, False)),
+            ))
+            if flat_parts is not None:
+                flat_parts.append(
+                    _pad_window_steps(match_idx[s0:s1], k, -1)
+                )
+            s0 = s1
+    stats = {
+        "windows": len(plans),
+        "spills": sum(1 for p in plans if p.spilled),
+        "writebacks_avoided": sum(p.writebacks_avoided for p in plans),
+        "pad_steps": sum(k - p.n_steps for p in plans),
+        "working_set_rows": max((p.n_live for p in plans), default=0),
+    }
+    return FusedChunk(
+        windows,
+        np.concatenate(flat_parts) if flat_parts else None,
+        stats,
+    )

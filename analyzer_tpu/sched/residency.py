@@ -1,0 +1,346 @@
+"""Per-window residency planning for the fused rating kernel.
+
+The fused window kernel (:mod:`analyzer_tpu.core.fused`) keeps every
+player row a window of supersteps touches resident in a working set —
+gathered from the HBM table once, written back once. The device side
+needs three things the schedule doesn't directly carry: which rows to
+gather (``slot_rows``), the per-step batches re-addressed in working-set
+slots (``slot_idx``), and a guarantee the working set fits the VMEM
+budget. All three are host-side facts the scheduler already knows — the
+assigner names every window's touched rows — so the plan is computed
+here, on the feed thread, alongside window materialization, and shipped
+with the slab (:func:`analyzer_tpu.sched.feed.stage_fused_windows`).
+
+Plan construction per window:
+
+  * slots are assigned in FIRST-TOUCH order (deterministic, so the whole
+    emitted schedule stays a pure function of the stream) with slot 0
+    unconditionally the padding row — the kernel derives the slot mask
+    as ``slot_idx != 0`` and routes every no-write to slot 0;
+  * ``first_use``/``last_use`` record each slot's live range within the
+    window (introspection + the overflow split below; the kernel itself
+    holds every slot for the whole window — eviction granularity is the
+    window boundary);
+  * the slot count is bucketed to the next power of two so consecutive
+    windows reuse one compiled kernel shape (unused slots point at the
+    padding row; they gather and write back the pristine pad row, which
+    duplicate-scatter-resolves deterministically because every copy is
+    bit-identical).
+
+VMEM budget / spill policy: when a window's working set would exceed
+``max_rows``, the window is CUT at the last step that still fits and the
+remainder becomes its own window(s) — a bulk spill at the cut, the whole
+working set written back and the next window re-gathering what it needs.
+The cut is exact, not iterative: with first-touch steps in hand, the
+working-set size of any prefix is the count of rows first touched at or
+before it. Cuts are counted (``fused.spills_total``) and shorter windows
+are padded back to the static window size with inert steps
+(``fused.pad_steps_total`` — the padding tax of a spill). Finer-grained
+eviction (per-slot LRU writeback mid-window) would need per-step
+variable writebacks inside the kernel; the window cut gets the same
+correctness at static shapes, and docs/kernels.md records the budget
+math that makes cuts rare at production batch sizes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+
+from analyzer_tpu.core import constants
+from analyzer_tpu.obs import get_registry as _obs_registry
+
+#: Default fused window: supersteps per dispatch. 16 amortizes the
+#: window gather/writeback over enough steps that recurring rows pay the
+#: scatter floor once, while keeping the working set (<= K * B * 2T new
+#: rows, far fewer with reuse) inside the slot budget at B=512.
+DEFAULT_WINDOW = 16
+
+#: Default working-set budget in table rows, rounded up to a power of
+#: two. 32768 rows x 64 B = 2 MiB — the VMEM budget math in
+#: docs/kernels.md: working set + its HBM staging copy + the K-step slab
+#: must fit ~16 MiB/core with double-buffering headroom.
+DEFAULT_MAX_ROWS = 32768
+
+#: Env override for the fused backend ("scan" | "pallas" | "interpret");
+#: the CLI/bench only expose kernel + window, so a TPU run can opt into
+#: the Pallas body without a code change.
+BACKEND_ENV = "ANALYZER_TPU_FUSE_BACKEND"
+
+
+@dataclasses.dataclass(frozen=True)
+class FuseSpec:
+    """Resolved fused-kernel parameters, threaded through the runners."""
+
+    window: int = DEFAULT_WINDOW
+    max_rows: int = DEFAULT_MAX_ROWS
+    backend: str = "scan"
+
+
+def resolve_fuse(
+    kernel: str,
+    fuse_window: int | None = None,
+    fuse_max_rows: int | None = None,
+    fuse_backend: str | None = None,
+) -> FuseSpec | None:
+    """``kernel`` ("reference" | "fused") + optional overrides -> a
+    :class:`FuseSpec`, or None for the reference path. The backend
+    defaults from ``ANALYZER_TPU_FUSE_BACKEND``, then "scan"."""
+    if kernel == "reference":
+        return None
+    if kernel != "fused":
+        raise ValueError(
+            f"unknown kernel {kernel!r}; use 'reference' or 'fused'"
+        )
+    backend = fuse_backend or os.environ.get(BACKEND_ENV) or "scan"
+    window = DEFAULT_WINDOW if fuse_window is None else fuse_window
+    if window < 1:
+        raise ValueError(f"fuse window must be >= 1, got {window}")
+    max_rows = _pow2(
+        DEFAULT_MAX_ROWS if fuse_max_rows is None else fuse_max_rows
+    )
+    return FuseSpec(window=window, max_rows=max_rows, backend=backend)
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+@dataclasses.dataclass
+class ResidencyPlan:
+    """One fused window's row -> VMEM-slot map.
+
+    slot_rows [n_slots] int32: player row per slot; slot 0 is the padding
+      row, unused bucket-padding slots also hold the padding row.
+    slot_idx  [n_steps, B, 2, T] int32: the window's batches re-addressed
+      in slots (REAL steps only; the stage pads to the static window).
+    first_use/last_use [n_live] int32: per-live-slot live range (step
+      indices within the window).
+    n_live: live slots including slot 0; the working-set size the VMEM
+      budget constrains.
+    writebacks_avoided: per-step scatter row-instances the fusion
+      eliminated (valid written slots minus unique written rows).
+    spilled: True when the VMEM budget cut this window short of the
+      requested window size.
+    """
+
+    slot_rows: np.ndarray
+    slot_idx: np.ndarray
+    first_use: np.ndarray
+    last_use: np.ndarray
+    n_live: int
+    writebacks_avoided: int
+    spilled: bool
+
+    @property
+    def n_steps(self) -> int:
+        return self.slot_idx.shape[0]
+
+    @property
+    def n_slots(self) -> int:
+        return self.slot_rows.size
+
+
+def plan_windows(
+    player_idx: np.ndarray,
+    valid: np.ndarray,
+    pad_row: int,
+    window: int,
+    max_rows: int,
+) -> list[ResidencyPlan]:
+    """Splits a chunk's ``[S, B, 2, T]`` gather window into fused windows
+    of at most ``window`` supersteps whose working set fits ``max_rows``
+    slots. ``valid`` is the written-slot mask (``slot_mask & ratable``),
+    used for the writebacks-avoided accounting only — residency itself
+    covers EVERY touched row (non-ratable matches still gather).
+
+    Deterministic and exact: the prefix working-set size is derived from
+    first-touch steps, so each cut lands on the last step that fits."""
+    if max_rows != _pow2(max_rows):
+        raise ValueError(f"max_rows must be a power of two, got {max_rows}")
+    s_total = player_idx.shape[0]
+    per_step = int(np.prod(player_idx.shape[1:]))
+    plans: list[ResidencyPlan] = []
+    s0 = 0
+    while s0 < s_total:
+        s1 = min(s0 + window, s_total)
+        sub = player_idx[s0:s1]
+        # Working-set size of every prefix from first-touch steps: a row
+        # first touched at step f is resident in any prefix reaching f.
+        flat = np.concatenate(
+            [np.full(1, pad_row, player_idx.dtype), sub.ravel()]
+        )
+        u, first = np.unique(flat, return_index=True)
+        first_step = np.maximum(first - 1, 0) // per_step
+        cum = np.cumsum(np.bincount(first_step, minlength=s1 - s0))
+        fits = int(np.searchsorted(cum, max_rows, side="right"))
+        if fits == 0:
+            raise ValueError(
+                f"one superstep touches {int(cum[0])} rows but the fused "
+                f"working-set budget is {max_rows}; raise fuse_max_rows "
+                "or shrink the batch size"
+            )
+        spilled = fits < (s1 - s0)
+        if spilled:
+            s1 = s0 + fits
+            sub = player_idx[s0:s1]
+        plans.append(
+            _build_plan(sub, valid[s0:s1], pad_row, spilled)
+        )
+        s0 = s1
+    return plans
+
+
+def _build_plan(
+    sub: np.ndarray, valid: np.ndarray, pad_row: int, spilled: bool
+) -> ResidencyPlan:
+    per_step = int(np.prod(sub.shape[1:]))
+    flat = np.concatenate([np.full(1, pad_row, sub.dtype), sub.ravel()])
+    u, first, inv = np.unique(flat, return_index=True, return_inverse=True)
+    order = np.argsort(first, kind="stable")  # first-touch order
+    rank = np.empty(u.size, np.int64)
+    rank[order] = np.arange(u.size)
+    slots_all = rank[inv]
+    # The virtual element at flat[0] makes the padding row's first touch
+    # position 0 unconditionally -> slot 0 (core.fused.PAD_SLOT).
+    slot_idx = slots_all[1:].reshape(sub.shape).astype(np.int32)
+    n_live = int(u.size)
+    n_slots = _pow2(max(n_live, 8))
+    slot_rows = np.full(n_slots, pad_row, np.int32)
+    slot_rows[rank] = u
+    first_use = np.empty(n_live, np.int32)
+    first_use[rank] = (np.maximum(first - 1, 0) // per_step).astype(np.int32)
+    last_pos = np.zeros(n_live, np.int64)
+    np.maximum.at(last_pos, slots_all[1:], np.arange(sub.size))
+    last_use = (last_pos // per_step).astype(np.int32)
+    written = sub[valid]
+    writebacks_avoided = int(written.size - np.unique(written).size)
+    return ResidencyPlan(
+        slot_rows=slot_rows,
+        slot_idx=slot_idx,
+        first_use=first_use,
+        last_use=last_use,
+        n_live=n_live,
+        writebacks_avoided=writebacks_avoided,
+        spilled=spilled,
+    )
+
+
+def record_plan_telemetry(plans: list[ResidencyPlan], window: int) -> None:
+    """The fused feed's observables (docs/observability.md catalog):
+    windows staged, budget spills, scatter rows avoided, inert padding
+    steps, and the working-set high-water mark."""
+    reg = _obs_registry()
+    reg.counter("fused.windows_total").add(len(plans))
+    spills = sum(1 for p in plans if p.spilled)
+    if spills:
+        reg.counter("fused.spills_total").add(spills)
+    avoided = sum(p.writebacks_avoided for p in plans)
+    if avoided:
+        reg.counter("fused.writebacks_avoided_total").add(avoided)
+    pad_steps = sum(window - p.n_steps for p in plans)
+    if pad_steps:
+        reg.counter("fused.pad_steps_total").add(pad_steps)
+    gauge = reg.gauge("fused.working_set_rows")
+    hi = max((p.n_live for p in plans), default=0)
+    if hi > gauge.value:
+        gauge.set(hi)
+
+
+def check_plan(
+    plan: ResidencyPlan, player_idx: np.ndarray, pad_row: int
+) -> None:
+    """Validates an UNTRUSTED residency plan against its window.
+
+    The planner holds these by construction; a hand-built or corrupted
+    plan that aliases two live rows to one VMEM slot would make the fused
+    chain silently rate one player with another's posterior — the fused
+    sibling of the scatter-collision race ``check_conflict_free`` guards
+    (SURVEY.md section 5.2). Raises ValueError with the offending slots.
+    """
+    live = plan.slot_rows[: plan.n_live]
+    uniq, counts = np.unique(live, return_counts=True)
+    dup = uniq[counts > 1]
+    if dup.size:
+        raise ValueError(
+            f"residency plan aliases player rows {dup[:16].tolist()} onto "
+            "shared VMEM slots: two live rows per slot means the fused "
+            "chain rates one player with another's posterior"
+        )
+    if plan.slot_rows[0] != pad_row:
+        raise ValueError(
+            f"residency plan slot 0 holds row {int(plan.slot_rows[0])}, "
+            f"not the padding row {pad_row}; the kernel routes every "
+            "masked write to slot 0 and would corrupt that player"
+        )
+    n_steps = plan.n_steps
+    if player_idx.shape[0] < n_steps:
+        raise ValueError(
+            f"residency plan covers {n_steps} steps but the window has "
+            f"{player_idx.shape[0]}"
+        )
+    recon = plan.slot_rows[plan.slot_idx]
+    # graftlint: disable=GL025 — untrusted-entry validation syncs on purpose
+    want = np.asarray(player_idx[:n_steps])
+    if not np.array_equal(recon, want):
+        bad = np.argwhere(recon != want)[:4]
+        raise ValueError(
+            "residency plan slot map disagrees with the window's player "
+            f"rows at (step, slot) {bad.tolist()}; the fused gather would "
+            "read the wrong players"
+        )
+
+
+def rate_window_checked(
+    state,
+    player_idx: np.ndarray,
+    winner: np.ndarray,
+    mode_id: np.ndarray,
+    afk: np.ndarray,
+    cfg,
+    plan: ResidencyPlan | None = None,
+    collect: bool = False,
+    backend: str = "scan",
+):
+    """Entry point for *untrusted* fused windows — the fused sibling of
+    ``core.update.rate_and_apply_checked``. Anything not produced by the
+    scheduler/planner pipeline (hand-built windows, replayed slabs) runs
+    the window-level race detector and the plan-aliasing check before the
+    fused dispatch commits K steps at once. ``plan=None`` builds a fresh
+    plan (then the checks pin the planner's own invariants)."""
+    from analyzer_tpu.core.fused import fused_apply_window
+    from analyzer_tpu.core.update import check_window_conflict_free
+
+    player_idx = np.ascontiguousarray(player_idx, np.int32)
+    # graftlint: disable=GL025 — untrusted-entry validation syncs on purpose
+    ratable = (np.asarray(mode_id) >= 0) & ~np.asarray(afk)
+    pad_row = state.pad_row
+    check_window_conflict_free(player_idx, ratable, pad_row=pad_row)
+    if plan is None:
+        valid = (player_idx != pad_row) & ratable[:, :, None, None]
+        plans = plan_windows(
+            player_idx, valid, pad_row,
+            window=player_idx.shape[0], max_rows=DEFAULT_MAX_ROWS,
+        )
+        if len(plans) != 1:  # pragma: no cover - budget >= one window here
+            raise ValueError("window exceeds the default residency budget")
+        plan = plans[0]
+    check_plan(plan, player_idx, pad_row)
+    return fused_apply_window(
+        state, plan.slot_rows, plan.slot_idx,
+        winner.astype(np.int32), mode_id.astype(np.int32), afk,
+        cfg, collect=collect, backend=backend,
+    )
+
+
+def window_reuse_stats(rows: np.ndarray) -> tuple[int, int]:
+    """(unique_rows, row_instances) over a window's written-row list —
+    the residency reuse measure. Shared with the sharded mesh feed
+    (:mod:`analyzer_tpu.parallel.mesh`), which applies it to its
+    per-shard compacted row lists to report how much a per-shard fused
+    window would save (``mesh.writebacks_avoidable_total``)."""
+    # graftlint: disable=GL025 — host row lists only (mesh routing input)
+    rows = np.asarray(rows).ravel()
+    return int(np.unique(rows).size), int(rows.size)

@@ -23,15 +23,23 @@ import jax.numpy as jnp
 import numpy as np
 
 from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.core import fused as fused_kernel
 from analyzer_tpu.core.state import MatchBatch, PlayerState
-from analyzer_tpu.core.update import rate_and_apply
+from analyzer_tpu.core.update import pack_outputs, rate_and_apply
 from analyzer_tpu.obs import (
     get_registry,
     get_tracer,
     maybe_sample_device_memory,
     track_jit,
 )
-from analyzer_tpu.sched.feed import DEFAULT_DEPTH, Prefetcher, stage_chunk
+from analyzer_tpu.sched.feed import (
+    DEFAULT_DEPTH,
+    Prefetcher,
+    stage_chunk,
+    stage_chunk_fused,
+    stage_fused_windows,
+)
+from analyzer_tpu.sched.residency import resolve_fuse
 from analyzer_tpu.sched.superstep import (
     PackedSchedule,
     compact_device_window,
@@ -80,27 +88,12 @@ def _scan_chunk(
         if not collect:
             return st, None
         # Collected outputs pack into ONE [B, 3 + 10T] f32 tensor (the
-        # [B,2,T,16] new_rows stay out — scatter plumbing that would
-        # dominate memory). One tensor = ONE D2H fetch per chunk: the
-        # service loop previously fetched 9 leaves per 500-match batch at
-        # ~a tunnel round trip each. Layout (unpacked by
-        # _gather_outputs): quality, any_afk, updated, then five [2T]
-        # blocks — shared_mu/sigma, delta, mode_mu/sigma.
-        b = out.quality.shape[0]
-        f32 = out.shared_mu.dtype
-        return st, jnp.concatenate(
-            [
-                out.quality[:, None].astype(f32),
-                out.any_afk[:, None].astype(f32),
-                out.updated[:, None].astype(f32),
-                out.shared_mu.reshape(b, -1),
-                out.shared_sigma.reshape(b, -1),
-                out.delta.reshape(b, -1),
-                out.mode_mu.reshape(b, -1),
-                out.mode_sigma.reshape(b, -1),
-            ],
-            axis=1,
-        )
+        # layout lives in core.update.pack_outputs, shared with the
+        # fused window kernel so the two cannot drift). One tensor = ONE
+        # D2H fetch per chunk: the service loop previously fetched 9
+        # leaves per 500-match batch at ~a tunnel round trip each.
+        # _gather_outputs unpacks.
+        return st, pack_outputs(out)
 
     return jax.lax.scan(step, state, arrays)
 
@@ -110,6 +103,34 @@ def _scan_chunk(
 # AFTER warmup is a retrace — the runtime form of graftlint's GL004/GL007
 # hazards, surfaced per entrypoint in every --metrics-out snapshot.
 track_jit("sched._scan_chunk", _scan_chunk)
+# The fused window kernel's shape ladder: one entry per (slot bucket,
+# window) pair — the pow2 slot bucketing exists to keep this ladder
+# short, and a moving cache after warmup means bucketing broke.
+track_jit("core.fused_window_step", fused_kernel.fused_window_step)
+
+
+def _dispatch_fused_chunk(state, staged, cfg, collect: bool, backend: str):
+    """Consumer-side fused dispatch of one staged chunk: every residency
+    window runs as one ``fused_window_step`` call (the table buffer is
+    donated window to window). Returns the new state and, when
+    collecting, the chunk's ``[n_windows * K, B, 3 + 10T]`` packed
+    outputs — same layout the reference scan emits, so the fetch
+    pipeline and ``_gather_outputs`` are shared."""
+    ys_parts = []
+    table = state.table
+    for slot_rows, slot_idx, winner, mode_id, afk in staged.windows:
+        table, ys = fused_kernel.fused_window_step(
+            table, slot_rows, slot_idx, winner, mode_id, afk,
+            cfg, collect, backend,
+        )
+        if collect:
+            ys_parts.append(ys)
+    state = dataclasses.replace(state, table=table)
+    if not collect:
+        return state, None
+    return state, (
+        ys_parts[0] if len(ys_parts) == 1 else jnp.concatenate(ys_parts)
+    )
 
 
 def rate_history(
@@ -123,9 +144,25 @@ def rate_history(
     on_chunk=None,
     view_publisher=None,
     prefetch_depth: int | None = None,
+    kernel: str = "reference",
+    fuse_window: int | None = None,
+    fuse_max_rows: int | None = None,
+    fuse_backend: str | None = None,
 ) -> tuple[PlayerState, HistoryOutputs | None]:
     """Rates a packed history. Returns the final state and, when
     ``collect``, per-match outputs reordered back to stream order.
+
+    ``kernel`` selects the device kernel: ``"reference"`` (the per-step
+    gather -> update -> scatter scan) or ``"fused"`` — the VMEM-resident
+    window kernel (:mod:`analyzer_tpu.core.fused`): each chunk is
+    residency-planned (:mod:`analyzer_tpu.sched.residency`) into windows
+    of ``fuse_window`` supersteps that gather every touched row once and
+    write it back once. Chunk boundaries, hooks, publishes, and results
+    are kernel-invariant — the fused path is bit-identical to the
+    reference (pinned by tests/test_fused.py). ``fuse_max_rows`` bounds
+    the working set (VMEM budget; overflow splits windows),
+    ``fuse_backend`` picks scan / pallas / interpret (default: the
+    ``ANALYZER_TPU_FUSE_BACKEND`` env, then the portable scan body).
 
     ``start_step`` re-enters the scan mid-schedule (checkpoint resume;
     the caller is responsible for passing the state snapshot taken at that
@@ -150,6 +187,7 @@ def rate_history(
     the chunk sequence, hook boundaries, and results are identical at
     every depth.
     """
+    fuse = resolve_fuse(kernel, fuse_window, fuse_max_rows, fuse_backend)
     n_steps = sched.n_steps if stop_after is None else min(stop_after, sched.n_steps)
     if steps_per_chunk is None:
         # ~8 chunks pipelines window materialization + H2D against the
@@ -177,15 +215,31 @@ def rate_history(
     def produce(put) -> None:
         for start in starts:
             stop = min(start + steps_per_chunk, n_steps)
-            put((start, stop, stage_chunk(sched, start, stop)))
+            if fuse is not None:
+                put((start, stop,
+                     stage_chunk_fused(sched, start, stop, fuse, collect)))
+            else:
+                put((start, stop, stage_chunk(sched, start, stop)))
 
+    # Fused + collect: inert window-padding steps make the emitted ys
+    # rows a superset of the schedule's — the staged chunks carry their
+    # own padded slot->match rows (-1 on inert steps) instead of
+    # sched.match_idx.
+    fused_flat = [] if (fuse is not None and collect) else None
     pending = None  # chunk k-1's outputs: fetched AFTER dispatching k
     with Prefetcher(produce, depth=prefetch_depth or DEFAULT_DEPTH) as pf:
         for start, stop, arrays in pf:
             with tracer.span("batch.compute", cat="sched", start=start):
-                state, ys = _scan_chunk(
-                    state, arrays, cfg, collect, sched.pad_row
-                )  # async dispatch
+                if fuse is not None:
+                    state, ys = _dispatch_fused_chunk(
+                        state, arrays, cfg, collect, fuse.backend
+                    )
+                    if fused_flat is not None:
+                        fused_flat.append(arrays.flat)
+                else:
+                    state, ys = _scan_chunk(
+                        state, arrays, cfg, collect, sched.pad_row
+                    )  # async dispatch
             del arrays  # let the consumed slab free when the scan is done
             if collect:
                 # One-chunk-deep fetch pipelining: start k's D2H stream
@@ -223,7 +277,13 @@ def rate_history(
         with tracer.span("batch.fetch", cat="sched", start=n_steps):
             outs.append(fetch_tree(pending))
 
-    flat_idx = sched.match_idx[start_step:n_steps].reshape(-1)
+    if fused_flat is not None:
+        flat_idx = (
+            np.concatenate(fused_flat).reshape(-1)
+            if fused_flat else np.empty(0, np.int32)
+        )
+    else:
+        flat_idx = sched.match_idx[start_step:n_steps].reshape(-1)
     return state, _gather_outputs(
         outs, flat_idx, sched.n_matches, sched.team_size
     )
@@ -293,12 +353,24 @@ def rate_stream(
     view_publisher=None,
     on_chunk=None,
     prefetch_depth: int | None = None,
+    kernel: str = "reference",
+    fuse_window: int | None = None,
+    fuse_max_rows: int | None = None,
+    fuse_backend: str | None = None,
 ) -> tuple[PlayerState, HistoryOutputs | None]:
     """Rates a raw MatchStream with the schedule built CONCURRENTLY with
     the device scan — the fully-streamed feed. ``stats_out`` (optional
     dict) receives n_steps / batch_size / occupancy after the run — the
     schedule never exists as one object here, so these are the only
     schedule-level observables.
+
+    ``kernel``/``fuse_*`` mirror :func:`rate_history`: ``"fused"``
+    residency-plans each emitted window on the feed thread and
+    dispatches it through the VMEM-resident window kernel; boundaries
+    and results are kernel-invariant. Not composable with ``mesh=`` —
+    the sharded scatter is already per-shard compacted and a per-shard
+    fused working set is future work (see ``parallel.mesh``'s reuse
+    accounting).
 
     ``mesh`` composes this feed with the sharded-table data parallelism
     (``parallel.mesh.ShardedRun``): every emitted window is routed per
@@ -391,12 +463,20 @@ def rate_stream(
         raise ValueError(
             f"stream team size {stream.team_size} exceeds team_size {team}"
         )
+    fuse = resolve_fuse(kernel, fuse_window, fuse_max_rows, fuse_backend)
     run = None
     if mesh is not None:
         if collect:
             raise ValueError(
                 "collect=True is not supported with mesh= (the sharded "
                 "scan carries only the table); use rate_history"
+            )
+        if fuse is not None:
+            raise ValueError(
+                "kernel='fused' is not supported with mesh= (the sharded "
+                "scatter is per-shard compacted; a per-shard fused "
+                "working set is tracked by parallel.mesh's "
+                "mesh.writebacks_avoidable_total accounting)"
             )
         from analyzer_tpu.parallel.mesh import ShardedRun
 
@@ -552,6 +632,13 @@ def rate_stream(
         with tracer.span("feed.materialize", cat="sched", start=e0):
             pidx, mask = materialize_gather_window(stream, mi, pad_row, team)
             winner, mode_id, afk = materialize_scalar_window(stream, mi)
+        if fuse is not None:
+            # Residency-planned fused windows (spans inside): the padded
+            # slot->match rows ride along for collect reordering.
+            return stage_fused_windows(
+                pidx, winner, mode_id, afk, pad_row, fuse,
+                match_idx=mi if collect else None, start=e0,
+            )
         with tracer.span("feed.transfer", cat="sched", start=e0):
             if run is not None:
                 return run.stage(pidx, mask, winner, mode_id, afk)
@@ -614,6 +701,7 @@ def rate_stream(
     # chunk's D2H fetch with the next chunk's compute (one-chunk-deep
     # fetch pipelining, same protocol as rate_history).
     pending = None
+    fused_flat = [] if (fuse is not None and collect) else None
     with Prefetcher(produce, depth=prefetch_depth or DEFAULT_DEPTH) as pf:
         for e0, e1, staged in pf:
             if run is not None:
@@ -621,9 +709,16 @@ def rate_stream(
                     run.dispatch_staged(staged)
             else:
                 with tracer.span("batch.compute", cat="sched", start=e0):
-                    state, ys = _scan_chunk(
-                        state, staged, cfg, collect, pad_row
-                    )
+                    if fuse is not None:
+                        state, ys = _dispatch_fused_chunk(
+                            state, staged, cfg, collect, fuse.backend
+                        )
+                        if fused_flat is not None:
+                            fused_flat.append(staged.flat)
+                    else:
+                        state, ys = _scan_chunk(
+                            state, staged, cfg, collect, pad_row
+                        )
                 if collect:
                     try:
                         ys.copy_to_host_async()
@@ -665,5 +760,11 @@ def rate_stream(
         view_publisher.publish_state(state)  # final table, unthrottled
     if not collect:
         return state, None
-    flat_idx = slot_map[: s_total * b]
+    if fused_flat is not None:
+        flat_idx = (
+            np.concatenate(fused_flat).reshape(-1)
+            if fused_flat else np.empty(0, np.int32)
+        )
+    else:
+        flat_idx = slot_map[: s_total * b]
     return state, _gather_outputs(outs, flat_idx, n, team)

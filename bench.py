@@ -43,6 +43,15 @@ ASAP width histogram against the v5e cost model
 (sched.choose_batch_size). The uncapped chain-bound profile remains
 reachable via BENCH_MAX_SHARE=0 for scheduler stress runs.
 
+The ``fused`` block captures the VMEM-resident window kernel
+(core/fused.py): when BENCH_KERNEL=fused (the default), BOTH kernels run
+under the same repeat protocol — the headline value is the fused
+throughput, ``fused.min_over_reference`` is the ratio the benchdiff gate
+watches (<1.0 = the fusion pays; ~1.0 = a silent fallback), and the
+block records the window size, working-set high-water mark, budget
+spills, writebacks avoided, and an on-rig bit-identity check of the two
+kernels' final tables.
+
 Env knobs: BENCH_MATCHES (default 500000), BENCH_PLAYERS (default
 BENCH_MATCHES//3), BENCH_BATCH (default 0 = auto), BENCH_REPEATS (default
 5), BENCH_CONC (default 0.8), BENCH_MAX_SHARE (default 1e-4; 0 = uncapped),
@@ -50,8 +59,12 @@ BENCH_MESH (default 0 = single device; N = data-parallel over the first N
 real devices via the sharded-table runner, metric still per chip),
 BENCH_FEED_DEPTH (default 0 = the feed's default ring depth; N sizes the
 prefetcher's committed-slab ring for the end-to-end lines — results are
-depth-invariant, only overlap changes), BENCH_OBS_PORT (serve obsd —
-/metrics, /statusz — on localhost while the capture runs;
+depth-invariant, only overlap changes), BENCH_KERNEL (default fused;
+reference skips the fused capture), BENCH_FUSE_WINDOW (default 16
+supersteps per fused dispatch), BENCH_FUSE_ROWS (working-set row budget,
+default sched.residency.DEFAULT_MAX_ROWS; the fused backend rides
+ANALYZER_TPU_FUSE_BACKEND — scan | pallas | interpret), BENCH_OBS_PORT
+(serve obsd — /metrics, /statusz — on localhost while the capture runs;
 `cli bench --obs-port` sets the same thing).
 """
 
@@ -211,29 +224,53 @@ def _bench_main(metrics_out: str | None) -> None:
     log(f"tunnel probe: {probe_ms:.0f} ms (quiet reference ~90-120); "
         f"cost model predicts {predicted:.3f}s quiet device time")
     state, best, times, stable = time_runs(run, repeats, max_extra=2 * repeats)
-    rate = sched.n_matches / best
+    log(f"reference kernel device-only best: {best:.3f}s")
+    del chunks  # free before staging the fused windows / e2e lines
+
+    # Fused window kernel (core/fused.py): SAME repeat protocol on the
+    # same schedule, pre-staged residency windows (the fused analogue of
+    # the pre-transferred chunks above), plus an on-rig bit-identity
+    # check of the two kernels' final tables. The headline becomes the
+    # fused throughput; min_over_reference is what benchdiff gates.
+    kernel = os.environ.get("BENCH_KERNEL", "fused")
+    fused_block = None
+    head_times, head_stable, head_best = times, stable, best
+    if kernel == "fused":
+        fused_block, fused_best, fused_table = bench_fused(
+            sched, state0, cfg, repeats, best
+        )
+        ref_table = np.asarray(state.table)
+        identical = bool(np.array_equal(ref_table, fused_table, equal_nan=True))
+        fused_block["bit_identical_to_reference"] = identical
+        if not identical:  # the acceptance contract — never report silently
+            log("WARNING: fused kernel table DIVERGED from reference")
+        head_times = fused_block.pop("_times")
+        head_stable = fused_block["stable"]
+        head_best = fused_best
+    rate = sched.n_matches / head_best
 
     # End-to-end feed+compute: the windowed schedule materializes gather
     # tensors inside rate_history's prefetch loop, so host packing work
     # overlaps the device scan. Reported as a ratio over pure device time
-    # (the VERDICT round-1 "host pipeline is serial" metric). Chunks are
-    # freed first so the schedule isn't resident twice.
-    del chunks
+    # (the VERDICT round-1 "host pipeline is serial" metric). The e2e
+    # lines run the HEADLINE kernel so their ratios stay comparable.
     from analyzer_tpu.sched import rate_history
 
     state_dev = jax.device_put(jax.tree.map(np.asarray, state0))
     feed_depth = int(os.environ.get("BENCH_FEED_DEPTH", 0)) or None
+    fuse_window = int(os.environ.get("BENCH_FUSE_WINDOW", 0)) or None
 
     def run_e2e():
         e2e_state, _ = rate_history(
-            state_dev, cfg=cfg, sched=sched, prefetch_depth=feed_depth
+            state_dev, cfg=cfg, sched=sched, prefetch_depth=feed_depth,
+            kernel=kernel, fuse_window=fuse_window,
         )
         np.asarray(e2e_state.table[:1])
         return e2e_state
 
     _, t_e2e, _, _ = time_runs(run_e2e, 2)
     log(f"end-to-end rate_history (overlapped windowed feed): {t_e2e:.2f}s "
-        f"= {t_e2e / best:.2f}x device-only time")
+        f"= {t_e2e / head_best:.2f}x device-only time")
 
     # Fully-streamed: the first-fit ASSIGNMENT also overlaps the scan
     # (worker thread + watermark, sched/runner.py rate_stream). This is
@@ -246,7 +283,8 @@ def _bench_main(metrics_out: str | None) -> None:
 
     def run_stream():
         s_state, _ = rate_stream(
-            state_dev, stream, cfg, prefetch_depth=feed_depth
+            state_dev, stream, cfg, prefetch_depth=feed_depth,
+            kernel=kernel, fuse_window=fuse_window,
         )
         np.asarray(s_state.table[:1])
         return s_state
@@ -255,26 +293,108 @@ def _bench_main(metrics_out: str | None) -> None:
         run_stream, repeats, max_extra=repeats
     )
     log(f"end-to-end rate_stream (assignment overlapped too): {t_stream:.2f}s "
-        f"= {t_stream / best:.2f}x device-only time")
-    streamed = streamed_stats(s_times, s_stable, best)
+        f"= {t_stream / head_best:.2f}x device-only time")
+    streamed = streamed_stats(s_times, s_stable, head_best)
 
     sanity(state, state0.n_players)
 
     probe_after = probe_tunnel()
     log(f"tunnel probe after: {probe_after:.0f} ms")
+    phases = {
+        "generate_s": t_gen,
+        "pack_s": t_pack,
+        "device_best_s": best,
+        "e2e_rate_history_s": t_e2e,
+        "e2e_rate_stream_s": t_stream,
+    }
+    if fused_block is not None:
+        phases["fused_best_s"] = head_best
     emit_metric(
         rate,
-        capture_stats(times, (probe_ms, probe_after), stable, predicted),
+        capture_stats(
+            head_times, (probe_ms, probe_after), head_stable, predicted
+        ),
         streamed,
-        telemetry=obs_breakdown({
-            "generate_s": t_gen,
-            "pack_s": t_pack,
-            "device_best_s": best,
-            "e2e_rate_history_s": t_e2e,
-            "e2e_rate_stream_s": t_stream,
-        }),
+        telemetry=obs_breakdown(phases),
         metrics_out=metrics_out,
+        fused=fused_block,
     )
+
+
+def bench_fused(sched, state0, cfg, repeats: int, ref_best: float):
+    """Times the fused window kernel on pre-staged residency windows.
+
+    Returns (fused_block, fused_best, final_table): the artifact block
+    (window/budget/spill/writeback stats from the planner, the repeat
+    list, and min_over_reference) plus the final table for the caller's
+    bit-identity check against the reference run."""
+    import jax
+
+    from analyzer_tpu.core.fused import fused_window_step
+    from analyzer_tpu.sched.feed import stage_chunk_fused
+    from analyzer_tpu.sched.residency import resolve_fuse
+
+    fuse = resolve_fuse(
+        "fused",
+        fuse_window=int(os.environ.get("BENCH_FUSE_WINDOW", 0)) or None,
+        fuse_max_rows=int(os.environ.get("BENCH_FUSE_ROWS", 0)) or None,
+    )
+    t0 = time.perf_counter()
+    steps_per_chunk = max(1, min(8192, sched.n_steps))
+    staged = []
+    stats = {"windows": 0, "spills": 0, "writebacks_avoided": 0,
+             "pad_steps": 0, "working_set_rows": 0}
+    for start in range(0, sched.n_steps, steps_per_chunk):
+        c = stage_chunk_fused(
+            sched, start, min(start + steps_per_chunk, sched.n_steps),
+            fuse, False,
+        )
+        staged.append(c)
+        for k in ("windows", "spills", "writebacks_avoided", "pad_steps"):
+            stats[k] += c.stats[k]
+        stats["working_set_rows"] = max(
+            stats["working_set_rows"], c.stats["working_set_rows"]
+        )
+    t_stage = time.perf_counter() - t0
+    log(f"fused staging (residency plans + transfers): {t_stage:.2f}s -> "
+        f"{stats['windows']} windows of {fuse.window} steps, "
+        f"working set <= {stats['working_set_rows']} rows, "
+        f"{stats['spills']} spills, "
+        f"{stats['writebacks_avoided']} writebacks avoided")
+
+    def run_fused():
+        table = jax.device_put(np.asarray(state0.table))
+        for c in staged:
+            for w in c.windows:
+                table, _ = fused_window_step(
+                    table, *w, cfg, False, fuse.backend
+                )
+        np.asarray(table[:1])
+        return table
+
+    table, fused_best, f_times, f_stable = time_runs(
+        run_fused, repeats, max_extra=2 * repeats
+    )
+    log(f"fused kernel device-only best: {fused_best:.3f}s = "
+        f"{fused_best / ref_best:.2f}x reference")
+    block = {
+        "window": fuse.window,
+        "backend": fuse.backend,
+        "max_rows": fuse.max_rows,
+        "working_set_rows": stats["working_set_rows"],
+        "windows": stats["windows"],
+        "spills": stats["spills"],
+        "writebacks_avoided": stats["writebacks_avoided"],
+        "pad_steps": stats["pad_steps"],
+        "stage_s": round(t_stage, 3),
+        "repeats_s": [round(t, 3) for t in f_times],
+        "min_s": round(fused_best, 3),
+        "stable": f_stable,
+        "reference_min_s": round(ref_best, 3),
+        "min_over_reference": round(fused_best / ref_best, 3),
+        "_times": f_times,
+    }
+    return block, fused_best, np.asarray(table)
 
 
 def probe_tunnel() -> float:
@@ -471,7 +591,8 @@ def obs_breakdown(phases: dict) -> dict:
 def emit_metric(rate, capture: dict | None = None,
                 streamed: dict | None = None,
                 telemetry: dict | None = None,
-                metrics_out: str | None = None):
+                metrics_out: str | None = None,
+                fused: dict | None = None):
     line = {
         "metric": "matches_per_sec_per_chip",
         "value": round(rate, 1),
@@ -485,6 +606,11 @@ def emit_metric(rate, capture: dict | None = None,
         line["capture"] = capture
     if streamed is not None:
         line["streamed"] = streamed
+    if fused is not None:
+        # The fused-kernel capture (window/residency stats + repeats +
+        # min_over_reference; benchdiff gates the ratio so a fused
+        # regression or a silent fallback-to-reference fails CI).
+        line["fused"] = fused
     if telemetry is not None:
         line["telemetry"] = telemetry
     if metrics_out:

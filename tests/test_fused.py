@@ -1,0 +1,486 @@
+"""The fused device-resident rating superstep (core/fused.py +
+sched/residency.py).
+
+The load-bearing property is BIT-IDENTITY: the fused window kernel —
+one working-set gather, K supersteps against the working set, one
+writeback — must reproduce ``rate_and_apply``'s final table AND the
+collected per-match outputs exactly, for every window size, both scan
+runners, every prefetch depth, and every backend (the portable fused
+scan and the Pallas kernel under ``interpret=True``). The unit half
+pins the residency planner's invariants (first-touch slots, the pinned
+pad slot, VMEM-budget window cuts) and the untrusted-entry checks that
+make a corrupted plan fail loudly instead of rating one player with
+another's posterior.
+"""
+
+import numpy as np
+import pytest
+
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.core.fused import PAD_SLOT, pallas_available
+from analyzer_tpu.core.state import PlayerState
+from analyzer_tpu.core.update import check_window_conflict_free
+from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
+from analyzer_tpu.obs import get_registry, retrace_counts
+from analyzer_tpu.sched import (
+    MatchStream,
+    check_plan,
+    pack_schedule,
+    plan_windows,
+    rate_history,
+    rate_stream,
+    rate_window_checked,
+)
+from analyzer_tpu.sched.residency import FuseSpec, resolve_fuse
+
+CFG = RatingConfig()
+
+_NO_PALLAS = not pallas_available()
+
+
+def small_stream(n_matches=300, n_players=60, seed=11, **kw):
+    players = synthetic_players(n_players, seed=seed)
+    stream = synthetic_stream(n_matches, players, seed=seed, **kw)
+    state = PlayerState.create(
+        n_players,
+        rank_points_ranked=players.rank_points_ranked,
+        rank_points_blitz=players.rank_points_blitz,
+        skill_tier=players.skill_tier,
+    )
+    return stream, state
+
+
+OUT_FIELDS = (
+    "quality", "shared_mu", "shared_sigma", "delta",
+    "mode_mu", "mode_sigma", "any_afk", "updated",
+)
+
+
+def assert_same_outputs(a, b, msg=""):
+    for field in OUT_FIELDS:
+        np.testing.assert_array_equal(
+            getattr(a, field), getattr(b, field), err_msg=f"{msg} {field}"
+        )
+
+
+class TestResidencyPlanner:
+    def window(self, pad_row=40):
+        # 3 steps, 2 matches, 1v1: rows chosen so 7 recurs across steps.
+        pidx = np.array(
+            [
+                [[[7], [3]], [[5], [pad_row]]],
+                [[[7], [9]], [[pad_row], [pad_row]]],
+                [[[2], [7]], [[9], [5]]],
+            ],
+            np.int32,
+        )
+        valid = pidx != pad_row
+        return pidx, valid
+
+    def test_first_touch_slot_order_and_pad_slot(self):
+        pidx, valid = self.window()
+        plans = plan_windows(pidx, valid, 40, window=3, max_rows=64)
+        assert len(plans) == 1
+        p = plans[0]
+        # Slot 0 is the pad row unconditionally; live rows follow in
+        # first-touch order: 7, 3, 5, then pad (touched in step 0), 9, 2.
+        assert p.slot_rows[PAD_SLOT] == 40
+        assert p.slot_rows[: p.n_live].tolist() == [40, 7, 3, 5, 9, 2]
+        assert p.n_live == 6
+        # Pow2 bucket, unused slots point at the pad row.
+        assert p.n_slots == 8
+        assert (p.slot_rows[p.n_live:] == 40).all()
+        # Reconstruction: slot_rows[slot_idx] is the original window.
+        np.testing.assert_array_equal(p.slot_rows[p.slot_idx], pidx)
+        # Live ranges: 7 spans the whole window, 2 only the last step.
+        by_row = {int(p.slot_rows[s]): s for s in range(p.n_live)}
+        assert p.first_use[by_row[7]] == 0 and p.last_use[by_row[7]] == 2
+        assert p.first_use[by_row[2]] == 2 and p.last_use[by_row[2]] == 2
+        # 7 is written in 3 steps, 5 and 9 in 2 each -> 4 avoided.
+        assert p.writebacks_avoided == 4
+        assert not p.spilled
+
+    def test_budget_overflow_splits_with_spill(self):
+        # Disjoint 3-row steps: working set grows 4 -> 7 -> 10 (with the
+        # pad slot). Budget 8 fits two steps, so the window is CUT there
+        # (a counted spill) and the remainder becomes its own window.
+        pad = 40
+        pidx = (1 + np.arange(9, dtype=np.int32)).reshape(3, 1, 1, 3)
+        pidx = np.concatenate([pidx, np.full((3, 1, 1, 3), pad)], axis=2)
+        valid = pidx != pad
+        plans = plan_windows(pidx, valid, pad, window=3, max_rows=8)
+        assert [p.n_steps for p in plans] == [2, 1]
+        assert [p.spilled for p in plans] == [True, False]
+        recon = np.concatenate([p.slot_rows[p.slot_idx] for p in plans])
+        np.testing.assert_array_equal(recon, pidx)
+
+    def test_single_step_over_budget_raises(self):
+        pidx, valid = self.window()
+        with pytest.raises(ValueError, match="working-set budget"):
+            plan_windows(pidx, valid, 40, window=3, max_rows=2)
+
+    def test_non_pow2_budget_rejected(self):
+        pidx, valid = self.window()
+        with pytest.raises(ValueError, match="power of two"):
+            plan_windows(pidx, valid, 40, window=3, max_rows=60)
+
+    def test_resolve_fuse(self):
+        assert resolve_fuse("reference") is None
+        spec = resolve_fuse("fused", fuse_window=4, fuse_max_rows=1000)
+        assert spec.window == 4
+        assert spec.max_rows == 1024  # rounded up to pow2
+        with pytest.raises(ValueError, match="kernel"):
+            resolve_fuse("warp")
+        with pytest.raises(ValueError, match="window"):
+            resolve_fuse("fused", fuse_window=0)
+
+
+class TestPlanChecks:
+    def good_plan(self):
+        stream, state = small_stream(n_matches=40, n_players=30, seed=3)
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=8)
+        pidx = sched.player_idx[:4]
+        valid = sched.valid_slots[:4]
+        (plan,) = plan_windows(
+            pidx, valid, state.pad_row, window=4, max_rows=1024
+        )
+        return plan, pidx, state
+
+    def test_planner_output_validates(self):
+        plan, pidx, state = self.good_plan()
+        check_plan(plan, pidx, state.pad_row)  # no raise
+
+    def test_aliased_slot_caught(self):
+        plan, pidx, state = self.good_plan()
+        # Alias two live rows onto one slot — the fused chain would rate
+        # one player with the other's posterior.
+        plan.slot_rows[2] = plan.slot_rows[1]
+        with pytest.raises(ValueError, match="aliases"):
+            check_plan(plan, pidx, state.pad_row)
+
+    def test_wrong_pad_slot_caught(self):
+        plan, pidx, state = self.good_plan()
+        plan.slot_rows[0] = 0
+        with pytest.raises(ValueError, match="slot 0"):
+            check_plan(plan, pidx, state.pad_row)
+
+    def test_slot_map_mismatch_caught(self):
+        plan, pidx, state = self.good_plan()
+        pidx = pidx.copy()
+        flip = pidx[0, 0, 0, 0]
+        pidx[0, 0, 0, 0] = flip + 1 if flip != state.pad_row else 0
+        with pytest.raises(ValueError, match="disagrees"):
+            check_plan(plan, pidx, state.pad_row)
+
+    def test_window_conflict_free_detector(self):
+        pad = 40
+        good = np.array(
+            [[[[1], [2]], [[3], [4]]], [[[1], [3]], [[2], [4]]]], np.int32
+        )
+        ratable = np.ones(good.shape[:2], bool)
+        check_window_conflict_free(good, ratable, pad_row=pad)  # re-use
+        # across steps is legal; a dup INSIDE one step is the race.
+        bad = good.copy()
+        bad[1, 1, 0, 0] = 1
+        with pytest.raises(ValueError, match="window step 1"):
+            check_window_conflict_free(bad, ratable, pad_row=pad)
+        # Non-ratable matches don't write -> their rows can't collide.
+        ratable2 = ratable.copy()
+        ratable2[1, 1] = False
+        check_window_conflict_free(bad, ratable2, pad_row=pad)
+        with pytest.raises(TypeError, match="pad_row or slot_mask"):
+            check_window_conflict_free(bad, ratable)
+
+    def test_rate_window_checked_matches_reference_and_rejects_bad(self):
+        from analyzer_tpu.core.state import MatchBatch
+        from analyzer_tpu.core.update import rate_and_apply_jit
+
+        stream, state = small_stream(n_matches=30, n_players=30, seed=5)
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=8)
+        k = min(4, sched.n_steps)
+        pidx = sched.player_idx[:k]
+        ref = state
+        for s in range(k):
+            batch = MatchBatch(
+                player_idx=pidx[s],
+                slot_mask=sched.slot_mask[s],
+                winner=sched.winner[s],
+                mode_id=sched.mode_id[s],
+                afk=sched.afk[s],
+            )
+            ref, _ = rate_and_apply_jit(ref, batch, CFG)
+        got, _ = rate_window_checked(
+            state, pidx, sched.winner[:k], sched.mode_id[:k], sched.afk[:k],
+            CFG,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.table), np.asarray(got.table)
+        )
+        # An aliased plan must be rejected before anything runs.
+        valid = sched.valid_slots[:k]
+        (plan,) = plan_windows(
+            pidx, valid, state.pad_row, window=k, max_rows=1024
+        )
+        plan.slot_rows[2] = plan.slot_rows[1]
+        with pytest.raises(ValueError, match="aliases"):
+            rate_window_checked(
+                state, pidx, sched.winner[:k], sched.mode_id[:k],
+                sched.afk[:k], CFG, plan=plan,
+            )
+
+
+class TestFusedBitIdentity:
+    """Fused-vs-reference across window sizes x runners x depths — the
+    acceptance contract (the ring and the fusion reorder time and
+    memory traffic, never results)."""
+
+    @pytest.mark.parametrize("window", [1, 4, 16])
+    def test_rate_history_windows(self, window):
+        stream, state = small_stream(n_matches=300, n_players=60, seed=21)
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=16)
+        base, base_outs = rate_history(
+            state, sched, CFG, collect=True, steps_per_chunk=5
+        )
+        for depth in (1, 3):
+            got, outs = rate_history(
+                state, sched, CFG, collect=True, steps_per_chunk=5,
+                prefetch_depth=depth, kernel="fused", fuse_window=window,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(base.table), np.asarray(got.table),
+                err_msg=f"window={window} depth={depth}",
+            )
+            assert_same_outputs(
+                base_outs, outs, f"window={window} depth={depth}"
+            )
+
+    @pytest.mark.parametrize("window", [1, 4, 16])
+    def test_rate_stream_windows(self, window):
+        stream, state = small_stream(n_matches=400, n_players=60, seed=23)
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=16)
+        base, base_outs = rate_history(state, sched, CFG, collect=True)
+        for depth in (1, 3):
+            got, outs = rate_stream(
+                state, stream, CFG, collect=True, batch_size=16,
+                steps_per_chunk=7, prefetch_depth=depth,
+                kernel="fused", fuse_window=window,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(base.table), np.asarray(got.table),
+                err_msg=f"window={window} depth={depth}",
+            )
+            assert_same_outputs(
+                base_outs, outs, f"window={window} depth={depth}"
+            )
+
+    def test_filler_heavy_stream(self):
+        stream, state = small_stream(
+            n_matches=200, n_players=40, seed=29, afk_rate=0.6,
+            unsupported_rate=0.1,
+        )
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=8)
+        base, base_outs = rate_history(state, sched, CFG, collect=True)
+        got, outs = rate_stream(
+            state, stream, CFG, collect=True, batch_size=8,
+            steps_per_chunk=5, kernel="fused", fuse_window=4,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(base.table), np.asarray(got.table)
+        )
+        assert_same_outputs(base_outs, outs, "filler-heavy")
+
+    def test_narrow_team_padding_edges(self):
+        # A 3-wide stream packed at team_size=5: the padded team tail all
+        # points at the pad row -> slot 0, exercising the pinned pad slot
+        # on every single gather.
+        stream, state = small_stream(n_matches=150, n_players=40, seed=31)
+        sched = pack_schedule(
+            stream, pad_row=state.pad_row, batch_size=8, team_size=5
+        )
+        base, base_outs = rate_history(state, sched, CFG, collect=True)
+        got, outs = rate_history(
+            state, sched, CFG, collect=True, steps_per_chunk=4,
+            kernel="fused", fuse_window=4,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(base.table), np.asarray(got.table)
+        )
+        assert_same_outputs(base_outs, outs, "narrow-team")
+
+    def test_working_set_overflow_spills_correctly(self):
+        # A budget barely above one step's touched rows forces window
+        # cuts (bulk spills). Results must not move; the spills must be
+        # visible in telemetry.
+        stream, state = small_stream(n_matches=300, n_players=200, seed=37)
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=8)
+        base, base_outs = rate_history(state, sched, CFG, collect=True)
+        spills0 = get_registry().counter("fused.spills_total").value
+        got, outs = rate_history(
+            state, sched, CFG, collect=True,
+            kernel="fused", fuse_window=16, fuse_max_rows=64,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(base.table), np.asarray(got.table)
+        )
+        assert_same_outputs(base_outs, outs, "spill")
+        assert get_registry().counter("fused.spills_total").value > spills0
+
+    def test_chain_bound_stream(self):
+        # Player 0 in every match: maximal in-window reuse — the case
+        # the fusion exists for (one writeback instead of n_steps).
+        n = 60
+        idx = np.zeros((n, 2, 3), np.int32)
+        idx[:, 0] = [0, 1, 2]
+        idx[:, 1, :] = np.arange(3, 3 * n + 3).reshape(n, 3) % 31 + 3
+        stream = MatchStream(
+            player_idx=idx,
+            winner=(np.arange(n) % 2).astype(np.int32),
+            mode_id=np.zeros(n, np.int32),
+            afk=np.zeros(n, bool),
+        )
+        state = PlayerState.create(40)
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=8)
+        base, _ = rate_history(state, sched, CFG)
+        got, _ = rate_history(
+            state, sched, CFG, kernel="fused", fuse_window=8,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(base.table), np.asarray(got.table)
+        )
+
+    def test_mesh_rejects_fused(self):
+        stream, state = small_stream(n_matches=50, n_players=30, seed=41)
+        with pytest.raises(ValueError, match="mesh"):
+            rate_stream(
+                state, stream, CFG, batch_size=8, kernel="fused",
+                mesh=object(),
+            )
+
+
+@pytest.mark.skipif(_NO_PALLAS, reason="Pallas unavailable in this build")
+class TestPallasBackend:
+    """The Pallas kernel under interpret=True (the CPU tier-1 path) must
+    equal the portable scan backend — which the suite above pins to the
+    reference — bit for bit."""
+
+    @pytest.mark.parametrize("window", [1, 4, 16])
+    def test_interpret_matches_reference(self, window):
+        stream, state = small_stream(n_matches=200, n_players=50, seed=43)
+        sched = pack_schedule(stream, pad_row=state.pad_row, batch_size=8)
+        base, base_outs = rate_history(state, sched, CFG, collect=True)
+        got, outs = rate_history(
+            state, sched, CFG, collect=True, steps_per_chunk=6,
+            kernel="fused", fuse_window=window, fuse_backend="interpret",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(base.table), np.asarray(got.table),
+            err_msg=f"window={window}",
+        )
+        assert_same_outputs(base_outs, outs, f"window={window}")
+
+    def test_interpret_stream_with_spills(self):
+        stream, state = small_stream(n_matches=150, n_players=40, seed=47)
+        base, _ = rate_stream(state, stream, CFG, batch_size=8)
+        got, _ = rate_stream(
+            state, stream, CFG, batch_size=8, steps_per_chunk=5,
+            kernel="fused", fuse_window=8, fuse_max_rows=64,
+            fuse_backend="interpret",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(base.table), np.asarray(got.table)
+        )
+
+
+class TestFusedSteadyState:
+    def test_repeat_runs_do_not_retrace(self):
+        # Pow2 slot bucketing + static window padding exist so repeated
+        # runs reuse one compiled ladder: a second identical run must add
+        # ZERO entries to the fused kernel's jit cache.
+        stream, state = small_stream(n_matches=300, n_players=60, seed=17)
+        run = lambda: rate_stream(
+            state, stream, CFG, batch_size=16, steps_per_chunk=6,
+            kernel="fused", fuse_window=4,
+        )
+        run()  # warm the shape ladder
+        warm = retrace_counts()["core.fused_window_step"]
+        run()
+        assert retrace_counts()["core.fused_window_step"] == warm
+
+    def test_telemetry_counters_move(self):
+        reg = get_registry()
+        w0 = reg.counter("fused.windows_total").value
+        stream, state = small_stream(n_matches=120, n_players=40, seed=19)
+        rate_stream(
+            state, stream, CFG, batch_size=8, steps_per_chunk=4,
+            kernel="fused", fuse_window=4,
+        )
+        assert reg.counter("fused.windows_total").value > w0
+        assert reg.gauge("fused.working_set_rows").value > 0
+
+
+class TestBenchdiffFusedFamily:
+    """cli benchdiff gates the fused capture: a fused-path regression —
+    or a silent fallback-to-reference pushing the ratio to ~1.0 — must
+    fail, and capture.min_over_predicted is gated alongside."""
+
+    def artifact(self, value, fused_ratio=None, predicted_ratio=None,
+                 stable=True, degraded=False):
+        data = {
+            "metric": "matches_per_sec_per_chip",
+            "value": value,
+            "unit": "matches/s",
+            "capture": {"degraded": degraded},
+        }
+        if predicted_ratio is not None:
+            data["capture"]["min_over_predicted"] = predicted_ratio
+        if fused_ratio is not None:
+            data["fused"] = {
+                "min_over_reference": fused_ratio, "stable": stable,
+            }
+        return data
+
+    def diff(self, a, b, pct=5.0):
+        from analyzer_tpu.obs.benchdiff import bench_configs, diff_configs
+
+        return diff_configs(bench_configs(a), bench_configs(b), pct)
+
+    def test_fused_regression_gates(self):
+        rows = self.diff(
+            self.artifact(1_500_000, fused_ratio=0.6),
+            self.artifact(1_480_000, fused_ratio=0.98),
+        )
+        by = {r.name: r for r in rows}
+        r = by["fused.min_over_reference"]
+        assert r.regressed and r.gated
+
+    def test_fused_improvement_passes(self):
+        rows = self.diff(
+            self.artifact(900_000, fused_ratio=0.9),
+            self.artifact(1_500_000, fused_ratio=0.55),
+        )
+        assert not any(r.regressed and r.gated for r in rows)
+
+    def test_unstable_fused_capture_not_gated(self):
+        rows = self.diff(
+            self.artifact(1_500_000, fused_ratio=0.6),
+            self.artifact(1_500_000, fused_ratio=1.0, stable=False),
+        )
+        by = {r.name: r for r in rows}
+        r = by["fused.min_over_reference"]
+        assert r.regressed and not r.gated
+
+    def test_min_over_predicted_gates(self):
+        rows = self.diff(
+            self.artifact(900_000, predicted_ratio=1.0),
+            self.artifact(900_000, predicted_ratio=1.3),
+        )
+        by = {r.name: r for r in rows}
+        r = by["capture.min_over_predicted"]
+        assert r.regressed and r.gated
+
+    def test_absent_fused_block_is_not_compared(self):
+        rows = self.diff(
+            self.artifact(900_000, fused_ratio=0.6),
+            self.artifact(910_000),
+        )
+        assert "fused.min_over_reference" not in {r.name for r in rows}

@@ -923,3 +923,102 @@ class TestGL025FeedSync:
         from analyzer_tpu.lint.findings import RULES
 
         assert "GL025" in RULES
+
+
+class TestGL026PallasContainment:
+    """GL026 keeps the Pallas surface in one place: pallas/pltpu imports
+    flag outside analyzer_tpu/core/ (the fused window kernel's home) and
+    outside tests; a LITERAL interpret=True on a pallas_call flags
+    everywhere outside tests — it would ship an interpreted kernel to
+    the TPU."""
+
+    IMPORTS = """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    import jax.experimental.pallas.tpu
+    """
+
+    def test_import_fires_outside_core(self):
+        for path in (
+            "analyzer_tpu/sched/runner.py",
+            "analyzer_tpu/serve/engine.py",
+            "bench.py",
+            "snippet.py",
+        ):
+            assert rules_of(self.IMPORTS, path) == [
+                "GL026", "GL026", "GL026",
+            ], path
+
+    def test_import_sanctioned_in_core_and_tests(self):
+        for path in (
+            "analyzer_tpu/core/fused.py",
+            "analyzer_tpu/core/update.py",
+            "tests/test_fused.py",
+            "test_kernels.py",
+        ):
+            assert rules_of(self.IMPORTS, path) == [], path
+
+    def test_unrelated_experimental_imports_are_fine(self):
+        src = """
+        from jax.experimental import mesh_utils
+        import jax.experimental.multihost_utils
+        """
+        assert rules_of(src, "analyzer_tpu/parallel/mesh.py") == []
+
+    def test_literal_interpret_true_fires_even_in_core(self):
+        src = """
+        import jax
+        from jax.experimental import pallas as pl
+
+        def f(kernel, x):
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                interpret=True,
+            )(x)
+        """
+        # core/ may IMPORT pallas, but a hardcoded interpret=True is a
+        # production hazard everywhere outside tests.
+        assert rules_of(src, "analyzer_tpu/core/fused.py") == ["GL026"]
+
+    def test_interpret_variable_is_fine(self):
+        src = """
+        import jax
+        from jax.experimental import pallas as pl
+
+        def f(kernel, x, interpret):
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                interpret=interpret,
+            )(x)
+        """
+        assert rules_of(src, "analyzer_tpu/core/fused.py") == []
+
+    def test_interpret_true_sanctioned_in_tests(self):
+        src = """
+        from jax.experimental import pallas as pl
+
+        def f(kernel, x, shape):
+            return pl.pallas_call(kernel, out_shape=shape, interpret=True)(x)
+        """
+        assert rules_of(src, "tests/test_fused.py") == []
+
+    def test_disable_escape(self):
+        src = """
+        from jax.experimental import pallas as pl  # graftlint: disable=GL026 — experiment harness
+        """
+        assert rules_of(src, "experiments/scatter_floor.py") == []
+
+    def test_windows_separators_normalized(self):
+        assert rules_of(
+            self.IMPORTS, "analyzer_tpu\\core\\fused.py"
+        ) == []
+        assert "GL026" in rules_of(
+            self.IMPORTS, "analyzer_tpu\\sched\\runner.py"
+        )
+
+    def test_catalog_has_gl026(self):
+        from analyzer_tpu.lint.findings import RULES
+
+        assert "GL026" in RULES
