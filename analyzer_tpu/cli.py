@@ -216,6 +216,7 @@ def _rate_streamed(
             kernel=getattr(args, "kernel", "reference") if mesh is None
             else "reference",
             fuse_window=getattr(args, "fuse_window", None),
+            hot_rows=getattr(args, "hot_rows", 0) if mesh is None else 0,
         )
         np.asarray(state.table[:1])  # force completion for honest timing
     if finalize is not None:
@@ -382,6 +383,18 @@ def _cmd_rate_impl(args) -> int:
     if args.fuse_window is not None and args.fuse_window <= 0:
         print("error: --fuse-window must be positive", file=sys.stderr)
         return 2
+    if args.hot_rows < 0:
+        print("error: --hot-rows must be >= 0 (0 = untiered)", file=sys.stderr)
+        return 2
+    if args.mesh is not None and args.hot_rows:
+        # Each shard tiering its table slice independently is the
+        # ROADMAP item 2 composition; refuse rather than silently
+        # running untiered on the mesh.
+        print(
+            "error: --hot-rows is not supported with --mesh yet; "
+            "drop --mesh or --hot-rows", file=sys.stderr,
+        )
+        return 2
     if not _require_one_source(args):
         return 2
     if args.db_write and not args.db:
@@ -463,6 +476,7 @@ def _cmd_rate_impl(args) -> int:
                 prefetch_depth=args.prefetch_depth,
                 kernel=args.kernel,
                 fuse_window=args.fuse_window,
+                hot_rows=args.hot_rows,
             )
             np.asarray(state.table[:1])  # force completion for honest timing
     finally:
@@ -849,6 +863,8 @@ def cmd_bench(args) -> int:
         os.environ["BENCH_KERNEL"] = args.kernel
     if getattr(args, "fuse_window", None):
         os.environ["BENCH_FUSE_WINDOW"] = str(args.fuse_window)
+    if getattr(args, "hot_rows", None):
+        os.environ["BENCH_HOT_ROWS"] = str(args.hot_rows)
     bench.main(
         metrics_out=getattr(args, "metrics_out", None),
         obs_port=getattr(args, "obs_port", None),
@@ -862,6 +878,7 @@ def cmd_benchdiff(args) -> int:
     from analyzer_tpu.obs.benchdiff import (
         bench_configs,
         diff_configs,
+        family_configs,
         find_bench_artifacts,
         latest_artifact,
         load_bench,
@@ -902,11 +919,21 @@ def cmd_benchdiff(args) -> int:
         )
         return 2
     try:
-        a = bench_configs(load_bench(a_path))
-        b = bench_configs(load_bench(b_path))
+        a = family_configs(bench_configs(load_bench(a_path)), args.family)
+        b = family_configs(bench_configs(load_bench(b_path)), args.family)
     except (OSError, ValueError) as err:
         print(f"error: {err}", file=sys.stderr)
         return 2
+    if args.family == "tiered" and a and not b:
+        # The baseline captured a tiered block but the candidate has
+        # none: the run silently fell back to untiered — exactly the
+        # regression this family exists to catch.
+        print(
+            f"error: {os.path.basename(b_path)} has no tiered capture "
+            f"but {os.path.basename(a_path)} does (silent fall-back to "
+            "untiered?)", file=sys.stderr,
+        )
+        return 1
     rows = diff_configs(a, b, args.regress_pct)
     sys.stdout.write(render_diff(a_path, b_path, rows))
     if any(r.regressed and r.gated for r in rows):
@@ -1211,6 +1238,15 @@ def main(argv=None) -> int:
         "writeback further but grows the VMEM working set; overflow "
         "splits the window (a counted spill)",
     )
+    s.add_argument(
+        "--hot-rows", type=int, metavar="N",
+        default=int(os.environ.get("BENCH_HOT_ROWS", 0)),
+        help="tiered ratings table (default 0 = untiered): keep only an "
+        "N-row hot set (pow2-bucketed) of the player table in device "
+        "memory, spilling cold rows to a host tier promoted ahead of "
+        "need on the feed thread; results bit-identical at every size "
+        "(sched/tier.py, docs/kernels.md). Not composable with --mesh",
+    )
     s.set_defaults(fn=cmd_rate)
 
     s = sub.add_parser(
@@ -1275,6 +1311,13 @@ def main(argv=None) -> int:
         "--fuse-window", type=int, metavar="K",
         help="fused window size (default: BENCH_FUSE_WINDOW env, else 16)",
     )
+    s.add_argument(
+        "--hot-rows", type=int, metavar="N",
+        help="also capture the tiered-table line with an N-row hot set "
+        "(BENCH_HOT_ROWS env): the BENCH line gains a `tiered` block — "
+        "hit rate, promotion bytes, min_over_resident — that "
+        "`cli benchdiff --family tiered` gates",
+    )
     s.set_defaults(fn=cmd_bench)
 
     s = sub.add_parser(
@@ -1303,10 +1346,13 @@ def main(argv=None) -> int:
         "than PCT percent (default: 5)",
     )
     s.add_argument(
-        "--family", choices=("bench", "serve"), default="bench",
+        "--family", choices=("bench", "serve", "tiered"), default="bench",
         help="artifact family for --against-latest scans: bench "
-        "(BENCH_*.json, the write path) or serve (SERVE_BENCH_*.json — "
-        "queries/sec + p99 latency, experiments/serve_bench.py); "
+        "(BENCH_*.json, the write path), serve (SERVE_BENCH_*.json — "
+        "queries/sec + p99 latency, experiments/serve_bench.py), or "
+        "tiered (the same BENCH_*.json artifacts, gating only the "
+        "tiered-table configs — min_over_resident + hit rate; a "
+        "candidate that silently dropped its tiered block fails); "
         "explicit two-path diffs auto-detect from the metric name",
     )
     s.set_defaults(fn=cmd_benchdiff)

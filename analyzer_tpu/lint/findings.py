@@ -50,6 +50,11 @@ RULES: dict[str, str] = {
         "analyzer_tpu/core/, or a literal interpret=True left enabled "
         "outside tests"
     ),
+    "GL027": (
+        "whole-table device transfer (jax.device_put / jnp.array on a "
+        "*table* value) outside the tier manager (sched/tier.py) and "
+        "the view publisher (serve/view.py)"
+    ),
 }
 
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")

@@ -47,6 +47,20 @@ hardcoded literal left enabled ships a silently-interpreted
 (hundredfold slower) kernel to the TPU. Backend selection must flow
 through a variable (``core.fused`` threads ``backend=`` / the
 ``ANALYZER_TPU_FUSE_BACKEND`` env).
+
+GL027 protects the tiered ratings table (``sched/tier.py``,
+``docs/kernels.md``): once HBM is a managed cache, a whole-table
+``jax.device_put(...)`` or ``jnp.array(...)`` of a *table* value
+anywhere else silently re-materializes the full ``[P+1, 16]`` table on
+device — exactly the HBM hard cap the tier manager exists to remove,
+and a second device copy the page table knows nothing about. The two
+sanctioned homes are the tier manager itself and the view publisher
+(``serve/view.py``, whose owning-copy ``jnp.array`` is the serve-plane
+double buffer). The linter flags the call when the transferred
+expression mentions a table-named value (``table``, ``state.table``,
+``host_table``, ...); literal arguments and test files are exempt, and
+a deliberate whole-table transfer (state construction at ingest, a
+bench baseline) carries a line-scoped disable with a reason.
 """
 
 from __future__ import annotations
@@ -77,6 +91,12 @@ _SERVER_MODULES = ("http.server", "socketserver")
 #: both halves of the rule (they drive interpret mode on purpose).
 _GL026_PALLAS_DIRS = ("analyzer_tpu/core/",)
 _PALLAS_MODULES = ("jax.experimental.pallas",)
+
+#: The sanctioned homes for a whole-table device transfer (GL027): the
+#: tier manager (hot-set promotion/demotion) and the view publisher
+#: (the serve plane's owning double-buffer copy).
+_GL027_TABLE_HOMES = ("analyzer_tpu/sched/tier.py", "analyzer_tpu/serve/view.py")
+_GL027_TRANSFERS = ("jax.device_put", "jax.numpy.array")
 
 _BROAD = {"Exception", "BaseException"}
 _MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
@@ -123,6 +143,7 @@ class ShellRules:
         feed_layer = self._in_feed_layer()
         tests = self._in_tests()
         pallas_home = self._in_pallas_home()
+        table_home = self._in_table_home()
         for node in ast.walk(self.tree):
             if isinstance(node, ast.Try):
                 self._check_try(node)
@@ -135,6 +156,8 @@ class ShellRules:
                     self._check_device_sync(node)
                 if not tests:
                     self._check_interpret_literal(node)
+                if not (tests or table_home):
+                    self._check_table_transfer(node)
             elif isinstance(node, (ast.Import, ast.ImportFrom)):
                 if not obs_layer:
                     self._check_server_import(node)
@@ -168,6 +191,10 @@ class ShellRules:
     def _in_pallas_home(self) -> bool:
         path = self.path.replace("\\", "/")
         return any(frag in path for frag in _GL026_PALLAS_DIRS)
+
+    def _in_table_home(self) -> bool:
+        path = self.path.replace("\\", "/")
+        return any(frag in path for frag in _GL027_TABLE_HOMES)
 
     def _in_tests(self) -> bool:
         path = self.path.replace("\\", "/")
@@ -249,6 +276,38 @@ class ShellRules:
                     "thread the flag through a variable "
                     "(core.fused backend=) so only tests pin it",
                 )
+
+    def _check_table_transfer(self, node: ast.Call) -> None:
+        """GL027: a whole-table device transfer outside the tier manager
+        and the view publisher. ``jax.device_put`` / ``jnp.array``
+        (resolved through the module's imports) flag when the
+        transferred expression mentions a table-named value — the
+        conservative needle for "the whole ratings table is about to be
+        re-materialized on device behind the page table's back"."""
+        resolved = self.imports.resolve(node.func)
+        if resolved not in _GL027_TRANSFERS or not node.args:
+            return
+        arg = node.args[0]
+        if isinstance(arg, _LITERAL_ARGS):
+            return
+        names = [
+            n.id.lower() for n in ast.walk(arg) if isinstance(n, ast.Name)
+        ] + [
+            n.attr.lower() for n in ast.walk(arg)
+            if isinstance(n, ast.Attribute)
+        ]
+        if not any("table" in name for name in names):
+            return
+        self._flag(
+            "GL027", node,
+            f"whole-table `{resolved.split('.')[-1]}` outside "
+            "sched/tier.py and serve/view.py bypasses the tier manager: "
+            "the full [P+1, 16] table lands in HBM behind the page "
+            "table's back, re-imposing the memory cap tiering removed; "
+            "route the transfer through the tier manager / view "
+            "publisher, or disable with a reason for a deliberate "
+            "whole-table load (ingest, bench baseline)",
+        )
 
     def _check_raw_clock(self, node: ast.Call) -> None:
         """GL023: ``time.perf_counter()`` (or a bare imported

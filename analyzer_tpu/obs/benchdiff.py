@@ -124,6 +124,34 @@ def bench_configs(data: dict) -> list[BenchConfig]:
                 degraded=degraded or not fused.get("stable", True),
             )
         )
+    tiered = data.get("tiered") or {}
+    if tiered.get("min_over_resident") is not None:
+        # The tiering tax (tiered end-to-end min / resident min, lower is
+        # better): thrash — a hot set suddenly too small for the working
+        # set, or a promotion path gone synchronous — moves this ratio
+        # even when absolute time is masked by the tunnel. A SILENT
+        # fall-back to the untiered path drops the block entirely, which
+        # the --family tiered gate reports as a vanished config.
+        t_degraded = degraded or not tiered.get("stable", True)
+        out.append(
+            BenchConfig(
+                name="tiered.min_over_resident",
+                value=float(tiered["min_over_resident"]),
+                higher_is_better=False,
+                degraded=t_degraded,
+            )
+        )
+        if tiered.get("hit_rate") is not None:
+            # Hot-set hit rate (higher is better): the leading indicator
+            # of thrash — it collapses before the wall clock does.
+            out.append(
+                BenchConfig(
+                    name="tiered.hit_rate",
+                    value=float(tiered["hit_rate"]),
+                    higher_is_better=True,
+                    degraded=t_degraded,
+                )
+            )
     streamed = data.get("streamed") or {}
     if streamed.get("min_s") is not None:
         out.append(
@@ -180,7 +208,23 @@ def diff_configs(
 
 
 #: Artifact family name -> filename prefix (``cli benchdiff --family``).
-FAMILIES = {"bench": "BENCH", "serve": "SERVE_BENCH"}
+#: ``tiered`` scans the same BENCH artifacts but gates only the tiered
+#: configs (``tiered.min_over_resident`` + the hit-rate delta) — see
+#: :func:`family_configs`.
+FAMILIES = {"bench": "BENCH", "serve": "SERVE_BENCH", "tiered": "BENCH"}
+
+
+def family_configs(
+    configs: list[BenchConfig], family: str
+) -> list[BenchConfig]:
+    """Restricts a config list to the family's own gate. The ``tiered``
+    family compares only ``tiered.*`` configs: a tier-thrash regression
+    must fail on its own ratio even when headline throughput holds, and
+    a capture that silently fell back to untiered (no tiered block at
+    all) shows up as "no comparable configs" instead of a clean pass."""
+    if family != "tiered":
+        return configs
+    return [c for c in configs if c.name.startswith("tiered.")]
 
 
 def find_bench_artifacts(directory: str, family: str = "bench") -> list[str]:

@@ -34,6 +34,22 @@ MIN_SAMPLE_INTERVAL_S = 1.0
 _lock = threading.Lock()
 _last_sample_at: float | None = None
 
+#: Host cold-tier byte probe (``sched/tier.py`` registers one when the
+#: first TierManager is built). Sampling it HERE, next to the HBM
+#: gauges, is deliberate: the tiered table's budget question is always
+#: "device bytes vs host bytes", and one /statusz scrape must answer
+#: both sides (``tier.host_bytes`` in the same snapshot as
+#: ``device.hbm_bytes_in_use``).
+_host_tier_sampler = None
+
+
+def set_host_tier_sampler(fn) -> None:
+    """Registers the callable that reports the cold tier's committed
+    host bytes (pinned/committed numpy buffers of every live tier
+    manager). One process-wide probe; None clears it (tests)."""
+    global _host_tier_sampler
+    _host_tier_sampler = fn
+
 
 def sample_device_memory(registry=None) -> dict:
     """Samples every jax device's memory state into gauges; returns
@@ -84,6 +100,14 @@ def sample_device_memory(registry=None) -> dict:
             "source": source,
         }
     reg.gauge("device.live_buffers").set(len(live))
+    if _host_tier_sampler is not None:
+        try:
+            tier_bytes = int(_host_tier_sampler())
+        except Exception:  # noqa: BLE001 — telemetry stays off the failure path
+            tier_bytes = None
+        if tier_bytes is not None:
+            reg.gauge("tier.host_bytes").set(tier_bytes)
+            out["host"] = {"tier_bytes": tier_bytes}
     return out
 
 

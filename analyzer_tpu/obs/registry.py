@@ -192,6 +192,17 @@ STANDARD_COUNTERS = (
     "fused.spills_total",
     "fused.writebacks_avoided_total",
     "fused.pad_steps_total",
+    # The tiered ratings table (sched/tier.py): touched-row hits against
+    # the HBM hot set vs misses that promoted from the host cold tier,
+    # LRU demotions, the dirty subset written back D2H, and window
+    # splits forced by a hot set smaller than one window's touched rows.
+    # Pre-declared so an untiered run reads 0, not missing.
+    "tier.hits_total",
+    "tier.misses_total",
+    "tier.promotions_total",
+    "tier.demotions_total",
+    "tier.dirty_writebacks_total",
+    "tier.spills_total",
     "mesh.put_bytes_total",
     "mesh.puts_total",
     # Residency reuse measured on the mesh feed's per-shard compacted
@@ -216,6 +227,12 @@ STANDARD_GAUGES = (
     # Fused working-set high-water mark in table rows (the VMEM budget's
     # denominator, sched/residency.py).
     "fused.working_set_rows",
+    # The tiered table's two budget gauges, arbitrated against the
+    # device.hbm_bytes_* series: the hot-set capacity in rows
+    # (pow2-bucketed from hot_rows) and the cold tier's committed host
+    # bytes (sampled by obs/devicemem.py next to the HBM gauges).
+    "tier.hot_rows",
+    "tier.host_bytes",
     # Per-device series (device.hbm_bytes_in_use{device=...}) appear on
     # first sample; the process total is pre-declared.
     "device.live_buffers",

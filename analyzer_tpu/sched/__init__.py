@@ -20,7 +20,8 @@ from analyzer_tpu.sched.superstep import (
     choose_batch_size_streamed,
     pack_schedule,
 )
-from analyzer_tpu.sched.feed import DeviceFeed, Prefetcher
+from analyzer_tpu.sched.feed import DeviceFeed, FeedStageError, Prefetcher
+from analyzer_tpu.sched.tier import TierManager
 from analyzer_tpu.sched.residency import (
     FuseSpec,
     ResidencyPlan,
@@ -33,7 +34,9 @@ from analyzer_tpu.sched.runner import HistoryOutputs, rate_history, rate_stream
 
 __all__ = [
     "DeviceFeed",
+    "FeedStageError",
     "FuseSpec",
+    "TierManager",
     "MatchStream",
     "PackedSchedule",
     "Prefetcher",

@@ -59,6 +59,25 @@ class FeedClosedError(RuntimeError):
     consumer raised and tore the run down; the producer must stop)."""
 
 
+class FeedStageError(RuntimeError):
+    """A producer-thread staging failure, tagged with the window it was
+    staging. Any exception raised while materializing, residency- or
+    tier-planning, or committing a window's slab — including a failure
+    mid staged PROMOTION on the tiered path — is wrapped in one of
+    these by the runner's produce loop, so it surfaces on the consumer's
+    next ``get()`` (after the already-staged prefix drains — those
+    windows are valid work) carrying the window id instead of a
+    context-free traceback from a daemon thread. The raw error is
+    ``__cause__``."""
+
+    def __init__(self, start: int, stop: int) -> None:
+        super().__init__(
+            f"feed staging failed at window [{start}, {stop})"
+        )
+        self.start = start
+        self.stop = stop
+
+
 class DeviceFeed:
     """Thread-safe bounded ring of committed window slabs.
 
@@ -192,21 +211,27 @@ class FusedChunk:
     """One chunk staged for the fused window kernel: the residency-
     planned per-window device slabs (``core.fused`` layout), the padded
     slot->match map rows for collect reordering (``flat``, or None),
-    and the chunk's planner aggregates for bench telemetry."""
+    the chunk's planner aggregates for bench telemetry, and — on a
+    tiered run — one ``TierPlan`` per window (``tier_plans``), since the
+    fused working-set gather then reads through the hot set."""
 
-    __slots__ = ("windows", "flat", "stats")
+    __slots__ = ("windows", "flat", "stats", "tier_plans")
 
-    def __init__(self, windows, flat, stats):
+    def __init__(self, windows, flat, stats, tier_plans=None):
         self.windows = windows
         self.flat = flat
         self.stats = stats
+        self.tier_plans = tier_plans
 
 
-def stage_chunk_fused(sched, start: int, stop: int, fuse, collect: bool):
+def stage_chunk_fused(sched, start: int, stop: int, fuse, collect: bool,
+                      tier=None):
     """Fused-path sibling of :func:`stage_chunk`: materializes the
     chunk's gather tensors, residency-plans it into fused windows
     (``feed.materialize`` span — the plan is host packing work), and
-    commits each window's slab (``feed.transfer`` span)."""
+    commits each window's slab (``feed.transfer`` span). ``tier``
+    (a ``sched.tier.TierManager``) remaps each window into hot-slot
+    space and attaches its promotion/demotion plan."""
     check = getattr(sched, "check_compact_invariant", None)
     if check is not None:
         check(start, stop)
@@ -216,7 +241,7 @@ def stage_chunk_fused(sched, start: int, stop: int, fuse, collect: bool):
     return stage_fused_windows(
         pidx, winner, mode_id, afk, sched.pad_row, fuse,
         match_idx=sched.match_idx[start:stop] if collect else None,
-        start=start,
+        start=start, tier=tier,
     )
 
 
@@ -234,7 +259,7 @@ def _pad_window_steps(arr, k: int, fill):
 
 def stage_fused_windows(
     pidx, winner, mode_id, afk, pad_row: int, fuse,
-    match_idx=None, start: int = 0,
+    match_idx=None, start: int = 0, tier=None,
 ):
     """The shared fused staging core (windowed-schedule chunks AND the
     streamed feed): residency plans, per-window padding to the static
@@ -242,7 +267,11 @@ def stage_fused_windows(
     write only the pinned pad slot), and the async H2D commit of each
     window's slab. ``match_idx`` (when collecting) yields the padded
     slot->match rows, -1 on inert steps so ``_gather_outputs`` drops
-    them."""
+    them. ``tier`` composes the hot set: each window's ``slot_rows``
+    are remapped into hot slots (the fused gather then reads through
+    the hot set) and its ``TierPlan`` rides along — the runner caps the
+    fused ``max_rows`` at the hot capacity, so every fused window fits
+    by construction."""
     import numpy as np
 
     import jax.numpy as jnp
@@ -258,14 +287,21 @@ def stage_fused_windows(
     record_plan_telemetry(plans, fuse.window)
     tracer = get_tracer()
     windows = []
+    tier_plans = [] if tier is not None else None
     flat_parts = [] if match_idx is not None else None
     k = fuse.window
     s0 = 0
     with tracer.span("feed.transfer", cat="sched", start=start):
         for plan in plans:
             s1 = s0 + plan.n_steps
+            slot_rows = plan.slot_rows
+            if tier is not None:
+                tplan, slot_rows = tier.plan_fused(
+                    plan.slot_rows, plan.n_live, pidx[s0:s1], valid[s0:s1]
+                )
+                tier_plans.append(tplan)
             windows.append((
-                jnp.asarray(plan.slot_rows),
+                jnp.asarray(slot_rows),
                 jnp.asarray(_pad_window_steps(plan.slot_idx, k, 0)),
                 jnp.asarray(_pad_window_steps(
                     winner[s0:s1].astype(np.int8), k, 0
@@ -292,4 +328,5 @@ def stage_fused_windows(
         windows,
         np.concatenate(flat_parts) if flat_parts else None,
         stats,
+        tier_plans,
     )

@@ -34,12 +34,14 @@ from analyzer_tpu.obs import (
 )
 from analyzer_tpu.sched.feed import (
     DEFAULT_DEPTH,
+    FeedStageError,
     Prefetcher,
     stage_chunk,
     stage_chunk_fused,
     stage_fused_windows,
 )
 from analyzer_tpu.sched.residency import resolve_fuse
+from analyzer_tpu.sched.tier import TierManager, stage_chunk_tiered
 from analyzer_tpu.sched.superstep import (
     PackedSchedule,
     compact_device_window,
@@ -109,16 +111,24 @@ track_jit("sched._scan_chunk", _scan_chunk)
 track_jit("core.fused_window_step", fused_kernel.fused_window_step)
 
 
-def _dispatch_fused_chunk(state, staged, cfg, collect: bool, backend: str):
+def _dispatch_fused_chunk(state, staged, cfg, collect: bool, backend: str,
+                          tier=None):
     """Consumer-side fused dispatch of one staged chunk: every residency
     window runs as one ``fused_window_step`` call (the table buffer is
     donated window to window). Returns the new state and, when
     collecting, the chunk's ``[n_windows * K, B, 3 + 10T]`` packed
     outputs — same layout the reference scan emits, so the fetch
-    pipeline and ``_gather_outputs`` are shared."""
+    pipeline and ``_gather_outputs`` are shared. On a tiered run each
+    window's ``TierPlan`` (promotions in, dirty demotions out) executes
+    against the hot table right before its dispatch."""
     ys_parts = []
     table = state.table
-    for slot_rows, slot_idx, winner, mode_id, afk in staged.windows:
+    plans = staged.tier_plans or (None,) * len(staged.windows)
+    for (slot_rows, slot_idx, winner, mode_id, afk), tplan in zip(
+        staged.windows, plans
+    ):
+        if tplan is not None:
+            table = tier.apply(table, tplan)
         table, ys = fused_kernel.fused_window_step(
             table, slot_rows, slot_idx, winner, mode_id, afk,
             cfg, collect, backend,
@@ -148,9 +158,21 @@ def rate_history(
     fuse_window: int | None = None,
     fuse_max_rows: int | None = None,
     fuse_backend: str | None = None,
+    hot_rows: int = 0,
 ) -> tuple[PlayerState, HistoryOutputs | None]:
     """Rates a packed history. Returns the final state and, when
     ``collect``, per-match outputs reordered back to stream order.
+
+    ``hot_rows`` > 0 runs TIERED (:mod:`analyzer_tpu.sched.tier`): only
+    a ``hot_rows``-slot hot set (pow2-bucketed) of the player table is
+    device-resident; the rest lives in a host cold tier, promoted ahead
+    of the window that needs it on the feed thread and LRU-demoted with
+    dirty rows written back D2H one batch per window. Results are
+    bit-identical to the untiered run at every hot-set size; 0 (the
+    default) leaves today's untiered compiled paths untouched. Composes
+    with ``kernel="fused"`` (the working-set gather reads through the
+    hot set) and with ``view_publisher`` (views publish from the hot
+    set + host shadow over the incremental patch path).
 
     ``kernel`` selects the device kernel: ``"reference"`` (the per-step
     gather -> update -> scatter scan) or ``"fused"`` — the VMEM-resident
@@ -188,6 +210,11 @@ def rate_history(
     every depth.
     """
     fuse = resolve_fuse(kernel, fuse_window, fuse_max_rows, fuse_backend)
+    if hot_rows < 0:
+        raise ValueError(f"hot_rows must be >= 0, got {hot_rows}")
+    tier = TierManager(state, hot_rows) if hot_rows else None
+    if tier is not None and fuse is not None:
+        fuse = tier.clamp_fuse(fuse)
     n_steps = sched.n_steps if stop_after is None else min(stop_after, sched.n_steps)
     if steps_per_chunk is None:
         # ~8 chunks pipelines window materialization + H2D against the
@@ -195,9 +222,16 @@ def rate_history(
         # 2.1x single-chunk); the floor keeps per-dispatch overhead
         # amortized, the ceiling bounds device memory for the slabs.
         steps_per_chunk = min(8192, max(256, -(-sched.n_steps // 8)))
-    # The chunked scan donates its carry; copy once at entry so the caller's
-    # state stays valid (the table is small — tens of MB at 10M players).
-    state = jax.tree.map(jnp.copy, state)
+    if tier is not None:
+        # Tiered: the compiled kernels only ever see the hot table; the
+        # caller's full state became the cold tier (one D2H at entry —
+        # the tiered sibling of the jnp.copy below) and is never donated.
+        state = tier.hot_state()
+    else:
+        # The chunked scan donates its carry; copy once at entry so the
+        # caller's state stays valid (the table is small — tens of MB at
+        # 10M players).
+        state = jax.tree.map(jnp.copy, state)
     outs = [] if collect else None
     tracer = get_tracer()
     reg = get_registry()
@@ -215,11 +249,22 @@ def rate_history(
     def produce(put) -> None:
         for start in starts:
             stop = min(start + steps_per_chunk, n_steps)
-            if fuse is not None:
-                put((start, stop,
-                     stage_chunk_fused(sched, start, stop, fuse, collect)))
-            else:
-                put((start, stop, stage_chunk(sched, start, stop)))
+            try:
+                if fuse is not None:
+                    item = stage_chunk_fused(
+                        sched, start, stop, fuse, collect, tier=tier
+                    )
+                elif tier is not None:
+                    item = stage_chunk_tiered(sched, start, stop, tier, collect)
+                else:
+                    item = stage_chunk(sched, start, stop)
+            except Exception as e:
+                # Window-id context for the consumer (sched/feed.py
+                # FeedStageError): a staging failure — materialization,
+                # residency/tier planning, or a staged promotion —
+                # surfaces on the next get() naming the window.
+                raise FeedStageError(start, stop) from e
+            put((start, stop, item))
 
     # Fused + collect: inert window-padding steps make the emitted ys
     # rows a superset of the schedule's — the staged chunks carry their
@@ -232,10 +277,14 @@ def rate_history(
             with tracer.span("batch.compute", cat="sched", start=start):
                 if fuse is not None:
                     state, ys = _dispatch_fused_chunk(
-                        state, arrays, cfg, collect, fuse.backend
+                        state, arrays, cfg, collect, fuse.backend, tier=tier
                     )
                     if fused_flat is not None:
                         fused_flat.append(arrays.flat)
+                elif tier is not None:
+                    state, ys = tier.dispatch_chunk(
+                        state, arrays, cfg, collect
+                    )
                 else:
                     state, ys = _scan_chunk(
                         state, arrays, cfg, collect, sched.pad_row
@@ -257,12 +306,23 @@ def rate_history(
                         outs.append(fetch_tree(pending))
                 pending = ys
             if on_chunk is not None:
-                on_chunk(state, stop)
+                # Tiered: the hook gets the logical full state (cold tier
+                # + resident written rows), same snapshot cost profile as
+                # the untiered hook's fetch.
+                on_chunk(
+                    tier.full_state(state.table) if tier is not None
+                    else state, stop,
+                )
             if view_publisher is not None:
                 # Throttled view publish BEFORE the next chunk dispatches:
                 # the carry buffer is about to be donated, so the publisher
-                # fetches its host copy here or not at all.
-                view_publisher.maybe_publish_state(state)
+                # fetches its host copy here or not at all. Tiered runs
+                # publish hot-set rows + host shadow over the incremental
+                # patch path instead of a full-table fetch.
+                if tier is not None:
+                    tier.maybe_publish_view(view_publisher, state.table)
+                else:
+                    view_publisher.maybe_publish_state(state)
             # HBM-occupancy gauges at chunk boundaries (throttled inside —
             # device.hbm_bytes_in_use / device.live_buffers,
             # obs/devicemem.py): a run creeping toward the HBM ceiling
@@ -270,7 +330,15 @@ def rate_history(
             # it OOMs.
             maybe_sample_device_memory()
     if view_publisher is not None:
-        view_publisher.publish_state(state)  # final table, unthrottled
+        if tier is not None:
+            tier.publish_view(view_publisher, state.table)  # unthrottled
+        else:
+            view_publisher.publish_state(state)  # final table, unthrottled
+    if tier is not None:
+        # Reconstruct the logical full state: the drained cold tier plus
+        # every resident row written since entry — bit-identical to the
+        # untiered runner's final table.
+        state = tier.finish(state.table)
     if not collect:
         return state, None
     if pending is not None:
@@ -357,12 +425,21 @@ def rate_stream(
     fuse_window: int | None = None,
     fuse_max_rows: int | None = None,
     fuse_backend: str | None = None,
+    hot_rows: int = 0,
 ) -> tuple[PlayerState, HistoryOutputs | None]:
     """Rates a raw MatchStream with the schedule built CONCURRENTLY with
     the device scan — the fully-streamed feed. ``stats_out`` (optional
     dict) receives n_steps / batch_size / occupancy after the run — the
     schedule never exists as one object here, so these are the only
     schedule-level observables.
+
+    ``hot_rows`` mirrors :func:`rate_history`: > 0 keeps only a pow2-
+    bucketed hot set of the table device-resident, promoting cold rows
+    from the host tier on this same feed thread ahead of the window
+    that needs them (:mod:`analyzer_tpu.sched.tier`); results stay
+    bit-identical and 0 leaves the untiered paths untouched. Not
+    composable with ``mesh=`` — each shard tiers independently is
+    ROADMAP item 2's composition.
 
     ``kernel``/``fuse_*`` mirror :func:`rate_history`: ``"fused"``
     residency-plans each emitted window on the feed thread and
@@ -464,6 +541,8 @@ def rate_stream(
             f"stream team size {stream.team_size} exceeds team_size {team}"
         )
     fuse = resolve_fuse(kernel, fuse_window, fuse_max_rows, fuse_backend)
+    if hot_rows < 0:
+        raise ValueError(f"hot_rows must be >= 0, got {hot_rows}")
     run = None
     if mesh is not None:
         if collect:
@@ -478,18 +557,31 @@ def rate_stream(
                 "working set is tracked by parallel.mesh's "
                 "mesh.writebacks_avoidable_total accounting)"
             )
+        if hot_rows:
+            raise ValueError(
+                "hot_rows > 0 is not supported with mesh= (each shard "
+                "tiering its slice independently is the ROADMAP item 2 "
+                "composition); drop mesh= or hot_rows"
+            )
         from analyzer_tpu.parallel.mesh import ShardedRun
 
         run = ShardedRun(state, cfg, mesh)
     pad_row = state.pad_row
+    tier = TierManager(state, hot_rows) if hot_rows else None
+    if tier is not None and fuse is not None:
+        fuse = tier.clamp_fuse(fuse)
     if run is None:
-        state = jax.tree.map(jnp.copy, state)
+        state = tier.hot_state() if tier is not None \
+            else jax.tree.map(jnp.copy, state)
     if n == 0:
         if stats_out is not None:
             stats_out.update(
                 n_steps=0, batch_size=0, occupancy=0.0, choose_batch_size_s=0.0
             )
-        state = run.finish() if run is not None else state
+        if run is not None:
+            state = run.finish()
+        elif tier is not None:
+            state = tier.finish(state.table)
         return state, (_gather_outputs([], np.empty(0, np.int32), 0, team)
                        if collect else None)
     if int(stream.player_idx.max()) >= pad_row:
@@ -637,12 +729,25 @@ def rate_stream(
             # slot->match rows ride along for collect reordering.
             return stage_fused_windows(
                 pidx, winner, mode_id, afk, pad_row, fuse,
-                match_idx=mi if collect else None, start=e0,
+                match_idx=mi if collect else None, start=e0, tier=tier,
             )
+        if tier is not None:
+            with tracer.span("feed.transfer", cat="sched", start=e0):
+                return tier.stage_windows(pidx, winner, mode_id, afk)
         with tracer.span("feed.transfer", cat="sched", start=e0):
             if run is not None:
                 return run.stage(pidx, mask, winner, mode_id, afk)
             return compact_device_window(pidx, winner, mode_id, afk)
+
+    def stage_checked(e0: int, e1: int):
+        """``stage`` with the window id attached to any failure — the
+        consumer's next ``get()`` raises a FeedStageError naming the
+        window instead of a bare producer-thread traceback (a staged
+        tier PROMOTION failing mid-flight included)."""
+        try:
+            return stage(e0, e1)
+        except Exception as e:
+            raise FeedStageError(e0, e1) from e
 
     result: dict = {}
 
@@ -658,7 +763,8 @@ def rate_stream(
             scatter_new(int(progress[0]))
             advanced = False
             while watermark - emitted >= spc:
-                put((emitted, emitted + spc, stage(emitted, emitted + spc)))
+                put((emitted, emitted + spc,
+                     stage_checked(emitted, emitted + spc)))
                 emitted += spc
                 advanced = True
             if done:
@@ -693,7 +799,7 @@ def rate_stream(
         grow(s_total)
         while emitted < s_total:
             e1 = min(emitted + spc, s_total)
-            put((emitted, e1, stage(emitted, e1)))
+            put((emitted, e1, stage_checked(emitted, e1)))
             emitted = e1
         result["s_total"] = s_total
 
@@ -711,10 +817,15 @@ def rate_stream(
                 with tracer.span("batch.compute", cat="sched", start=e0):
                     if fuse is not None:
                         state, ys = _dispatch_fused_chunk(
-                            state, staged, cfg, collect, fuse.backend
+                            state, staged, cfg, collect, fuse.backend,
+                            tier=tier,
                         )
                         if fused_flat is not None:
                             fused_flat.append(staged.flat)
+                    elif tier is not None:
+                        state, ys = tier.dispatch_chunk(
+                            state, staged, cfg, collect
+                        )
                     else:
                         state, ys = _scan_chunk(
                             state, staged, cfg, collect, pad_row
@@ -729,13 +840,19 @@ def rate_stream(
                             outs.append(fetch_tree(pending))
                     pending = ys
                 if view_publisher is not None:
-                    view_publisher.maybe_publish_state(state)
+                    if tier is not None:
+                        tier.maybe_publish_view(view_publisher, state.table)
+                    else:
+                        view_publisher.maybe_publish_state(state)
             del staged  # let the consumed slab free behind the dispatch
             if on_chunk is not None:
                 if run is not None:
                     run.call_hook(on_chunk, e1)
                 else:
-                    on_chunk(state, e1)
+                    on_chunk(
+                        tier.full_state(state.table) if tier is not None
+                        else state, e1,
+                    )
             maybe_sample_device_memory()  # batch-boundary HBM gauges
     if pending is not None:
         with tracer.span("batch.fetch", cat="sched", start=result["s_total"]):
@@ -757,7 +874,12 @@ def rate_stream(
             view_publisher.publish_state(state)
         return state, None
     if view_publisher is not None:
-        view_publisher.publish_state(state)  # final table, unthrottled
+        if tier is not None:
+            tier.publish_view(view_publisher, state.table)  # unthrottled
+        else:
+            view_publisher.publish_state(state)  # final table, unthrottled
+    if tier is not None:
+        state = tier.finish(state.table)
     if not collect:
         return state, None
     if fused_flat is not None:

@@ -1,0 +1,589 @@
+"""Tiered ratings table: HBM-resident hot set with prefetch-ahead host
+spill.
+
+Until this module, the WHOLE ``[P+1, 16]`` player table had to live in
+device memory for the scan runners to rate against it — player count per
+chip was hard-capped by HBM, and every run paid device bytes for rows it
+never touched. The tier manager turns HBM into a managed cache:
+
+  * a **hot set** — a device-resident ``[H+1, 16]`` table of ``hot_rows``
+    slots (pow2-bucketed like the slot ladder, row ``H`` the padding
+    row) — is all the compiled kernels ever see;
+  * a **cold tier** — the full ``[P+1, 16]`` table as host float32 (the
+    authoritative copy for every non-resident row) — holds the rest;
+  * an explicit **page table** (row -> hot slot) is maintained on the
+    FEED thread: the same producer that materializes windows already
+    names every window's touched rows, so promotion is planned exactly
+    ``depth`` windows ahead and the cold-row H2D copies ride the
+    existing prefetch ring, overlapping the in-flight scan;
+  * **demotion** is LRU at window granularity: when a window needs slots,
+    the least-recently-used resident rows not touched by it are evicted;
+    rows the device wrote since promotion (**dirty**) are gathered off
+    the hot table in one batched D2H per window, materialized into the
+    cold tier one window later — the consumer never blocks on a miss in
+    steady state.
+
+Split of authority (the cross-thread contract):
+
+  * the PRODUCER (feed thread) owns the page table, the LRU clock, the
+    dirty bits, and ``host_version`` — it plans every promotion/demotion
+    sequentially, so its model of future device state is exact, just
+    ahead of time;
+  * the CONSUMER (dispatch loop) owns the cold tier's WRITES (writeback
+    materialization), the pending-writeback queue, and ``applied`` — the
+    highest plan whose writebacks are guaranteed materialized;
+  * the producer may stage a cold row's H2D eagerly ("fresh") only when
+    ``host_version[row] <= applied`` — i.e. no writeback of that row is
+    still in flight. Otherwise the promotion is DEFERRED: the consumer
+    gathers it from the cold tier at dispatch time, after draining the
+    queue. The GIL orders the consumer's host-table writes before its
+    ``applied`` store and the producer's ``applied`` load before its
+    host-table reads, so the fresh path never reads a stale row.
+
+Bit-identity: tiering is a memory-PLACEMENT change, not a numeric one.
+Remapped indices gather and scatter the same float32 values in the same
+order through the same kernels (``hot_rows=0`` doesn't even construct a
+manager — the untiered compiled paths are byte-for-byte untouched), so
+the final table, the collected outputs, and every published view are
+bit-identical to the untiered runner at every hot-set size, depth, and
+kernel (pinned by tests/test_tier.py).
+
+Telemetry (docs/observability.md catalog): ``tier.hits_total`` /
+``misses_total`` / ``promotions_total`` / ``demotions_total`` /
+``dirty_writebacks_total`` / ``spills_total`` counters, the
+``tier.hot_rows`` and ``tier.host_bytes`` gauges (the latter sampled by
+``obs.devicemem`` next to the HBM gauges so one /statusz scrape shows
+both sides of the budget), and ``tier.promote`` / ``tier.demote`` spans
+on the staging and writeback paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import weakref
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from analyzer_tpu.obs import get_registry, get_tracer, track_jit
+from analyzer_tpu.obs.devicemem import set_host_tier_sampler
+
+#: Pow2 bucket floor for the promotion/writeback row-count axis, so the
+#: tier's gather/scatter kernels compile a short shape ladder instead of
+#: one entry per miss count (the serve patch path's PATCH_BUCKET_FLOOR
+#: idea applied to the write plane).
+TIER_BUCKET_FLOOR = 64
+
+#: Smallest hot-set capacity: below this the pow2 ladder floor dominates
+#: and a single superstep rarely fits anyway.
+MIN_HOT_ROWS = 8
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_hot(table, idx, rows):
+    """Writes promoted rows into their hot slots. Bucket-padding entries
+    point at the hot padding slot and carry the pristine pad-row values,
+    so the duplicate scatter resolves to identical bits and the pad row
+    stays a fixed point. Donated: the hot table is the run's carry."""
+    return table.at[idx].set(rows)
+
+
+@jax.jit
+def _gather_hot(table, idx):
+    """Batched demotion read: the dirty rows' current values off the hot
+    table (bucket-padding entries read the pad slot and are dropped)."""
+    return table[idx]
+
+
+track_jit("tier._scatter_hot", _scatter_hot)
+track_jit("tier._gather_hot", _gather_hot)
+
+#: Live managers for the devicemem host-bytes probe (obs/devicemem.py
+#: samples the cold tier next to the HBM gauges).
+_MANAGERS: "weakref.WeakSet[TierManager]" = weakref.WeakSet()
+_SAMPLER_INSTALLED = False
+
+
+def _host_tier_bytes() -> int:
+    return sum(m.host_nbytes for m in list(_MANAGERS))
+
+
+@dataclasses.dataclass
+class TierPlan:
+    """One dispatch window's page-table transaction, planned on the feed
+    thread and executed by the consumer before the window's compute.
+
+    ``wb_*`` name the dirty evictions (batched D2H); ``fresh_*`` carry
+    the eagerly staged promotions (the H2D already issued on the feed
+    thread); ``deferred_*`` are promotions whose latest value is a
+    not-yet-materialized writeback — the consumer fills them from the
+    cold tier after draining the queue. ``evict_rows`` /
+    ``promote_rows``+``promote_slots`` / ``written_rows`` replay the
+    transaction into the consumer's own row->slot map (the publish /
+    final-reconstruction view of residency)."""
+
+    seq: int
+    wb_idx: object | None  # jnp [nb] bucketed hot slots to gather
+    wb_rows: np.ndarray  # [n_wb] cold-tier rows the gather lands in
+    fresh_idx: object | None  # jnp [nb] bucketed destination slots
+    fresh_rows: object | None  # jnp [nb, 16] staged promotion data
+    deferred_rows: np.ndarray  # [n_def]
+    deferred_slots: np.ndarray  # [n_def]
+    evict_rows: np.ndarray  # all evicted rows (clean included)
+    promote_rows: np.ndarray  # all promoted rows
+    promote_slots: np.ndarray
+    written_rows: np.ndarray  # rows this window's scatter commits
+
+
+class TieredChunk:
+    """One staged chunk of the reference-kernel tiered path: budget-split
+    sub-windows, each a (plan, compact slab) pair dispatched in order."""
+
+    __slots__ = ("parts",)
+
+    def __init__(self, parts):
+        self.parts = parts
+
+
+class TierManager:
+    """The hot-set/cold-tier state machine. One per tiered run; the feed
+    thread calls the ``plan_*``/``stage_*`` half, the dispatch loop the
+    ``apply``/``finish``/``publish`` half (see the module docstring for
+    the cross-thread contract)."""
+
+    def __init__(self, state, hot_rows: int) -> None:
+        if hot_rows < 1:
+            raise ValueError(f"hot_rows must be >= 1, got {hot_rows}")
+        global _SAMPLER_INSTALLED
+        self._template = state
+        self.pad_row = state.pad_row
+        self.n_players = state.pad_row
+        # Entry-point fetch of the authoritative table: the cold tier
+        # starts as the caller's full state. One sync at run start, the
+        # tiered sibling of the untiered path's jnp.copy.
+        # graftlint: disable=GL025 — one intentional run-entry D2H fetch
+        self._host_table = np.array(state.table, np.float32)
+        self.capacity = _pow2(max(hot_rows, MIN_HOT_ROWS))
+        self.hot_pad = self.capacity
+        self._pad_vals = self._host_table[self.pad_row].copy()
+        # -- producer-owned page table --
+        self._slot_lut = np.full(self.pad_row + 1, -1, np.int32)
+        self._slot_lut[self.pad_row] = self.hot_pad
+        self._row_of = np.full(self.capacity, -1, np.int32)
+        self._dirty = np.zeros(self.capacity, bool)
+        self._last_use = np.zeros(self.capacity, np.int64)
+        self._free = list(range(self.capacity - 1, -1, -1))  # slot 0 first
+        self._host_version = np.full(self.pad_row + 1, -1, np.int64)
+        self._seq = 0
+        # -- consumer-owned --
+        self._applied = -1
+        self._pending: list = []  # (rows, n, device gather) FIFO
+        self._c_slot_of = np.full(self.pad_row + 1, -1, np.int32)
+        self._written_pub = np.zeros(self.pad_row + 1, bool)
+        self._written_start = np.zeros(self.pad_row + 1, bool)
+        reg = get_registry()
+        self._hits = reg.counter("tier.hits_total")
+        self._misses = reg.counter("tier.misses_total")
+        self._promotions = reg.counter("tier.promotions_total")
+        self._demotions = reg.counter("tier.demotions_total")
+        self._writebacks = reg.counter("tier.dirty_writebacks_total")
+        self._spills = reg.counter("tier.spills_total")
+        reg.gauge("tier.hot_rows").set(self.capacity)
+        reg.gauge("tier.host_bytes").set(self.host_nbytes)
+        self._tracer = get_tracer()
+        _MANAGERS.add(self)
+        if not _SAMPLER_INSTALLED:
+            set_host_tier_sampler(_host_tier_bytes)
+            _SAMPLER_INSTALLED = True
+
+    # -- sizing ----------------------------------------------------------
+    @property
+    def host_nbytes(self) -> int:
+        """Cold-tier host bytes: the table plus the page-table arrays —
+        what the obs/devicemem ``tier.host_bytes`` gauge reports."""
+        return int(
+            self._host_table.nbytes + self._slot_lut.nbytes
+            + self._row_of.nbytes + self._last_use.nbytes
+            + self._host_version.nbytes + self._c_slot_of.nbytes
+        )
+
+    def hot_state(self):
+        """The device-resident hot PlayerState the compiled kernels run
+        against: a ``[capacity+1, 16]`` table whose last row is the
+        padding row (copied from the full table so masked gathers read
+        identical bits); free slots hold zeros and are never gathered.
+        Feature arrays are inert placeholders — the rating kernel never
+        reads them (core/state.py docstring)."""
+        hot = np.zeros((self.capacity + 1, self._host_table.shape[1]),
+                       np.float32)
+        hot[self.hot_pad] = self._pad_vals
+        return dataclasses.replace(
+            self._template,
+            table=jnp.asarray(hot),
+            rank_points_ranked=jnp.zeros(self.capacity + 1, jnp.float32),
+            rank_points_blitz=jnp.zeros(self.capacity + 1, jnp.float32),
+            skill_tier=jnp.zeros(self.capacity + 1, jnp.int32),
+        )
+
+    def clamp_fuse(self, fuse):
+        """Caps the fused working-set budget at the hot capacity so every
+        fused window's touched rows fit the hot set by construction (the
+        residency planner's budget cut then doubles as the tier's
+        forced-miss split)."""
+        return dataclasses.replace(
+            fuse, max_rows=min(fuse.max_rows, self.capacity)
+        )
+
+    # -- producer half (feed thread) -------------------------------------
+    def split_spans(self, player_idx: np.ndarray) -> list[tuple[int, int]]:
+        """Cuts a chunk at step boundaries so each sub-window's distinct
+        touched rows fit the hot capacity — the forced-miss/thrash path:
+        a window bigger than the hot set still rates correctly, paying
+        extra promotion traffic (counted as ``tier.spills_total``). The
+        cut is exact, from first-touch prefix counts (the same math as
+        the fused planner's VMEM budget cut)."""
+        s_total = player_idx.shape[0]
+        per_step = int(np.prod(player_idx.shape[1:]))
+        spans: list[tuple[int, int]] = []
+        s0 = 0
+        while s0 < s_total:
+            sub = player_idx[s0:]
+            flat = np.concatenate(
+                [np.full(1, self.pad_row, player_idx.dtype), sub.ravel()]
+            )
+            u, first = np.unique(flat, return_index=True)
+            first_step = np.maximum(first - 1, 0) // per_step
+            cum = np.cumsum(np.bincount(first_step, minlength=s_total - s0))
+            # cum counts the padding row once (the virtual element), so
+            # real rows in a prefix are cum - 1.
+            fits = int(np.searchsorted(cum, self.capacity + 1, side="right"))
+            if fits == 0:
+                raise ValueError(
+                    f"one superstep touches {int(cum[0]) - 1} distinct rows "
+                    f"but the hot set holds {self.capacity}; raise hot_rows "
+                    "or shrink the batch size"
+                )
+            spans.append((s0, s0 + fits))
+            s0 += fits
+        if len(spans) > 1:
+            self._spills.add(len(spans) - 1)
+        return spans
+
+    def plan_rows(self, touched: np.ndarray, written: np.ndarray) -> TierPlan:
+        """The page-table transaction for one dispatch window: ``touched``
+        (unique, pad-free) must all be resident when the window runs,
+        ``written`` (unique, pad-free) become dirty. Returns the plan the
+        consumer executes; the page table here is updated immediately —
+        the producer's model runs ahead of the device by exactly the
+        prefetch depth."""
+        seq = self._seq
+        if touched.size > self.capacity:
+            raise ValueError(
+                f"window touches {touched.size} rows but the hot set "
+                f"holds {self.capacity} (split_spans missed a cut)"
+            )
+        slots = self._slot_lut[touched]
+        miss_mask = slots < 0
+        misses = touched[miss_mask]
+        n_hit = int(touched.size - misses.size)
+        if n_hit:
+            self._hits.add(n_hit)
+        evict_rows = np.empty(0, np.int32)
+        wb_slots = np.empty(0, np.int32)
+        wb_rows = np.empty(0, np.int32)
+        assign = np.empty(0, np.int32)
+        if misses.size:
+            self._misses.add(int(misses.size))
+            self._promotions.add(int(misses.size))
+            take = min(len(self._free), misses.size)
+            freed = [self._free.pop() for _ in range(take)]
+            need = misses.size - take
+            if need:
+                # LRU among resident slots the window does not touch;
+                # deterministic tie-break on the slot id.
+                lu = np.where(
+                    self._row_of >= 0, self._last_use, np.iinfo(np.int64).max
+                )
+                lu[slots[~miss_mask]] = np.iinfo(np.int64).max
+                order = np.lexsort((np.arange(self.capacity), lu))
+                ev = order[:need].astype(np.int32)
+                evict_rows = self._row_of[ev].copy()
+                ev_dirty = self._dirty[ev]
+                wb_slots = ev[ev_dirty]
+                wb_rows = evict_rows[ev_dirty]
+                self._demotions.add(int(ev.size))
+                if wb_rows.size:
+                    self._writebacks.add(int(wb_rows.size))
+                    self._host_version[wb_rows] = seq
+                self._slot_lut[evict_rows] = -1
+                self._row_of[ev] = -1
+                self._dirty[ev] = False
+                assign = np.concatenate(
+                    [np.fromiter(freed, np.int32, count=take), ev]
+                )
+            else:
+                assign = np.fromiter(freed, np.int32, count=take)
+            self._slot_lut[misses] = assign
+            self._row_of[assign] = misses
+        # Fresh vs deferred: a row whose last dirty demotion the consumer
+        # has already materialized (host_version <= applied, read ONCE)
+        # can be staged eagerly from the cold tier on this thread.
+        applied = self._applied
+        fresh_idx = fresh_rows = None
+        deferred_rows = np.empty(0, np.int32)
+        deferred_slots = np.empty(0, np.int32)
+        if misses.size:
+            fresh_mask = self._host_version[misses] <= applied
+            f_rows = misses[fresh_mask]
+            f_slots = assign[fresh_mask]
+            deferred_rows = misses[~fresh_mask]
+            deferred_slots = assign[~fresh_mask]
+            if f_rows.size:
+                with self._tracer.span("tier.promote", cat="tier", seq=seq):
+                    nb = _pow2(max(int(f_rows.size), TIER_BUCKET_FLOOR))
+                    idx = np.full(nb, self.hot_pad, np.int32)
+                    idx[: f_rows.size] = f_slots
+                    data = np.broadcast_to(
+                        self._pad_vals, (nb, self._pad_vals.size)
+                    ).copy()
+                    data[: f_rows.size] = self._host_table[f_rows]
+                    fresh_idx = jnp.asarray(idx)
+                    fresh_rows = jnp.asarray(data)  # async H2D, rides ahead
+        self._last_use[self._slot_lut[touched]] = seq
+        if written.size:
+            self._dirty[self._slot_lut[written]] = True
+        wb_idx = None
+        if wb_slots.size:
+            nb = _pow2(max(int(wb_slots.size), TIER_BUCKET_FLOOR))
+            idx = np.full(nb, self.hot_pad, np.int32)
+            idx[: wb_slots.size] = wb_slots
+            wb_idx = jnp.asarray(idx)
+        self._seq = seq + 1
+        return TierPlan(
+            seq=seq,
+            wb_idx=wb_idx,
+            wb_rows=wb_rows,
+            fresh_idx=fresh_idx,
+            fresh_rows=fresh_rows,
+            deferred_rows=deferred_rows,
+            deferred_slots=deferred_slots,
+            evict_rows=evict_rows,
+            promote_rows=misses,
+            promote_slots=assign,
+            written_rows=written,
+        )
+
+    def plan_window(self, player_idx: np.ndarray, valid: np.ndarray):
+        """Reference-kernel staging of one (already budget-split)
+        sub-window: plans residency for its touched rows and remaps the
+        gather indices into hot-slot space. ``valid`` is the written-slot
+        mask (``slot_mask & ratable``) — exactly the rows the device
+        scatter commits, which is what dirtiness means."""
+        touched = np.unique(player_idx)
+        if touched.size and touched[-1] == self.pad_row:
+            touched = touched[:-1]
+        written = np.unique(player_idx[valid])
+        plan = self.plan_rows(
+            touched.astype(np.int32), written.astype(np.int32)
+        )
+        hot_pidx = self._slot_lut[player_idx]
+        return plan, hot_pidx
+
+    def plan_fused(self, slot_rows: np.ndarray, n_live: int,
+                   player_idx: np.ndarray, valid: np.ndarray):
+        """Fused-kernel staging of one residency window: the fused plan
+        already names the touched rows (``slot_rows[1:n_live]`` — slot 0
+        is the padding row), so the tier plan reuses them and the remap
+        is a single take over ``slot_rows`` (bucket-padding entries map
+        to the hot padding slot). The fused working set then reads
+        through the hot set — composition is exactly this remap."""
+        touched = np.sort(slot_rows[1:n_live]).astype(np.int32)
+        written = np.unique(player_idx[valid]).astype(np.int32)
+        plan = self.plan_rows(touched, written)
+        return plan, self._slot_lut[slot_rows]
+
+    def stage_windows(self, player_idx, winner, mode_id, afk) -> TieredChunk:
+        """Producer-side staging of one reference-kernel chunk: budget
+        splits, per-sub-window residency plans, index remap, and the
+        async H2D commit of each remapped compact slab."""
+        from analyzer_tpu.sched.superstep import compact_device_window
+
+        ratable = (mode_id >= 0) & ~afk
+        parts = []
+        for s0, s1 in self.split_spans(player_idx):
+            sub = player_idx[s0:s1]
+            valid = (sub != self.pad_row) & ratable[s0:s1][:, :, None, None]
+            plan, hot_pidx = self.plan_window(sub, valid)
+            slab = compact_device_window(
+                hot_pidx, winner[s0:s1], mode_id[s0:s1], afk[s0:s1]
+            )
+            parts.append((plan, slab))
+        return TieredChunk(parts)
+
+    # -- consumer half (dispatch loop) ------------------------------------
+    def _drain(self) -> None:
+        """Materializes every queued writeback into the cold tier. The
+        queued gathers have had at least one window of device time to
+        complete, so this is a cheap host copy in steady state."""
+        while self._pending:
+            rows, n, dev = self._pending.pop(0)
+            # graftlint: disable=GL025 — intentional batched writeback
+            host = np.asarray(dev)
+            self._host_table[rows] = host[:n]
+
+    def apply(self, table, plan: TierPlan):
+        """Executes one plan against the hot table, in the only order
+        that is correct: drain earlier writebacks (the cold tier becomes
+        current through ``plan.seq - 1``), gather THIS plan's dirty
+        evictions off the table (before their slots are overwritten),
+        then scatter the promotions in. Returns the new hot table; the
+        caller dispatches the window's compute against it."""
+        self._drain()
+        self._applied = plan.seq - 1  # GIL orders the host writes first
+        if plan.wb_rows.size:
+            with self._tracer.span("tier.demote", cat="tier", seq=plan.seq):
+                dev = _gather_hot(table, plan.wb_idx)
+                try:
+                    dev.copy_to_host_async()
+                except AttributeError:  # pragma: no cover — older jax
+                    pass
+                self._pending.append(
+                    (plan.wb_rows, int(plan.wb_rows.size), dev)
+                )
+        if plan.fresh_idx is not None:
+            table = _scatter_hot(table, plan.fresh_idx, plan.fresh_rows)
+        if plan.deferred_rows.size:
+            # The miss path: the row's latest value was still in flight
+            # at plan time. The drain above made the cold tier current,
+            # so this gather-H2D is correct — just not overlapped.
+            with self._tracer.span("tier.promote", cat="tier",
+                                   seq=plan.seq, deferred=True):
+                nb = _pow2(max(int(plan.deferred_rows.size),
+                               TIER_BUCKET_FLOOR))
+                idx = np.full(nb, self.hot_pad, np.int32)
+                idx[: plan.deferred_slots.size] = plan.deferred_slots
+                data = np.broadcast_to(
+                    self._pad_vals, (nb, self._pad_vals.size)
+                ).copy()
+                data[: plan.deferred_rows.size] = (
+                    self._host_table[plan.deferred_rows]
+                )
+                table = _scatter_hot(
+                    table, jnp.asarray(idx), jnp.asarray(data)
+                )
+        # Replay the transaction into the consumer's own residency view
+        # (the publish / final-reconstruction side never reads producer
+        # state, which runs ahead of the device).
+        if plan.evict_rows.size:
+            self._c_slot_of[plan.evict_rows] = -1
+        if plan.promote_rows.size:
+            self._c_slot_of[plan.promote_rows] = plan.promote_slots
+        if plan.written_rows.size:
+            self._written_pub[plan.written_rows] = True
+            self._written_start[plan.written_rows] = True
+        return table
+
+    def dispatch_chunk(self, state, staged: TieredChunk, cfg, collect):
+        """Consumer-side dispatch of one reference-kernel tiered chunk:
+        apply each sub-window's plan, scan it, concatenate the collected
+        outputs (one fetchable tensor per chunk, like the fused path)."""
+        from analyzer_tpu.sched.runner import _scan_chunk
+
+        ys_parts = []
+        for plan, slab in staged.parts:
+            table = self.apply(state.table, plan)
+            state = dataclasses.replace(state, table=table)
+            state, ys = _scan_chunk(state, slab, cfg, collect, self.hot_pad)
+            if collect:
+                ys_parts.append(ys)
+        if not collect:
+            return state, None
+        return state, (
+            ys_parts[0] if len(ys_parts) == 1 else jnp.concatenate(ys_parts)
+        )
+
+    def _fetch_resident(self, table, rows: np.ndarray) -> np.ndarray:
+        """Current values of resident ``rows`` off the hot table (one
+        bucketed gather + D2H)."""
+        nb = _pow2(max(int(rows.size), TIER_BUCKET_FLOOR))
+        idx = np.full(nb, self.hot_pad, np.int32)
+        idx[: rows.size] = self._c_slot_of[rows]
+        # graftlint: disable=GL025 — snapshot/publish boundary sync
+        return np.asarray(_gather_hot(table, jnp.asarray(idx)))[: rows.size]
+
+    def full_table(self, table) -> np.ndarray:
+        """The logical full ``[P+1, 16]`` table as of the last dispatched
+        window: the cold tier (drained) plus the current values of every
+        resident row written since run start. Used for the final state,
+        checkpoint hooks, and full view rebuilds."""
+        self._drain()
+        full = self._host_table.copy()
+        changed = np.flatnonzero(self._written_start)
+        resident = changed[self._c_slot_of[changed] >= 0]
+        if resident.size:
+            full[resident] = self._fetch_resident(table, resident)
+        return full
+
+    def full_state(self, table):
+        """A PlayerState view of :meth:`full_table` (checkpoint hooks —
+        same one-sync-per-snapshot cost profile as the untiered hook)."""
+        return dataclasses.replace(
+            self._template, table=jnp.asarray(self.full_table(table))
+        )
+
+    def finish(self, table):
+        """Final state of a tiered run: drain, reconstruct, and return a
+        PlayerState bit-identical to the untiered runner's."""
+        return self.full_state(table)
+
+    # -- serve-view publish ------------------------------------------------
+    def publish_view(self, publisher, table, force: bool = True):
+        """Publishes the logical table through ``publisher`` from the hot
+        set: rows written since the last publish come from the hot table
+        (resident) or the drained cold tier (demoted), and ride the
+        incremental ``.at[rows].set`` patch path; everything else is the
+        host-side shadow the previous view already serves. Views stay
+        snapshot-consistent and bit-identical to untiered publishes."""
+        if not force and not publisher.due():
+            return None
+        self._drain()
+        changed = np.flatnonzero(self._written_pub)
+        vals = self._host_table[changed].copy()
+        res_mask = self._c_slot_of[changed] >= 0
+        if res_mask.any():
+            vals[res_mask] = self._fetch_resident(table, changed[res_mask])
+        view = publisher.publish_state_patch(
+            changed, vals, self.n_players,
+            full_table=lambda: self.full_table(table),
+        )
+        self._written_pub[:] = False
+        return view
+
+    def maybe_publish_view(self, publisher, table):
+        """Throttled :meth:`publish_view` — the chunk-boundary hook."""
+        return self.publish_view(publisher, table, force=False)
+
+
+def stage_chunk_tiered(sched, start: int, stop: int, tier: TierManager,
+                       collect: bool) -> TieredChunk:
+    """Tiered sibling of ``feed.stage_chunk``: materializes the window
+    (``feed.materialize`` span), then splits/plans/remaps/commits it
+    through the tier manager (promotion H2D inside ``tier.promote``
+    spans). ``collect`` needs no extra staging — the collected-output
+    layout is row-id-free and the chunk's slot->match map is unchanged
+    by the split (sub-windows are prefixes in order)."""
+    check = getattr(sched, "check_compact_invariant", None)
+    if check is not None:
+        check(start, stop)
+    tracer = get_tracer()
+    with tracer.span("feed.materialize", cat="sched", start=start):
+        pidx, _mask, winner, mode_id, afk = sched.host_window(start, stop)
+    with tracer.span("feed.transfer", cat="sched", start=start):
+        return tier.stage_windows(pidx, winner, mode_id, afk)

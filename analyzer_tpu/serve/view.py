@@ -250,15 +250,70 @@ class ViewPublisher:
             # jnp.array (owning copy) — see publish_rows on aliasing.
             return self._swap(jnp.array(self._staging), p)
 
+    def publish_state_patch(
+        self, rows_idx, rows, n_players: int, full_table
+    ) -> RatingsView:
+        """Table-mode INCREMENTAL publish for a writer that knows exactly
+        which index-addressed rows changed since the previous version —
+        the tiered runner (``sched/tier.py``), whose hot set names every
+        row written since the last publish. Only those rows cross H2D,
+        riding the same ``.at[rows].set`` patch path as
+        :meth:`publish_rows`; the staging buffer keeps the full-table
+        invariant so later publishes (either method) stay consistent.
+
+        ``full_table`` is a zero-arg callable producing the whole
+        ``[P+1, 16]`` host table — the rebuild fallback, paid only when
+        there is no patchable previous view (first publish, an id-mapped
+        publisher, or a row-bucket change)."""
+        rows = np.asarray(rows, np.float32)
+        rows_idx = np.asarray(rows_idx, np.int64)
+        with self._lock:
+            alloc = row_bucket(n_players)
+            prev = self._view
+            patchable = (
+                prev is not None
+                and self._row_of is None
+                and prev.table.shape[0] == alloc + 1
+                and prev.n_players == n_players
+                and self._staging.shape[0] == alloc + 1
+            )
+            if not patchable:
+                host = np.asarray(full_table(), np.float32)
+                self._row_of = None
+                self._ids = None
+                self._staging = np.full(
+                    (alloc + 1, TABLE_WIDTH), np.nan, np.float32
+                )
+                self._staging[:n_players] = host[:n_players]
+                # jnp.array (owning copy) — see publish_rows on aliasing.
+                return self._swap(jnp.array(self._staging), n_players)
+            self._staging[rows_idx] = rows
+            nb = _pow2_bucket(len(rows_idx), PATCH_BUCKET_FLOOR)
+            idx = np.full(nb, alloc, np.int32)
+            idx[: len(rows_idx)] = rows_idx
+            pad_rows = np.full((nb, TABLE_WIDTH), np.nan, np.float32)
+            pad_rows[: len(rows_idx)] = rows
+            table = _patch_rows(
+                prev.table, jnp.asarray(idx), jnp.asarray(pad_rows)
+            )
+            return self._swap(table, n_players)
+
+    def due(self) -> bool:
+        """Whether the publish throttle window has elapsed. Callers whose
+        publish is expensive to PREPARE (the tiered runner's dirty-row
+        fetch) check this before building the payload; the first publish
+        is always due."""
+        return (
+            self._last_publish is None
+            or time.monotonic() - self._last_publish
+            >= self.min_publish_interval_s
+        )
+
     def maybe_publish_state(self, state, ids=None) -> RatingsView | None:
         """Throttled :meth:`publish_state` — the sched runners call this
         at chunk boundaries, where an unthrottled publish would pay a
         device fetch per chunk. The first call always publishes."""
-        now = time.monotonic()
-        if (
-            self._last_publish is not None
-            and now - self._last_publish < self.min_publish_interval_s
-        ):
+        if not self.due():
             return None
         return self.publish_state(state, ids=ids)
 

@@ -63,7 +63,11 @@ depth-invariant, only overlap changes), BENCH_KERNEL (default fused;
 reference skips the fused capture), BENCH_FUSE_WINDOW (default 16
 supersteps per fused dispatch), BENCH_FUSE_ROWS (working-set row budget,
 default sched.residency.DEFAULT_MAX_ROWS; the fused backend rides
-ANALYZER_TPU_FUSE_BACKEND — scan | pallas | interpret), BENCH_OBS_PORT
+ANALYZER_TPU_FUSE_BACKEND — scan | pallas | interpret), BENCH_HOT_ROWS
+(default 0 = untiered; N keeps only an N-row hot set of the table
+device-resident — sched/tier.py — and embeds a `tiered` block: hit
+rate, promotion bytes, min_over_resident vs the resident rate_history
+line, plus an on-rig bit-identity check), BENCH_OBS_PORT
 (serve obsd — /metrics, /statusz — on localhost while the capture runs;
 `cli bench --obs-port` sets the same thing).
 """
@@ -296,6 +300,23 @@ def _bench_main(metrics_out: str | None) -> None:
         f"= {t_stream / head_best:.2f}x device-only time")
     streamed = streamed_stats(s_times, s_stable, head_best)
 
+    # Tiered table (BENCH_HOT_ROWS > 0): the SAME rate_history line with
+    # only hot_rows of the table device-resident — min_over_resident is
+    # the tiering tax benchdiff gates (sched/tier.py, docs/kernels.md).
+    tiered_block = None
+    hot_rows = int(os.environ.get("BENCH_HOT_ROWS", 0))
+    if hot_rows > 0:
+        tiered_block, tiered_table = bench_tiered(
+            sched, state_dev, stream, cfg, repeats, t_e2e, hot_rows,
+            kernel, fuse_window, feed_depth,
+        )
+        identical = bool(np.array_equal(
+            np.asarray(state.table), tiered_table, equal_nan=True
+        ))
+        tiered_block["bit_identical_to_resident"] = identical
+        if not identical:  # the acceptance contract — never report silently
+            log("WARNING: tiered table DIVERGED from the resident run")
+
     sanity(state, state0.n_players)
 
     probe_after = probe_tunnel()
@@ -309,6 +330,8 @@ def _bench_main(metrics_out: str | None) -> None:
     }
     if fused_block is not None:
         phases["fused_best_s"] = head_best
+    if tiered_block is not None:
+        phases["tiered_best_s"] = tiered_block["min_s"]
     emit_metric(
         rate,
         capture_stats(
@@ -318,6 +341,7 @@ def _bench_main(metrics_out: str | None) -> None:
         telemetry=obs_breakdown(phases),
         metrics_out=metrics_out,
         fused=fused_block,
+        tiered=tiered_block,
     )
 
 
@@ -363,6 +387,7 @@ def bench_fused(sched, state0, cfg, repeats: int, ref_best: float):
         f"{stats['writebacks_avoided']} writebacks avoided")
 
     def run_fused():
+        # graftlint: disable=GL027 — bench baseline: deliberate untiered load
         table = jax.device_put(np.asarray(state0.table))
         for c in staged:
             for w in c.windows:
@@ -395,6 +420,64 @@ def bench_fused(sched, state0, cfg, repeats: int, ref_best: float):
         "_times": f_times,
     }
     return block, fused_best, np.asarray(table)
+
+
+def bench_tiered(sched, state_dev, stream, cfg, repeats: int,
+                 resident_best: float, hot_rows: int, kernel: str,
+                 fuse_window, feed_depth):
+    """Times the tiered rate_history line (hot set of ``hot_rows`` rows,
+    host cold tier) under the shared repeat protocol and reads the tier
+    counters off the registry for the capture's hit-rate / promotion
+    accounting. Returns (tiered_block, final_table) — the caller checks
+    bit-identity against the resident run's table."""
+    from analyzer_tpu.core.state import TABLE_WIDTH
+    from analyzer_tpu.obs import get_registry
+    from analyzer_tpu.sched import rate_history
+
+    reg = get_registry()
+    names = ("hits", "misses", "promotions", "demotions",
+             "dirty_writebacks", "spills")
+    before = {n: reg.counter(f"tier.{n}_total").value for n in names}
+
+    def run_tiered():
+        t_state, _ = rate_history(
+            state_dev, cfg=cfg, sched=sched, prefetch_depth=feed_depth,
+            kernel=kernel, fuse_window=fuse_window, hot_rows=hot_rows,
+        )
+        np.asarray(t_state.table[:1])
+        return t_state
+
+    t_state, t_best, t_times, t_stable = time_runs(
+        run_tiered, repeats, max_extra=repeats
+    )
+    runs = len(t_times) + 1  # warmup included — the counters saw it too
+    delta = {
+        n: reg.counter(f"tier.{n}_total").value - before[n] for n in names
+    }
+    touched = delta["hits"] + delta["misses"]
+    hit_rate = delta["hits"] / touched if touched else None
+    log(f"tiered rate_history (hot_rows={hot_rows}): {t_best:.2f}s = "
+        f"{t_best / resident_best:.2f}x resident, hit rate "
+        f"{hit_rate if hit_rate is None else round(hit_rate, 4)}")
+    block = {
+        "hot_rows": hot_rows,
+        "capacity": int(reg.gauge("tier.hot_rows").value),
+        "host_bytes": int(reg.gauge("tier.host_bytes").value),
+        "hit_rate": None if hit_rate is None else round(hit_rate, 4),
+        "promotions_per_run": int(delta["promotions"] // runs),
+        "promotion_bytes_per_run": int(
+            delta["promotions"] // runs * TABLE_WIDTH * 4
+        ),
+        "demotions_per_run": int(delta["demotions"] // runs),
+        "dirty_writebacks_per_run": int(delta["dirty_writebacks"] // runs),
+        "spills_per_run": int(delta["spills"] // runs),
+        "repeats_s": [round(t, 3) for t in t_times],
+        "min_s": round(t_best, 3),
+        "stable": t_stable,
+        "resident_min_s": round(resident_best, 3),
+        "min_over_resident": round(t_best / resident_best, 3),
+    }
+    return block, np.asarray(t_state.table)
 
 
 def probe_tunnel() -> float:
@@ -592,7 +675,8 @@ def emit_metric(rate, capture: dict | None = None,
                 streamed: dict | None = None,
                 telemetry: dict | None = None,
                 metrics_out: str | None = None,
-                fused: dict | None = None):
+                fused: dict | None = None,
+                tiered: dict | None = None):
     line = {
         "metric": "matches_per_sec_per_chip",
         "value": round(rate, 1),
@@ -611,6 +695,11 @@ def emit_metric(rate, capture: dict | None = None,
         # min_over_reference; benchdiff gates the ratio so a fused
         # regression or a silent fallback-to-reference fails CI).
         line["fused"] = fused
+    if tiered is not None:
+        # The tiered-table capture (hit rate, promotion bytes,
+        # min_over_resident; benchdiff --family tiered gates the ratio
+        # so tier thrash or a silent fall-back-to-untiered fails CI).
+        line["tiered"] = tiered
     if telemetry is not None:
         line["telemetry"] = telemetry
     if metrics_out:

@@ -495,3 +495,101 @@ class TestMaterializerParity:
         )
         assert winner.dtype == np.int32 and mode_id.dtype == np.int32
         assert afk.dtype == bool
+
+
+class TestStagingErrorPropagation:
+    """A producer-thread failure during staging — materialization,
+    residency planning, or a staged tier promotion — must surface on the
+    consumer's next get() wrapped in a FeedStageError naming the window,
+    with the raw error as __cause__ (sched/feed.py). The already-staged
+    prefix is valid work and still drains first."""
+
+    def test_rate_history_staging_failure_carries_window_id(self):
+        from analyzer_tpu.sched.feed import FeedStageError
+        from analyzer_tpu.sched import pack_schedule
+
+        stream, state = small_stream(n_matches=120, n_players=40)
+        sched = pack_schedule(stream, pad_row=state.pad_row, windowed=True)
+        orig = sched.host_window
+
+        def failing(start, stop):
+            if start >= 6:
+                raise RuntimeError("disk vanished")
+            return orig(start, stop)
+
+        sched.host_window = failing
+        with pytest.raises(FeedStageError) as ei:
+            rate_history(state, sched, CFG, steps_per_chunk=6)
+        assert ei.value.start == 6
+        assert "window [6," in str(ei.value)
+        assert isinstance(ei.value.__cause__, RuntimeError)
+        assert "disk vanished" in str(ei.value.__cause__)
+
+    def test_rate_stream_staging_failure_carries_window_id(self, monkeypatch):
+        from analyzer_tpu.sched import feed as feed_mod
+        from analyzer_tpu.sched import superstep as ss
+        from analyzer_tpu.sched.feed import FeedStageError
+
+        stream, state = small_stream(n_matches=120, n_players=40)
+        orig = ss.materialize_gather_window
+        calls = []
+
+        def failing(*args, **kw):
+            calls.append(1)
+            if len(calls) > 1:
+                raise RuntimeError("NFS hiccup")
+            return orig(*args, **kw)
+
+        monkeypatch.setattr(ss, "materialize_gather_window", failing)
+        with pytest.raises(FeedStageError) as ei:
+            rate_stream(state, stream, CFG, batch_size=8, steps_per_chunk=4)
+        assert ei.value.start > 0  # the second staged window
+        assert isinstance(ei.value.__cause__, RuntimeError)
+        assert feed_mod.FeedStageError is FeedStageError  # exported home
+
+    def test_tiered_promotion_failure_carries_window_id(self, monkeypatch):
+        from analyzer_tpu.sched.feed import FeedStageError
+        from analyzer_tpu.sched.tier import TierManager
+
+        stream, state = small_stream(n_matches=120, n_players=40)
+        orig = TierManager.plan_rows
+        calls = []
+
+        def failing(self, touched, written):
+            calls.append(1)
+            if len(calls) > 2:
+                raise RuntimeError("promotion staging torn")
+            return orig(self, touched, written)
+
+        monkeypatch.setattr(TierManager, "plan_rows", failing)
+        with pytest.raises(FeedStageError) as ei:
+            rate_stream(
+                state, stream, CFG, batch_size=8, steps_per_chunk=4,
+                hot_rows=32,
+            )
+        assert isinstance(ei.value.__cause__, RuntimeError)
+        assert "promotion staging torn" in str(ei.value.__cause__)
+
+    def test_staged_prefix_drains_before_the_error(self):
+        """Windows staged before the failure are valid and consumed:
+        the hook sees every boundary below the failing window."""
+        from analyzer_tpu.sched import pack_schedule
+        from analyzer_tpu.sched.feed import FeedStageError
+
+        stream, state = small_stream(n_matches=120, n_players=40)
+        sched = pack_schedule(stream, pad_row=state.pad_row, windowed=True)
+        orig = sched.host_window
+
+        def failing(start, stop):
+            if start >= 4:
+                raise RuntimeError("boom")
+            return orig(start, stop)
+
+        sched.host_window = failing
+        seen = []
+        with pytest.raises(FeedStageError):
+            rate_history(
+                state, sched, CFG, steps_per_chunk=2, prefetch_depth=1,
+                on_chunk=lambda st, stop: seen.append(stop),
+            )
+        assert seen == [2, 4]

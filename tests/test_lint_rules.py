@@ -1022,3 +1022,101 @@ class TestGL026PallasContainment:
         from analyzer_tpu.lint.findings import RULES
 
         assert "GL026" in RULES
+
+
+class TestGL027TableTransferContainment:
+    """GL027 keeps whole-table device transfers in the tier manager
+    (sched/tier.py) and the view publisher (serve/view.py): a
+    ``jax.device_put``/``jnp.array`` of a *table* value anywhere else
+    re-materializes the full table in HBM behind the page table's back —
+    the memory cap the tiered table exists to remove."""
+
+    SRC = """
+    import jax
+    import jax.numpy as jnp
+
+    def f(state, host_table):
+        a = jax.device_put(state.table)
+        b = jnp.array(host_table)
+        return a, b
+    """
+
+    def test_fires_outside_the_table_homes(self):
+        for path in (
+            "analyzer_tpu/sched/runner.py",
+            "analyzer_tpu/service/worker.py",
+            "bench.py",
+            "snippet.py",
+        ):
+            assert rules_of(self.SRC, path) == ["GL027", "GL027"], path
+
+    def test_silent_in_tier_manager_view_publisher_and_tests(self):
+        for path in (
+            "analyzer_tpu/sched/tier.py",
+            "analyzer_tpu/serve/view.py",
+            "tests/test_tier.py",
+            "test_snippet.py",
+        ):
+            assert rules_of(self.SRC, path) == [], path
+
+    def test_non_table_values_are_fine(self):
+        # The needle is the *table* name: slab/batch transfers are the
+        # feed's job and stay legal everywhere.
+        src = """
+        import jax
+        import jax.numpy as jnp
+
+        def stage(pidx, winner):
+            return jax.device_put(pidx), jnp.array(winner)
+        """
+        assert rules_of(src, "analyzer_tpu/sched/feed.py") == []
+
+    def test_jnp_asarray_is_not_banned(self):
+        # asarray is the (possibly zero-copy) staging idiom the state
+        # constructors use; the ban is on the owning transfer forms.
+        src = """
+        import jax.numpy as jnp
+
+        def load(table):
+            return jnp.asarray(table)
+        """
+        assert rules_of(src, "analyzer_tpu/core/state.py") == []
+
+    def test_literal_args_exempt(self):
+        src = """
+        import jax.numpy as jnp
+
+        TABLE_DEFAULTS = jnp.array([0.0, 1.0])
+        """
+        assert rules_of(src, "analyzer_tpu/core/state.py") == []
+
+    def test_alias_resolves(self):
+        src = """
+        from jax import device_put
+
+        def f(host_table):
+            return device_put(host_table)
+        """
+        assert rules_of(src, "analyzer_tpu/sched/runner.py") == ["GL027"]
+
+    def test_disable_escape(self):
+        src = """
+        import jax
+        import numpy as np
+
+        def run(state0):
+            # graftlint: disable=GL027 — bench baseline: deliberate untiered load
+            return jax.device_put(np.asarray(state0.table))
+        """
+        assert rules_of(src, "bench.py") == []
+
+    def test_windows_separators_normalized(self):
+        assert rules_of(self.SRC, "analyzer_tpu\\sched\\tier.py") == []
+        assert "GL027" in rules_of(
+            self.SRC, "analyzer_tpu\\sched\\runner.py"
+        )
+
+    def test_catalog_has_gl027(self):
+        from analyzer_tpu.lint.findings import RULES
+
+        assert "GL027" in RULES

@@ -1,0 +1,472 @@
+"""The tiered ratings table (sched/tier.py): HBM hot set + host spill.
+
+The load-bearing property is BIT-IDENTITY: tiering is a memory-placement
+change, not a numeric one — the final table, the collected per-match
+outputs, every checkpoint-hook snapshot, and every published serve view
+must equal the untiered runner's exactly, for every hot-set size
+(smaller than the working set, exact fit, oversized), both runners, both
+kernels, and every prefetch depth; ``hot_rows=0`` must not even build a
+manager. The unit half pins the cross-thread promotion protocol (dirty
+writeback -> deferred re-promotion ordering), the forced-miss window
+split, the LRU demotion choice, the telemetry/benchdiff surfaces, and
+the feed's window-tagged error propagation.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.core.state import PlayerState
+from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
+from analyzer_tpu.obs import get_registry, retrace_counts
+from analyzer_tpu.obs.benchdiff import bench_configs, diff_configs, family_configs
+from analyzer_tpu.sched import (
+    FeedStageError,
+    MatchStream,
+    TierManager,
+    pack_schedule,
+    rate_history,
+    rate_stream,
+)
+from analyzer_tpu.sched.tier import _gather_hot
+from analyzer_tpu.serve.view import ViewPublisher
+
+CFG = RatingConfig()
+
+OUT_FIELDS = (
+    "quality", "shared_mu", "shared_sigma", "delta",
+    "mode_mu", "mode_sigma", "any_afk", "updated",
+)
+
+
+def small_stream(n_matches=300, n_players=60, seed=11, **kw):
+    players = synthetic_players(n_players, seed=seed)
+    stream = synthetic_stream(n_matches, players, seed=seed, **kw)
+    state = PlayerState.create(
+        n_players,
+        rank_points_ranked=players.rank_points_ranked,
+        rank_points_blitz=players.rank_points_blitz,
+        skill_tier=players.skill_tier,
+    )
+    return stream, state
+
+
+def assert_same_outputs(a, b, msg=""):
+    for field in OUT_FIELDS:
+        np.testing.assert_array_equal(
+            getattr(a, field), getattr(b, field), err_msg=f"{msg} {field}"
+        )
+
+
+@pytest.fixture(scope="module")
+def workload():
+    """One shared stream/state/schedule plus the untiered baselines."""
+    stream, state = small_stream()
+    sched = pack_schedule(stream, pad_row=state.pad_row, windowed=True)
+    hist_state, hist_outs = rate_history(
+        state, sched, CFG, collect=True, steps_per_chunk=6
+    )
+    stream_state, stream_outs = rate_stream(
+        state, stream, CFG, collect=True, batch_size=8, steps_per_chunk=5
+    )
+    return {
+        "stream": stream,
+        "state": state,
+        "sched": sched,
+        "hist": (np.asarray(hist_state.table), hist_outs),
+        "stream_run": (np.asarray(stream_state.table), stream_outs),
+    }
+
+
+# hot_rows=16 buckets to a 16-slot hot set — far below the ~60 touched
+# rows of the workload (thrash); 64 is the exact player-count fit; 4096
+# is oversized (everything resident after first touch). The streamed
+# matrix floors at 32: its fixed batch_size=8 supersteps can touch >16
+# distinct rows, which is the (tested) hard-error case, not thrash.
+HOT_SIZES = (16, 64, 4096)
+HOT_SIZES_STREAM = (32, 64, 4096)
+
+
+class TestBitIdentityMatrix:
+    @pytest.mark.parametrize("hot_rows", HOT_SIZES)
+    @pytest.mark.parametrize("kernel", ["reference", "fused"])
+    @pytest.mark.parametrize("depth", [1, 3])
+    def test_rate_history(self, workload, hot_rows, kernel, depth):
+        base_table, base_outs = workload["hist"]
+        got, outs = rate_history(
+            workload["state"], workload["sched"], CFG, collect=True,
+            steps_per_chunk=6, prefetch_depth=depth, hot_rows=hot_rows,
+            kernel=kernel, fuse_window=4, fuse_backend="scan",
+        )
+        np.testing.assert_array_equal(
+            base_table, np.asarray(got.table),
+            err_msg=f"hot_rows={hot_rows} kernel={kernel} depth={depth}",
+        )
+        assert_same_outputs(
+            base_outs, outs, f"hot_rows={hot_rows} kernel={kernel}"
+        )
+
+    @pytest.mark.parametrize("hot_rows", HOT_SIZES_STREAM)
+    @pytest.mark.parametrize("kernel", ["reference", "fused"])
+    @pytest.mark.parametrize("depth", [1, 3])
+    def test_rate_stream(self, workload, hot_rows, kernel, depth):
+        base_table, base_outs = workload["stream_run"]
+        got, outs = rate_stream(
+            workload["state"], workload["stream"], CFG, collect=True,
+            batch_size=8, steps_per_chunk=5, prefetch_depth=depth,
+            hot_rows=hot_rows, kernel=kernel, fuse_window=4,
+        )
+        np.testing.assert_array_equal(
+            base_table, np.asarray(got.table),
+            err_msg=f"hot_rows={hot_rows} kernel={kernel} depth={depth}",
+        )
+        assert_same_outputs(
+            base_outs, outs, f"hot_rows={hot_rows} kernel={kernel}"
+        )
+
+    def test_hook_snapshots_match_untiered(self, workload):
+        """The checkpoint hook sees the logical FULL state on a tiered
+        run — every boundary snapshot equals the untiered hook's."""
+        def capture(**kw):
+            snaps = []
+            rate_history(
+                workload["state"], workload["sched"], CFG,
+                steps_per_chunk=6,
+                on_chunk=lambda st, stop: snaps.append(
+                    (stop, np.asarray(st.table).copy())
+                ),
+                **kw,
+            )
+            return snaps
+
+        base = capture()
+        got = capture(hot_rows=32)
+        assert [s for s, _ in base] == [s for s, _ in got]
+        for (stop, a), (_, b) in zip(base, got):
+            np.testing.assert_array_equal(a, b, err_msg=f"stop={stop}")
+
+    def test_caller_state_survives(self, workload):
+        state = workload["state"]
+        before = np.asarray(state.table).copy()
+        rate_history(state, workload["sched"], CFG, hot_rows=32)
+        np.testing.assert_array_equal(before, np.asarray(state.table))
+
+
+def chain_heavy_stream(n=60, width=1):
+    """A 1v1 stream over many distinct players: step working sets stay
+    tiny (<= 2 * batch rows) while the chunk working set spans the whole
+    roster — the forced-miss shape for a small hot set."""
+    rng = np.random.default_rng(5)
+    idx = np.zeros((n, 2, width), np.int32)
+    idx[:, 0, 0] = rng.permutation(n) % 40
+    idx[:, 1, 0] = (idx[:, 0, 0] + 1 + rng.integers(0, 38, n)) % 40
+    return MatchStream(
+        player_idx=idx,
+        winner=(np.arange(n) % 2).astype(np.int32),
+        mode_id=np.zeros(n, np.int32),
+        afk=np.zeros(n, bool),
+    ), PlayerState.create(40)
+
+
+class TestForcedMissThrash:
+    def test_hot_set_smaller_than_window_splits_and_stays_correct(self):
+        stream, state = chain_heavy_stream()
+        base, _ = rate_stream(state, stream, CFG, batch_size=4,
+                              steps_per_chunk=8)
+        reg = get_registry()
+        spills0 = reg.counter("tier.spills_total").value
+        # capacity 8 slots vs ~40 distinct rows per 8-step chunk: every
+        # chunk must split (counted spills) and still rate exactly.
+        got, _ = rate_stream(state, stream, CFG, batch_size=4,
+                             steps_per_chunk=8, hot_rows=8)
+        np.testing.assert_array_equal(
+            np.asarray(base.table), np.asarray(got.table)
+        )
+        assert reg.counter("tier.spills_total").value > spills0
+
+    def test_single_step_over_budget_raises(self):
+        stream, state = small_stream(n_matches=40, n_players=60)
+        with pytest.raises(FeedStageError) as ei:
+            # 8-slot hot set, 3v3 batches of 8: one superstep can touch
+            # up to 48 rows — no step-boundary cut can fit it.
+            rate_history(
+                state,
+                pack_schedule(stream, pad_row=state.pad_row, batch_size=8,
+                              windowed=True),
+                CFG, hot_rows=8,
+            )
+        assert "hot set" in str(ei.value.__cause__)
+
+
+class TestPromotionProtocol:
+    """Unit half: the dirty-writeback -> deferred re-promotion ordering
+    that makes the cold tier correct under pipelining."""
+
+    def manager(self, n_players=32, hot_rows=8):
+        state = PlayerState.create(n_players)
+        return TierManager(state, hot_rows), state
+
+    def test_lru_demotes_dirty_row_and_defers_its_repromotion(self):
+        tier, state = self.manager()
+        table = tier.hot_state().table
+        rows0 = np.arange(8, dtype=np.int32)
+        p0 = tier.plan_rows(rows0, rows0)  # fill the hot set, all dirty
+        table = tier.apply(table, p0)
+        # Emulate the device writing row 0's slot (the window's compute).
+        slot0 = int(tier._slot_lut[0])
+        table = table.at[slot0, 0].set(123.0)
+        # Next window touches 8 fresh rows: all 8 slots evict, dirty.
+        rows1 = np.arange(8, 16, dtype=np.int32)
+        p1 = tier.plan_rows(rows1, np.empty(0, np.int32))
+        assert p1.wb_rows.size == 8  # LRU demoted the dirty residents
+        table = tier.apply(table, p1)
+        # Row 0 again: its writeback is still in flight at plan time, so
+        # the promotion must be DEFERRED, not staged from the stale host.
+        p2 = tier.plan_rows(np.asarray([0], np.int32), np.empty(0, np.int32))
+        assert p2.deferred_rows.tolist() == [0]
+        assert p2.fresh_idx is None
+        table = tier.apply(table, p2)  # drains p1's writeback first
+        assert tier._host_table[0, 0] == 123.0  # writeback landed
+        slot = int(tier._slot_lut[0])
+        got = np.asarray(_gather_hot(table, jnp.asarray([slot])))
+        assert got[0, 0] == 123.0  # re-promotion read the written value
+
+    def test_clean_demotion_repromotes_fresh(self):
+        tier, _ = self.manager()
+        table = tier.hot_state().table
+        rows0 = np.arange(8, dtype=np.int32)
+        table = tier.apply(
+            table, tier.plan_rows(rows0, np.empty(0, np.int32))
+        )  # resident but never written: clean
+        p1 = tier.plan_rows(
+            np.arange(8, 16, dtype=np.int32), np.empty(0, np.int32)
+        )
+        assert p1.wb_rows.size == 0  # clean demotions need no writeback
+        table = tier.apply(table, p1)
+        p2 = tier.plan_rows(np.asarray([0], np.int32), np.empty(0, np.int32))
+        assert p2.deferred_rows.size == 0  # host copy never went stale
+        assert p2.fresh_idx is not None
+
+    def test_lru_picks_least_recently_used(self):
+        tier, _ = self.manager()
+        table = tier.hot_state().table
+        table = tier.apply(table, tier.plan_rows(
+            np.arange(8, dtype=np.int32), np.empty(0, np.int32)
+        ))
+        # Touch rows 4..7 again: rows 0..3 become the LRU candidates.
+        table = tier.apply(table, tier.plan_rows(
+            np.arange(4, 8, dtype=np.int32), np.empty(0, np.int32)
+        ))
+        p = tier.plan_rows(
+            np.asarray([20, 21], np.int32), np.empty(0, np.int32)
+        )
+        assert sorted(p.evict_rows.tolist()) == [0, 1]
+
+    def test_hot_rows_validation(self):
+        state = PlayerState.create(16)
+        with pytest.raises(ValueError, match="hot_rows"):
+            TierManager(state, 0)
+        with pytest.raises(ValueError, match="hot_rows"):
+            rate_history(
+                state,
+                pack_schedule(
+                    MatchStream(
+                        np.zeros((0, 2, 3), np.int32), np.zeros(0, np.int32),
+                        np.zeros(0, np.int32), np.zeros(0, bool),
+                    ),
+                    pad_row=state.pad_row, windowed=True,
+                ),
+                CFG, hot_rows=-1,
+            )
+
+    def test_mesh_refuses_hot_rows(self):
+        stream, state = small_stream(n_matches=20, n_players=20)
+        with pytest.raises(ValueError, match="hot_rows"):
+            rate_stream(state, stream, CFG, mesh=object(), hot_rows=8)
+
+
+class TestSteadyState:
+    def test_repeat_tiered_runs_do_not_retrace(self):
+        # The pow2 hot capacity + bucketed promotion/writeback shapes
+        # exist so a second identical tiered run adds ZERO entries to
+        # the tier kernels' and the scan's jit caches.
+        stream, state = small_stream(n_matches=300, n_players=60, seed=17)
+        run = lambda: rate_stream(
+            state, stream, CFG, batch_size=16, steps_per_chunk=6,
+            hot_rows=32,
+        )
+        run()  # warm the shape ladder
+        warm = {
+            k: retrace_counts()[k]
+            for k in ("tier._scatter_hot", "tier._gather_hot",
+                      "sched._scan_chunk")
+        }
+        run()
+        for k, v in warm.items():
+            assert retrace_counts()[k] == v, k
+
+    def test_telemetry_counters_and_gauges_move(self):
+        reg = get_registry()
+        before = {
+            n: reg.counter(f"tier.{n}_total").value
+            for n in ("hits", "misses", "promotions", "demotions",
+                      "dirty_writebacks")
+        }
+        stream, state = small_stream(n_matches=200, n_players=50, seed=23)
+        rate_stream(state, stream, CFG, batch_size=8, steps_per_chunk=4,
+                    hot_rows=16)
+        after = {
+            n: reg.counter(f"tier.{n}_total").value
+            for n in before
+        }
+        for n in ("hits", "misses", "promotions", "demotions"):
+            assert after[n] > before[n], n
+        assert reg.gauge("tier.hot_rows").value == 16
+        assert reg.gauge("tier.host_bytes").value > 0
+
+    def test_standard_schema_has_tier_series(self):
+        from analyzer_tpu.obs.registry import (
+            STANDARD_COUNTERS, STANDARD_GAUGES,
+        )
+
+        for name in (
+            "tier.hits_total", "tier.misses_total", "tier.promotions_total",
+            "tier.demotions_total", "tier.dirty_writebacks_total",
+            "tier.spills_total",
+        ):
+            assert name in STANDARD_COUNTERS, name
+        assert "tier.hot_rows" in STANDARD_GAUGES
+        assert "tier.host_bytes" in STANDARD_GAUGES
+
+    def test_devicemem_samples_host_tier_bytes(self):
+        from analyzer_tpu.obs.devicemem import sample_device_memory
+
+        state = PlayerState.create(64)
+        tier = TierManager(state, 16)  # registers the process sampler
+        out = sample_device_memory()
+        assert out["host"]["tier_bytes"] >= tier.host_nbytes
+        assert get_registry().gauge("tier.host_bytes").value >= (
+            tier.host_nbytes
+        )
+
+
+class TestServeViewParity:
+    def capture_views(self, workload, **kw):
+        pub = ViewPublisher(min_publish_interval_s=0.0)
+        versions = []
+        orig = pub._swap
+
+        def swap(table, n):
+            view = orig(table, n)
+            versions.append((view.version, view.host_table().copy()))
+            return view
+
+        pub._swap = swap
+        rate_history(
+            workload["state"], workload["sched"], CFG, steps_per_chunk=6,
+            view_publisher=pub, **kw,
+        )
+        return versions, pub
+
+    def test_tiered_views_bit_identical_to_untiered(self, workload):
+        base, _ = self.capture_views(workload)
+        got, _ = self.capture_views(workload, hot_rows=32)
+        assert [v for v, _ in base] == [v for v, _ in got]
+        for (version, a), (_, b) in zip(base, got):
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"version={version}"
+            )
+
+    def test_tiered_publishes_ride_the_patch_path(self, workload):
+        """After the first (full-rebuild) publish, tiered publishes go
+        through the incremental ``.at[rows].set`` patch — pinned via the
+        patch kernel's retrace counter moving."""
+        _, pub = self.capture_views(workload, hot_rows=32)
+        assert pub.version > 1
+        assert retrace_counts().get("serve._patch_rows", 0) >= 1
+
+    def test_publish_state_patch_matches_full_rebuild(self):
+        state = PlayerState.create(20)
+        table = np.asarray(state.table).copy()
+        table[3, 0] = 30.0
+        pub_patch = ViewPublisher(min_publish_interval_s=0.0)
+        pub_full = ViewPublisher(min_publish_interval_s=0.0)
+        pub_patch.publish_state(state)
+        pub_full.publish_state(state)
+        pub_patch.publish_state_patch(
+            np.asarray([3]), table[3:4], 20,
+            full_table=lambda: pytest.fail("patch path must not rebuild"),
+        )
+        pub_full.publish_state(table)
+        np.testing.assert_array_equal(
+            pub_patch.current().host_table(), pub_full.current().host_table()
+        )
+
+    def test_due_throttles(self):
+        pub = ViewPublisher(min_publish_interval_s=3600.0)
+        assert pub.due()  # first publish always due
+        pub.publish_state(PlayerState.create(4))
+        assert not pub.due()
+
+
+class TestBenchdiffTieredFamily:
+    """cli benchdiff gates the tiered capture: a min_over_resident or
+    hit-rate regression fails, unstable captures are excluded, and a
+    candidate that silently dropped its tiered block fails outright."""
+
+    def artifact(self, ratio=1.05, hit_rate=0.9, stable=True,
+                 tiered=True):
+        data = {
+            "metric": "matches_per_sec_per_chip",
+            "value": 500000.0,
+            "capture": {"degraded": False},
+        }
+        if tiered:
+            data["tiered"] = {
+                "min_over_resident": ratio,
+                "hit_rate": hit_rate,
+                "stable": stable,
+            }
+        return data
+
+    def configs(self, **kw):
+        return family_configs(bench_configs(self.artifact(**kw)), "tiered")
+
+    def test_family_filter_keeps_only_tiered_configs(self):
+        names = [c.name for c in self.configs()]
+        assert names == ["tiered.min_over_resident", "tiered.hit_rate"]
+
+    def test_thrash_regression_gates(self):
+        rows = diff_configs(self.configs(), self.configs(ratio=1.40), 5.0)
+        bad = [r for r in rows if r.name == "tiered.min_over_resident"]
+        assert bad and bad[0].regressed and bad[0].gated
+
+    def test_hit_rate_drop_gates(self):
+        rows = diff_configs(self.configs(), self.configs(hit_rate=0.5), 5.0)
+        bad = [r for r in rows if r.name == "tiered.hit_rate"]
+        assert bad and bad[0].regressed and bad[0].gated
+
+    def test_unstable_capture_reported_not_gated(self):
+        rows = diff_configs(
+            self.configs(), self.configs(ratio=1.40, stable=False), 5.0
+        )
+        bad = [r for r in rows if r.name == "tiered.min_over_resident"]
+        assert bad and bad[0].regressed and not bad[0].gated
+
+    def test_cli_gate_and_silent_fallback(self, tmp_path):
+        from analyzer_tpu.cli import main
+
+        a = tmp_path / "BENCH_r01.json"
+        b = tmp_path / "BENCH_r02.json"
+        a.write_text(json.dumps(self.artifact()))
+        b.write_text(json.dumps(self.artifact(ratio=1.40)))
+        assert main(["benchdiff", str(a), str(b), "--family", "tiered"]) == 1
+        b.write_text(json.dumps(self.artifact(ratio=1.06)))
+        assert main(["benchdiff", str(a), str(b), "--family", "tiered"]) == 0
+        # Candidate silently fell back to untiered: no tiered block.
+        b.write_text(json.dumps(self.artifact(tiered=False)))
+        assert main(["benchdiff", str(a), str(b), "--family", "tiered"]) == 1
