@@ -19,6 +19,10 @@ Subcommands:
   serve   ratesrv: the standalone query-serving plane over a checkpoint
           or database table (/v1/ratings /v1/leaderboard /v1/winprob
           /v1/tiers — docs/serving.md)
+  soak    closed-loop matchmaking soak: matchmake from the served
+          ratings, rate through the worker, query /v1/* concurrently,
+          gate SLOs; emits SOAK_*.json for benchdiff --family soak
+          (deterministic per seed — docs/OPERATIONS.md)
   query   one query against a running serve endpoint (HTTP client)
   lint    graftlint static analysis (JAX hazards + native ABI, docs/lint.md)
   metrics runtime telemetry snapshots (docs/observability.md): render a
@@ -919,11 +923,30 @@ def cmd_benchdiff(args) -> int:
         )
         return 2
     try:
+        b_raw = load_bench(b_path)
         a = family_configs(bench_configs(load_bench(a_path)), args.family)
-        b = family_configs(bench_configs(load_bench(b_path)), args.family)
+        b = family_configs(bench_configs(b_raw), args.family)
     except (OSError, ValueError) as err:
         print(f"error: {err}", file=sys.stderr)
         return 2
+    rc = 0
+    if args.family == "soak":
+        # The soak family's ABSOLUTE half: SLOs re-derived from the
+        # candidate's deterministic block (zero dead-letters, flat
+        # steady-state retraces, bounded view staleness, drained
+        # backlog), gated on the candidate alone — a regression-free
+        # delta must not mask a violated SLO.
+        from analyzer_tpu.obs.benchdiff import soak_slo_violations
+
+        violations = soak_slo_violations(b_raw)
+        for v in violations:
+            print(f"SLO VIOLATION: {v}")
+        if violations:
+            print(
+                f"error: {os.path.basename(b_path)} violates "
+                f"{len(violations)} soak SLO(s)", file=sys.stderr,
+            )
+            rc = 1
     if args.family == "tiered" and a and not b:
         # The baseline captured a tiered block but the candidate has
         # none: the run silently fell back to untiered — exactly the
@@ -942,7 +965,7 @@ def cmd_benchdiff(args) -> int:
             file=sys.stderr,
         )
         return 1
-    return 0
+    return rc
 
 
 def cmd_metrics(args) -> int:
@@ -1098,6 +1121,75 @@ def cmd_query(args) -> int:
         print(f"error: {url}: {reason}", file=sys.stderr)
         return 1
     print(body, end="")
+    return 0
+
+
+def cmd_soak(args) -> int:
+    """The closed-loop matchmaking soak (analyzer_tpu/loadgen,
+    ROADMAP item 3): matchmaker -> broker -> worker -> commit -> view
+    publish, with concurrent /v1/* query traffic, SLO sampling per
+    virtual tick, and a SOAK_*.json artifact for
+    ``benchdiff --family soak``. Deterministic per (seed, config);
+    exit 1 when any SLO is violated."""
+    from analyzer_tpu.loadgen import SoakConfig, SoakDriver
+    from analyzer_tpu.loadgen.driver import write_artifact
+
+    for flag in ("duration", "qps", "tick", "players", "batch_size",
+                 "polls_per_tick"):
+        if getattr(args, flag) <= 0:
+            print(f"error: --{flag.replace('_', '-')} must be positive",
+                  file=sys.stderr)
+            return 2
+    if args.query_qps < 0:
+        print("error: --query-qps must be >= 0 (0 = no read traffic)",
+              file=sys.stderr)
+        return 2
+    _obs_begin(args)
+    server = _obs_serve(args)
+    cfg = SoakConfig(
+        seed=args.seed,
+        duration_s=args.duration,
+        tick_s=args.tick,
+        qps=args.qps,
+        query_qps=args.query_qps,
+        n_players=args.players,
+        batch_size=args.batch_size,
+        polls_per_tick=args.polls_per_tick,
+        team5_frac=args.team5_frac,
+        afk_rate=args.afk_rate,
+        warmup=not args.no_warmup,
+        use_http=not args.in_process,
+        realtime=args.realtime,
+        max_view_lag_ticks=args.max_view_lag_ticks,
+        min_matches_per_sec=args.min_matches_per_sec,
+        max_p99_ms=args.max_p99_ms,
+    )
+    driver = SoakDriver(cfg)
+    try:
+        artifact = driver.run()
+    finally:
+        driver.close()
+        if server is not None:
+            server.close()
+    _obs_write(args)
+    # The headline line mirrors bench.py's contract (one JSON line on
+    # stdout); the full artifact — the benchdiff input — goes to --out.
+    line = {
+        k: artifact[k]
+        for k in ("metric", "value", "latency_ms", "measured", "slo")
+    }
+    line["deterministic"] = {
+        k: v for k, v in artifact["deterministic"].items()
+        if k != "trajectory"
+    }
+    print(json.dumps(line))
+    if args.out:
+        write_artifact(artifact, args.out)
+        print(f"wrote soak artifact to {args.out}", file=sys.stderr)
+    if not artifact["slo"]["pass"]:
+        for v in artifact["slo"]["violations"]:
+            print(f"SLO VIOLATION: {v}", file=sys.stderr)
+        return 1
     return 0
 
 
@@ -1346,13 +1438,17 @@ def main(argv=None) -> int:
         "than PCT percent (default: 5)",
     )
     s.add_argument(
-        "--family", choices=("bench", "serve", "tiered"), default="bench",
+        "--family", choices=("bench", "serve", "tiered", "soak"),
+        default="bench",
         help="artifact family for --against-latest scans: bench "
         "(BENCH_*.json, the write path), serve (SERVE_BENCH_*.json — "
-        "queries/sec + p99 latency, experiments/serve_bench.py), or "
+        "queries/sec + p99 latency, experiments/serve_bench.py), "
         "tiered (the same BENCH_*.json artifacts, gating only the "
         "tiered-table configs — min_over_resident + hit rate; a "
-        "candidate that silently dropped its tiered block fails); "
+        "candidate that silently dropped its tiered block fails), or "
+        "soak (SOAK_*.json from `cli soak` — throughput/p99 regression "
+        "PLUS the absolute SLOs: zero dead-letters, flat steady-state "
+        "retraces, bounded view staleness, drained backlog); "
         "explicit two-path diffs auto-detect from the metric name",
     )
     s.set_defaults(fn=cmd_benchdiff)
@@ -1387,6 +1483,88 @@ def main(argv=None) -> int:
         "summary (human digest)",
     )
     s.set_defaults(fn=cmd_metrics)
+
+    s = sub.add_parser(
+        "soak",
+        help="closed-loop matchmaking soak with SLO gates "
+        "(analyzer_tpu/loadgen; artifact for benchdiff --family soak)",
+    )
+    s.add_argument("--seed", type=int, default=0)
+    s.add_argument(
+        "--duration", type=float, default=8.0, metavar="S",
+        help="VIRTUAL seconds to soak (ticks = duration/tick; wall time "
+        "only matters with --realtime). Default: 8",
+    )
+    s.add_argument(
+        "--qps", type=float, default=24.0,
+        help="matches formed per virtual second (default: 24)",
+    )
+    s.add_argument(
+        "--query-qps", type=float, default=10.0, metavar="QPS",
+        help="serve queries per virtual second against /v1/* "
+        "(default: 10; mix: ratings/winprob/leaderboard/tiers)",
+    )
+    s.add_argument(
+        "--tick", type=float, default=1.0, metavar="S",
+        help="virtual tick length (default: 1.0)",
+    )
+    s.add_argument("--players", type=int, default=400)
+    s.add_argument(
+        "--batch-size", type=int, default=64,
+        help="worker micro-batch size (default: 64)",
+    )
+    s.add_argument(
+        "--polls-per-tick", type=int, default=4,
+        help="worker poll budget per tick — overload shows up as queue "
+        "depth instead of stretching the tick (default: 4)",
+    )
+    s.add_argument("--team5-frac", type=float, default=0.3,
+                   help="fraction of 5v5 matches (default: 0.3)")
+    s.add_argument("--afk-rate", type=float, default=0.0,
+                   help="fraction of matches with an AFK participant")
+    s.add_argument(
+        "--max-view-lag-ticks", type=int, default=2, metavar="N",
+        help="SLO: ticks the served view may stay stale while commits "
+        "are pending (default: 2)",
+    )
+    s.add_argument(
+        "--min-matches-per-sec", type=float, metavar="N",
+        help="SLO: absolute wall-throughput floor (default: ungated — "
+        "regressions gate via benchdiff)",
+    )
+    s.add_argument(
+        "--max-p99-ms", type=float, metavar="MS",
+        help="SLO: absolute serve-query p99 cap (default: ungated)",
+    )
+    s.add_argument(
+        "--no-warmup", action="store_true",
+        help="skip the worker/serve/publish compile warmup (the retrace "
+        "SLO then measures warmup compiles too)",
+    )
+    s.add_argument(
+        "--in-process", action="store_true",
+        help="query the engine in-process instead of over HTTP /v1/*",
+    )
+    s.add_argument(
+        "--realtime", action="store_true",
+        help="pace ticks against the wall clock (rig soaks); decisions "
+        "still run on the virtual clock, so results stay deterministic",
+    )
+    s.add_argument(
+        "--out", metavar="PATH",
+        help="write the SOAK_*.json artifact (the benchdiff --family "
+        "soak input; stdout always carries the one-line summary)",
+    )
+    s.add_argument(
+        "--metrics-out", metavar="PATH",
+        help="also write the full telemetry snapshot as JSON",
+    )
+    s.add_argument(
+        "--obs-port", type=int, metavar="PORT",
+        help="serve the obsd introspection endpoints during the soak "
+        "(watch soak.* and broker.queue_depth live; 0 = ephemeral)",
+    )
+    s.set_defaults(fn=cmd_soak)
 
     s = sub.add_parser("worker", help="broker-consuming service loop")
     s.add_argument(

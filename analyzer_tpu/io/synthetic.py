@@ -22,14 +22,26 @@ from analyzer_tpu.sched.superstep import MatchStream
 _MODE_TEAM_SIZE = np.array([3, 3, 3, 3, 5, 5], dtype=np.int32)
 
 
-class _AliasSampler:
-    """Walker alias method: O(P) build, O(1) per draw.
+class AliasSampler:
+    """Walker alias method over a fixed weight vector: O(P) build, O(1)
+    per draw.
 
     ``rng.choice(p=weights)`` costs a ~20-probe binary search per draw
     (log2 of the population) — ~37 s for the 100M draws of a 10M-match
     generation. The alias table replaces that with two table reads per
     draw (~5x faster end to end). Build is the standard Vose two-stack
     pairing; exactness: every draw is distributed exactly per ``weights``.
+
+    Public API (the loadgen matchmaker reuses this for activity-weighted
+    player sampling — ``analyzer_tpu/loadgen/matchmaker.py`` — instead of
+    rebuilding the alias construction):
+
+      * ``AliasSampler(weights)`` — ``weights`` is a 1-D positive float
+        array; it is normalized internally (callers need not sum to 1).
+      * ``draw(rng, size)`` — samples indices ``[0, len(weights))`` with
+        probability proportional to ``weights``, shaped ``size``, using
+        exactly two ``rng`` streams (cell + keep) per call, so a given
+        ``rng`` state yields a deterministic draw sequence.
     """
 
     def __init__(self, weights: np.ndarray) -> None:
@@ -238,7 +250,7 @@ def synthetic_stream(
     # draw with replacement, then iteratively redraw only the rows that
     # still contain duplicates (converges in a few rounds).
     k_max = 2 * t_max
-    sampler = _AliasSampler(weights)
+    sampler = AliasSampler(weights)
     flat = sampler.draw(rng, (n, k_max))
     need = np.arange(n)
     for _ in range(64):

@@ -55,6 +55,13 @@ RULES: dict[str, str] = {
         "*table* value) outside the tier manager (sched/tier.py) and "
         "the view publisher (serve/view.py)"
     ),
+    "GL028": (
+        "unseeded randomness (random.*, global np.random, seedless "
+        "np.random.default_rng()) or a wall-clock read "
+        "(time.time/monotonic/perf_counter/sleep, datetime.now) inside "
+        "analyzer_tpu/loadgen/ — the soak harness must be "
+        "deterministic per seed, on a virtual clock"
+    ),
 }
 
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")

@@ -61,6 +61,17 @@ expression mentions a table-named value (``table``, ``state.table``,
 ``host_table``, ...); literal arguments and test files are exempt, and
 a deliberate whole-table transfer (state construction at ingest, a
 bench baseline) carries a line-scoped disable with a reason.
+
+GL028 is PATH-SCOPED to ``analyzer_tpu/loadgen/``, the closed-loop soak
+harness, whose entire contract is a bit-identical artifact per
+(seed, config) — which is what lets a CPU smoke soak live in tier-1.
+Unseeded randomness (the stdlib ``random`` module, the legacy
+``np.random`` global stream, a seedless ``np.random.default_rng()``)
+and wall-clock reads (``time.time``/``monotonic``/``perf_counter``/
+``sleep``, ``datetime.now``) in decision paths silently break that
+contract; the few legitimate wall reads — realtime pacing sleeps, the
+artifact's measured-latency block — carry line-scoped disables with
+reasons, like every other escape.
 """
 
 from __future__ import annotations
@@ -97,6 +108,23 @@ _PALLAS_MODULES = ("jax.experimental.pallas",)
 #: (the serve plane's owning double-buffer copy).
 _GL027_TABLE_HOMES = ("analyzer_tpu/sched/tier.py", "analyzer_tpu/serve/view.py")
 _GL027_TRANSFERS = ("jax.device_put", "jax.numpy.array")
+
+#: Directories where GL028 applies: the soak harness, whose whole
+#: contract is bit-identical artifacts per (seed, config).
+_GL028_DIRS = ("analyzer_tpu/loadgen/",)
+
+#: Wall-clock reads GL028 bans in loadgen decision paths. Pacing and
+#: measured-latency reads carry line-scoped disables with reasons.
+_GL028_CLOCKS = {
+    "time.time",
+    "time.monotonic",
+    "time.perf_counter",
+    "time.process_time",
+    "time.sleep",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.date.today",
+}
 
 _BROAD = {"Exception", "BaseException"}
 _MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
@@ -141,6 +169,7 @@ class ShellRules:
         timed_layer = self._in_timed_layer()
         obs_layer = self._in_obs_layer()
         feed_layer = self._in_feed_layer()
+        loadgen_layer = self._in_loadgen_layer()
         tests = self._in_tests()
         pallas_home = self._in_pallas_home()
         table_home = self._in_table_home()
@@ -154,6 +183,8 @@ class ShellRules:
                     self._check_raw_clock(node)
                 if feed_layer:
                     self._check_device_sync(node)
+                if loadgen_layer:
+                    self._check_soak_determinism(node)
                 if not tests:
                     self._check_interpret_literal(node)
                 if not (tests or table_home):
@@ -195,6 +226,10 @@ class ShellRules:
     def _in_table_home(self) -> bool:
         path = self.path.replace("\\", "/")
         return any(frag in path for frag in _GL027_TABLE_HOMES)
+
+    def _in_loadgen_layer(self) -> bool:
+        path = self.path.replace("\\", "/")
+        return any(frag in path for frag in _GL028_DIRS)
 
     def _in_tests(self) -> bool:
         path = self.path.replace("\\", "/")
@@ -308,6 +343,65 @@ class ShellRules:
             "publisher, or disable with a reason for a deliberate "
             "whole-table load (ingest, bench baseline)",
         )
+
+    def _check_soak_determinism(self, node: ast.Call) -> None:
+        """GL028: unseeded randomness or wall-clock reads inside
+        ``analyzer_tpu/loadgen/`` — the soak harness's contract is a
+        bit-identical artifact per (seed, config), so every decision
+        must flow from a seeded ``np.random.default_rng`` stream or the
+        virtual clock. Flags:
+
+          * any call into the stdlib ``random`` module (one hidden
+            process-global stream, seeded or not — callers can't tell);
+          * ``np.random.default_rng()`` with NO seed argument (OS
+            entropy), and the legacy global-stream ``np.random.<fn>()``
+            functions (lowercase module-level draws); constructing
+            ``Generator``/``SeedSequence``/bit generators with explicit
+            state stays legal;
+          * the wall clocks in :data:`_GL028_CLOCKS` — pacing sleeps
+            and measured-latency reads are legitimate and carry
+            line-scoped disables with reasons.
+        """
+        resolved = self.imports.resolve(node.func)
+        if resolved is None:
+            return
+        if resolved in _GL028_CLOCKS:
+            self._flag(
+                "GL028", node,
+                f"wall-clock read `{resolved}` in the soak harness's "
+                "decision path — pace and decide on the driver's "
+                "VirtualClock so the soak replays bit-identically per "
+                "seed; a realtime pacing sleep or measured-latency "
+                "read carries a line-scoped disable with a reason",
+            )
+            return
+        if resolved == "random" or resolved.startswith("random."):
+            self._flag(
+                "GL028", node,
+                "stdlib `random` in the soak harness draws from one "
+                "hidden process-global stream — use a seeded "
+                "np.random.default_rng(...) stream owned by the caller",
+            )
+            return
+        if resolved == "numpy.random.default_rng":
+            if not node.args and not node.keywords:
+                self._flag(
+                    "GL028", node,
+                    "np.random.default_rng() with no seed pulls OS "
+                    "entropy — the soak must be deterministic per "
+                    "seed; pass the seed (or a SeedSequence) in",
+                )
+            return
+        if resolved.startswith("numpy.random."):
+            tail = resolved.rsplit(".", 1)[-1]
+            if tail and tail[0].islower():
+                self._flag(
+                    "GL028", node,
+                    f"global-stream `np.random.{tail}` in the soak "
+                    "harness shares (and mutates) one hidden process "
+                    "RNG — draw from a seeded default_rng(...) "
+                    "generator instead",
+                )
 
     def _check_raw_clock(self, node: ast.Call) -> None:
         """GL023: ``time.perf_counter()`` (or a bare imported

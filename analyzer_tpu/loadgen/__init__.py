@@ -1,0 +1,47 @@
+"""loadgen: the closed-loop matchmaking soak harness (ROADMAP item 3).
+
+Every BENCH_*/SERVE_BENCH_* number is open-loop — fixed synthetic
+batches into the runners, a canned query mix into the serve plane. This
+package closes the loop into the production shape: a matchmaker samples
+active players by an activity distribution, queues them by the
+conservative rating the serve plane CURRENTLY publishes, balances teams
+through the QueryEngine's winprob/quality path, resolves outcomes with a
+TrueSkill-consistent win model, and publishes the finished matches onto
+the ``analyze`` queue — while a concurrent-shaped query workload hits
+``/v1/*``. Ratings drift therefore feeds back into matchmaking exactly
+like production, and the :class:`~analyzer_tpu.loadgen.driver.SoakDriver`
+runs broker -> worker -> commit -> view publish under that load with
+per-tick SLO sampling and a ``SOAK_r*.json`` artifact that
+``cli benchdiff --family soak`` gates.
+
+Everything here is DETERMINISTIC per (seed, config): player sampling,
+match formation, outcomes, and query traffic all draw from seeded
+``np.random.default_rng`` streams, and pacing decisions run on a
+virtual clock — so a short CPU soak is a tier-1 test, not just a rig
+artifact. graftlint GL028 bans unseeded randomness and wall-clock reads
+in this package's decision paths (the few legitimate wall clocks — the
+measured-latency block, realtime pacing sleeps — carry line-scoped
+disables with reasons).
+"""
+
+from analyzer_tpu.loadgen.driver import SoakConfig, SoakDriver
+from analyzer_tpu.loadgen.matchmaker import (
+    EngineServeClient,
+    FormedMatch,
+    HttpServeClient,
+    Matchmaker,
+)
+from analyzer_tpu.loadgen.outcomes import OutcomeModel
+from analyzer_tpu.loadgen.shaper import TrafficShaper, VirtualClock
+
+__all__ = [
+    "EngineServeClient",
+    "FormedMatch",
+    "HttpServeClient",
+    "Matchmaker",
+    "OutcomeModel",
+    "SoakConfig",
+    "SoakDriver",
+    "TrafficShaper",
+    "VirtualClock",
+]

@@ -1,0 +1,480 @@
+"""SoakDriver: the closed-loop soak — matchmaker -> broker -> worker ->
+commit -> view publish -> query traffic — under one virtual clock.
+
+One tick of virtual time runs the whole production loop once:
+
+  1. the **matchmaker** forms this tick's matches FROM THE SERVED
+     RATINGS (queue by served conservative rating, winprob-balanced
+     splits — ``matchmaker.py``), the **outcome model** resolves winners
+     from latent truth, and the finished matches land in the store and
+     on the ``analyze`` queue;
+  2. the **worker** consumes (bounded polls per tick, so overload shows
+     up as queue depth instead of silently stretching the tick), rates,
+     commits, and publishes a new view version at each commit boundary;
+  3. the **query workload** hits ``/v1/*`` (HTTP or in-process) with a
+     deterministic kind mix, so the read plane serves while the write
+     plane ingests;
+  4. **SLO samples**: queue depth, view-version staleness, dead
+     letters, retraces past warmup — all deterministic; wall-clock
+     latencies and throughput land in the artifact's *measured* block.
+
+Determinism contract (pinned by ``tests/test_loadgen.py``): the
+artifact's ``deterministic`` block — matches formed, outcomes, query
+digests, SLO counters, per-tick trajectory — is BIT-IDENTICAL for the
+same (seed, config), because every decision reads a seeded RNG stream
+or the virtual clock (graftlint GL028 enforces this package-wide).
+
+The emitted ``SOAK_r*.json`` artifact is gated by
+``cli benchdiff --family soak``: absolute SLOs (zero dead letters, flat
+steady-state retraces, bounded view staleness, drained backlog) from
+the deterministic block, throughput/p99 regressions against the
+previous artifact (``obs/benchdiff.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import time
+
+import numpy as np
+
+from analyzer_tpu.config import RatingConfig, ServiceConfig
+from analyzer_tpu.loadgen.matchmaker import (
+    EngineServeClient,
+    HttpServeClient,
+    Matchmaker,
+    player_id,
+)
+from analyzer_tpu.loadgen.outcomes import OutcomeModel
+from analyzer_tpu.loadgen.shaper import (
+    DEFAULT_QUERY_MIX,
+    TrafficShaper,
+    VirtualClock,
+    choose_kind,
+)
+from analyzer_tpu.logging_utils import get_logger
+from analyzer_tpu.obs import get_registry, install_jax_hooks
+from analyzer_tpu.obs.benchdiff import soak_slo_violations
+
+logger = get_logger(__name__)
+
+#: Fixed leaderboard depth for the query workload (one compiled top-k
+#: bucket; the engine's warmup ladder covers it).
+LEADERBOARD_K = 10
+
+#: Ids in one ratings point-lookup of the query workload — fixed so the
+#: serve gather bucket is one shape (the matchmaker's pages are separate,
+#: matchmaker.RATINGS_PAGE).
+QUERY_RATINGS_IDS = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class SoakConfig:
+    """One soak's full parameterization. ``duration_s`` is VIRTUAL time
+    (ticks = duration_s / tick_s); wall time only matters in realtime
+    mode. Defaults are a CPU smoke soak — seconds, tier-1 safe."""
+
+    seed: int = 0
+    duration_s: float = 8.0
+    tick_s: float = 1.0
+    qps: float = 24.0  # matches formed per virtual second
+    query_qps: float = 10.0  # serve queries per virtual second
+    n_players: int = 400
+    batch_size: int = 64
+    polls_per_tick: int = 4
+    team5_frac: float = 0.3
+    afk_rate: float = 0.0
+    activity_concentration: float = 1.2
+    warmup: bool = True  # precompile worker + serve + publish ladders
+    use_http: bool = True  # query workload over /v1/* vs in-process
+    realtime: bool = False  # pace ticks against the wall clock
+    max_view_lag_ticks: int = 2  # SLO: served view staleness bound
+    min_matches_per_sec: float | None = None  # SLO: absolute wall floor
+    max_p99_ms: float | None = None  # SLO: absolute serve-latency bound
+
+    @property
+    def n_ticks(self) -> int:
+        return max(1, int(round(self.duration_s / self.tick_s)))
+
+
+class SoakDriver:
+    """Owns the rig (broker, store, worker + serve plane) and the loop.
+
+    ``run()`` executes the configured soak and returns the artifact
+    dict; ``close()`` tears the rig down (idempotent; ``run`` does NOT
+    close, so a test can inspect the live worker afterwards).
+    """
+
+    def __init__(self, config: SoakConfig | None = None) -> None:
+        from analyzer_tpu.io.synthetic import synthetic_players
+        from analyzer_tpu.service.broker import InMemoryBroker
+        from analyzer_tpu.service.store import InMemoryStore
+        from analyzer_tpu.service.worker import Worker
+
+        self.cfg = config or SoakConfig()
+        cfg = self.cfg
+        install_jax_hooks()  # retraces countable before the first compile
+        self.vclock = VirtualClock()
+        self.broker = InMemoryBroker()
+        self.store = InMemoryStore()
+        self.rating_config = RatingConfig()
+        service_cfg = ServiceConfig(
+            batch_size=cfg.batch_size, idle_timeout=0.0, pipeline=False,
+        )
+        # Sequential worker on the virtual clock: the pipelined engine's
+        # writer thread would put commit ORDER on wall-time scheduling,
+        # which the bit-identical contract cannot absorb.
+        self.worker = Worker(
+            self.broker, self.store, service_cfg, self.rating_config,
+            clock=self.vclock.monotonic, pipeline=False, serve_port=0,
+        )
+        self.players = synthetic_players(cfg.n_players, seed=cfg.seed)
+        self.outcomes = OutcomeModel(
+            self.players, self.rating_config, seed=cfg.seed
+        )
+        if cfg.use_http:
+            self.client = HttpServeClient(self.worker.serve_server.url)
+        else:
+            self.client = EngineServeClient(self.worker.query_engine)
+        self.matchmaker = Matchmaker(
+            self.players, self.client, seed=cfg.seed,
+            cfg=self.rating_config,
+            activity_concentration=cfg.activity_concentration,
+            team5_frac=cfg.team5_frac,
+        )
+        # Driver-level draws (afk flags, query kinds/payloads): a third
+        # stream so query traffic never perturbs formation or outcomes.
+        self.qrng = np.random.default_rng(
+            np.random.SeedSequence(entropy=cfg.seed, spawn_key=(2,))
+        )
+        self._seq = 0
+        self._player_cache: dict[int, object] = {}
+        self._match_digest = hashlib.sha256()
+        self._query_digest = hashlib.sha256()
+        self._closed = False
+
+    # -- rig preparation ---------------------------------------------------
+    def prepare(self) -> None:
+        """Primes the served view with the seeded population and (when
+        ``cfg.warmup``) precompiles every shape the soak can hit — the
+        production discipline (`Worker.warmup`, `QueryEngine.warmup`),
+        which is also what makes "zero steady-state retraces" a gateable
+        SLO instead of a race against the compile cache."""
+        from analyzer_tpu.core.state import PlayerState
+
+        cfg = self.cfg
+        state = PlayerState.create(
+            cfg.n_players,
+            rank_points_ranked=self.players.rank_points_ranked,
+            rank_points_blitz=self.players.rank_points_blitz,
+            skill_tier=self.players.skill_tier,
+            cfg=self.rating_config,
+        )
+        ids = [player_id(i) for i in range(cfg.n_players)]
+        rows = np.asarray(state.table)[: cfg.n_players]
+        # Version 1: every player known-but-unrated, seeds served — the
+        # production bootstrap from the player table. Matchmaking reads
+        # these seed estimates until real posteriors land.
+        self.worker.view_publisher.publish_rows(ids, rows)
+        if cfg.warmup:
+            self.worker.warmup()
+            self.worker.query_engine.warmup()
+            self._warm_publish_buckets(ids, rows)
+        self._retrace_base = float(
+            get_registry().counter("jax.retraces_total").value
+        )
+
+    def _warm_publish_buckets(self, ids, rows) -> None:
+        """Compiles the view publisher's patch-scatter ladder for every
+        id-count bucket a commit can carry, by re-publishing seed pages
+        (idempotent content; versions advance, values do not). Without
+        this the Nth distinct batch size would compile mid-soak and
+        count against the retrace SLO."""
+        from analyzer_tpu.core.state import MAX_TEAM_SIZE
+        from analyzer_tpu.serve.view import PATCH_BUCKET_FLOOR, _pow2_bucket
+
+        n = len(ids)
+        cap = _pow2_bucket(
+            min(self.cfg.batch_size * 2 * MAX_TEAM_SIZE, max(n, 1)),
+            PATCH_BUCKET_FLOOR,
+        )
+        b = PATCH_BUCKET_FLOOR
+        while b <= cap:
+            page = [ids[i % n] for i in range(b)]
+            page_rows = rows[[i % n for i in range(b)]]
+            self.worker.view_publisher.publish_rows(page, page_rows)
+            b *= 2
+
+    # -- match materialization --------------------------------------------
+    def _player_obj(self, row: int):
+        """The SHARED duck-typed player object for ``row`` — one object
+        per player for the whole soak, so the worker's write-back
+        updates the priors the next batch loads (the store half of the
+        closed loop)."""
+        obj = self._player_cache.get(row)
+        if obj is None:
+            from analyzer_tpu.fixtures import fake_player
+
+            p = self.players
+
+            def _opt(x):
+                return None if np.isnan(x) else float(x)
+
+            obj = fake_player(
+                skill_tier=int(p.skill_tier[row]),
+                rank_points_ranked=_opt(p.rank_points_ranked[row]),
+                rank_points_blitz=_opt(p.rank_points_blitz[row]),
+            )
+            obj.api_id = player_id(row)
+            self._player_cache[row] = obj
+        return obj
+
+    def _build_match(self, formed, winner: int, afk: bool):
+        from analyzer_tpu.fixtures import (
+            fake_match,
+            fake_participant,
+            fake_roster,
+        )
+
+        rosters = []
+        for t, rows in enumerate((formed.team_a_rows, formed.team_b_rows)):
+            parts = [
+                fake_participant(
+                    player=self._player_obj(r),
+                    skill_tier=int(self.players.skill_tier[r]),
+                    went_afk=bool(afk and t == 0 and s == 0),
+                )
+                for s, r in enumerate(rows)
+            ]
+            rosters.append(
+                fake_roster(winner=int(t == winner), participants=parts)
+            )
+        match = fake_match(formed.mode, rosters, api_id=f"soak-{self._seq:08d}")
+        match.created_at = self._seq
+        self._seq += 1
+        return match
+
+    def _publish_matches(self, n: int) -> int:
+        """Forms, resolves, stores and enqueues ``n`` matches; folds
+        each into the match digest. Returns the count published."""
+        formed = self.matchmaker.form(n)
+        reg = get_registry()
+        for m in formed:
+            winner, p_model = self.outcomes.resolve(
+                m.team_a_rows, m.team_b_rows
+            )
+            afk = bool(self.qrng.random() < self.cfg.afk_rate)
+            match = self._build_match(m, winner, afk)
+            self.store.add_match(match)
+            self.broker.publish(
+                self.worker.config.queue, match.api_id.encode()
+            )
+            self._match_digest.update(
+                json.dumps(
+                    {
+                        "id": match.api_id,
+                        "mode": m.mode,
+                        "a": m.team_a_ids,
+                        "b": m.team_b_ids,
+                        "split": m.split,
+                        "p_served": m.p_a,
+                        "quality": m.quality,
+                        "p_model": p_model,
+                        "winner": winner,
+                        "afk": afk,
+                    },
+                    sort_keys=True,
+                ).encode()
+            )
+        reg.counter("soak.matches_published_total").add(len(formed))
+        return len(formed)
+
+    # -- query workload ----------------------------------------------------
+    def _issue_queries(self, n: int, latencies_ms: list,
+                       counts: dict) -> None:
+        """``n`` serve queries with the deterministic kind mix. Payload
+        draws come off the driver stream; latency is the one legitimate
+        wall read (measured block, never a decision input)."""
+        client = self.client
+        for _ in range(n):
+            kind = choose_kind(self.qrng, DEFAULT_QUERY_MIX)
+            if kind == "ratings":
+                rows = self.matchmaker.sample_rows(
+                    QUERY_RATINGS_IDS, rng=self.qrng
+                )
+                call = (client.get_ratings, ([player_id(r) for r in rows],))
+            elif kind == "winprob":
+                rows = self.matchmaker.sample_rows(6, rng=self.qrng)
+                call = (
+                    client.win_probability,
+                    (
+                        [player_id(r) for r in rows[:3]],
+                        [player_id(r) for r in rows[3:]],
+                    ),
+                )
+            elif kind == "leaderboard":
+                call = (client.leaderboard, (LEADERBOARD_K,))
+            else:
+                call = (client.tiers, ())
+            t0 = time.perf_counter()  # graftlint: disable=GL028 — measured-block latency, not a decision input
+            resp = call[0](*call[1])
+            dt = time.perf_counter() - t0  # graftlint: disable=GL028 — measured-block latency, not a decision input
+            latencies_ms.append(dt * 1e3)
+            counts[kind] = counts.get(kind, 0) + 1
+            self._query_digest.update(
+                (kind + "\n" + json.dumps(resp, sort_keys=True)).encode()
+            )
+        get_registry().counter("soak.queries_sent_total").add(n)
+
+    # -- the loop ----------------------------------------------------------
+    def run(self) -> dict:
+        """Executes the soak and returns the SOAK artifact dict."""
+        cfg = self.cfg
+        reg = get_registry()
+        reg.gauge("soak.qps_target").set(cfg.qps)
+        self.prepare()
+        match_shaper = TrafficShaper(cfg.qps, cfg.tick_s)
+        query_shaper = TrafficShaper(cfg.query_qps, cfg.tick_s)
+        published = 0
+        query_counts: dict[str, int] = {}
+        latencies_ms: list[float] = []
+        trajectory: list[list] = []
+        depth_max = 0
+        lag_ticks = 0
+        lag_ticks_max = 0
+        last_version = self.worker.view_publisher.version
+        wall_t0 = time.perf_counter()  # graftlint: disable=GL028 — measured-block wall anchor, not a decision input
+        queue = self.worker.config.queue
+
+        def sample(tick: int) -> int:
+            nonlocal depth_max, lag_ticks, lag_ticks_max, last_version
+            depth = self.broker.qsize(queue) + len(self.worker.queue)
+            depth_max = max(depth_max, depth)
+            version = self.worker.view_publisher.version
+            rated = self.worker.matches_rated
+            # Staleness in ticks: a tick with work still pending and no
+            # new published version ages the view; a publish (or a fully
+            # drained loop) resets it. Deterministic — purely counters.
+            if version != last_version or (depth == 0 and rated == published):
+                lag_ticks = 0
+            else:
+                lag_ticks += 1
+            lag_ticks_max = max(lag_ticks_max, lag_ticks)
+            last_version = version
+            trajectory.append([tick, depth, version, rated])
+            return depth
+
+        for tick in range(cfg.n_ticks):
+            self.vclock.advance(cfg.tick_s)
+            published += self._publish_matches(match_shaper.due())
+            for _ in range(cfg.polls_per_tick):
+                self.worker.poll()
+            self._issue_queries(query_shaper.due(), latencies_ms, query_counts)
+            sample(tick)
+            reg.counter("soak.ticks_total").add(1)
+            reg.gauge("soak.virtual_seconds").set(self.vclock.now)
+            if cfg.realtime:
+                target = wall_t0 + (tick + 1) * cfg.tick_s
+                delay = target - time.perf_counter()  # graftlint: disable=GL028 — realtime pacing reads the wall by definition
+                if delay > 0:
+                    time.sleep(delay)  # graftlint: disable=GL028 — realtime pacing sleep, virtual schedule already fixed
+
+        # Drain: the backlog must clear in bounded virtual time — an
+        # undrainable soak is itself an SLO violation, not a hang.
+        drained = False
+        for extra in range(cfg.n_ticks + 100):
+            if (
+                self.broker.qsize(queue) == 0
+                and not self.worker.queue
+                and self.worker.matches_rated >= published
+            ):
+                drained = True
+                break
+            self.vclock.advance(cfg.tick_s)
+            for _ in range(cfg.polls_per_tick):
+                self.worker.poll()
+            sample(cfg.n_ticks + extra)
+        wall_s = time.perf_counter() - wall_t0  # graftlint: disable=GL028 — measured-block wall clock, not a decision input
+
+        retraces_steady = (
+            float(reg.counter("jax.retraces_total").value)
+            - self._retrace_base
+        )
+        lat = np.asarray(latencies_ms, np.float64)
+        latency_ms = {
+            "p50": round(float(np.percentile(lat, 50)), 3) if lat.size else None,
+            "p90": round(float(np.percentile(lat, 90)), 3) if lat.size else None,
+            "p99": round(float(np.percentile(lat, 99)), 3) if lat.size else None,
+        }
+        rated = self.worker.matches_rated
+        artifact = {
+            "metric": "soak.matches_per_sec",
+            "value": round(rated / wall_s, 2) if wall_s > 0 else 0.0,
+            "config": dataclasses.asdict(self.cfg),
+            "deterministic": {
+                "seed": self.cfg.seed,
+                "ticks": cfg.n_ticks,
+                "virtual_s": round(cfg.n_ticks * cfg.tick_s, 6),
+                "matches_published": published,
+                "matches_rated": rated,
+                "matches_digest": self._match_digest.hexdigest(),
+                "queries_digest": self._query_digest.hexdigest(),
+                "queries": dict(sorted(query_counts.items())),
+                "serve_calls": dict(sorted(self.client.calls.items())),
+                "batches_ok": self.worker.batches_ok,
+                "dead_letters": self.worker.dead_letters,
+                "view_version_final": self.worker.view_publisher.version,
+                "view_lag_ticks_max": lag_ticks_max,
+                "queue_depth_max": depth_max,
+                "queue_depth_final": (
+                    self.broker.qsize(queue) + len(self.worker.queue)
+                ),
+                "retraces_steady": retraces_steady,
+                "drained": drained,
+                "trajectory": trajectory,
+            },
+            "slo": {
+                "pass": True,
+                "violations": [],
+                "thresholds": {
+                    "max_view_lag_ticks": cfg.max_view_lag_ticks,
+                    "min_matches_per_sec": cfg.min_matches_per_sec,
+                    "max_p99_ms": cfg.max_p99_ms,
+                },
+            },
+            "latency_ms": latency_ms,
+            "measured": {
+                "wall_s": round(wall_s, 3),
+                "queries_per_sec": (
+                    round(len(latencies_ms) / wall_s, 2) if wall_s > 0 else 0.0
+                ),
+            },
+            "capture": {"degraded": False},
+        }
+        violations = soak_slo_violations(artifact)
+        artifact["slo"]["violations"] = violations
+        artifact["slo"]["pass"] = not violations
+        if violations:
+            reg.counter("soak.slo_violations_total").add(len(violations))
+            logger.warning("soak SLO violations: %s", "; ".join(violations))
+        logger.info(
+            "soak done: %d matches over %d ticks (%.1f wall s), slo=%s",
+            rated, cfg.n_ticks, wall_s,
+            "pass" if not violations else "FAIL",
+        )
+        return artifact
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self.worker.close()
+
+
+def write_artifact(artifact: dict, path: str) -> None:
+    """One pretty-printed SOAK artifact (the ``SOAK_rNN.json`` shape
+    ``cli benchdiff --family soak`` scans for)."""
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write("\n")

@@ -1,7 +1,8 @@
 """Bench trajectory monitoring: diff two bench JSON artifacts.
 
 The repo accumulates one benchmark artifact per round (``BENCH_rNN.json``
-for the write path, ``SERVE_BENCH_rNN.json`` for the read path) but
+for the write path, ``SERVE_BENCH_rNN.json`` for the read path,
+``SOAK_rNN.json`` for the closed loop) but
 nothing ever LOOKED at the sequences — a 20% regression would ride along
 unnoticed until a human happened to eyeball two files. ``cli benchdiff``
 turns each trajectory into a gate:
@@ -93,6 +94,23 @@ def bench_configs(data: dict) -> list[BenchConfig]:
             degraded=degraded,
         )
     ]
+    if str(data["metric"]).startswith("soak."):
+        # Soak family (``SOAK_*``, metric ``soak.*``): wall ingest
+        # matches/s (higher) + the query workload's client-observed p99
+        # (lower). The ABSOLUTE SLOs (dead letters, retraces, view
+        # staleness, drain) are not deltas — :func:`soak_slo_violations`
+        # gates them on the candidate alone.
+        latency = data.get("latency_ms") or {}
+        if latency.get("p99") is not None:
+            out.append(
+                BenchConfig(
+                    name="soak.p99_ms",
+                    value=float(latency["p99"]),
+                    higher_is_better=False,
+                    degraded=degraded,
+                )
+            )
+        return out
     if str(data["metric"]).startswith("serve."):
         latency = data.get("latency_ms") or {}
         if latency.get("p99") is not None:
@@ -210,8 +228,15 @@ def diff_configs(
 #: Artifact family name -> filename prefix (``cli benchdiff --family``).
 #: ``tiered`` scans the same BENCH artifacts but gates only the tiered
 #: configs (``tiered.min_over_resident`` + the hit-rate delta) — see
-#: :func:`family_configs`.
-FAMILIES = {"bench": "BENCH", "serve": "SERVE_BENCH", "tiered": "BENCH"}
+#: :func:`family_configs`. Prefix-disambiguation contract: each family's
+#: glob anchors on its full prefix, so ``BENCH_*`` must never swallow
+#: ``SERVE_BENCH_*`` or ``SOAK_*`` files (pinned by the family tests).
+FAMILIES = {
+    "bench": "BENCH",
+    "serve": "SERVE_BENCH",
+    "tiered": "BENCH",
+    "soak": "SOAK",
+}
 
 
 def family_configs(
@@ -221,10 +246,73 @@ def family_configs(
     family compares only ``tiered.*`` configs: a tier-thrash regression
     must fail on its own ratio even when headline throughput holds, and
     a capture that silently fell back to untiered (no tiered block at
-    all) shows up as "no comparable configs" instead of a clean pass."""
-    if family != "tiered":
-        return configs
-    return [c for c in configs if c.name.startswith("tiered.")]
+    all) shows up as "no comparable configs" instead of a clean pass.
+    The ``soak`` family likewise keeps only ``soak.*`` configs (its
+    absolute SLO gate is :func:`soak_slo_violations`, not a delta)."""
+    if family == "tiered":
+        return [c for c in configs if c.name.startswith("tiered.")]
+    if family == "soak":
+        return [c for c in configs if c.name.startswith("soak.")]
+    return configs
+
+
+def soak_slo_violations(data: dict) -> list[str]:
+    """The soak family's ABSOLUTE gate, re-derived from the artifact's
+    deterministic block (never trusted from a precomputed pass flag):
+    zero dead letters, flat steady-state retraces, bounded view
+    staleness, a drained backlog, every published match rated — plus
+    the optional absolute throughput/latency floors the soak was
+    configured with (``slo.thresholds``). Returns human-readable
+    violation strings; empty means the artifact passes.
+
+    Shared owner: ``SoakDriver`` computes its artifact's ``slo`` block
+    through this same function, so the driver's verdict and the CI
+    gate's can never drift."""
+    det = data.get("deterministic")
+    if not isinstance(det, dict):
+        return ["artifact has no deterministic block (not a SOAK capture?)"]
+    thr = (data.get("slo") or {}).get("thresholds") or {}
+    out: list[str] = []
+    dead = det.get("dead_letters", 0)
+    if dead:
+        out.append(f"dead_letters: {dead} (SLO: 0)")
+    retraces = det.get("retraces_steady", 0)
+    if retraces:
+        out.append(
+            f"retraces_steady: {retraces:g} post-warmup retraces (SLO: flat)"
+        )
+    max_lag = thr.get("max_view_lag_ticks", 2)
+    lag = det.get("view_lag_ticks_max", 0)
+    if lag > max_lag:
+        out.append(
+            f"view_lag_ticks_max: {lag} > {max_lag} (served view went stale "
+            "while commits were pending)"
+        )
+    if not det.get("drained", True) or det.get("queue_depth_final", 0):
+        out.append(
+            f"backlog not drained: {det.get('queue_depth_final', '?')} "
+            "message(s) left after the drain window"
+        )
+    published = det.get("matches_published", 0)
+    rated = det.get("matches_rated", 0)
+    if rated < published:
+        out.append(
+            f"matches_rated {rated} < matches_published {published} "
+            "(ingest lost work)"
+        )
+    floor = thr.get("min_matches_per_sec")
+    if floor is not None and float(data.get("value", 0.0)) < floor:
+        out.append(
+            f"matches_per_sec {data.get('value')} below the configured "
+            f"floor {floor}"
+        )
+    p99_cap = thr.get("max_p99_ms")
+    p99 = (data.get("latency_ms") or {}).get("p99")
+    if p99_cap is not None and p99 is not None and p99 > p99_cap:
+        out.append(
+            f"serve p99 {p99} ms above the configured cap {p99_cap} ms"
+        )
+    return out
 
 
 def find_bench_artifacts(directory: str, family: str = "bench") -> list[str]:

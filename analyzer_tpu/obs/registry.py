@@ -214,6 +214,14 @@ STANDARD_COUNTERS = (
     "obs.flight_dumps_total",
     "serve.queries_total",
     "serve.view_publishes_total",
+    # The closed-loop soak harness (analyzer_tpu/loadgen): virtual
+    # ticks executed, matchmade matches pushed onto the analyze queue,
+    # serve queries issued by the load workload, and SLO-gate failures.
+    # Pre-declared so "no soak ran" reads 0, not missing.
+    "soak.ticks_total",
+    "soak.matches_published_total",
+    "soak.queries_sent_total",
+    "soak.slo_violations_total",
 )
 STANDARD_GAUGES = (
     "worker.pipeline_lag",
@@ -240,6 +248,14 @@ STANDARD_GAUGES = (
     # first publish — a scraper can tell "no read plane" from "broken".
     "serve.view_version",
     "serve.view_age_seconds",
+    # Broker backpressure: ready messages on the consume queue, sampled
+    # (throttled) in Worker.poll; per-queue series
+    # broker.queue_depth{queue=...} appear on first sample.
+    "broker.queue_depth",
+    # Soak harness gauges: the configured match rate and how far the
+    # virtual clock has advanced (loadgen/driver.py).
+    "soak.qps_target",
+    "soak.virtual_seconds",
 )
 
 

@@ -38,6 +38,16 @@ class Broker(Protocol):
 
     def nack(self, delivery_tag: int, requeue: bool = False) -> None: ...
 
+    def qsize(self, queue: str) -> int:
+        """Best-effort ready-message depth of ``queue`` (excluding
+        in-flight/unacked deliveries). Both shipped brokers have always
+        had queue-depth access — the Protocol just omitted it, so the
+        soak harness and the worker's ``broker.queue_depth`` gauge had
+        nothing typed to call. The number is a SNAPSHOT (on AMQP it
+        costs a passive-declare round trip), for backpressure
+        visibility, never for control flow."""
+        ...
+
 
 class InMemoryBroker:
     """Queues as deques with unacked-message redelivery semantics: ``get``
@@ -296,5 +306,19 @@ def make_pika_broker(uri: str, prefetch: int = 0):
                 delivery_tag,
                 lambda real: self._ch.basic_nack(real, requeue=requeue),
             )
+
+        def qsize(self, queue: str) -> int:
+            """Server-side ready depth (the passive-redeclare
+            ``message_count`` snapshot) plus deliveries already pushed
+            into the local buffer but not yet handed to the consumer —
+            the caller-visible backlog. Older pika stubs return no
+            declare result; those report the local buffer alone."""
+
+            def op():
+                res = self._ch.queue_declare(queue=queue, durable=True)
+                method = getattr(res, "method", None)
+                return int(getattr(method, "message_count", 0) or 0)
+
+            return self._retry(op) + len(self._buf.get(queue, ()))
 
     return PikaBroker(uri, prefetch)

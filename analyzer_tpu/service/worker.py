@@ -109,6 +109,7 @@ class Worker:
         self.clock = clock
         self.queue: list[Message] = []
         self._first_message_at: float | None = None
+        self._queue_depth_sampled_at: float | None = None
         self.matches_rated = 0
         self.batches_failed = 0
         self.batches_ok = 0
@@ -239,6 +240,7 @@ class Worker:
             if got and self._first_message_at is None:
                 self._first_message_at = self.clock()
             self.queue.extend(got)
+        self._sample_queue_depth()
         full = len(self.queue) >= self.config.batch_size
         idle = (
             self._first_message_at is not None
@@ -257,6 +259,33 @@ class Worker:
             # exit, and explicit Worker.drain().
             self._engine.harvest()
         return False
+
+    def _sample_queue_depth(self) -> None:
+        """Samples the broker's ready depth into the
+        ``broker.queue_depth{queue=}`` gauge (plus the unlabeled
+        process gauge) so soak/production backpressure is visible on
+        /statusz. Throttled on the worker clock — on AMQP the depth is
+        a passive-declare round trip, which a 100 Hz poll loop must not
+        pay per iteration. Best-effort: a broker blip here must not
+        take down the consume loop."""
+        qsize = getattr(self.broker, "qsize", None)
+        if qsize is None:
+            return
+        now = self.clock()
+        if (
+            self._queue_depth_sampled_at is not None
+            and now - self._queue_depth_sampled_at < 1.0
+        ):
+            return
+        self._queue_depth_sampled_at = now
+        try:
+            depth = int(qsize(self.config.queue))
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            logger.debug("broker qsize probe failed", exc_info=True)
+            return
+        reg = get_registry()
+        reg.gauge("broker.queue_depth").set(depth)
+        reg.gauge("broker.queue_depth", queue=self.config.queue).set(depth)
 
     def request_stop(self) -> None:
         """Asks the consume loop to exit after the current batch. Safe

@@ -37,17 +37,60 @@ class TestSynthetic:
             assert len(np.unique(ids)) == len(ids)
 
     def test_alias_sampler_matches_weights(self):
-        from analyzer_tpu.io.synthetic import _AliasSampler
+        from analyzer_tpu.io.synthetic import AliasSampler
 
         rng = np.random.default_rng(6)
         w = rng.random(50) ** 3 + 1e-6
         w /= w.sum()
-        sampler = _AliasSampler(w)
+        sampler = AliasSampler(w)
         draws = sampler.draw(np.random.default_rng(7), (200_000,))
         freq = np.bincount(draws, minlength=50) / draws.size
         np.testing.assert_allclose(freq, w, atol=0.004)
         # prob table is a valid alias structure: all mass accounted for
         assert (sampler.prob >= 0).all() and (sampler.prob <= 1 + 1e-9).all()
+
+
+class TestAliasSampler:
+    """Direct unit tests for the PUBLIC AliasSampler (the loadgen
+    matchmaker reuses it for activity-weighted player sampling)."""
+
+    def test_deterministic_per_rng_state(self):
+        from analyzer_tpu.io.synthetic import AliasSampler
+
+        w = np.array([0.5, 0.25, 0.125, 0.125])
+        s = AliasSampler(w)
+        a = s.draw(np.random.default_rng(3), (1000,))
+        b = s.draw(np.random.default_rng(3), (1000,))
+        np.testing.assert_array_equal(a, b)
+
+    def test_unnormalized_weights_accepted(self):
+        from analyzer_tpu.io.synthetic import AliasSampler
+
+        # Same distribution whether or not the caller normalized.
+        w = np.array([3.0, 1.0])
+        a = AliasSampler(w).draw(np.random.default_rng(5), (100_000,))
+        b = AliasSampler(w / w.sum()).draw(np.random.default_rng(5), (100_000,))
+        np.testing.assert_array_equal(a, b)
+        freq = np.bincount(a, minlength=2) / a.size
+        np.testing.assert_allclose(freq, [0.75, 0.25], atol=0.01)
+
+    def test_degenerate_cases(self):
+        from analyzer_tpu.io.synthetic import AliasSampler
+
+        one = AliasSampler(np.array([7.0]))
+        assert (one.draw(np.random.default_rng(0), (100,)) == 0).all()
+        uniform = AliasSampler(np.ones(8))
+        draws = uniform.draw(np.random.default_rng(1), (80_000,))
+        freq = np.bincount(draws, minlength=8) / draws.size
+        np.testing.assert_allclose(freq, np.full(8, 0.125), atol=0.01)
+
+    def test_shape_and_zero_weight(self):
+        from analyzer_tpu.io.synthetic import AliasSampler
+
+        s = AliasSampler(np.array([0.0, 1.0, 0.0, 1.0]))
+        draws = s.draw(np.random.default_rng(2), (50, 4))
+        assert draws.shape == (50, 4)
+        assert set(np.unique(draws)) <= {1, 3}  # zero-weight cells never drawn
 
     def test_seed_features_present(self):
         players = synthetic_players(500, seed=4)

@@ -1120,3 +1120,106 @@ class TestGL027TableTransferContainment:
         from analyzer_tpu.lint.findings import RULES
 
         assert "GL027" in RULES
+
+
+class TestGL028SoakDeterminism:
+    """GL028 bans unseeded randomness and wall-clock reads inside
+    ``analyzer_tpu/loadgen/`` — the soak harness's bit-identical-per-seed
+    contract is what makes a CPU smoke soak a tier-1 test, and one
+    ``random.random()`` or ``time.monotonic()`` in a decision path
+    silently breaks it."""
+
+    RANDOM_SRC = """
+    import random
+    import numpy as np
+
+    def form():
+        a = random.random()
+        b = random.choice([1, 2])
+        rng = np.random.default_rng()
+        c = np.random.random(4)
+        return a, b, rng, c
+    """
+
+    CLOCK_SRC = """
+    import time
+    from datetime import datetime
+
+    def pace():
+        t = time.monotonic()
+        time.sleep(0.1)
+        now = datetime.now()
+        return t, now
+    """
+
+    def test_unseeded_randomness_fires_in_loadgen(self):
+        assert rules_of(
+            self.RANDOM_SRC, "analyzer_tpu/loadgen/matchmaker.py"
+        ) == ["GL028"] * 4
+
+    def test_wall_clocks_fire_in_loadgen(self):
+        assert rules_of(
+            self.CLOCK_SRC, "analyzer_tpu/loadgen/driver.py"
+        ) == ["GL028"] * 3
+
+    def test_silent_outside_loadgen(self):
+        for path in (
+            "analyzer_tpu/io/synthetic.py",
+            "analyzer_tpu/serve/engine.py",
+            "experiments/serve_bench.py",
+            "snippet.py",
+        ):
+            assert "GL028" not in rules_of(self.RANDOM_SRC, path), path
+            assert "GL028" not in rules_of(self.CLOCK_SRC, path), path
+
+    def test_seeded_streams_and_virtual_clock_are_fine(self):
+        src = """
+        import numpy as np
+
+        def form(seed, clock):
+            rng = np.random.default_rng(seed)
+            rng2 = np.random.default_rng(np.random.SeedSequence(entropy=seed))
+            now = clock.monotonic()
+            return rng.random(), rng2, now
+        """
+        assert rules_of(src, "analyzer_tpu/loadgen/driver.py") == []
+
+    def test_generator_methods_not_confused_with_module(self):
+        # rng.random()/rng.integers() are draws from a SEEDED generator
+        # the caller owns — only the module-level streams flag.
+        src = """
+        def draw(rng):
+            return rng.random(4), rng.integers(0, 10)
+        """
+        assert rules_of(src, "analyzer_tpu/loadgen/matchmaker.py") == []
+
+    def test_from_imports_resolve(self):
+        src = """
+        from random import choice
+        from time import perf_counter
+
+        def f():
+            return choice([1]), perf_counter()
+        """
+        assert rules_of(src, "analyzer_tpu/loadgen/shaper.py") == [
+            "GL028", "GL028",
+        ]
+
+    def test_disable_escape_for_pacing(self):
+        src = """
+        import time
+
+        def pace(delay):
+            time.sleep(delay)  # graftlint: disable=GL028 — realtime pacing sleep
+        """
+        assert rules_of(src, "analyzer_tpu/loadgen/driver.py") == []
+
+    def test_windows_separators_normalized(self):
+        assert "GL028" in rules_of(
+            self.CLOCK_SRC, "analyzer_tpu\\loadgen\\driver.py"
+        )
+
+    def test_catalog_has_gl028(self):
+        from analyzer_tpu.lint.findings import RULES
+
+        assert "GL028" in RULES

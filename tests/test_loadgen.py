@@ -1,0 +1,536 @@
+"""loadgen: the closed-loop matchmaking soak harness.
+
+Covers the tentpole contract end to end:
+
+  * deterministic building blocks (virtual clock, traffic shaper,
+    matchmaker formation, TrueSkill-consistent outcome model);
+  * matchmaking reads the SERVED ratings and re-ranks as they drift
+    (the closed loop, against a stub client for unit determinism);
+  * the full soak: broker -> worker -> commit -> view publish -> /v1/*
+    query traffic under one virtual clock, bit-identical deterministic
+    block per (seed, config) across two runs, SLOs all green on the
+    smoke config;
+  * the SOAK artifact + ``cli soak`` + ``cli benchdiff --family soak``
+    gates (absolute SLOs on the candidate, throughput/p99 regression
+    deltas, prefix disambiguation against the BENCH/SERVE globs);
+  * the broker ``qsize`` Protocol satellite and the worker's
+    ``broker.queue_depth`` gauge.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from analyzer_tpu.config import RatingConfig, ServiceConfig
+from analyzer_tpu.io.synthetic import synthetic_players
+from analyzer_tpu.loadgen import (
+    Matchmaker,
+    OutcomeModel,
+    SoakConfig,
+    SoakDriver,
+    TrafficShaper,
+    VirtualClock,
+)
+from analyzer_tpu.loadgen.matchmaker import player_id
+from analyzer_tpu.loadgen.shaper import DEFAULT_QUERY_MIX, choose_kind
+from analyzer_tpu.obs import get_registry
+
+CFG = RatingConfig()
+
+#: The tier-1 smoke soak: seconds on CPU, every SLO green.
+SMOKE = SoakConfig(
+    seed=3, duration_s=3.0, tick_s=1.0, qps=10.0, query_qps=6.0,
+    n_players=100, batch_size=32, polls_per_tick=4,
+)
+
+
+class TestVirtualClock:
+    def test_advance_only(self):
+        c = VirtualClock()
+        assert c.monotonic() == 0.0
+        c.advance(1.5)
+        c.advance(0.5)
+        assert c.now == 2.0
+        with pytest.raises(ValueError):
+            c.advance(-1.0)
+
+    def test_bound_method_is_worker_clock_shaped(self):
+        c = VirtualClock(start=10.0)
+        clock = c.monotonic  # what Worker(clock=) receives
+        assert clock() == 10.0
+        c.advance(1.0)
+        assert clock() == 11.0
+
+
+class TestTrafficShaper:
+    def test_exact_long_run_rate(self):
+        s = TrafficShaper(rate_per_s=7.5, tick_s=0.4)  # 3 per tick exactly
+        assert sum(s.due() for _ in range(10)) == 30
+
+    def test_fractional_carry(self):
+        s = TrafficShaper(rate_per_s=2.5, tick_s=1.0)
+        seq = [s.due() for _ in range(4)]
+        assert seq == [2, 3, 2, 3]
+
+    def test_kind_mix_deterministic(self):
+        a = np.random.default_rng(5)
+        b = np.random.default_rng(5)
+        kinds_a = [choose_kind(a, DEFAULT_QUERY_MIX) for _ in range(50)]
+        kinds_b = [choose_kind(b, DEFAULT_QUERY_MIX) for _ in range(50)]
+        assert kinds_a == kinds_b
+        assert set(kinds_a) <= {"ratings", "winprob", "leaderboard", "tiers"}
+
+
+class _StubClient:
+    """ServeClient stub: serves conservative ratings from a dict (the
+    "published view" a unit test controls) and a quality that rewards
+    balanced splits — deterministic, no engine, no HTTP."""
+
+    def __init__(self, conservative: dict[str, float]) -> None:
+        self.conservative = dict(conservative)
+        self.calls: dict[str, int] = {}
+
+    def get_ratings(self, ids):
+        self.calls["ratings"] = self.calls.get("ratings", 0) + 1
+        out, unknown = [], []
+        for pid in ids:
+            c = self.conservative.get(pid)
+            if c is None:
+                unknown.append(pid)
+            else:
+                out.append({
+                    "id": pid, "rated": True, "mu": c, "sigma": 0.0,
+                    "conservative": c, "seed_mu": 1500.0,
+                    "seed_sigma": 1000.0,
+                })
+        return {"version": 1, "ratings": out, "unknown": unknown}
+
+    def win_probability(self, team_a, team_b):
+        self.calls["winprob"] = self.calls.get("winprob", 0) + 1
+        sa = sum(self.conservative.get(p, 0.0) for p in team_a)
+        sb = sum(self.conservative.get(p, 0.0) for p in team_b)
+        gap = abs(sa - sb)
+        return {
+            "version": 1,
+            "p_a": 0.5 + (sa - sb) / (2 * (gap + 1000.0)),
+            "quality": 1.0 / (1.0 + gap / 100.0),
+        }
+
+
+class TestMatchmaker:
+    def _mm(self, scores=None, seed=0, n=60, **kw):
+        players = synthetic_players(n, seed=seed)
+        scores = scores or {
+            player_id(i): float(1500.0 + 10 * i) for i in range(n)
+        }
+        client = _StubClient(scores)
+        return Matchmaker(players, client, seed=seed, cfg=CFG, **kw), client
+
+    def test_formation_invariants(self):
+        mm, _ = self._mm(team5_frac=0.5)
+        formed = mm.form(20)
+        assert len(formed) == 20
+        saw = {m.mode for m in formed}
+        assert saw == {"ranked", "5v5_ranked"}
+        for m in formed:
+            t = 5 if m.mode == "5v5_ranked" else 3
+            assert len(m.team_a_rows) == len(m.team_b_rows) == t
+            everyone = m.team_a_rows + m.team_b_rows
+            assert len(set(everyone)) == 2 * t  # distinct players
+            assert m.team_a_ids == tuple(player_id(r) for r in m.team_a_rows)
+            assert m.split in ("snake", "pairs")
+            assert 0.0 <= m.p_a <= 1.0 and 0.0 < m.quality <= 1.0
+
+    def test_deterministic_per_seed(self):
+        a, _ = self._mm(seed=4)
+        b, _ = self._mm(seed=4)
+        fa, fb = a.form(12), b.form(12)
+        assert fa == fb
+        c, _ = self._mm(seed=5)
+        assert c.form(12) != fa
+
+    def test_balance_beats_blocked_split(self):
+        """The chosen split's quality is at least the snake split's —
+        i.e. the matchmaker really consults the served winprob path
+        instead of pairing the ranked queue top-half vs bottom-half."""
+        mm, client = self._mm()
+        for m in mm.form(10):
+            # Recompute both candidates through the same client: the
+            # winner must be their max.
+            ids = sorted(
+                m.team_a_ids + m.team_b_ids,
+                key=lambda p: (-client.conservative[p], p),
+            )
+            t = len(m.team_a_ids)
+            snake_a = tuple(x for i, x in enumerate(ids) if i % 4 in (0, 3))
+            snake_b = tuple(x for i, x in enumerate(ids) if i % 4 not in (0, 3))
+            pairs_a, pairs_b = tuple(ids[0::2]), tuple(ids[1::2])
+            q = [
+                client.win_probability(a, b)["quality"]
+                for a, b in ((snake_a, snake_b), (pairs_a, pairs_b))
+            ]
+            assert m.quality == pytest.approx(max(q))
+            assert len(snake_a) == t
+
+    def test_rating_drift_changes_pairings(self):
+        """The closed loop: identical seeds, different SERVED ratings
+        ⇒ different team splits (formation reads the serve plane)."""
+        n = 60
+        flat = {player_id(i): 1500.0 for i in range(n)}
+        skew = {player_id(i): 1500.0 + 40.0 * i for i in range(n)}
+        a, _ = self._mm(scores=flat, seed=11, n=n)
+        b, _ = self._mm(scores=skew, seed=11, n=n)
+        fa, fb = a.form(10), b.form(10)
+        # Same candidates drawn (same seed) but at least one pairing
+        # differs once ratings order the queue differently.
+        assert [set(m.team_a_rows + m.team_b_rows) for m in fa] == [
+            set(m.team_a_rows + m.team_b_rows) for m in fb
+        ]
+        assert any(
+            set(ma.team_a_rows) != set(mb.team_a_rows)
+            for ma, mb in zip(fa, fb)
+        )
+
+    def test_ratings_pages_are_fixed_size(self):
+        """Every conservative sweep pads to the fixed page so the serve
+        gather ladder sees exactly one shape (retrace discipline)."""
+        seen = []
+
+        class _PageSpy(_StubClient):
+            def get_ratings(self, ids):
+                seen.append(len(ids))
+                return super().get_ratings(ids)
+
+        players = synthetic_players(50, seed=0)
+        scores = {player_id(i): 1500.0 for i in range(50)}
+        mm = Matchmaker(
+            players, _PageSpy(scores), seed=0, cfg=CFG, ratings_page=16
+        )
+        mm.form(7)
+        assert seen and set(seen) == {16}
+
+    def test_unknown_ids_fall_back_to_floor(self):
+        mm, _ = self._mm(scores={player_id(0): 1500.0})
+        got = mm.conservative_of([player_id(0), "ghost"])
+        assert got["ghost"] == pytest.approx(CFG.mu0 - 3 * CFG.sigma0)
+
+
+class TestOutcomeModel:
+    def test_probability_matches_trueskill_link(self):
+        players = synthetic_players(20, seed=1)
+        om = OutcomeModel(players, CFG, seed=1)
+        p = om.win_probability((0, 1, 2), (3, 4, 5))
+        import math
+
+        skill = players.latent_skill
+        gap = skill[[0, 1, 2]].sum() - skill[[3, 4, 5]].sum()
+        want = 0.5 * math.erfc(-(gap / (CFG.beta * math.sqrt(6))) / math.sqrt(2))
+        assert p == pytest.approx(want, rel=1e-12)
+        # Symmetry: P(A beats B) + P(B beats A) == 1.
+        assert p + om.win_probability((3, 4, 5), (0, 1, 2)) == pytest.approx(1.0)
+
+    def test_resolution_deterministic_and_skill_correlated(self):
+        players = synthetic_players(40, seed=2)
+        strong = np.argsort(players.latent_skill)[-3:]
+        weak = np.argsort(players.latent_skill)[:3]
+        a = OutcomeModel(players, CFG, seed=9)
+        b = OutcomeModel(players, CFG, seed=9)
+        wins_a = [a.resolve(tuple(strong), tuple(weak))[0] for _ in range(100)]
+        wins_b = [b.resolve(tuple(strong), tuple(weak))[0] for _ in range(100)]
+        assert wins_a == wins_b  # same seed, same stream
+        assert wins_a.count(0) > 60  # the stronger team mostly wins
+
+
+@pytest.fixture(scope="module")
+def smoke_artifacts():
+    """TWO full smoke soaks with the same (seed, config) — the pair the
+    determinism tests compare — plus one with a different seed."""
+    arts = []
+    for cfg in (SMOKE, SMOKE, SoakConfig(**{
+        **{f.name: getattr(SMOKE, f.name)
+           for f in SMOKE.__dataclass_fields__.values()},
+        "seed": 17,
+    })):
+        driver = SoakDriver(cfg)
+        try:
+            arts.append(driver.run())
+        finally:
+            driver.close()
+    return arts
+
+
+class TestSoakDeterminism:
+    def test_bit_identical_deterministic_block(self, smoke_artifacts):
+        a, b, _ = smoke_artifacts
+        # The whole deterministic block — matches formed, outcomes,
+        # query responses, SLO counters, per-tick trajectory — is
+        # BIT-IDENTICAL across two runs of the same (seed, config).
+        assert json.dumps(a["deterministic"], sort_keys=True) == json.dumps(
+            b["deterministic"], sort_keys=True
+        )
+
+    def test_seed_changes_everything(self, smoke_artifacts):
+        a, _, c = smoke_artifacts
+        assert a["deterministic"]["matches_digest"] != (
+            c["deterministic"]["matches_digest"]
+        )
+        assert a["deterministic"]["queries_digest"] != (
+            c["deterministic"]["queries_digest"]
+        )
+
+
+class TestSoakSmoke:
+    """The worker-integration smoke soak: broker -> worker -> commit ->
+    published view -> query traffic, all SLOs green on the tier-1
+    config."""
+
+    def test_end_to_end_slos_green(self, smoke_artifacts):
+        art = smoke_artifacts[0]
+        det = art["deterministic"]
+        assert art["slo"]["pass"] and art["slo"]["violations"] == []
+        assert det["dead_letters"] == 0
+        assert det["retraces_steady"] == 0
+        assert det["drained"] and det["queue_depth_final"] == 0
+        assert det["matches_rated"] == det["matches_published"] > 0
+        assert det["view_lag_ticks_max"] <= SMOKE.max_view_lag_ticks
+
+    def test_loop_closed_through_serve_plane(self, smoke_artifacts):
+        det = smoke_artifacts[0]["deterministic"]
+        # The matchmaker's reads ride the serve plane: ratings pages +
+        # two winprob evaluations per formed match, ON TOP of the query
+        # workload's own mix.
+        assert det["serve_calls"]["winprob"] >= 2 * det["matches_published"]
+        assert det["serve_calls"]["ratings"] > det["queries"].get("ratings", 0)
+        # Commits published new view versions past the warmup publishes.
+        assert det["view_version_final"] > 1
+        assert det["batches_ok"] > 0
+
+    def test_latency_and_throughput_measured(self, smoke_artifacts):
+        art = smoke_artifacts[0]
+        assert art["metric"] == "soak.matches_per_sec" and art["value"] > 0
+        assert art["latency_ms"]["p99"] is not None
+        assert art["measured"]["wall_s"] > 0
+
+    def test_soak_registry_series_move(self, smoke_artifacts):
+        reg = get_registry()
+        assert reg.counter("soak.ticks_total").value >= SMOKE.n_ticks
+        assert reg.counter("soak.matches_published_total").value > 0
+        assert reg.counter("soak.queries_sent_total").value > 0
+
+
+@pytest.mark.slow
+class TestSoakLong:
+    """The longer soak variant (excluded from tier-1): sustained load,
+    backpressure visible, still deterministic and SLO-green."""
+
+    def test_sustained_soak(self):
+        cfg = SoakConfig(
+            seed=1, duration_s=30.0, tick_s=1.0, qps=60.0, query_qps=20.0,
+            n_players=1500, batch_size=128, polls_per_tick=4,
+        )
+        driver = SoakDriver(cfg)
+        try:
+            art = driver.run()
+        finally:
+            driver.close()
+        det = art["deterministic"]
+        assert art["slo"]["pass"], art["slo"]["violations"]
+        assert det["matches_rated"] == det["matches_published"] >= 1700
+        assert det["retraces_steady"] == 0
+
+
+class TestSoakCli:
+    def test_cli_soak_and_benchdiff_gate(self, tmp_path, capsys):
+        from analyzer_tpu import cli
+
+        out = tmp_path / "SOAK_r01.json"
+        rc = cli.main([
+            "soak", "--seed", "5", "--duration", "2", "--qps", "8",
+            "--query-qps", "4", "--players", "80", "--batch-size", "16",
+            "--out", str(out),
+        ])
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        assert rc == 0
+        parsed = json.loads(line)
+        assert parsed["metric"] == "soak.matches_per_sec"
+        assert parsed["slo"]["pass"]
+        # The artifact self-gates through benchdiff (candidate-only
+        # absolute SLOs — no baseline needed for the soak family half).
+        art = json.loads(out.read_text())
+        second = tmp_path / "SOAK_r02.json"
+        second.write_text(json.dumps(art))
+        rc = cli.main([
+            "benchdiff", "--against-latest", "--family", "soak",
+            "--dir", str(tmp_path),
+        ])
+        assert rc == 0
+        capsys.readouterr()
+
+    def test_cli_rejects_bad_args(self, capsys):
+        from analyzer_tpu import cli
+
+        assert cli.main(["soak", "--duration", "0"]) == 2
+        assert "must be positive" in capsys.readouterr().err
+
+
+class TestSoakBenchdiffFamily:
+    def _artifact(self, mps=100.0, p99=5.0, **det_overrides):
+        det = {
+            "seed": 0, "ticks": 4, "virtual_s": 4.0,
+            "matches_published": 40, "matches_rated": 40,
+            "matches_digest": "x", "queries_digest": "y",
+            "queries": {}, "serve_calls": {}, "batches_ok": 4,
+            "dead_letters": 0, "view_version_final": 5,
+            "view_lag_ticks_max": 0, "queue_depth_max": 0,
+            "queue_depth_final": 0, "retraces_steady": 0,
+            "drained": True, "trajectory": [],
+        }
+        det.update(det_overrides)
+        return {
+            "metric": "soak.matches_per_sec", "value": mps,
+            "latency_ms": {"p50": p99 / 2, "p99": p99},
+            "deterministic": det,
+            "slo": {"pass": True, "violations": [],
+                    "thresholds": {"max_view_lag_ticks": 2}},
+            "capture": {"degraded": False},
+        }
+
+    def test_family_registered_with_own_prefix(self):
+        from analyzer_tpu.obs.benchdiff import FAMILIES
+
+        assert FAMILIES["soak"] == "SOAK"
+
+    def test_prefix_globs_do_not_swallow_soak_files(self, tmp_path):
+        """The prefix-disambiguation contract: the write family's scan
+        must not pick up SOAK (or SERVE_BENCH) files, and vice versa."""
+        from analyzer_tpu.obs.benchdiff import find_bench_artifacts
+
+        for name in ("BENCH_r01.json", "SERVE_BENCH_r01.json",
+                     "SOAK_r01.json", "SOAK_r02.json"):
+            (tmp_path / name).write_text("{}")
+        names = lambda fam: [  # noqa: E731 — test-local shorthand
+            p.rsplit("/", 1)[-1]
+            for p in find_bench_artifacts(str(tmp_path), family=fam)
+        ]
+        assert names("bench") == ["BENCH_r01.json"]
+        assert names("serve") == ["SERVE_BENCH_r01.json"]
+        assert names("soak") == ["SOAK_r01.json", "SOAK_r02.json"]
+
+    def test_soak_configs_gate_both_axes(self):
+        from analyzer_tpu.obs.benchdiff import bench_configs, diff_configs
+
+        a = bench_configs(self._artifact(100.0, 5.0))
+        assert [(c.name, c.higher_is_better) for c in a] == [
+            ("soak.matches_per_sec", True), ("soak.p99_ms", False),
+        ]
+        b = bench_configs(self._artifact(60.0, 20.0))
+        rows = diff_configs(a, b, regress_pct=5.0)
+        assert all(r.regressed and r.gated for r in rows)
+        assert not any(
+            r.regressed
+            for r in diff_configs(a, bench_configs(self._artifact(120.0, 4.0)), 5.0)
+        )
+
+    def test_slo_violations_each_axis(self):
+        from analyzer_tpu.obs.benchdiff import soak_slo_violations
+
+        assert soak_slo_violations(self._artifact()) == []
+        v = soak_slo_violations(self._artifact(dead_letters=2))
+        assert v and "dead_letters" in v[0]
+        v = soak_slo_violations(self._artifact(retraces_steady=3))
+        assert v and "retraces_steady" in v[0]
+        v = soak_slo_violations(self._artifact(view_lag_ticks_max=5))
+        assert v and "view_lag" in v[0]
+        v = soak_slo_violations(
+            self._artifact(drained=False, queue_depth_final=7)
+        )
+        assert v and "not drained" in v[0]
+        v = soak_slo_violations(self._artifact(matches_rated=30))
+        assert v and "lost work" in v[0]
+        assert soak_slo_violations({"metric": "soak.x"})  # no det block
+
+    def test_optional_absolute_thresholds(self):
+        from analyzer_tpu.obs.benchdiff import soak_slo_violations
+
+        art = self._artifact(mps=50.0, p99=100.0)
+        art["slo"]["thresholds"].update(
+            min_matches_per_sec=80.0, max_p99_ms=50.0
+        )
+        v = soak_slo_violations(art)
+        assert len(v) == 2
+
+    def test_cli_gate_fails_on_violated_candidate(self, tmp_path, capsys):
+        from analyzer_tpu import cli
+
+        (tmp_path / "SOAK_r01.json").write_text(json.dumps(self._artifact()))
+        (tmp_path / "SOAK_r02.json").write_text(
+            json.dumps(self._artifact(dead_letters=1))
+        )
+        rc = cli.main([
+            "benchdiff", "--against-latest", "--family", "soak",
+            "--dir", str(tmp_path),
+        ])
+        out = capsys.readouterr()
+        assert rc == 1
+        assert "SLO VIOLATION" in out.out and "dead_letters" in out.out
+
+
+class TestBrokerQueueDepth:
+    def test_qsize_is_in_the_protocol(self):
+        from analyzer_tpu.service.broker import Broker, InMemoryBroker
+
+        assert callable(getattr(Broker, "qsize"))
+        b = InMemoryBroker()
+        b.publish("q", b"1")
+        b.publish("q", b"2")
+        assert b.qsize("q") == 2
+        got = b.get("q", 1)
+        assert b.qsize("q") == 1  # in-flight unacked not counted
+        b.ack(got[0].delivery_tag)
+        assert b.qsize("q") == 1
+
+    def test_worker_poll_samples_queue_depth_gauge(self):
+        from analyzer_tpu.service.broker import InMemoryBroker
+        from analyzer_tpu.service.store import InMemoryStore
+        from analyzer_tpu.service.worker import Worker
+
+        clock = VirtualClock(start=100.0)
+        broker = InMemoryBroker()
+        cfg = ServiceConfig(batch_size=2, idle_timeout=1e9)
+        worker = Worker(
+            broker, InMemoryStore(), cfg, clock=clock.monotonic,
+            pipeline=False,
+        )
+        for i in range(5):
+            broker.publish(cfg.queue, f"m{i}".encode())
+        worker.poll()  # pulls 2, leaves 3 ready — sampled post-pull
+        reg = get_registry()
+        assert reg.gauge("broker.queue_depth").value == 3
+        assert reg.gauge("broker.queue_depth", queue=cfg.queue).value == 3
+        # Throttled on the worker clock: a same-second poll re-samples
+        # nothing; advancing the clock does.
+        broker.publish(cfg.queue, b"m5")
+        worker.queue = []  # make room so poll pulls again
+        worker.poll()
+        assert reg.gauge("broker.queue_depth").value == 3  # throttled
+        clock.advance(1.5)
+        worker.poll()
+        assert reg.gauge("broker.queue_depth").value == broker.qsize(cfg.queue)
+
+    def test_standard_schema_has_soak_and_queue_depth(self):
+        from analyzer_tpu.obs.registry import (
+            STANDARD_COUNTERS,
+            STANDARD_GAUGES,
+        )
+
+        for name in (
+            "soak.ticks_total", "soak.matches_published_total",
+            "soak.queries_sent_total", "soak.slo_violations_total",
+        ):
+            assert name in STANDARD_COUNTERS, name
+        assert "broker.queue_depth" in STANDARD_GAUGES
+        assert "soak.qps_target" in STANDARD_GAUGES
+        assert "soak.virtual_seconds" in STANDARD_GAUGES

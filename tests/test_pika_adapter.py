@@ -97,7 +97,12 @@ def make_stub_pika():
         def queue_declare(self, queue, durable=False):
             self._check()
             self.declared.append((queue, durable))
-            self._server.queues.setdefault(queue, deque())
+            q = self._server.queues.setdefault(queue, deque())
+            # Real pika returns a Method frame whose message_count is
+            # the server-side ready depth — the qsize() probe's source.
+            return types.SimpleNamespace(
+                method=types.SimpleNamespace(message_count=len(q))
+            )
 
         def basic_qos(self, prefetch_count=0):
             self._check()
@@ -221,6 +226,25 @@ class TestPikaAdapter:
         assert len(broker.get("q", 3)) == 3
         assert len(broker.get("q", 10)) == 2
         assert broker.get("q", 10) == []
+
+    def test_qsize_reports_server_depth_plus_local_buffer(self, stub_pika):
+        """The Broker-Protocol qsize satellite on the AMQP adapter:
+        server-side ready depth via the passive redeclare's
+        message_count, plus deliveries already pushed into the local
+        buffer but not yet handed to the caller."""
+        from analyzer_tpu.service.broker import make_pika_broker
+
+        broker = make_pika_broker("amqp://localhost")
+        broker.declare_queue("q")
+        for i in range(4):
+            broker.publish("q", f"{i}".encode())
+        assert broker.qsize("q") == 4  # nothing consumed yet
+        got = broker.get("q", 2)  # subscribes: the stub pushes ALL 4;
+        # 2 handed out, 2 sit in the local buffer — still the backlog.
+        assert len(got) == 2
+        assert broker.qsize("q") == 2
+        assert [m.body for m in broker.get("q", 10)] == [b"2", b"3"]
+        assert broker.qsize("q") == 0
 
     def test_requeue_failed_drains_via_push_consumer(self, stub_pika):
         # The redrive tool against the PUSH-consumer adapter (the
