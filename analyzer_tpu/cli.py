@@ -957,6 +957,20 @@ def cmd_benchdiff(args) -> int:
             "untiered?)", file=sys.stderr,
         )
         return 1
+    if args.family == "serve":
+        # Same vanished-block contract for the shard plane: a baseline
+        # with sharded.* configs and a candidate without them means the
+        # bench silently fell back to the single-device engine.
+        a_sharded = any(c.name.startswith("sharded.") for c in a)
+        b_sharded = any(c.name.startswith("sharded.") for c in b)
+        if a_sharded and not b_sharded:
+            print(
+                f"error: {os.path.basename(b_path)} has no sharded "
+                f"capture but {os.path.basename(a_path)} does (silent "
+                "fall-back to the single-device serve plane?)",
+                file=sys.stderr,
+            )
+            return 1
     rows = diff_configs(a, b, args.regress_pct)
     sys.stdout.write(render_diff(a_path, b_path, rows))
     if any(r.regressed and r.gated for r in rows):
@@ -1018,16 +1032,30 @@ def cmd_serve(args) -> int:
     import time
 
     from analyzer_tpu.config import RatingConfig
-    from analyzer_tpu.serve import QueryEngine, ViewPublisher
+    from analyzer_tpu.serve import (
+        QueryEngine,
+        ShardedQueryEngine,
+        ShardedViewPublisher,
+        ViewPublisher,
+    )
     from analyzer_tpu.serve.server import ServeServer
 
     if not _require_one_source_serve(args):
+        return 2
+    if args.shards < 1:
+        print("error: --shards must be >= 1", file=sys.stderr)
         return 2
     cfg = RatingConfig.from_env()
     _obs_begin(args)
     obs = _obs_serve(args)
     try:
-        publisher = ViewPublisher()
+        # Topology-blind bootstrap (ServePlane): publish_state splits the
+        # table by interleaved row ownership when sharded; everything
+        # below — warmup, /v1/* — is the same code either way.
+        sharded = args.shards > 1
+        publisher = (
+            ShardedViewPublisher(args.shards) if sharded else ViewPublisher()
+        )
         if args.checkpoint:
             from analyzer_tpu.io.checkpoint import load_checkpoint
 
@@ -1040,7 +1068,15 @@ def cmd_serve(args) -> int:
             store = SqlStore(args.db)
             hist = store.load_stream(cfg)
             view = publisher.publish_state(hist.state, ids=hist.player_ids)
-        engine = QueryEngine(publisher, cfg=cfg, max_batch=args.max_batch)
+        if sharded:
+            engine = ShardedQueryEngine(
+                publisher, cfg=cfg, max_batch=args.max_batch,
+                all_gather_topk=args.all_gather_topk,
+            )
+        else:
+            engine = QueryEngine(
+                publisher, cfg=cfg, max_batch=args.max_batch
+            )
         engine.warmup(view)  # no first-query XLA stall
         engine.start()
         server = ServeServer(engine, port=args.port)
@@ -1048,6 +1084,7 @@ def cmd_serve(args) -> int:
             "serving": server.url,
             "players": view.n_players,
             "version": view.version,
+            "shards": args.shards,
             "source": args.checkpoint or args.db,
         }))
         sys.stdout.flush()
@@ -1135,7 +1172,7 @@ def cmd_soak(args) -> int:
     from analyzer_tpu.loadgen.driver import write_artifact
 
     for flag in ("duration", "qps", "tick", "players", "batch_size",
-                 "polls_per_tick"):
+                 "polls_per_tick", "serve_shards"):
         if getattr(args, flag) <= 0:
             print(f"error: --{flag.replace('_', '-')} must be positive",
                   file=sys.stderr)
@@ -1159,6 +1196,7 @@ def cmd_soak(args) -> int:
         afk_rate=args.afk_rate,
         warmup=not args.no_warmup,
         use_http=not args.in_process,
+        serve_shards=args.serve_shards,
         realtime=args.realtime,
         max_view_lag_ticks=args.max_view_lag_ticks,
         min_matches_per_sec=args.min_matches_per_sec,
@@ -1216,7 +1254,7 @@ def cmd_worker(args) -> int:
 
     worker_main(
         obs_port=args.obs_port, flight_dir=args.flight_dir,
-        serve_port=args.serve_port,
+        serve_port=args.serve_port, serve_shards=args.serve_shards,
     )
     return 0
 
@@ -1546,6 +1584,13 @@ def main(argv=None) -> int:
         help="query the engine in-process instead of over HTTP /v1/*",
     )
     s.add_argument(
+        "--serve-shards", type=int, default=1, metavar="S",
+        help="serve the soak's read plane through S shards "
+        "(ShardedViewPublisher + ShardedQueryEngine); the deterministic "
+        "block is bit-identical to --serve-shards 1 for the same seed "
+        "(docs/serving.md \"Sharded plane\")",
+    )
+    s.add_argument(
         "--realtime", action="store_true",
         help="pace ticks against the wall clock (rig soaks); decisions "
         "still run on the virtual clock, so results stay deterministic",
@@ -1591,6 +1636,13 @@ def main(argv=None) -> int:
         "ANALYZER_TPU_SERVE_PORT): a new view version publishes at every "
         "batch commit (docs/serving.md)",
     )
+    s.add_argument(
+        "--serve-shards", type=int, metavar="S",
+        help="serve through the sharded plane: S per-shard views + "
+        "routed lookups + distributed top-k (also "
+        "ANALYZER_TPU_SERVE_SHARDS; bit-identical results, "
+        "docs/serving.md \"Sharded plane\")",
+    )
     s.set_defaults(fn=cmd_worker)
 
     s = sub.add_parser(
@@ -1617,6 +1669,20 @@ def main(argv=None) -> int:
         "--max-seconds", type=float, metavar="S",
         help="serve for S seconds then exit (default: forever; smoke "
         "tests and drills)",
+    )
+    s.add_argument(
+        "--shards", type=int, default=1, metavar="S",
+        help="serve through the sharded plane: the table splits into S "
+        "per-shard views (interleaved by row), lookups route by "
+        "player-id shard, leaderboards merge per-shard top-k — "
+        "bit-identical to --shards 1 (docs/serving.md \"Sharded "
+        "plane\")",
+    )
+    s.add_argument(
+        "--all-gather-topk", action="store_true",
+        help="with --shards > 1: one shard_map'd all-gather top-k "
+        "dispatch over a serve mesh instead of S per-shard dispatches "
+        "(the rig flag; needs one device per shard)",
     )
     s.add_argument(
         "--obs-port", type=int, metavar="PORT",

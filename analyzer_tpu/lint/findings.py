@@ -62,6 +62,13 @@ RULES: dict[str, str] = {
         "analyzer_tpu/loadgen/ — the soak harness must be "
         "deterministic per seed, on a virtual clock"
     ),
+    "GL029": (
+        "whole-table cross-shard gather in analyzer_tpu/serve/ "
+        "(jax.device_get, or np.asarray/np.array/jnp.array/"
+        "jax.device_put on a *table* value) outside the designated "
+        "merge helpers — routed per-shard microbatches must not decay "
+        "into per-query host round-trips"
+    ),
 }
 
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")

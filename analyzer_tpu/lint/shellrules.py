@@ -72,6 +72,20 @@ and wall-clock reads (``time.time``/``monotonic``/``perf_counter``/
 contract; the few legitimate wall reads — realtime pacing sleeps, the
 artifact's measured-latency block — carry line-scoped disables with
 reasons, like every other escape.
+
+GL029 is PATH-SCOPED to ``analyzer_tpu/serve/``, the sharded serving
+plane (``docs/serving.md`` "Sharded plane"): once the table spans
+shards, the whole point of routed per-shard microbatches is that NO
+query path ever reassembles the full table on the host — a
+``jax.device_get(...)``, or an ``np.asarray``/``np.array``/
+``jnp.array``/``jax.device_put`` whose argument is a *table*-named
+value, anywhere outside the DESIGNATED merge helpers
+(``host_table`` — the oracle/acceptance reassembly, ``_stacked_tables``
+— the all-gather top-k's per-device stack, ``publish_state`` — the
+whole-table bootstrap ingest) silently reintroduces the per-query host
+round-trip the shard plane exists to kill. Test files are exempt; a
+deliberate whole-table fetch elsewhere carries a line-scoped disable
+with a reason.
 """
 
 from __future__ import annotations
@@ -112,6 +126,22 @@ _GL027_TRANSFERS = ("jax.device_put", "jax.numpy.array")
 #: Directories where GL028 applies: the soak harness, whose whole
 #: contract is bit-identical artifacts per (seed, config).
 _GL028_DIRS = ("analyzer_tpu/loadgen/",)
+
+#: Directories where GL029 applies: the serving plane, whose sharded
+#: query paths must stay per-shard microbatches (docs/serving.md).
+_GL029_DIRS = ("analyzer_tpu/serve/",)
+
+#: Functions DESIGNATED to reassemble/ingest a whole table (the merge
+#: helpers GL029 exempts): host_table (oracle/acceptance + debug
+#: surfaces), _stacked_tables (the all-gather top-k's per-device
+#: stack), publish_state (the whole-table bootstrap publish).
+_GL029_MERGE_HELPERS = ("host_table", "_stacked_tables", "publish_state")
+
+#: Host<->device transfer calls GL029 inspects for a table-named
+#: argument (jax.device_get flags regardless of argument shape).
+_GL029_TRANSFERS = (
+    "numpy.asarray", "numpy.array", "jax.numpy.array", "jax.device_put",
+)
 
 #: Wall-clock reads GL028 bans in loadgen decision paths. Pacing and
 #: measured-latency reads carry line-scoped disables with reasons.
@@ -170,9 +200,13 @@ class ShellRules:
         obs_layer = self._in_obs_layer()
         feed_layer = self._in_feed_layer()
         loadgen_layer = self._in_loadgen_layer()
+        serve_layer = self._in_serve_layer()
         tests = self._in_tests()
         pallas_home = self._in_pallas_home()
         table_home = self._in_table_home()
+        merge_ranges = (
+            self._merge_helper_ranges() if serve_layer and not tests else ()
+        )
         for node in ast.walk(self.tree):
             if isinstance(node, ast.Try):
                 self._check_try(node)
@@ -185,6 +219,8 @@ class ShellRules:
                     self._check_device_sync(node)
                 if loadgen_layer:
                     self._check_soak_determinism(node)
+                if serve_layer and not tests:
+                    self._check_cross_shard_gather(node, merge_ranges)
                 if not tests:
                     self._check_interpret_literal(node)
                 if not (tests or table_home):
@@ -230,6 +266,23 @@ class ShellRules:
     def _in_loadgen_layer(self) -> bool:
         path = self.path.replace("\\", "/")
         return any(frag in path for frag in _GL028_DIRS)
+
+    def _in_serve_layer(self) -> bool:
+        path = self.path.replace("\\", "/")
+        return any(frag in path for frag in _GL029_DIRS)
+
+    def _merge_helper_ranges(self) -> tuple:
+        """(start, end) line spans of the designated merge helpers —
+        the only functions in serve/ sanctioned to move a whole table
+        across the host/device boundary (GL029)."""
+        out = []
+        for node in ast.walk(self.tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in _GL029_MERGE_HELPERS
+            ):
+                out.append((node.lineno, node.end_lineno or node.lineno))
+        return tuple(out)
 
     def _in_tests(self) -> bool:
         path = self.path.replace("\\", "/")
@@ -342,6 +395,52 @@ class ShellRules:
             "route the transfer through the tier manager / view "
             "publisher, or disable with a reason for a deliberate "
             "whole-table load (ingest, bench baseline)",
+        )
+
+    def _check_cross_shard_gather(self, node: ast.Call, merge_ranges) -> None:
+        """GL029: a whole-table host round-trip in the serving plane
+        outside the designated merge helpers. ``jax.device_get`` flags
+        on sight (it exists to fetch whole arrays); the transfer calls
+        in :data:`_GL029_TRANSFERS` flag when their first argument IS a
+        table-named value (``<x>.table`` or a name containing
+        ``table``) — the conservative needle for "a view's full table
+        is about to cross the boundary per query"."""
+        resolved = self.imports.resolve(node.func)
+        if resolved is None:
+            return
+        in_helper = any(
+            lo <= node.lineno <= hi for lo, hi in merge_ranges
+        )
+        if resolved == "jax.device_get":
+            if in_helper:
+                return
+            self._flag(
+                "GL029", node,
+                "jax.device_get in the serving plane fetches a whole "
+                "(possibly sharded) array to host per call; route "
+                "cross-shard reads through the designated merge helpers "
+                "(host_table / _stacked_tables), or disable with a "
+                "reason for a deliberate whole-table fetch",
+            )
+            return
+        if resolved not in _GL029_TRANSFERS or not node.args or in_helper:
+            return
+        arg = node.args[0]
+        table_named = (
+            isinstance(arg, ast.Attribute) and arg.attr == "table"
+        ) or (
+            isinstance(arg, ast.Name) and "table" in arg.id.lower()
+        )
+        if not table_named:
+            return
+        self._flag(
+            "GL029", node,
+            f"whole-table `{resolved.split('.')[-1]}` on a table value "
+            "in the serving plane outside the designated merge helpers "
+            "— per-query host round-trips are exactly what the routed "
+            "per-shard microbatches exist to kill (docs/serving.md "
+            '"Sharded plane"); use the merge helpers or disable with a '
+            "reason",
         )
 
     def _check_soak_determinism(self, node: ast.Call) -> None:

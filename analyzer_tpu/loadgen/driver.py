@@ -89,6 +89,12 @@ class SoakConfig:
     activity_concentration: float = 1.2
     warmup: bool = True  # precompile worker + serve + publish ladders
     use_http: bool = True  # query workload over /v1/* vs in-process
+    # > 1 serves through the sharded plane (ShardedViewPublisher +
+    # ShardedQueryEngine, docs/serving.md "Sharded plane"). The
+    # deterministic block is BIT-IDENTICAL across serve_shards values
+    # for the same (seed, config-otherwise) — the sharded engine's
+    # contract, pinned by tests/test_loadgen.py.
+    serve_shards: int = 1
     realtime: bool = False  # pace ticks against the wall clock
     max_view_lag_ticks: int = 2  # SLO: served view staleness bound
     min_matches_per_sec: float | None = None  # SLO: absolute wall floor
@@ -129,6 +135,7 @@ class SoakDriver:
         self.worker = Worker(
             self.broker, self.store, service_cfg, self.rating_config,
             clock=self.vclock.monotonic, pipeline=False, serve_port=0,
+            serve_shards=cfg.serve_shards,
         )
         self.players = synthetic_players(cfg.n_players, seed=cfg.seed)
         self.outcomes = OutcomeModel(
@@ -188,24 +195,20 @@ class SoakDriver:
 
     def _warm_publish_buckets(self, ids, rows) -> None:
         """Compiles the view publisher's patch-scatter ladder for every
-        id-count bucket a commit can carry, by re-publishing seed pages
-        (idempotent content; versions advance, values do not). Without
+        id-count bucket a commit can carry (the publisher's own
+        ``warm_patch_buckets`` — re-publishing seed pages with
+        idempotent content; versions advance, values do not). Without
         this the Nth distinct batch size would compile mid-soak and
-        count against the retrace SLO."""
+        count against the retrace SLO. The ladder LENGTH is a pure
+        function of the cap and the published population — identical
+        across plane topologies, so the soak's version sequence (and
+        therefore its deterministic block) does not depend on
+        ``serve_shards``."""
         from analyzer_tpu.core.state import MAX_TEAM_SIZE
-        from analyzer_tpu.serve.view import PATCH_BUCKET_FLOOR, _pow2_bucket
 
-        n = len(ids)
-        cap = _pow2_bucket(
-            min(self.cfg.batch_size * 2 * MAX_TEAM_SIZE, max(n, 1)),
-            PATCH_BUCKET_FLOOR,
+        self.worker.view_publisher.warm_patch_buckets(
+            self.cfg.batch_size * 2 * MAX_TEAM_SIZE
         )
-        b = PATCH_BUCKET_FLOOR
-        while b <= cap:
-            page = [ids[i % n] for i in range(b)]
-            page_rows = rows[[i % n for i in range(b)]]
-            self.worker.view_publisher.publish_rows(page, page_rows)
-            b *= 2
 
     # -- match materialization --------------------------------------------
     def _player_obj(self, row: int):

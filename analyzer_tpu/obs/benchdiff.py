@@ -122,6 +122,33 @@ def bench_configs(data: dict) -> list[BenchConfig]:
                     degraded=degraded,
                 )
             )
+        sharded = data.get("sharded") or {}
+        if sharded.get("min_over_single") is not None:
+            # The shard-plane tax (sharded batched seconds / single
+            # batched seconds, lower is better): a routed-lookup
+            # regression or a merge gone quadratic moves this ratio even
+            # when the headline single-engine qps holds. A candidate
+            # with NO sharded block at all (silent fall-back to the
+            # single-device plane) is caught by the serve family's
+            # vanished-block check in ``cli benchdiff``.
+            s_degraded = degraded or not sharded.get("stable", True)
+            out.append(
+                BenchConfig(
+                    name="sharded.min_over_single",
+                    value=float(sharded["min_over_single"]),
+                    higher_is_better=False,
+                    degraded=s_degraded,
+                )
+            )
+            if sharded.get("queries_per_sec") is not None:
+                out.append(
+                    BenchConfig(
+                        name="sharded.queries_per_sec",
+                        value=float(sharded["queries_per_sec"]),
+                        higher_is_better=True,
+                        degraded=s_degraded,
+                    )
+                )
         return out
     if capture.get("min_over_predicted") is not None:
         out.append(

@@ -214,6 +214,16 @@ STANDARD_COUNTERS = (
     "obs.flight_dumps_total",
     "serve.queries_total",
     "serve.view_publishes_total",
+    # The sharded serve plane (serve/view.py + serve/engine.py): H2D
+    # bytes the publish path moved (the patch-vs-rebuild pin), routed
+    # per-shard query traffic (per-shard serve.shard.queries_total
+    # {shard=} series appear on first sample; the base is pre-declared),
+    # and the distributed top-k's host merges + candidate volume.
+    # Pre-declared so a single-device plane reads 0, not missing.
+    "serve.view_publish_bytes_total",
+    "serve.shard.queries_total",
+    "serve.shard.merges_total",
+    "serve.shard.merge_candidates_total",
     # The closed-loop soak harness (analyzer_tpu/loadgen): virtual
     # ticks executed, matchmade matches pushed onto the analyze queue,
     # serve queries issued by the load workload, and SLO-gate failures.
@@ -248,6 +258,8 @@ STANDARD_GAUGES = (
     # first publish — a scraper can tell "no read plane" from "broken".
     "serve.view_version",
     "serve.view_age_seconds",
+    # Shard count of the sharded serve plane (0 = single-device).
+    "serve.shards",
     # Broker backpressure: ready messages on the consume queue, sampled
     # (throttled) in Worker.poll; per-queue series
     # broker.queue_depth{queue=...} appear on first sample.

@@ -367,6 +367,7 @@ class ShardedRun:
         cfg: RatingConfig,
         mesh: Mesh,
         routing_capacity: int | None = None,
+        track_dirty: bool = False,
     ) -> None:
         if (
             state.seed_cfg is not None
@@ -393,6 +394,15 @@ class ShardedRun:
         )
         self._batch_sh = NamedSharding(mesh, P(None, DATA_AXIS))
         self._route_sh = NamedSharding(mesh, P(None, DATA_AXIS, None))
+        # Per-shard dirty-row accounting for the sharded serve plane:
+        # the routing's dst lists already name every local row each
+        # shard writes, so a view publish ships exactly those rows —
+        # producer (stage) computes, consumer (dispatch) accumulates,
+        # publish drains. Off unless a publisher is wired.
+        self.track_dirty = track_dirty
+        self._dirty: list[list[np.ndarray]] = [
+            [] for _ in range(self.n_dev)
+        ]
 
         # Pad the table to D * rps rows, reorder into shard-major
         # (interleaved ownership: global row r -> shard r % D, local row
@@ -480,9 +490,18 @@ class ShardedRun:
         the consumer thread executes window k. ``mask`` is consumed
         host-side (routing) only — the device derives it from
         ``pidx != pad_row``, and winner/mode cross the link as int8
-        (the step fn widens them)."""
+        (the step fn widens them). With ``track_dirty`` the staged
+        tuple also carries each shard's written local rows (from the
+        same compacted ``dst`` lists the scatter consumes) for the
+        serve plane's per-shard patch publish."""
         if sel is None:
             sel, dst = self._route_window(pidx, mask, mode_id, afk)
+        dirty = None
+        if self.track_dirty:
+            dirty = []
+            for d in range(self.n_dev):
+                rows = np.unique(dst[:, d, :])
+                dirty.append(rows[rows < self.rps].astype(np.int64))
         return (
             _put_global(pidx, self._batch_sh),
             _put_global(winner.astype(np.int8), self._batch_sh),
@@ -490,13 +509,21 @@ class ShardedRun:
             _put_global(afk, self._batch_sh),
             _put_global(sel, self._route_sh),
             _put_global(dst, self._route_sh),
+            dirty,
         )
 
     def dispatch_staged(self, staged: tuple) -> None:
         """Runs one staged window (donates and replaces the carried
         table). Consumer-thread only — the donation chain on the table
-        is what serializes windows."""
-        self._table = self._step_fn(self._table, *staged)
+        is what serializes windows; the dirty accumulation shares that
+        ordering, so a publish covers exactly the windows dispatched
+        before it."""
+        *dev, dirty = staged
+        if dirty is not None:
+            for d, rows in enumerate(dirty):
+                if rows.size:
+                    self._dirty[d].append(rows)
+        self._table = self._step_fn(self._table, *dev)
 
     def dispatch(
         self,
@@ -538,6 +565,48 @@ class ShardedRun:
         on_chunk(snapshot, next_step)
         live[0] = False
 
+    # -- sharded serve-plane publish --------------------------------------
+    def _shard_blocks(self) -> list[np.ndarray]:
+        """Each shard's ``[rps, W]`` block fetched D2H INDEPENDENTLY
+        (``addressable_shards`` — never a cross-shard gather). Block
+        ``d``'s local row ``j`` is global row ``j*D + d``: the
+        shard-major layout IS the serve plane's interleaved local
+        order, so the blocks feed ``ShardedViewPublisher`` verbatim."""
+        shards = sorted(
+            self._table.addressable_shards,
+            key=lambda s: (s.index[0].start or 0),
+        )
+        return [np.asarray(s.data) for s in shards]
+
+    def maybe_publish_views(self, publisher) -> bool:
+        """Throttled :meth:`publish_views` (the chunk-boundary hook)."""
+        if not publisher.due():
+            return False
+        self.publish_views(publisher)
+        return True
+
+    def publish_views(self, publisher) -> None:
+        """Publishes one version-consistent per-shard view set: each
+        shard's block crosses D2H on its own, and only the local rows
+        written since the last publish (the accumulated routing ``dst``
+        lists) ride the per-shard H2D patch path back up into the
+        serving tables. ``publisher`` is a
+        :class:`~analyzer_tpu.serve.view.ShardedViewPublisher` with
+        ``n_shards == mesh size`` (validated by the runner wiring)."""
+        blocks = self._shard_blocks()
+        n_players = self.n_rows - 1
+        patches = []
+        for d in range(self.n_dev):
+            if self._dirty[d]:
+                rows_idx = np.unique(np.concatenate(self._dirty[d]))
+            else:
+                rows_idx = np.empty(0, np.int64)
+            patches.append((rows_idx, blocks[d][rows_idx]))
+            self._dirty[d] = []
+        publisher.publish_shard_patches(
+            patches, n_players, lambda: blocks
+        )
+
     def finish(self) -> PlayerState:
         """Assembles and returns the final row-major state."""
         return dataclasses.replace(
@@ -557,6 +626,7 @@ def rate_history_sharded(
     routing: Routing | None = None,
     routing_capacity: int | None = None,
     prefetch_depth: int | None = None,
+    view_publisher=None,
 ) -> PlayerState:
     """Full-history re-rate, data-parallel over the mesh. Returns final state.
 
@@ -581,6 +651,17 @@ def rate_history_sharded(
     constant BASELINE.md's D=1 ablation pinned now overlaps device time
     instead of preceding it. Chunk order, hook boundaries, and results
     are depth-invariant.
+
+    ``view_publisher`` wires the sharded SERVE plane (the read half of
+    ROADMAP item 2): a
+    :class:`~analyzer_tpu.serve.view.ShardedViewPublisher` whose
+    ``n_shards`` equals the mesh size gets throttled per-shard view
+    publishes at chunk boundaries — each shard's dirty rows riding its
+    own patch path, one monotone version across shards — plus an
+    unthrottled final publish. A plain ``ViewPublisher`` gets only the
+    final assembled table (a mid-run cross-shard gather would serialize
+    the feed overlap). Single-process only: a multi-host serve tier is
+    ``parallel/multihost.py`` future work.
     """
     mesh = mesh or make_mesh()
     n_dev = mesh.devices.size
@@ -621,7 +702,27 @@ def rate_history_sharded(
 
     from analyzer_tpu.sched.feed import DEFAULT_DEPTH, Prefetcher
 
-    run = ShardedRun(state, cfg, mesh, routing_capacity=routing_capacity)
+    sharded_publisher = view_publisher is not None and hasattr(
+        view_publisher, "publish_shard_patches"
+    )
+    if sharded_publisher:
+        if jax.process_count() != 1:
+            raise ValueError(
+                "per-shard view publishing is single-process (the "
+                "publisher would only see this process's shards); run "
+                "the serve tier separately on multi-host"
+            )
+        if view_publisher.n_shards != n_dev:
+            raise ValueError(
+                f"view publisher has {view_publisher.n_shards} shards "
+                f"but the mesh has {n_dev} devices; build the "
+                "ShardedViewPublisher with n_shards == mesh size"
+            )
+
+    run = ShardedRun(
+        state, cfg, mesh, routing_capacity=routing_capacity,
+        track_dirty=sharded_publisher,
+    )
     n_steps = sched.n_steps if stop_after is None else min(stop_after, sched.n_steps)
     tracer = get_tracer()
 
@@ -646,6 +747,13 @@ def rate_history_sharded(
         for stop, staged in pf:
             run.dispatch_staged(staged)
             del staged
+            if sharded_publisher:
+                run.maybe_publish_views(view_publisher)
             if on_chunk is not None:
                 run.call_hook(on_chunk, stop)
-    return run.finish()
+    if sharded_publisher:
+        run.publish_views(view_publisher)  # final per-shard, unthrottled
+    final = run.finish()
+    if view_publisher is not None and not sharded_publisher:
+        view_publisher.publish_state(final)  # final table, unthrottled
+    return final

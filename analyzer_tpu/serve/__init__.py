@@ -21,19 +21,41 @@ Three layers:
     the shared :mod:`analyzer_tpu.obs.httpd` plumbing, started via
     ``Worker(serve_port=)`` or ``cli serve``.
 
+The SHARDED plane mirrors each layer across the mesh:
+:class:`ShardedViewPublisher` publishes one per-shard view per commit
+under a single monotone version, :class:`ShardedQueryEngine` routes
+point lookups by player-id shard and merges per-shard ``lax.top_k``
+leaderboards on host — bit-identical to the single-device plane — and
+everything above programs against the :class:`ServePlane` protocol, so
+``/v1/*``, the worker, and loadgen are topology-blind.
+
 ``serve/oracle.py`` is the pure-Python reference the parity tests pin
 bit-for-bit results against; it is never imported by the serving path.
 
 Consistency model and operational notes: ``docs/serving.md``.
 """
 
-from analyzer_tpu.serve.engine import QueryEngine, UnknownPlayerError
-from analyzer_tpu.serve.view import RatingsView, ViewPublisher
+from analyzer_tpu.serve.engine import (
+    QueryEngine,
+    ServePlane,
+    ShardedQueryEngine,
+    UnknownPlayerError,
+)
+from analyzer_tpu.serve.view import (
+    RatingsView,
+    ShardedRatingsView,
+    ShardedViewPublisher,
+    ViewPublisher,
+)
 
 __all__ = [
     "QueryEngine",
     "RatingsView",
+    "ServePlane",
     "ServeServer",
+    "ShardedQueryEngine",
+    "ShardedRatingsView",
+    "ShardedViewPublisher",
     "UnknownPlayerError",
     "ViewPublisher",
 ]

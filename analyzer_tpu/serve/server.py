@@ -33,7 +33,7 @@ from analyzer_tpu.obs.httpd import (
     json_body,
     text_body,
 )
-from analyzer_tpu.serve.engine import QueryEngine, UnknownPlayerError
+from analyzer_tpu.serve.engine import ServePlane, UnknownPlayerError
 
 logger = get_logger(__name__)
 
@@ -53,16 +53,20 @@ def _ids_param(params: dict, key: str, limit: int) -> list[str]:
 
 
 class ServeServer:
-    """The ratesrv thread: routes ``/v1/*`` onto a :class:`QueryEngine`.
+    """The ratesrv thread: routes ``/v1/*`` onto a :class:`ServePlane`.
 
-    ``port=0`` binds an ephemeral port (tests); the bound port is
-    readable at :attr:`port`. The caller owns the engine's lifecycle —
-    ``Worker(serve_port=)`` and ``cli serve`` start the engine's tick
+    ``engine`` is anything satisfying the ServePlane protocol — the
+    single-device :class:`~analyzer_tpu.serve.engine.QueryEngine` or the
+    mesh-backed :class:`~analyzer_tpu.serve.engine.ShardedQueryEngine`;
+    the HTTP layer is topology-blind (``docs/serving.md`` "Sharded
+    plane"). ``port=0`` binds an ephemeral port (tests); the bound port
+    is readable at :attr:`port`. The caller owns the engine's lifecycle
+    — ``Worker(serve_port=)`` and ``cli serve`` start the engine's tick
     thread before the server and close both on shutdown."""
 
     def __init__(
         self,
-        engine: QueryEngine,
+        engine: ServePlane,
         port: int = 0,
         host: str = DEFAULT_HOST,
     ) -> None:

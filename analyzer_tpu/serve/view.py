@@ -28,6 +28,16 @@ Row sizing rides the same power-of-two bucket ladder as the write path
 (``service.encode.row_bucket``), so the serving kernels see a handful of
 table shapes, not one per player-count — the serve half of the package's
 zero-steady-state-retrace discipline (``docs/serving.md``).
+
+The SHARDED plane (:class:`ShardedViewPublisher`) applies the same
+contract per mesh shard: the table splits by the mesh's interleaved
+ownership (global row ``r`` -> shard ``r % S`` at local row ``r // S``,
+the :mod:`analyzer_tpu.parallel.mesh` layout invariant), every publish
+swaps ONE :class:`ShardedRatingsView` holding all ``S`` per-shard
+snapshots under a single monotone version — a reader can never observe
+a torn cross-shard version — and per-shard updates ride the same
+``.at[rows].set`` patch kernel, so only each shard's touched rows cross
+H2D (``docs/serving.md`` "Sharded plane").
 """
 
 from __future__ import annotations
@@ -54,6 +64,33 @@ PATCH_BUCKET_FLOOR = 64
 
 def _pow2_bucket(n: int, floor: int) -> int:
     return max(floor, 1 << max(n - 1, 0).bit_length())
+
+
+def shard_of_row(row: int, n_shards: int) -> int:
+    """Interleaved shard ownership — THE mesh layout invariant (global
+    row ``r`` lives in shard ``r % S``; ``parallel/mesh.py:_owner``).
+    The serve plane and the write mesh must agree or routed lookups read
+    the wrong shard; pinned against the mesh helpers by test."""
+    return row % n_shards
+
+
+def local_of_row(row: int, n_shards: int) -> int:
+    """Shard-local row for a global row (``r // S`` — see
+    :func:`shard_of_row`)."""
+    return row // n_shards
+
+
+def shard_player_count(n_players: int, shard: int, n_shards: int) -> int:
+    """How many of the first ``n_players`` global rows shard owns."""
+    return max(0, -(-(n_players - shard) // n_shards))
+
+
+def _count_publish_bytes(nbytes: int) -> None:
+    """H2D accounting for the publish path: the patch-vs-rebuild split
+    is invisible in wall time at test scale, so the byte counter is what
+    pins "appends ride the patch path" (tests/test_serve.py)."""
+    reg = get_registry()
+    reg.counter("serve.view_publish_bytes_total").add(int(nbytes))
 
 
 @jax.jit
@@ -213,6 +250,7 @@ class ViewPublisher:
                 idx[: len(ids)] = touched
                 pad_rows = np.full((nb, TABLE_WIDTH), np.nan, np.float32)
                 pad_rows[: len(ids)] = rows
+                _count_publish_bytes(idx.nbytes + pad_rows.nbytes)
                 table = _patch_rows(prev.table, jnp.asarray(idx),
                                     jnp.asarray(pad_rows))
             else:
@@ -220,6 +258,7 @@ class ViewPublisher:
                 # alias the numpy buffer zero-copy, and an aliased view
                 # would mutate under later staging merges — the exact
                 # torn-read class this double buffer exists to kill.
+                _count_publish_bytes(self._staging[: alloc + 1].nbytes)
                 table = jnp.array(self._staging[: alloc + 1])
             return self._swap(table, p)
 
@@ -248,6 +287,7 @@ class ViewPublisher:
             )
             self._staging[:p] = host[:p]
             # jnp.array (owning copy) — see publish_rows on aliasing.
+            _count_publish_bytes(self._staging.nbytes)
             return self._swap(jnp.array(self._staging), p)
 
     def publish_state_patch(
@@ -264,7 +304,12 @@ class ViewPublisher:
         ``full_table`` is a zero-arg callable producing the whole
         ``[P+1, 16]`` host table — the rebuild fallback, paid only when
         there is no patchable previous view (first publish, an id-mapped
-        publisher, or a row-bucket change)."""
+        publisher, or a row-bucket change). A GROWN ``n_players`` within
+        the same row bucket stays on the patch path: index-addressed
+        appends are just patches past the previous view's ``n_players``,
+        and the per-view ``n_players`` guard already freezes the old
+        version — re-uploading the whole table there was pure waste
+        (pinned by a transfer-bytes assertion in tests/test_serve.py)."""
         rows = np.asarray(rows, np.float32)
         rows_idx = np.asarray(rows_idx, np.int64)
         with self._lock:
@@ -274,7 +319,7 @@ class ViewPublisher:
                 prev is not None
                 and self._row_of is None
                 and prev.table.shape[0] == alloc + 1
-                and prev.n_players == n_players
+                and prev.n_players <= n_players
                 and self._staging.shape[0] == alloc + 1
             )
             if not patchable:
@@ -286,6 +331,7 @@ class ViewPublisher:
                 )
                 self._staging[:n_players] = host[:n_players]
                 # jnp.array (owning copy) — see publish_rows on aliasing.
+                _count_publish_bytes(self._staging.nbytes)
                 return self._swap(jnp.array(self._staging), n_players)
             self._staging[rows_idx] = rows
             nb = _pow2_bucket(len(rows_idx), PATCH_BUCKET_FLOOR)
@@ -293,6 +339,7 @@ class ViewPublisher:
             idx[: len(rows_idx)] = rows_idx
             pad_rows = np.full((nb, TABLE_WIDTH), np.nan, np.float32)
             pad_rows[: len(rows_idx)] = rows
+            _count_publish_bytes(idx.nbytes + pad_rows.nbytes)
             table = _patch_rows(
                 prev.table, jnp.asarray(idx), jnp.asarray(pad_rows)
             )
@@ -317,6 +364,37 @@ class ViewPublisher:
             return None
         return self.publish_state(state, ids=ids)
 
+    def warm_patch_buckets(self, cap_ids: int) -> int:
+        """Pre-compiles the patch-scatter ladder for every id-count
+        bucket up to ``cap_ids`` by re-publishing EXISTING rows
+        (idempotent values; versions advance). Without this the Nth
+        distinct commit size compiles mid-serve and counts against the
+        zero-steady-state-retrace SLO (``loadgen`` calls this in
+        ``SoakDriver.prepare``). Returns the number of warm publishes —
+        the ladder length is a pure function of ``cap_ids`` and the
+        published population, identical across plane topologies, so a
+        soak's version sequence does not depend on the plane it warmed."""
+        with self._lock:
+            ids = list(self._ids or [])
+            if not ids:
+                return 0
+            row_of = dict(self._row_of)
+            staging = self._staging
+            n = len(ids)
+            cap = _pow2_bucket(
+                min(int(cap_ids), max(n, 1)), PATCH_BUCKET_FLOOR
+            )
+            pages = []
+            b = PATCH_BUCKET_FLOOR
+            while b <= cap:
+                page = [ids[i % n] for i in range(b)]
+                rows = staging[[row_of[pid] for pid in page]].copy()
+                pages.append((page, rows))
+                b *= 2
+        for page, rows in pages:
+            self.publish_rows(page, rows)
+        return len(pages)
+
     def _grow(self, alloc: int) -> None:
         if alloc + 1 <= self._staging.shape[0]:
             return
@@ -336,5 +414,405 @@ class ViewPublisher:
         reg = get_registry()
         reg.gauge("serve.view_version").set(self._version)
         reg.gauge("serve.view_age_seconds").set(0.0)
+        reg.counter("serve.view_publishes_total").add(1)
+        return view
+
+
+class ShardedRatingsView:
+    """One immutable published snapshot of the SHARDED serving plane:
+    ``S`` per-shard :class:`RatingsView` objects frozen under a single
+    version number. A reader resolving ``current()`` once can never mix
+    shard tables from two publishes — the cross-shard torn-read guard
+    is this object's existence, not any per-shard discipline.
+
+    Per-shard tables are ``[local_alloc+1, 16]`` in shard-LOCAL row
+    order (global row ``r`` -> shard ``r % S`` local row ``r // S`` —
+    the mesh's interleaved layout, :func:`shard_of_row`), all shards
+    sharing ONE local row bucket so the serving kernels compile one
+    shape ladder for the whole mesh."""
+
+    __slots__ = (
+        "version", "shards", "n_players", "n_shards", "published_at",
+        "_row_of", "_ids", "_host",
+    )
+
+    def __init__(self, version, shards, n_players, row_of, ids) -> None:
+        self.version = version
+        self.shards = tuple(shards)
+        self.n_players = n_players
+        self.n_shards = len(self.shards)
+        self.published_at = time.monotonic()
+        self._row_of = row_of
+        self._ids = ids
+        self._host = None
+
+    @property
+    def age_s(self) -> float:
+        return time.monotonic() - self.published_at
+
+    def resolve(self, player_id: str) -> int | None:
+        """GLOBAL row for ``player_id`` at this version (same contract
+        as :meth:`RatingsView.resolve`)."""
+        if self._row_of is None:  # identity mode: ids ARE row indices
+            try:
+                row = int(player_id)
+            except (TypeError, ValueError):
+                return None
+        else:
+            row = self._row_of.get(player_id)
+            if row is None:
+                return None
+        return row if 0 <= row < self.n_players else None
+
+    def locate(self, player_id: str) -> tuple[int, int] | None:
+        """(shard, local_row) for ``player_id``, or None when unknown —
+        the routed-lookup primitive the sharded engine groups by."""
+        row = self.resolve(player_id)
+        if row is None:
+            return None
+        return shard_of_row(row, self.n_shards), local_of_row(
+            row, self.n_shards
+        )
+
+    def id_of(self, row: int) -> str:
+        """The player id published at GLOBAL ``row`` (< ``n_players``)."""
+        if self._ids is None:
+            return str(row)
+        return self._ids[row]
+
+    def host_table(self) -> np.ndarray:
+        """The logical ``[n_players, 16]`` host table reassembled from
+        the per-shard slices (fetched once, cached). This is a
+        DESIGNATED merge helper (graftlint GL029): the oracle acceptance
+        path and leaderboard response formatting read it; the routed
+        query kernels never do."""
+        if self._host is None:
+            out = np.empty((self.n_players, TABLE_WIDTH), np.float32)
+            for d, shard in enumerate(self.shards):
+                ln = shard.n_players
+                if ln:
+                    out[d :: self.n_shards] = shard.host_table()[:ln]
+            self._host = out
+        return self._host
+
+
+class ShardedViewPublisher:
+    """The sharded plane's write side: one version-consistent
+    :class:`RatingsView` per mesh shard, swapped atomically as a single
+    :class:`ShardedRatingsView` under one monotone version.
+
+    Mirrors :class:`ViewPublisher`'s modes (id-merge ``publish_rows``,
+    whole-table ``publish_state``) and adds the mesh runner's
+    per-shard incremental entry :meth:`publish_shard_patches` — each
+    shard's touched rows ride the same ``.at[rows].set`` patch kernel,
+    so a commit's H2D cost is per-shard rows, never the table.
+
+    ``devices`` (optional) commits shard ``d``'s table to
+    ``devices[d % len(devices)]`` — the rig shape where each serving
+    chip holds only its slice; None serves every shard from the default
+    device (the CPU test shape).
+
+    Thread contract: identical to :class:`ViewPublisher` — one writer
+    at a time (writer lock), :meth:`current` lock-free from any thread.
+    """
+
+    def __init__(
+        self,
+        n_shards: int,
+        min_publish_interval_s: float = 2.0,
+        devices=None,
+    ) -> None:
+        if n_shards < 1:
+            raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+        self.n_shards = int(n_shards)
+        self._lock = threading.Lock()
+        self._row_of: dict[str, int] | None = {}
+        self._ids: list[str] | None = []
+        self._devices = list(devices) if devices is not None else None
+        self._local_alloc = PATCH_BUCKET_FLOOR
+        self._staging = [
+            np.full(
+                (self._local_alloc + 1, TABLE_WIDTH), np.nan, np.float32
+            )
+            for _ in range(self.n_shards)
+        ]
+        self._view: ShardedRatingsView | None = None
+        self._version = 0
+        self.min_publish_interval_s = min_publish_interval_s
+        self._last_publish: float | None = None
+
+    # -- read side --------------------------------------------------------
+    def current(self) -> ShardedRatingsView | None:
+        """The latest published sharded view (None before the first
+        publish). One atomic reference read — never blocks, never tears
+        across shards."""
+        return self._view
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def view_age_s(self) -> float | None:
+        view = self._view
+        return None if view is None else view.age_s
+
+    def due(self) -> bool:
+        """Same throttle contract as :meth:`ViewPublisher.due`."""
+        return (
+            self._last_publish is None
+            or time.monotonic() - self._last_publish
+            >= self.min_publish_interval_s
+        )
+
+    # -- write side -------------------------------------------------------
+    def publish_rows(self, ids, rows) -> ShardedRatingsView:
+        """Id-merge publish (the service worker's commit boundary):
+        routes each id's row to its owner shard and patches only the
+        shards a commit touched — untouched shards carry their previous
+        device table forward with zero transfer."""
+        rows = np.asarray(rows, np.float32)
+        if (
+            rows.ndim != 2
+            or rows.shape[1] != TABLE_WIDTH
+            or len(ids) != rows.shape[0]
+        ):
+            raise ValueError(
+                f"publish_rows wants [n, {TABLE_WIDTH}] rows matching ids; "
+                f"got {rows.shape} for {len(ids)} ids"
+            )
+        with self._lock:
+            if self._row_of is None:
+                raise ValueError(
+                    "publisher is in table mode (publish_state with "
+                    "index-addressed rows); per-id merges need id-mapped "
+                    "publishes from the start"
+                )
+            prev = self._view
+            touched = np.empty(len(ids), np.int64)
+            for i, pid in enumerate(ids):
+                row = self._row_of.get(pid)
+                if row is None:
+                    row = len(self._ids)
+                    self._row_of[pid] = row
+                    self._ids.append(pid)
+                touched[i] = row
+            p = len(self._ids)
+            alloc = row_bucket(shard_player_count(p, 0, self.n_shards))
+            patchable = (
+                prev is not None and alloc == self._local_alloc
+            )
+            self._grow_local(alloc)
+            shard = shard_of_row(touched, self.n_shards)
+            local = local_of_row(touched, self.n_shards)
+            tables = []
+            for d in range(self.n_shards):
+                mine = shard == d
+                self._staging[d][local[mine]] = rows[mine]
+                if patchable and not mine.any():
+                    tables.append(prev.shards[d].table)  # zero transfer
+                elif patchable:
+                    tables.append(
+                        self._patch_shard(
+                            prev.shards[d].table, local[mine], rows[mine]
+                        )
+                    )
+                else:
+                    tables.append(self._rebuild_shard(d))
+            return self._swap(tables, p)
+
+    def publish_state(self, state, ids=None) -> ShardedRatingsView:
+        """Whole-table publish, split by interleaved ownership — the
+        topology-blind bootstrap (``cli serve --shards``, checkpoint
+        standbys, the sched runners' final snapshot)."""
+        table = getattr(state, "table", state)
+        host = np.asarray(table, np.float32)
+        p = host.shape[0] - 1
+        if ids is not None and len(ids) != p:
+            raise ValueError(f"{len(ids)} ids for a {p}-player table")
+        with self._lock:
+            if ids is None:
+                self._row_of = None
+                self._ids = None
+            else:
+                self._row_of = {pid: i for i, pid in enumerate(ids)}
+                self._ids = list(ids)
+            self._local_alloc = row_bucket(
+                shard_player_count(p, 0, self.n_shards)
+            )
+            tables = []
+            for d in range(self.n_shards):
+                self._staging[d] = np.full(
+                    (self._local_alloc + 1, TABLE_WIDTH), np.nan, np.float32
+                )
+                mine = host[:p][d :: self.n_shards]
+                self._staging[d][: mine.shape[0]] = mine
+                tables.append(self._rebuild_shard(d))
+            return self._swap(tables, p)
+
+    def maybe_publish_state(self, state, ids=None) -> ShardedRatingsView | None:
+        """Throttled :meth:`publish_state` (the sched-runner surface)."""
+        if not self.due():
+            return None
+        return self.publish_state(state, ids=ids)
+
+    def publish_shard_patches(
+        self, patches, n_players: int, full_slices
+    ) -> ShardedRatingsView:
+        """Table-mode INCREMENTAL publish from a writer that already
+        holds per-shard slices in shard-local order — the sharded mesh
+        runner (``parallel.mesh.ShardedRun``), whose routing names every
+        row each shard wrote since the last publish.
+
+        ``patches``: one ``(local_rows_idx, rows)`` pair per shard —
+        only those rows cross H2D, riding the per-shard patch kernel.
+        ``full_slices``: zero-arg callable producing per-shard
+        ``[>= local_n, 16]`` host slices in local row order — the
+        rebuild fallback (first publish, id-mapped publisher, bucket
+        growth), mirroring :meth:`ViewPublisher.publish_state_patch`."""
+        if len(patches) != self.n_shards:
+            raise ValueError(
+                f"{len(patches)} shard patches for a {self.n_shards}-shard "
+                "publisher"
+            )
+        with self._lock:
+            alloc = row_bucket(
+                shard_player_count(n_players, 0, self.n_shards)
+            )
+            prev = self._view
+            patchable = (
+                prev is not None
+                and self._row_of is None
+                and alloc == self._local_alloc
+                and prev.n_players <= n_players
+            )
+            if not patchable:
+                slices = full_slices()
+                self._row_of = None
+                self._ids = None
+                self._local_alloc = alloc
+                tables = []
+                for d in range(self.n_shards):
+                    ln = shard_player_count(n_players, d, self.n_shards)
+                    self._staging[d] = np.full(
+                        (alloc + 1, TABLE_WIDTH), np.nan, np.float32
+                    )
+                    self._staging[d][:ln] = np.asarray(
+                        slices[d], np.float32
+                    )[:ln]
+                    tables.append(self._rebuild_shard(d))
+                return self._swap(tables, n_players)
+            tables = []
+            for d, (idx, rows) in enumerate(patches):
+                idx = np.asarray(idx, np.int64)
+                rows = np.asarray(rows, np.float32)
+                self._staging[d][idx] = rows
+                if idx.size:
+                    tables.append(
+                        self._patch_shard(prev.shards[d].table, idx, rows)
+                    )
+                else:
+                    tables.append(prev.shards[d].table)
+            return self._swap(tables, n_players)
+
+    def warm_patch_buckets(self, cap_ids: int) -> int:
+        """The sharded mirror of
+        :meth:`ViewPublisher.warm_patch_buckets`: one publish per ladder
+        bucket — each carrying ``b`` ids PER SHARD so every shard's
+        patch bucket compiles — keeping the publish COUNT (and therefore
+        the version sequence a soak digests) identical to the
+        single-device plane's ladder."""
+        with self._lock:
+            ids = list(self._ids or [])
+            if not ids:
+                return 0
+            row_of = dict(self._row_of)
+            owned = [
+                [pid for pid in ids if shard_of_row(row_of[pid], self.n_shards) == d]
+                for d in range(self.n_shards)
+            ]
+            n = len(ids)
+            cap = _pow2_bucket(
+                min(int(cap_ids), max(n, 1)), PATCH_BUCKET_FLOOR
+            )
+            pages = []
+            b = PATCH_BUCKET_FLOOR
+            while b <= cap:
+                page = []
+                for mine in owned:
+                    if mine:
+                        page.extend(mine[i % len(mine)] for i in range(b))
+                rows = np.stack(
+                    [
+                        self._staging[shard_of_row(row_of[pid], self.n_shards)][
+                            local_of_row(row_of[pid], self.n_shards)
+                        ]
+                        for pid in page
+                    ]
+                )
+                pages.append((page, rows))
+                b *= 2
+        for page, rows in pages:
+            self.publish_rows(page, rows)
+        return len(pages)
+
+    # -- internals --------------------------------------------------------
+    def _device_of(self, d: int):
+        if self._devices is None:
+            return None
+        return self._devices[d % len(self._devices)]
+
+    def _patch_shard(self, prev_table, local_idx, rows):
+        """One shard's ``.at[rows].set`` patch, padded to the shared
+        pow2 bucket ladder (pad entries aim at the shard's pad row)."""
+        nb = _pow2_bucket(len(local_idx), PATCH_BUCKET_FLOOR)
+        idx = np.full(nb, self._local_alloc, np.int32)
+        idx[: len(local_idx)] = local_idx
+        pad_rows = np.full((nb, TABLE_WIDTH), np.nan, np.float32)
+        pad_rows[: len(local_idx)] = rows
+        _count_publish_bytes(idx.nbytes + pad_rows.nbytes)
+        return _patch_rows(prev_table, jnp.asarray(idx), jnp.asarray(pad_rows))
+
+    def _rebuild_shard(self, d: int):
+        """One shard's owning full-slice upload (jnp.array — see
+        :meth:`ViewPublisher.publish_rows` on aliasing), committed to
+        the shard's device when a device list was given."""
+        _count_publish_bytes(self._staging[d].nbytes)
+        dev = self._device_of(d)
+        if dev is None:
+            return jnp.array(self._staging[d])
+        return jax.device_put(np.ascontiguousarray(self._staging[d]), dev)
+
+    def _grow_local(self, alloc: int) -> None:
+        if alloc <= self._local_alloc:
+            return
+        for d in range(self.n_shards):
+            bigger = np.full((alloc + 1, TABLE_WIDTH), np.nan, np.float32)
+            bigger[: self._staging[d].shape[0] - 1] = self._staging[d][:-1]
+            self._staging[d] = bigger
+        self._local_alloc = alloc
+
+    def _swap(self, tables, n_players: int) -> ShardedRatingsView:
+        """Builds the next version — ALL shards under one number — and
+        swaps the single reference. Caller holds the writer lock."""
+        self._version += 1
+        shards = [
+            RatingsView(
+                self._version,
+                t,
+                shard_player_count(n_players, d, self.n_shards),
+                None,
+                None,
+            )
+            for d, t in enumerate(tables)
+        ]
+        view = ShardedRatingsView(
+            self._version, shards, n_players, self._row_of, self._ids
+        )
+        self._view = view
+        self._last_publish = time.monotonic()
+        reg = get_registry()
+        reg.gauge("serve.view_version").set(self._version)
+        reg.gauge("serve.view_age_seconds").set(0.0)
+        reg.gauge("serve.shards").set(self.n_shards)
         reg.counter("serve.view_publishes_total").add(1)
         return view

@@ -101,6 +101,7 @@ class Worker:
         flight_dir: str | None = None,
         serve_port: int | None = None,
         serve_host: str | None = None,
+        serve_shards: int | None = None,
     ) -> None:
         self.broker = broker
         self.store = store
@@ -209,13 +210,29 @@ class Worker:
         self.serve_server = None
         if serve_port is not None:
             from analyzer_tpu.obs.httpd import DEFAULT_HOST as LOOPBACK
-            from analyzer_tpu.serve import QueryEngine, ViewPublisher
+            from analyzer_tpu.serve import (
+                QueryEngine,
+                ShardedQueryEngine,
+                ShardedViewPublisher,
+                ViewPublisher,
+            )
             from analyzer_tpu.serve.server import ServeServer
 
-            self.view_publisher = ViewPublisher()
-            self.query_engine = QueryEngine(
-                self.view_publisher, cfg=self.rating_config
-            ).start()
+            # Topology is a constructor knob, not a caller concern: both
+            # planes satisfy the ServePlane protocol, so everything from
+            # _publish_view to /v1/* is identical either way — and the
+            # served numbers are bit-identical by the sharded engine's
+            # contract (tests/test_serve_sharded.py).
+            if serve_shards is not None and serve_shards > 1:
+                self.view_publisher = ShardedViewPublisher(serve_shards)
+                self.query_engine = ShardedQueryEngine(
+                    self.view_publisher, cfg=self.rating_config
+                ).start()
+            else:
+                self.view_publisher = ViewPublisher()
+                self.query_engine = QueryEngine(
+                    self.view_publisher, cfg=self.rating_config
+                ).start()
             self.serve_server = ServeServer(
                 self.query_engine,
                 port=serve_port,
@@ -1084,6 +1101,7 @@ def main(
     obs_port: int | None = None,
     flight_dir: str | None = None,
     serve_port: int | None = None,
+    serve_shards: int | None = None,
 ) -> Worker:
     """``python -m analyzer_tpu.service.worker`` — the reference's
     ``python3 worker.py`` entry point (``worker.py:219-221``), requiring a
@@ -1097,12 +1115,17 @@ def main(
     ``obs_port`` (or ``ANALYZER_TPU_OBS_PORT``) starts obsd;
     ``flight_dir`` (or ``ANALYZER_TPU_FLIGHT_DIR``) arms flight-recorder
     dumps; ``serve_port`` (or ``ANALYZER_TPU_SERVE_PORT``) starts the
-    ratesrv query-serving plane (docs/serving.md)."""
+    ratesrv query-serving plane (docs/serving.md); ``serve_shards`` (or
+    ``ANALYZER_TPU_SERVE_SHARDS``) > 1 serves through the sharded plane
+    (ShardedViewPublisher + ShardedQueryEngine — bit-identical results,
+    docs/serving.md "Sharded plane")."""
     config = ServiceConfig.from_env()
     if obs_port is None and os.environ.get("ANALYZER_TPU_OBS_PORT"):
         obs_port = int(os.environ["ANALYZER_TPU_OBS_PORT"])
     if serve_port is None and os.environ.get("ANALYZER_TPU_SERVE_PORT"):
         serve_port = int(os.environ["ANALYZER_TPU_SERVE_PORT"])
+    if serve_shards is None and os.environ.get("ANALYZER_TPU_SERVE_SHARDS"):
+        serve_shards = int(os.environ["ANALYZER_TPU_SERVE_SHARDS"])
     from analyzer_tpu.service.broker import make_pika_broker
 
     # Sequential mode: prefetch_count=BATCHSIZE bounds in-flight messages
@@ -1124,7 +1147,7 @@ def main(
         store = InMemoryStore()
     worker = Worker(
         broker, store, config, obs_port=obs_port, flight_dir=flight_dir,
-        serve_port=serve_port,
+        serve_port=serve_port, serve_shards=serve_shards,
     )
     worker.warmup()  # compile before consuming: no first-batch stall
     try:

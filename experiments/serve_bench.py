@@ -18,13 +18,27 @@ batched phase — both pinned in the emitted telemetry block, sourced
 from the obs retrace counters (``obs/retrace.py`` hooks installed
 before the first compile).
 
+The SHARDED phase (ISSUE 9) re-runs the batched workload through a
+``ShardedQueryEngine`` over ``--shards`` per-shard views and emits a
+``sharded`` block: shards, queries/sec, the shard-plane tax
+``min_over_single`` (sharded batched seconds / single batched seconds,
+lower is better — ~S dispatches per tick on one device, approaching
+1.0 as shards spread over real chips), the leaderboard merge overhead
+(per-shard top-k + host merge vs the single dispatch, uncached), the
+sharded phase's steady retraces (zero per shard once warmed), and a
+``bit_identical_to_single`` sample check. ``cli benchdiff --family
+serve`` gates ``sharded.min_over_single`` and fails a candidate whose
+sharded block vanished (a silent fall-back to the single-device
+plane). ``--shards 0`` skips the phase (the explicit opt-out the gate
+will then flag against a baseline that had one).
+
 Output: one JSON line on stdout (the ``SERVE_BENCH`` artifact;
 ``--out`` also writes it to a file for ``cli benchdiff --family
 serve``).
 
 Usage:
     python experiments/serve_bench.py [--players 100000]
-        [--queries 5000] [--out SERVE_BENCH_rNN.json]
+        [--queries 5000] [--shards 8] [--out SERVE_BENCH_rNN.json]
 """
 
 from __future__ import annotations
@@ -42,11 +56,18 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from analyzer_tpu.config import RatingConfig
 from analyzer_tpu.core.state import MU_LO, SIGMA_LO, PlayerState
 from analyzer_tpu.obs import get_registry, install_jax_hooks
-from analyzer_tpu.serve import QueryEngine, ViewPublisher
+from analyzer_tpu.serve import (
+    QueryEngine,
+    ShardedQueryEngine,
+    ShardedViewPublisher,
+    ViewPublisher,
+)
 
 
-def build_view(publisher: ViewPublisher, n_players: int, seed: int):
-    """A fully-rated synthetic table published as version 1."""
+def build_table(n_players: int, seed: int):
+    """One fully-rated synthetic host table + id list + config — shared
+    verbatim by the single-device and sharded phases, so the sharded
+    bit-identity sample compares the same published rows."""
     rng = np.random.default_rng(seed)
     cfg = RatingConfig()
     state = PlayerState.create(
@@ -60,7 +81,7 @@ def build_view(publisher: ViewPublisher, n_players: int, seed: int):
         60.0, 600.0, n_players
     ).astype(np.float32)
     ids = [f"p{i}" for i in range(n_players)]
-    return publisher.publish_rows(ids, table[:n_players]), cfg
+    return table, ids, cfg
 
 
 def gen_matchups(n_players: int, count: int, seed: int):
@@ -83,6 +104,91 @@ def quantile(xs, q: float):
     return xs[min(len(xs) - 1, max(0, round(q * (len(xs) - 1))))]
 
 
+def run_batched(engine, payloads) -> float:
+    """Floods ``payloads`` through the tick thread; returns wall seconds
+    (the engine is started and closed here — one steady-state phase)."""
+    engine.start()
+    t0 = time.perf_counter()
+    pendings = [engine.submit("winprob", p) for p in payloads]
+    for p in pendings:
+        p.result(timeout=120.0)
+    dt = time.perf_counter() - t0
+    engine.close()
+    return dt
+
+
+def leaderboard_ms(engine, k: int, reps: int = 5) -> float:
+    """Best-of-``reps`` UNCACHED leaderboard milliseconds — the cache is
+    cleared each rep so the sharded number prices the per-shard top-k
+    dispatches PLUS the host merge, not a cache hit."""
+    best = None
+    for _ in range(reps):
+        engine._lb_cache = None
+        t0 = time.perf_counter()
+        engine.query_now("leaderboard", k)
+        dt = (time.perf_counter() - t0) * 1e3
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def sharded_phase(
+    args, table, ids, cfg, reg, single_batched_s: float,
+    single_lb_ms: float, single_engine,
+) -> dict:
+    """The sharded plane measured on the single plane's exact workload,
+    plus a response-level bit-identity sample against ``single_engine``
+    (the CPU half of the acceptance contract; the full matrix lives in
+    tests/test_serve_sharded.py)."""
+    publisher = ShardedViewPublisher(args.shards)
+    publisher.publish_rows(ids, table[: args.players])
+    engine = ShardedQueryEngine(
+        publisher, cfg=cfg, max_batch=args.max_batch
+    )
+    t0 = time.perf_counter()
+    engine.warmup()
+    t_warm = time.perf_counter() - t0
+
+    retraces_before = reg.counter("jax.retraces_total").value
+    batched_q = gen_matchups(args.players, args.queries, args.seed + 2)
+    t_batched = run_batched(engine, batched_q)
+    lb_ms = leaderboard_ms(engine, k=100)
+    steady = reg.counter("jax.retraces_total").value - retraces_before
+
+    # Response-level sample parity: every kind, same payloads both ways.
+    sample = gen_matchups(args.players, 16, args.seed + 3)
+    identical = all(
+        engine.query_now("winprob", p) == single_engine.query_now("winprob", p)
+        for p in sample
+    )
+    identical = identical and (
+        engine.query_now("leaderboard", 50)
+        == single_engine.query_now("leaderboard", 50)
+    )
+    identical = identical and (
+        engine.query_now("tiers") == single_engine.query_now("tiers")
+    )
+    qps = args.queries / t_batched if t_batched > 0 else 0.0
+    return {
+        "shards": args.shards,
+        "queries_per_sec": round(qps, 1),
+        "min_over_single": (
+            round(t_batched / single_batched_s, 3)
+            if single_batched_s > 0 else None
+        ),
+        "merge": {
+            "leaderboard_ms": round(lb_ms, 3),
+            "leaderboard_single_ms": round(single_lb_ms, 3),
+            "overhead_ms": round(lb_ms - single_lb_ms, 3),
+        },
+        "warmup_s": round(t_warm, 3),
+        "steady_retraces": steady,
+        "bit_identical_to_single": identical,
+        # A retraced or divergent sharded phase is not a comparable
+        # capture — benchdiff treats unstable like degraded (no gate).
+        "stable": bool(steady == 0 and identical),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--players", type=int, default=100_000)
@@ -92,6 +198,11 @@ def main() -> int:
                     help="naive-baseline winprob queries")
     ap.add_argument("--max-batch", type=int, default=256)
     ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument(
+        "--shards", type=int, default=8,
+        help="sharded-plane phase width (0 skips the phase — the "
+        "benchdiff gate will flag the vanished block)",
+    )
     ap.add_argument("--out", help="also write the artifact to this path")
     args = ap.parse_args()
 
@@ -100,9 +211,10 @@ def main() -> int:
     install_jax_hooks()
     reg = get_registry()
 
-    publisher = ViewPublisher()
     t0 = time.perf_counter()
-    view, cfg = build_view(publisher, args.players, args.seed)
+    table, ids, cfg = build_table(args.players, args.seed)
+    publisher = ViewPublisher()
+    view = publisher.publish_rows(ids, table[: args.players])
     t_build = time.perf_counter() - t0
     engine = QueryEngine(publisher, cfg=cfg, max_batch=args.max_batch)
 
@@ -140,6 +252,14 @@ def main() -> int:
         "serve.microbatch_occupancy", kind="winprob"
     ).summary()
 
+    # -- sharded plane: same workload through per-shard views ------------
+    single_lb_ms = leaderboard_ms(engine, k=100)
+    sharded = None
+    if args.shards > 0:
+        sharded = sharded_phase(
+            args, table, ids, cfg, reg, t_batched, single_lb_ms, engine
+        )
+
     steady_retraces = retraces_after - retraces_before
     speedup = qps / naive_qps if naive_qps > 0 else None
     line = {
@@ -160,6 +280,7 @@ def main() -> int:
         "occupancy": {
             "mean": occ["mean"], "p50": occ["p50"], "p99": occ["p99"],
         },
+        "sharded": sharded,
         "phases": {
             "build_s": round(t_build, 3),
             "warmup_s": round(t_warm, 3),

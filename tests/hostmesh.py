@@ -1,0 +1,70 @@
+"""Reusable forced-host-device platform helpers for tests.
+
+The test session itself already runs on a virtual 8-device CPU mesh
+(``conftest.py`` sets ``--xla_force_host_platform_device_count=8``
+before the first jax import), but some contracts need a FRESH
+interpreter with its own device topology — the sitecustomize platform
+pin means env vars alone are not enough mid-process, so "N devices" is
+a subprocess-shaped requirement. The mesh tests used to roll this ad
+hoc (``multihost_worker.py``); these helpers are the shared version:
+
+  * :func:`scrubbed_env` — ``os.environ`` minus the harness's XLA/JAX
+    pins (so a child process starts from a clean platform slate), with
+    the repo on ``PYTHONPATH`` and, when ``n_devices`` is given, the
+    forced-host-device flags re-applied at the requested width;
+  * :func:`run_forced_host` — run a code snippet in a fresh interpreter
+    on an N-device forced-host CPU platform and return the completed
+    process (callers assert on ``returncode``/``stdout``).
+
+Used by ``tests/test_serve_sharded.py`` (the sharded serve plane's
+standalone-platform check) and ``tests/test_multihost.py`` (the
+2-process cluster's env scrub).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def scrubbed_env(
+    n_devices: int | None = None, extra: dict | None = None
+) -> dict:
+    """A child-process environment with the harness's platform pins
+    removed. ``n_devices`` re-applies the forced-host CPU platform at
+    that width; ``extra`` merges last (caller wins)."""
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if n_devices is not None:
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={n_devices}"
+        )
+        env["JAX_PLATFORMS"] = "cpu"
+    if extra:
+        env.update(extra)
+    return env
+
+
+def run_forced_host(
+    code: str, n_devices: int = 8, timeout: float = 300.0
+) -> subprocess.CompletedProcess:
+    """Runs ``code`` with ``python -c`` on a fresh ``n_devices``-wide
+    forced-host CPU platform. The snippet should re-pin the platform
+    through the live config (``jax.config.update("jax_platforms",
+    "cpu")``) right after importing jax, mirroring ``conftest.py`` —
+    the environment's sitecustomize may import jax first."""
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO,
+        env=scrubbed_env(n_devices=n_devices),
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )

@@ -1053,11 +1053,16 @@ class TestGL027TableTransferContainment:
     def test_silent_in_tier_manager_view_publisher_and_tests(self):
         for path in (
             "analyzer_tpu/sched/tier.py",
-            "analyzer_tpu/serve/view.py",
             "tests/test_tier.py",
             "test_snippet.py",
         ):
             assert rules_of(self.SRC, path) == [], path
+        # serve/view.py is a GL027 home, but the same transfer outside
+        # the plane's DESIGNATED merge helpers is GL029's business —
+        # the serve layer answers to the stricter cross-shard rule.
+        assert rules_of(self.SRC, "analyzer_tpu/serve/view.py") == [
+            "GL029", "GL029",
+        ]
 
     def test_non_table_values_are_fine(self):
         # The needle is the *table* name: slab/batch transfers are the
@@ -1223,3 +1228,90 @@ class TestGL028SoakDeterminism:
         from analyzer_tpu.lint.findings import RULES
 
         assert "GL028" in RULES
+
+
+class TestGL029CrossShardGather:
+    """GL029 bans whole-table host round-trips in ``analyzer_tpu/serve/``
+    outside the designated merge helpers — once the serving plane is
+    sharded, a per-query ``jax.device_get`` / table-valued transfer is
+    exactly the cross-shard reassembly the routed microbatches exist to
+    kill (docs/serving.md "Sharded plane")."""
+
+    GATHER_SRC = """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    def _run_leaderboard(view):
+        host = np.asarray(view.table)
+        whole = jax.device_get(view.shards)
+        again = np.array(view.table)
+        staged = jnp.array(host_table)
+        up = jax.device_put(full_table)
+        return host, whole, again, staged, up
+    """
+
+    def test_round_trips_fire_in_serve(self):
+        # GL027 (whole-table transfer outside its homes) legitimately
+        # co-fires on the jnp.array/device_put lines — count GL029 only.
+        rules = rules_of(self.GATHER_SRC, "analyzer_tpu/serve/engine.py")
+        assert rules.count("GL029") == 5, rules
+
+    def test_silent_outside_serve(self):
+        for path in (
+            "analyzer_tpu/sched/runner.py",
+            "analyzer_tpu/parallel/mesh.py",
+            "experiments/serve_bench.py",
+            "snippet.py",
+        ):
+            assert "GL029" not in rules_of(self.GATHER_SRC, path), path
+
+    def test_tests_exempt(self):
+        assert "GL029" not in rules_of(
+            self.GATHER_SRC, "tests/test_serve_sharded.py"
+        )
+
+    def test_designated_merge_helpers_exempt(self):
+        src = """
+        import numpy as np
+        import jax
+
+        def host_table(self):
+            return np.asarray(self.table)
+
+        def _stacked_tables(self, view):
+            return jax.device_get(view.shards)
+
+        def publish_state(self, state):
+            table = getattr(state, "table", state)
+            return np.asarray(table, np.float32)
+        """
+        assert rules_of(src, "analyzer_tpu/serve/view.py") == []
+
+    def test_microbatch_gathers_are_fine(self):
+        # The sanctioned shape: a padded per-shard kernel result crossing
+        # D2H — the argument is a call, not a table value.
+        src = """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def _sharded_gather(shard, idx):
+            rows = np.asarray(_gather_rows(shard.table, jnp.asarray(idx)))
+            return rows
+        """
+        assert rules_of(src, "analyzer_tpu/serve/engine.py") == []
+
+    def test_disable_escape(self):
+        src = """
+        import jax
+
+        def debug_dump(view):
+            # graftlint: disable=GL029 — operator debug dump, not a query path
+            return jax.device_get(view.shards)
+        """
+        assert rules_of(src, "analyzer_tpu/serve/engine.py") == []
+
+    def test_catalog_has_gl029(self):
+        from analyzer_tpu.lint.findings import RULES
+
+        assert "GL029" in RULES

@@ -534,3 +534,42 @@ class TestBrokerQueueDepth:
         assert "broker.queue_depth" in STANDARD_GAUGES
         assert "soak.qps_target" in STANDARD_GAUGES
         assert "soak.virtual_seconds" in STANDARD_GAUGES
+
+
+class TestShardedSoak:
+    """ISSUE 9: the closed loop against the SHARDED serve plane. The
+    deterministic block must be bit-identical to the single-device run
+    for the same (seed, config) — routed lookups, per-shard publishes
+    and the distributed top-k change the topology, never the bits."""
+
+    def _run(self, serve_shards: int) -> dict:
+        cfg = SoakConfig(**{
+            **{f.name: getattr(SMOKE, f.name)
+               for f in SMOKE.__dataclass_fields__.values()},
+            "serve_shards": serve_shards,
+        })
+        driver = SoakDriver(cfg)
+        try:
+            if serve_shards > 1:
+                from analyzer_tpu.serve import (
+                    ShardedQueryEngine, ShardedViewPublisher,
+                )
+
+                assert isinstance(
+                    driver.worker.query_engine, ShardedQueryEngine
+                )
+                assert isinstance(
+                    driver.worker.view_publisher, ShardedViewPublisher
+                )
+            return driver.run()
+        finally:
+            driver.close()
+
+    def test_sharded_smoke_bit_identical_to_single(self, smoke_artifacts):
+        single = smoke_artifacts[0]
+        sharded = self._run(serve_shards=4)
+        assert sharded["slo"]["pass"], sharded["slo"]["violations"]
+        assert sharded["deterministic"]["retraces_steady"] == 0
+        assert json.dumps(
+            sharded["deterministic"], sort_keys=True
+        ) == json.dumps(single["deterministic"], sort_keys=True)

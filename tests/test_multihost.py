@@ -15,7 +15,8 @@ import socket
 import subprocess
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from tests.hostmesh import REPO, scrubbed_env
+
 WORKER = os.path.join(REPO, "tests", "multihost_worker.py")
 
 
@@ -28,12 +29,9 @@ def _free_port() -> int:
 class TestTwoProcessCluster:
     def test_sharded_rate_bit_identical_across_processes(self):
         coordinator = f"127.0.0.1:{_free_port()}"
-        env = {
-            k: v
-            for k, v in os.environ.items()
-            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
-        }
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        # The shared forced-host helper owns the env scrub (the worker
+        # script pins its own 2-device XLA_FLAGS, so no n_devices here).
+        env = scrubbed_env()
         procs = [
             subprocess.Popen(
                 [sys.executable, WORKER, coordinator, str(i)],

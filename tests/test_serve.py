@@ -799,3 +799,62 @@ class TestStatsServeKeys:
         assert s["view_version"] == 1 and s["queries_total"] == 0
         eng.leaderboard(2)
         assert eng.stats()["queries_total"] == 1
+
+
+class TestPublishTransferBytes:
+    """The ISSUE-9 bugfix pin: ``publish_state_patch`` must keep a GROWN
+    ``n_players`` (same row bucket) on the patch path — the old
+    ``prev.n_players == n_players`` guard forced a full-table rebuild
+    (re-uploading the whole staging buffer, id map and all) for every
+    append, when index-addressed appends are just patches past the
+    previous view's ``n_players``. Pinned via the
+    ``serve.view_publish_bytes_total`` H2D accounting."""
+
+    def _bootstrap(self, pub, n_players, table):
+        full = np.full(
+            (n_players + 1, 16), np.nan, np.float32
+        )
+        full[:n_players] = table[:n_players]
+        return pub.publish_state_patch(
+            np.empty(0, np.int64), np.empty((0, 16), np.float32),
+            n_players, lambda: full,
+        )
+
+    def test_append_within_bucket_rides_patch_path(self):
+        from analyzer_tpu.serve.view import PATCH_BUCKET_FLOOR, _pow2_bucket
+
+        table = rated_table(500, 500, seed=6)
+        pub = ViewPublisher()
+        v1 = self._bootstrap(pub, 400, table)  # rebuild: full upload
+        counter = get_registry().counter("serve.view_publish_bytes_total")
+        before = counter.value
+        # Grow 400 -> 404 players WITHIN bucket 512: three patched rows
+        # + four appended rows, all index-addressed.
+        idx = np.asarray([2, 7, 11, 400, 401, 402, 403], np.int64)
+        v2 = pub.publish_state_patch(idx, table[idx], 404, lambda: 1 / 0)
+        nb = _pow2_bucket(len(idx), PATCH_BUCKET_FLOOR)
+        patch_bytes = nb * 4 + nb * 16 * 4  # int32 idx + float32 rows
+        assert counter.value - before == patch_bytes
+        # NOT the full staging buffer (the old rebuild cost).
+        assert patch_bytes < pub._staging.nbytes
+        assert v2.version == 2 and v2.n_players == 404
+        host = v2.host_table()
+        np.testing.assert_array_equal(host[:404], pub._staging[:404])
+        # The appended rows resolve at v2 and stay invisible to v1.
+        assert v2.resolve("403") == 403
+        assert v1.resolve("403") is None
+
+    def test_bucket_growth_still_rebuilds(self):
+        table = rated_table(200, 200, seed=6)
+        pub = ViewPublisher()
+        self._bootstrap(pub, 60, table)  # bucket 64
+        counter = get_registry().counter("serve.view_publish_bytes_total")
+        before = counter.value
+        full = np.full((129, 16), np.nan, np.float32)
+        full[:100] = table[:100]
+        v2 = pub.publish_state_patch(
+            np.empty(0, np.int64), np.empty((0, 16), np.float32),
+            100, lambda: full,
+        )  # 100 players -> bucket 128: the rebuild fallback is correct
+        assert v2.table.shape[0] == 129
+        assert counter.value - before == pub._staging.nbytes

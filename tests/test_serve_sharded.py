@@ -1,0 +1,750 @@
+"""Sharded serving plane (ISSUE 9): per-shard views, routed lookups,
+distributed top-k.
+
+The acceptance contract: every :class:`ShardedQueryEngine` response is
+BIT-IDENTICAL to the single-device :class:`QueryEngine`'s and to the
+pure-Python oracle's for every query kind on the virtual 8-device CPU
+mesh — including leaderboard tie-breaks that span shard boundaries —
+with zero steady-state retraces per shard, one monotone version number
+across all shards (no torn cross-shard reads), and the mesh runner's
+``view_publisher=`` wiring publishing per-shard patches at chunk
+boundaries. The forced-host-device subprocess check rides the shared
+``tests/hostmesh.py`` helpers.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from analyzer_tpu.config import RatingConfig, ServiceConfig
+from analyzer_tpu.core.state import MU_LO, SIGMA_LO, PlayerState
+from analyzer_tpu.obs import get_registry, reset_registry
+from analyzer_tpu.obs.retrace import retrace_counts
+from analyzer_tpu.serve import (
+    QueryEngine,
+    ServePlane,
+    ShardedQueryEngine,
+    ShardedViewPublisher,
+    UnknownPlayerError,
+    ViewPublisher,
+)
+from analyzer_tpu.serve import oracle
+from analyzer_tpu.serve.server import ServeServer
+from analyzer_tpu.serve.view import (
+    PATCH_BUCKET_FLOOR,
+    _pow2_bucket,
+    local_of_row,
+    shard_of_row,
+    shard_player_count,
+)
+from analyzer_tpu.service import InMemoryBroker, InMemoryStore, Worker
+from tests.hostmesh import run_forced_host
+from tests.test_serve import http_get, mk_match, rated_table
+
+CFG = RatingConfig()
+
+_NO_SHARD_MAP = not hasattr(jax, "shard_map")
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    reset_registry()
+    yield
+    reset_registry()
+
+
+def publish_pair(n_players=60, n_rated=45, seed=0, n_shards=4, table=None):
+    """The same rows published through BOTH planes — the comparison rig
+    every parity test drives."""
+    if table is None:
+        table = rated_table(n_players, n_rated, seed)
+    ids = [f"p{i}" for i in range(n_players)]
+    pub1 = ViewPublisher()
+    pubS = ShardedViewPublisher(n_shards)
+    v1 = pub1.publish_rows(ids, table)
+    vS = pubS.publish_rows(ids, table)
+    return pub1, pubS, v1, vS, ids, table
+
+
+def tied_table(n_players=40, n_shards=4, seed=5) -> np.ndarray:
+    """A rated table with exact score ties pinned on rows owned by
+    DIFFERENT shards (rows 3, 6, 9, 13 -> shards 3, 2, 1, 1 at S=4), so
+    the merge's tie-break is exercised across shard boundaries."""
+    table = rated_table(n_players, n_players, seed)
+    for row in (3, 6, 9, 13):
+        table[row, MU_LO] = np.float32(1987.5)
+        table[row, SIGMA_LO] = np.float32(12.25)
+    return table
+
+
+class TestShardRouting:
+    """The serve plane's routing MUST agree with the write mesh's
+    interleaved ownership — these pins tie serve/view.py to
+    parallel/mesh.py's layout helpers."""
+
+    def test_matches_mesh_owner_helpers(self):
+        from analyzer_tpu.parallel.mesh import _local_row, _owner
+
+        rows = np.arange(1000, dtype=np.int64)
+        for s in (1, 2, 3, 4, 8):
+            np.testing.assert_array_equal(
+                shard_of_row(rows, s), np.asarray(_owner(rows, s))
+            )
+            np.testing.assert_array_equal(
+                local_of_row(rows, s), np.asarray(_local_row(rows, s))
+            )
+
+    def test_shard_player_count_partitions_exactly(self):
+        for n in (0, 1, 7, 64, 100, 1001):
+            for s in (1, 2, 4, 8):
+                counts = [shard_player_count(n, d, s) for d in range(s)]
+                assert sum(counts) == n
+                for d in range(s):
+                    assert counts[d] == sum(
+                        1 for r in range(n) if shard_of_row(r, s) == d
+                    )
+
+    def test_locate_routes_by_ownership(self):
+        _pub1, _pubS, _v1, vS, _ids, _table = publish_pair()
+        for row in (0, 1, 7, 42, 59):
+            shard, local = vS.locate(f"p{row}")
+            assert shard == row % 4 and local == row // 4
+        assert vS.locate("ghost") is None
+
+
+class TestShardedViewPublisher:
+    def test_one_version_spans_all_shards(self):
+        _pub1, pubS, _v1, vS, ids, table = publish_pair()
+        assert vS.version == 1
+        assert all(s.version == 1 for s in vS.shards)
+        v2 = pubS.publish_rows(ids[:3], table[:3])
+        assert v2.version == 2
+        assert all(s.version == 2 for s in v2.shards)
+
+    def test_host_table_matches_single_plane(self):
+        _pub1, _pubS, v1, vS, _ids, _table = publish_pair()
+        np.testing.assert_array_equal(
+            vS.host_table(), v1.host_table()[: v1.n_players]
+        )
+
+    def test_untouched_shards_carry_tables_forward(self):
+        _pub1, pubS, _v1, vS, ids, table = publish_pair()
+        # Rows owned by shard 0 only (row % 4 == 0).
+        mine = [i for i in range(60) if i % 4 == 0][:5]
+        v2 = pubS.publish_rows([f"p{i}" for i in mine], table[mine])
+        assert v2.shards[0].table is not vS.shards[0].table
+        for d in (1, 2, 3):
+            # Zero transfer: the untouched shard's DEVICE table rides
+            # into the next version by reference.
+            assert v2.shards[d].table is vS.shards[d].table
+
+    def test_shared_local_bucket_and_growth_rebuilds(self):
+        pub1, pubS, v1, vS, ids, table = publish_pair()
+        # 60 players / 4 shards = 15 local rows -> shared bucket 64.
+        assert all(s.table.shape[0] == 65 for s in vS.shards)
+        extra = rated_table(200, 200, seed=8)
+        eids = [f"x{i}" for i in range(200)]
+        v2 = pubS.publish_rows(eids, extra)
+        # 260 players -> ceil(260/4)=65 local rows -> bucket 128.
+        assert all(s.table.shape[0] == 129 for s in v2.shards)
+        pub1.publish_rows(eids, extra)
+        np.testing.assert_array_equal(
+            v2.host_table(), pub1.current().host_table()[:260]
+        )
+        # The old version's shards are untouched by the growth.
+        assert all(s.table.shape[0] == 65 for s in vS.shards)
+
+    def test_mode_and_shape_validation(self):
+        pub = ShardedViewPublisher(4)
+        state = PlayerState.create(10, cfg=CFG)
+        pub.publish_state(state)  # identity mode
+        with pytest.raises(ValueError, match="table mode"):
+            pub.publish_rows(["a"], rated_table(1, 1))
+        with pytest.raises(ValueError):
+            ShardedViewPublisher(0)
+        with pytest.raises(ValueError):
+            ShardedViewPublisher(4).publish_rows(
+                ["a", "b"], np.zeros((1, 16), np.float32)
+            )
+
+    def test_publish_state_splits_by_interleaved_ownership(self):
+        table = rated_table(30, 22, seed=3)
+        state = PlayerState.create(30, cfg=CFG)
+        host = np.asarray(state.table).copy()
+        host[:30] = table
+        stateish = type("S", (), {"table": host})()
+        pubS = ShardedViewPublisher(4)
+        vS = pubS.publish_state(stateish)
+        for d, shard in enumerate(vS.shards):
+            expect = table[d::4]
+            np.testing.assert_array_equal(
+                shard.host_table()[: expect.shape[0]], expect
+            )
+        np.testing.assert_array_equal(vS.host_table(), table)
+
+    def test_publish_shard_patches_patch_equals_rebuild(self):
+        table = rated_table(60, 60, seed=2)
+        pubS = ShardedViewPublisher(4)
+
+        def slices():
+            return [table[d::4] for d in range(4)]
+
+        v1 = pubS.publish_shard_patches(
+            [(np.empty(0, np.int64), np.empty((0, 16), np.float32))] * 4,
+            60,
+            slices,
+        )  # first publish: rebuild fallback
+        np.testing.assert_array_equal(v1.host_table(), table)
+        table2 = table.copy()
+        table2[[5, 9, 17], MU_LO] += np.float32(3.0)
+        patches = []
+        for d in range(4):
+            rows_idx = np.asarray(
+                [r // 4 for r in (5, 9, 17) if r % 4 == d], np.int64
+            )
+            patches.append((rows_idx, table2[d::4][rows_idx]))
+        v2 = pubS.publish_shard_patches(patches, 60, lambda: 1 / 0)
+        assert v2.version == 2
+        np.testing.assert_array_equal(v2.host_table(), table2)
+        # v1 froze: the patch never mutated the previous version.
+        np.testing.assert_array_equal(v1.host_table(), table)
+
+    def test_shard_patch_transfer_bytes_are_per_shard_buckets(self):
+        table = rated_table(60, 60, seed=2)
+        pubS = ShardedViewPublisher(4)
+        pubS.publish_shard_patches(
+            [(np.empty(0, np.int64), np.empty((0, 16), np.float32))] * 4,
+            60,
+            lambda: [table[d::4] for d in range(4)],
+        )
+        counter = get_registry().counter("serve.view_publish_bytes_total")
+        before = counter.value
+        patches = []
+        for d in range(4):
+            rows_idx = np.asarray([0, 1], np.int64) if d < 2 else np.empty(
+                0, np.int64
+            )
+            patches.append((rows_idx, table[d::4][rows_idx]))
+        pubS.publish_shard_patches(patches, 60, lambda: 1 / 0)
+        nb = _pow2_bucket(2, PATCH_BUCKET_FLOOR)
+        per_shard = nb * 4 + nb * 16 * 4  # int32 idx + float32 rows
+        # Two shards patched, two carried forward with ZERO transfer.
+        assert counter.value - before == 2 * per_shard
+
+    def test_torn_read_absence_under_concurrent_publishes(self):
+        """mu encodes the version on every row; any reader-visible view
+        mixing shard tables from two publishes would decode two
+        different versions inside one ShardedRatingsView."""
+        n = 48
+        ids = [f"p{i}" for i in range(n)]
+        base = np.asarray(PlayerState.create(n, cfg=CFG).table).copy()[:n]
+        pubS = ShardedViewPublisher(4)
+
+        def rows_for(v: int) -> np.ndarray:
+            rows = base.copy()
+            rows[:, MU_LO] = np.float32(1000.0 * v) + np.arange(
+                n, dtype=np.float32
+            )
+            rows[:, SIGMA_LO] = np.float32(50.0)
+            return rows
+
+        pubS.publish_rows(ids, rows_for(1))
+        stop = threading.Event()
+        failures: list = []
+
+        def writer():
+            for v in range(2, 30):
+                pubS.publish_rows(ids, rows_for(v))
+            stop.set()
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    view = pubS.current()
+                    v = view.version
+                    for d, shard in enumerate(view.shards):
+                        host = shard.host_table()
+                        for j in range(shard.n_players):
+                            got = float(host[j, MU_LO])
+                            expect = 1000.0 * v + (j * 4 + d)
+                            assert got == expect, (
+                                "torn cross-shard read", v, d, j, got
+                            )
+            except BaseException as err:  # noqa: BLE001 — surfaced below
+                failures.append(err)
+
+        readers = [threading.Thread(target=reader) for _ in range(3)]
+        wt = threading.Thread(target=writer)
+        for t in readers:
+            t.start()
+        wt.start()
+        wt.join(timeout=60)
+        stop.set()
+        for t in readers:
+            t.join(timeout=60)
+        assert not failures, failures[0]
+        assert pubS.version == 29
+
+    def test_warm_patch_buckets_parity_with_single_plane(self):
+        pub1, pubS, _v1, _vS, _ids, _table = publish_pair()
+        n1 = pub1.warm_patch_buckets(512)
+        nS = pubS.warm_patch_buckets(512)
+        # Same ladder length -> same publish count -> same version
+        # sequence for a soak, whatever the plane topology.
+        assert n1 == nS > 0
+        assert pub1.version == pubS.version
+
+
+class TestShardedEngineParity:
+    """The acceptance core: bit-identity across planes and vs oracle."""
+
+    @pytest.mark.parametrize("n_shards", [1, 2, 4, 8])
+    def test_every_query_kind_bit_identical(self, n_shards):
+        pub1, pubS, v1, vS, ids, table = publish_pair(n_shards=n_shards)
+        e1 = QueryEngine(pub1, cfg=CFG)
+        eS = ShardedQueryEngine(pubS, cfg=CFG)
+        host = vS.host_table()
+        assert e1.get_ratings(["p2", "p50", "ghost"]) == eS.get_ratings(
+            ["p2", "p50", "ghost"]
+        )
+        rng = np.random.default_rng(7)
+        for _ in range(10):
+            na, nb = rng.integers(1, 6), rng.integers(1, 6)
+            picks = rng.choice(60, na + nb, replace=False)
+            a = [f"p{i}" for i in picks[:na]]
+            b = [f"p{i}" for i in picks[na:]]
+            r1 = e1.win_probability(a, b)
+            rS = eS.win_probability(a, b)
+            assert r1 == rS
+            rows_a = [int(i) for i in picks[:na]]
+            rows_b = [int(i) for i in picks[na:]]
+            assert np.float32(rS["p_a"]) == oracle.win_probability(
+                host, rows_a, rows_b, CFG.beta2
+            )
+            assert np.float32(rS["quality"]) == oracle.quality(
+                host, rows_a, rows_b, CFG.beta2
+            )
+        for k in (1, 5, 44, 45, 60):
+            l1 = e1.leaderboard(k)
+            lS = eS.leaderboard(k)
+            assert l1 == lS
+            exp = oracle.leaderboard(host, vS.n_players, k)
+            assert len(lS["leaders"]) == len(exp)
+            for lead, (row, score) in zip(lS["leaders"], exp):
+                assert lead["id"] == f"p{row}"
+                assert np.float32(lead["conservative"]) == score
+                assert np.float32(lead["mu"]) == np.float32(host[row, MU_LO])
+        t1, tS = e1.tier_histogram(), eS.tier_histogram()
+        assert t1 == tS
+        counts, rated = oracle.tier_histogram(host, 60, eS.tier_edges)
+        assert tS["counts"] == counts and tS["rated"] == rated
+        for score in (-3000.0, 0.0, 612.25, 5000.0):
+            p1, pS = e1.percentile(score), eS.percentile(score)
+            assert p1 == pS
+            below, rated = oracle.percentile(host, 60, score)
+            assert pS["below"] == below and pS["rated"] == rated
+
+    def test_cross_shard_tie_break_matches_topk_and_oracle(self):
+        table = tied_table(n_players=40, n_shards=4)
+        pub1, pubS, _v1, vS, _ids, _table = publish_pair(
+            n_players=40, n_rated=40, n_shards=4, table=table
+        )
+        e1 = QueryEngine(pub1, cfg=CFG)
+        eS = ShardedQueryEngine(pubS, cfg=CFG)
+        l1 = e1.leaderboard(40)
+        lS = eS.leaderboard(40)
+        assert l1 == lS
+        # The tied rows (3, 6, 9, 13) live on shards 3, 2, 1, 1 — the
+        # merge must order them by GLOBAL row, exactly like lax.top_k on
+        # the unsharded table and the oracle's stable sort.
+        tied_ids = [e["id"] for e in lS["leaders"] if e["id"] in
+                    ("p3", "p6", "p9", "p13")]
+        assert tied_ids == ["p3", "p6", "p9", "p13"]
+        exp = oracle.leaderboard(vS.host_table(), 40, 40)
+        assert [e["id"] for e in lS["leaders"]] == [
+            f"p{r}" for r, _ in exp
+        ]
+
+    def test_allgather_topk_variant_bit_identical(self):
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 devices for the all-gather serve mesh")
+        table = tied_table(n_players=40, n_shards=4)
+        pub1, pubS, _v1, _vS, _ids, _table = publish_pair(
+            n_players=40, n_rated=40, n_shards=4, table=table
+        )
+        e1 = QueryEngine(pub1, cfg=CFG)
+        eAG = ShardedQueryEngine(pubS, cfg=CFG, all_gather_topk=True)
+        for k in (1, 7, 40):
+            assert e1.leaderboard(k)["leaders"] == eAG.leaderboard(k)[
+                "leaders"
+            ]
+
+    def test_unknown_ids_and_errors_match(self):
+        pub1, pubS, _v1, _vS, _ids, _table = publish_pair()
+        e1 = QueryEngine(pub1, cfg=CFG)
+        eS = ShardedQueryEngine(pubS, cfg=CFG)
+        with pytest.raises(UnknownPlayerError):
+            eS.win_probability(["p0"], ["ghost"])
+        assert e1.get_ratings(["ghost"]) == eS.get_ratings(["ghost"])
+
+    def test_rolling_publishes_keep_parity(self):
+        pub1, pubS, _v1, _vS, ids, table = publish_pair()
+        e1 = QueryEngine(pub1, cfg=CFG)
+        eS = ShardedQueryEngine(pubS, cfg=CFG)
+        rng = np.random.default_rng(3)
+        for step in range(6):
+            picks = rng.choice(60, 9, replace=False)
+            upd = table[picks].copy()
+            upd[:, MU_LO] += np.float32(step + 1)
+            pids = [f"p{i}" for i in picks]
+            pub1.publish_rows(pids, upd)
+            pubS.publish_rows(pids, upd)
+            assert pub1.version == pubS.version
+            assert e1.leaderboard(10) == eS.leaderboard(10)
+            assert e1.get_ratings(pids[:4]) == eS.get_ratings(pids[:4])
+            assert e1.tier_histogram() == eS.tier_histogram()
+
+    def test_both_engines_satisfy_serve_plane(self):
+        pub1, pubS, _v1, _vS, _ids, _table = publish_pair()
+        assert isinstance(QueryEngine(pub1, cfg=CFG), ServePlane)
+        assert isinstance(ShardedQueryEngine(pubS, cfg=CFG), ServePlane)
+
+
+class TestShardedRetraceDiscipline:
+    def test_zero_steady_state_retraces_per_shard(self):
+        pub1, pubS, _v1, _vS, ids, table = publish_pair(n_shards=4)
+        eS = ShardedQueryEngine(pubS, cfg=CFG, max_batch=32)
+        eS.warmup()
+        # One warm pass of the publish ladder, like the soak's prepare.
+        pubS.warm_patch_buckets(64)
+        baseline = {
+            k: v for k, v in retrace_counts().items()
+            if k.startswith("serve.")
+        }
+        rng = np.random.default_rng(0)
+        for count in (1, 3, 8, 17):
+            for _ in range(2):
+                reqs = [
+                    eS.submit("winprob", (("p0", "p1"), ("p2",)))
+                    for _ in range(count)
+                ]
+                reqs.append(eS.submit("ratings", ("p0", "p4", "p9")))
+                reqs.append(eS.submit("percentile", 100.0))
+                reqs.append(eS.submit("leaderboard", int(rng.integers(1, 30))))
+                reqs.append(eS.submit("tiers"))
+                while eS.tick():
+                    pass
+                for r in reqs:
+                    r.result(timeout=0)
+                picks = rng.choice(60, 5, replace=False)
+                pubS.publish_rows([f"p{i}" for i in picks], table[picks])
+        after = {
+            k: v for k, v in retrace_counts().items()
+            if k.startswith("serve.")
+        }
+        assert after == baseline, "sharded steady state retraced a kernel"
+
+    def test_per_shard_query_counters_move(self):
+        _pub1, pubS, _v1, _vS, _ids, _table = publish_pair(n_shards=4)
+        eS = ShardedQueryEngine(pubS, cfg=CFG)
+        eS.get_ratings([f"p{i}" for i in range(8)])  # every shard owns 2
+        reg = get_registry()
+        for d in range(4):
+            assert reg.counter(
+                "serve.shard.queries_total", shard=str(d)
+            ).value == 2
+        eS.leaderboard(5)
+        assert reg.counter("serve.shard.merges_total").value == 1
+        assert reg.counter("serve.shard.merge_candidates_total").value > 0
+
+
+class TestShardedServeServer:
+    def test_http_plane_is_topology_blind(self):
+        pub1, pubS, v1, _vS, _ids, _table = publish_pair()
+        e1 = QueryEngine(pub1, cfg=CFG).start()
+        eS = ShardedQueryEngine(pubS, cfg=CFG).start()
+        s1 = ServeServer(e1, port=0)
+        sS = ServeServer(eS, port=0)
+        try:
+            for path in (
+                "/v1/ratings?ids=p0,p1,ghost",
+                "/v1/leaderboard?k=5",
+                "/v1/winprob?a=p0,p1&b=p2",
+                "/v1/tiers?score=250",
+            ):
+                c1, b1 = http_get(s1.url + path)
+                cS, bS = http_get(sS.url + path)
+                assert (c1, b1) == (cS, bS), path
+        finally:
+            s1.close()
+            sS.close()
+            e1.close()
+            eS.close()
+
+
+class TestWorkerShardedIntegration:
+    def _feed(self, broker, store, prefix: str, n=4, t0=0):
+        for i in range(n):
+            mid = f"{prefix}{i}"
+            store.add_match(mk_match(mid, created_at=t0 + i))
+            broker.publish("analyze", mid.encode())
+
+    def test_worker_serves_through_the_sharded_plane(self):
+        broker = InMemoryBroker()
+        store = InMemoryStore()
+        cfg = ServiceConfig(batch_size=4, idle_timeout=0.0)
+        worker = Worker(broker, store, cfg, serve_port=0, serve_shards=4)
+        try:
+            assert isinstance(worker.query_engine, ShardedQueryEngine)
+            assert isinstance(worker.view_publisher, ShardedViewPublisher)
+            self._feed(broker, store, "a")
+            assert worker.poll()
+            assert worker.stats()["serve"]["view_version"] == 1
+            pid = "a0_pl0"
+            code, body = http_get(
+                worker.serve_server.url + f"/v1/ratings?ids={pid}"
+            )
+            assert code == 200
+            player = next(
+                p for m in store.matches.values() for r in m.rosters
+                for part in r.participants for p in part.player
+                if p.api_id == pid
+            )
+            assert np.float32(body["ratings"][0]["mu"]) == np.float32(
+                player.trueskill_mu
+            )
+            self._feed(broker, store, "b", t0=10)
+            assert worker.poll()
+            assert worker.stats()["serve"]["view_version"] == 2
+        finally:
+            worker.close()
+
+
+@pytest.mark.skipif(
+    _NO_SHARD_MAP, reason="jax.shard_map unavailable in this build"
+)
+class TestMeshRunnerPublish:
+    """rate_history_sharded(view_publisher=) — per-shard views at chunk
+    boundaries, one monotone cross-shard version, final unthrottled
+    publish bit-identical to the finished state."""
+
+    def _setup(self, n_matches=120, n_players=50, batch_size=16, seed=11):
+        from analyzer_tpu.io.synthetic import (
+            synthetic_players, synthetic_stream,
+        )
+        from analyzer_tpu.sched import pack_schedule
+
+        players = synthetic_players(n_players, seed=seed)
+        stream = synthetic_stream(n_matches, players, seed=seed)
+        state = PlayerState.create(
+            n_players,
+            rank_points_ranked=players.rank_points_ranked,
+            rank_points_blitz=players.rank_points_blitz,
+            skill_tier=players.skill_tier,
+        )
+        sched = pack_schedule(
+            stream, pad_row=state.pad_row, batch_size=batch_size
+        )
+        return state, sched
+
+    def test_chunk_boundary_publishes_and_final_bit_identity(self):
+        from analyzer_tpu.parallel import make_mesh, rate_history_sharded
+
+        n_dev = min(4, len(jax.devices()))
+        mesh = make_mesh(n_dev)
+        state, sched = self._setup()
+        pub = ShardedViewPublisher(n_dev, min_publish_interval_s=0.0)
+        versions: list[int] = []
+
+        def on_chunk(_snapshot, _stop):
+            versions.append(pub.version)
+
+        final = rate_history_sharded(
+            state, sched, CFG, mesh=mesh, steps_per_chunk=7,
+            view_publisher=pub, on_chunk=on_chunk,
+        )
+        view = pub.current()
+        assert view is not None and view.n_players == 50
+        # Per-shard views published AT chunk boundaries, not only at the
+        # end: versions advanced while chunks were still flowing.
+        assert versions and versions[-1] >= 2
+        assert view.version == sorted(versions + [view.version])[-1]
+        np.testing.assert_array_equal(
+            view.host_table(), np.asarray(final.table)[:50]
+        )
+        # Routed lookups serve the finished ratings bit-for-bit.
+        eng = ShardedQueryEngine(pub, cfg=CFG)
+        resp = eng.get_ratings(["7"])
+        got = np.float32(resp["ratings"][0]["mu"])
+        assert got == np.float32(np.asarray(final.table)[7, MU_LO])
+
+    def test_throttled_publisher_still_gets_final(self):
+        from analyzer_tpu.parallel import make_mesh, rate_history_sharded
+
+        n_dev = min(2, len(jax.devices()))
+        mesh = make_mesh(n_dev)
+        state, sched = self._setup(n_matches=40)
+        pub = ShardedViewPublisher(n_dev, min_publish_interval_s=3600.0)
+        final = rate_history_sharded(
+            state, sched, CFG, mesh=mesh, view_publisher=pub
+        )
+        view = pub.current()
+        # Throttle suppressed every chunk publish except the first-due
+        # one; the FINAL publish is unthrottled and carries the result.
+        assert view is not None
+        np.testing.assert_array_equal(
+            view.host_table(), np.asarray(final.table)[:50]
+        )
+
+    def test_shard_count_mismatch_rejected(self):
+        from analyzer_tpu.parallel import make_mesh, rate_history_sharded
+
+        mesh = make_mesh(min(2, len(jax.devices())))
+        state, sched = self._setup(n_matches=20)
+        with pytest.raises(ValueError, match="n_shards == mesh size"):
+            rate_history_sharded(
+                state, sched, CFG, mesh=mesh,
+                view_publisher=ShardedViewPublisher(7),
+            )
+
+    def test_plain_publisher_gets_final_state_only(self):
+        from analyzer_tpu.parallel import make_mesh, rate_history_sharded
+
+        mesh = make_mesh(min(2, len(jax.devices())))
+        state, sched = self._setup(n_matches=40)
+        pub = ViewPublisher(min_publish_interval_s=0.0)
+        final = rate_history_sharded(
+            state, sched, CFG, mesh=mesh, view_publisher=pub
+        )
+        view = pub.current()
+        assert view is not None and view.version == 1
+        np.testing.assert_array_equal(
+            view.host_table()[:50], np.asarray(final.table)[:50]
+        )
+
+
+class TestForcedHostSubprocess:
+    """The reusable tests/hostmesh.py fixture end-to-end: a FRESH
+    interpreter on an 8-way forced-host platform runs the sharded plane
+    with shards spread one-per-device (the ``devices=`` rig shape) and
+    checks bit-identity against the single-device engine there."""
+
+    SNIPPET = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.core.state import MU_LO, SIGMA_LO, PlayerState
+from analyzer_tpu.serve import (
+    QueryEngine, ShardedQueryEngine, ShardedViewPublisher, ViewPublisher,
+)
+
+devices = jax.devices()
+assert len(devices) == 8, f"expected 8 forced host devices, got {len(devices)}"
+cfg = RatingConfig()
+rng = np.random.default_rng(0)
+n = 96
+state = PlayerState.create(n, skill_tier=rng.integers(1, 29, n), cfg=cfg)
+table = np.asarray(state.table).copy()[:n]
+table[:, MU_LO] = rng.normal(1500, 400, n).astype(np.float32)
+table[:, SIGMA_LO] = rng.uniform(50, 600, n).astype(np.float32)
+ids = [f"p{i}" for i in range(n)]
+pub1 = ViewPublisher(); pub1.publish_rows(ids, table)
+pubS = ShardedViewPublisher(8, devices=devices)
+pubS.publish_rows(ids, table)
+view = pubS.current()
+# One shard table per device — the spread-plane rig shape.
+assert sorted({s.table.device.id for s in view.shards}) == list(range(8))
+e1 = QueryEngine(pub1, cfg=cfg)
+eS = ShardedQueryEngine(pubS, cfg=cfg)
+assert e1.leaderboard(20) == eS.leaderboard(20)
+assert e1.get_ratings(ids[:10]) == eS.get_ratings(ids[:10])
+assert e1.win_probability(ids[:3], ids[3:6]) == eS.win_probability(ids[:3], ids[3:6])
+assert e1.tier_histogram() == eS.tier_histogram()
+print("SHARDED-8DEV-OK")
+"""
+
+    @pytest.mark.slow
+    def test_spread_shards_on_fresh_8_device_platform(self):
+        proc = run_forced_host(self.SNIPPET, n_devices=8)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "SHARDED-8DEV-OK" in proc.stdout
+
+
+class TestShardedBenchdiffFamily:
+    def _artifact(self, qps, p99, sharded=True, ratio=1.5, stable=True):
+        art = {
+            "metric": "serve.queries_per_sec", "value": qps,
+            "latency_ms": {"p50": p99 / 2, "p99": p99},
+            "capture": {"degraded": False},
+        }
+        if sharded:
+            art["sharded"] = {
+                "shards": 8, "queries_per_sec": qps / 2,
+                "min_over_single": ratio, "steady_retraces": 0,
+                "bit_identical_to_single": True, "stable": stable,
+            }
+        return art
+
+    def test_sharded_configs_parse_and_gate(self):
+        from analyzer_tpu.obs.benchdiff import bench_configs, diff_configs
+
+        a = bench_configs(self._artifact(10000.0, 20.0, ratio=1.5))
+        names = [c.name for c in a]
+        assert "sharded.min_over_single" in names
+        assert "sharded.queries_per_sec" in names
+        # Shard-plane tax regression (ratio UP) gates even when the
+        # headline holds.
+        b = bench_configs(self._artifact(10000.0, 20.0, ratio=2.5))
+        rows = diff_configs(a, b, regress_pct=5.0)
+        by = {r.name: r for r in rows}
+        assert by["sharded.min_over_single"].regressed
+        assert by["sharded.min_over_single"].gated
+        assert not by["serve.queries_per_sec"].regressed
+        # An unstable sharded capture is reported but not gated.
+        b = bench_configs(
+            self._artifact(10000.0, 20.0, ratio=2.5, stable=False)
+        )
+        rows = diff_configs(a, b, regress_pct=5.0)
+        assert not {r.name: r for r in rows}["sharded.min_over_single"].gated
+
+    def test_vanished_sharded_block_exits_1(self, tmp_path, capsys):
+        import json as _json
+
+        from analyzer_tpu import cli
+
+        a = tmp_path / "SERVE_BENCH_r01.json"
+        b = tmp_path / "SERVE_BENCH_r02.json"
+        a.write_text(_json.dumps(self._artifact(10000.0, 20.0)))
+        b.write_text(
+            _json.dumps(self._artifact(10000.0, 20.0, sharded=False))
+        )
+        rc = cli.main([
+            "benchdiff", "--family", "serve", str(a), str(b),
+        ])
+        err = capsys.readouterr().err
+        assert rc == 1
+        assert "no sharded capture" in err
+        # Same artifact both sides: clean pass.
+        assert cli.main([
+            "benchdiff", "--family", "serve", str(a), str(a),
+        ]) == 0
+
+
+class TestShardSchema:
+    def test_standard_schema_has_shard_series(self):
+        from analyzer_tpu.obs.registry import (
+            STANDARD_COUNTERS,
+            STANDARD_GAUGES,
+        )
+
+        for name in (
+            "serve.view_publish_bytes_total",
+            "serve.shard.queries_total",
+            "serve.shard.merges_total",
+            "serve.shard.merge_candidates_total",
+        ):
+            assert name in STANDARD_COUNTERS, name
+        assert "serve.shards" in STANDARD_GAUGES
