@@ -971,6 +971,22 @@ def cmd_benchdiff(args) -> int:
                 file=sys.stderr,
             )
             return 1
+    if args.family in ("bench", "tiered"):
+        # Absolute tracing-tax gate on the candidate alone: the bench's
+        # trace_overhead block (tracing-on vs tracing-off on the same
+        # config) must stay <= TRACE_OVERHEAD_MAX_PCT — causal tracing
+        # that stops being ~free would silently tax every traced run.
+        from analyzer_tpu.obs.benchdiff import trace_overhead_violations
+
+        overhead = trace_overhead_violations(b_raw)
+        for v in overhead:
+            print(f"TRACE OVERHEAD VIOLATION: {v}")
+        if overhead:
+            print(
+                f"error: {os.path.basename(b_path)} fails the tracing "
+                "overhead gate", file=sys.stderr,
+            )
+            rc = 1
     rows = diff_configs(a, b, args.regress_pct)
     sys.stdout.write(render_diff(a_path, b_path, rows))
     if any(r.regressed and r.gated for r in rows):
@@ -1004,6 +1020,77 @@ def cmd_metrics(args) -> int:
     else:
         json.dump(snap, sys.stdout, indent=1, sort_keys=True)
         sys.stdout.write("\n")
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Trace analyzer (obs/traceview.py): reconstruct per-match /
+    per-batch timelines from a trace-events JSONL (``--trace-events``)
+    or a flight-recorder dump directory, with the stage decomposition
+    (queue wait -> encode -> pack -> feed staging -> H2D -> dispatch ->
+    fetch -> commit -> publish lag) and a critical-path report naming
+    the dominant stage. Needs a trace captured with causal tracing ON
+    (``cli soak --trace``, ``ANALYZER_TPU_TRACE=1``)."""
+    from analyzer_tpu.obs.traceview import (
+        batch_report,
+        build_model,
+        critical_path,
+        load_events,
+        match_report,
+        render_batch,
+        render_critical_path,
+        render_match,
+        verify_chain,
+    )
+
+    try:
+        events = load_events(args.artifact)
+    except (OSError, ValueError) as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 2
+    model = build_model(events)
+    if not model.batches and not model.enqueue_ts:
+        print(
+            "error: no causal-trace events in the artifact — was the "
+            "capture taken with tracing enabled (cli soak --trace / "
+            "ANALYZER_TPU_TRACE=1)?", file=sys.stderr,
+        )
+        return 2
+    if args.match:
+        report = match_report(model, args.match)
+        if report is None:
+            print(f"error: match {args.match!r} not in this trace",
+                  file=sys.stderr)
+            return 1
+        problems = verify_chain(model, args.match)
+        if args.json:
+            report = dict(report, problems=problems)
+            json.dump(report, sys.stdout, indent=1, sort_keys=True)
+            sys.stdout.write("\n")
+        else:
+            sys.stdout.write(render_match(report))
+            for p in problems:
+                print(f"  incomplete: {p}")
+        return 0
+    if args.batch:
+        bt = model.batches.get(args.batch)
+        if bt is None:
+            print(f"error: batch {args.batch!r} not in this trace",
+                  file=sys.stderr)
+            return 1
+        report = batch_report(bt)
+        if args.json:
+            json.dump(report, sys.stdout, indent=1, sort_keys=True)
+            sys.stdout.write("\n")
+        else:
+            sys.stdout.write(render_batch(report))
+        return 0
+    cp = critical_path(model, window=args.window or None)
+    if args.json:
+        json.dump(cp, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(render_critical_path(cp))
     return 0
 
 
@@ -1185,6 +1272,7 @@ def cmd_soak(args) -> int:
     server = _obs_serve(args)
     cfg = SoakConfig(
         seed=args.seed,
+        trace=bool(args.trace or args.trace_events),
         duration_s=args.duration,
         tick_s=args.tick,
         qps=args.qps,
@@ -1209,6 +1297,9 @@ def cmd_soak(args) -> int:
         driver.close()
         if server is not None:
             server.close()
+    # _obs_write exports --trace-events (the ring still carries the
+    # causal ids after close — only the enable flag is restored); the
+    # export is the `cli trace` input.
     _obs_write(args)
     # The headline line mirrors bench.py's contract (one JSON line on
     # stdout); the full artifact — the benchdiff input — goes to --out.
@@ -1255,6 +1346,7 @@ def cmd_worker(args) -> int:
     worker_main(
         obs_port=args.obs_port, flight_dir=args.flight_dir,
         serve_port=args.serve_port, serve_shards=args.serve_shards,
+        profile_dir=args.profile_dir,
     )
     return 0
 
@@ -1507,6 +1599,35 @@ def main(argv=None) -> int:
     s.set_defaults(fn=cmd_lint)
 
     s = sub.add_parser(
+        "trace",
+        help="reconstruct per-match/per-batch causal timelines from a "
+        "trace-events JSONL or a flight-recorder dump "
+        "(docs/observability.md \"Causal tracing\")",
+    )
+    s.add_argument(
+        "artifact",
+        help="a --trace-events JSONL export, or a flight-recorder dump "
+        "directory (its trace.jsonl is used)",
+    )
+    s.add_argument(
+        "--match", metavar="ID",
+        help="one match's journey: queue wait + its batch's stage "
+        "decomposition + the view version that served it",
+    )
+    s.add_argument(
+        "--batch", metavar="ID",
+        help="one batch's stage decomposition (ids look like b17; "
+        "`--match` prints the owning batch id)",
+    )
+    s.add_argument(
+        "--window", type=int, default=0, metavar="N",
+        help="restrict the critical-path report to the last N batches "
+        "(default: all)",
+    )
+    s.add_argument("--json", action="store_true", help="JSON output")
+    s.set_defaults(fn=cmd_trace)
+
+    s = sub.add_parser(
         "metrics",
         help="render a runtime telemetry snapshot (docs/observability.md)",
     )
@@ -1609,6 +1730,19 @@ def main(argv=None) -> int:
         help="serve the obsd introspection endpoints during the soak "
         "(watch soak.* and broker.queue_depth live; 0 = ephemeral)",
     )
+    s.add_argument(
+        "--trace", action="store_true",
+        help="causal tracing: every match carries a TraceContext from "
+        "broker enqueue to view publish, and the artifact gains a "
+        "`trace` block (stage decomposition + dominant stage); the "
+        "deterministic block stays bit-identical "
+        "(docs/observability.md \"Causal tracing\")",
+    )
+    s.add_argument(
+        "--trace-events", metavar="PATH",
+        help="write the span ring as Chrome trace-event JSONL after the "
+        "soak (implies --trace; the `cli trace` input)",
+    )
     s.set_defaults(fn=cmd_soak)
 
     s = sub.add_parser("worker", help="broker-consuming service loop")
@@ -1642,6 +1776,14 @@ def main(argv=None) -> int:
         "routed lookups + distributed top-k (also "
         "ANALYZER_TPU_SERVE_SHARDS; bit-identical results, "
         "docs/serving.md \"Sharded plane\")",
+    )
+    s.add_argument(
+        "--profile-dir", metavar="DIR",
+        help="arm on-demand jax.profiler capture windows into DIR (also "
+        "ANALYZER_TPU_PROFILE_DIR): SIGUSR2 captures the next batch's "
+        "dispatch; dead-letters/degradation capture automatically "
+        "(throttled) and the flight dump names the capture directory "
+        "(docs/observability.md \"Device-time attribution\")",
     )
     s.set_defaults(fn=cmd_worker)
 

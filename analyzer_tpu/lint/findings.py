@@ -69,6 +69,14 @@ RULES: dict[str, str] = {
         "merge helpers — routed per-shard microbatches must not decay "
         "into per-query host round-trips"
     ),
+    "GL030": (
+        "runtime-emitted metric/span name not in the pre-declared "
+        "schema: a string-literal counter()/gauge()/histogram() name "
+        "outside STANDARD_COUNTERS/GAUGES/HISTOGRAMS, or a "
+        ".span()/.instant() name outside SPAN_CATALOG, inside "
+        "analyzer_tpu/service/, sched/ or serve/ — a typo'd name "
+        "silently mints a series no dashboard reads"
+    ),
 }
 
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")

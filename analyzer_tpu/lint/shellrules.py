@@ -86,6 +86,19 @@ whole-table bootstrap ingest) silently reintroduces the per-query host
 round-trip the shard plane exists to kill. Test files are exempt; a
 deliberate whole-table fetch elsewhere carries a line-scoped disable
 with a reason.
+
+GL030 is PATH-SCOPED to ``analyzer_tpu/service/``, ``sched/`` and
+``serve/``: every STRING-LITERAL metric name handed to
+``counter()``/``gauge()``/``histogram()`` and every literal span name
+handed to ``.span()``/``.instant()`` must resolve to the pre-declared
+schema (``obs.registry.STANDARD_COUNTERS``/``STANDARD_GAUGES``/
+``STANDARD_HISTOGRAMS``) or the span catalog
+(``obs.registry.SPAN_CATALOG``). A typo'd name fails nothing at
+runtime — it just mints a fresh series no dashboard reads and a span
+no timeline joins, which is the silent failure mode of a
+string-keyed telemetry surface. Computed names (f-strings, variables)
+are out of scope by design; test files are exempt; a deliberately
+local series carries a line-scoped disable with a reason.
 """
 
 from __future__ import annotations
@@ -136,6 +149,17 @@ _GL029_DIRS = ("analyzer_tpu/serve/",)
 #: surfaces), _stacked_tables (the all-gather top-k's per-device
 #: stack), publish_state (the whole-table bootstrap publish).
 _GL029_MERGE_HELPERS = ("host_table", "_stacked_tables", "publish_state")
+
+#: Directories where GL030 applies: the layers whose runtime telemetry
+#: the operator schema pre-declares (docs/observability.md catalog).
+_GL030_DIRS = (
+    "analyzer_tpu/service/", "analyzer_tpu/sched/", "analyzer_tpu/serve/",
+)
+
+#: Call-attribute -> which catalog the literal first argument must
+#: resolve against (GL030).
+_GL030_REGISTRY_KINDS = ("counter", "gauge", "histogram")
+_GL030_TRACER_KINDS = ("span", "instant")
 
 #: Host<->device transfer calls GL029 inspects for a table-named
 #: argument (jax.device_get flags regardless of argument shape).
@@ -201,6 +225,7 @@ class ShellRules:
         feed_layer = self._in_feed_layer()
         loadgen_layer = self._in_loadgen_layer()
         serve_layer = self._in_serve_layer()
+        schema_layer = self._in_schema_layer()
         tests = self._in_tests()
         pallas_home = self._in_pallas_home()
         table_home = self._in_table_home()
@@ -221,6 +246,8 @@ class ShellRules:
                     self._check_soak_determinism(node)
                 if serve_layer and not tests:
                     self._check_cross_shard_gather(node, merge_ranges)
+                if schema_layer and not tests:
+                    self._check_schema_name(node)
                 if not tests:
                     self._check_interpret_literal(node)
                 if not (tests or table_home):
@@ -270,6 +297,10 @@ class ShellRules:
     def _in_serve_layer(self) -> bool:
         path = self.path.replace("\\", "/")
         return any(frag in path for frag in _GL029_DIRS)
+
+    def _in_schema_layer(self) -> bool:
+        path = self.path.replace("\\", "/")
+        return any(frag in path for frag in _GL030_DIRS)
 
     def _merge_helper_ranges(self) -> tuple:
         """(start, end) line spans of the designated merge helpers —
@@ -441,6 +472,60 @@ class ShellRules:
             "per-shard microbatches exist to kill (docs/serving.md "
             '"Sharded plane"); use the merge helpers or disable with a '
             "reason",
+        )
+
+    def _check_schema_name(self, node: ast.Call) -> None:
+        """GL030: a string-literal metric/span name in the service/
+        sched/serve layers that does not resolve to the pre-declared
+        schema. The catalogs are the ONE owner (``obs/registry.py``):
+        ``counter()``/``gauge()``/``histogram()`` literals must be in
+        STANDARD_COUNTERS/GAUGES/HISTOGRAMS, ``.span()``/``.instant()``
+        literals in SPAN_CATALOG — a typo'd name mints a series no
+        dashboard reads and a span no timeline joins, silently.
+        Computed names are out of scope (string-literal check only)."""
+        f = node.func
+        if not isinstance(f, ast.Attribute) or not node.args:
+            return
+        kind = f.attr
+        if kind not in _GL030_REGISTRY_KINDS and kind not in _GL030_TRACER_KINDS:
+            return
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+            return
+        name = arg.value
+        # Lazy import: the catalogs live with the schema (stdlib-only
+        # module), not duplicated into the linter.
+        from analyzer_tpu.obs.registry import (
+            SPAN_CATALOG,
+            STANDARD_COUNTERS,
+            STANDARD_GAUGES,
+            STANDARD_HISTOGRAMS,
+        )
+
+        if kind in _GL030_TRACER_KINDS:
+            if name in SPAN_CATALOG:
+                return
+            self._flag(
+                "GL030", node,
+                f'span name "{name}" is not in the span catalog '
+                "(obs.registry.SPAN_CATALOG) — a mistyped span vanishes "
+                "from every reconstructed timeline; add it to the "
+                "catalog (and docs/observability.md) or fix the typo",
+            )
+            return
+        allowed = {
+            "counter": STANDARD_COUNTERS,
+            "gauge": STANDARD_GAUGES,
+            "histogram": STANDARD_HISTOGRAMS,
+        }[kind]
+        if name in allowed:
+            return
+        self._flag(
+            "GL030", node,
+            f'{kind} name "{name}" is not in the pre-declared schema '
+            f"(obs.registry.STANDARD_{kind.upper()}S) — a mistyped "
+            "metric mints a series no dashboard reads; declare it in "
+            "the schema (and docs/observability.md) or fix the typo",
         )
 
     def _check_soak_determinism(self, node: ast.Call) -> None:

@@ -57,6 +57,12 @@ from analyzer_tpu.loadgen.shaper import (
 from analyzer_tpu.logging_utils import get_logger
 from analyzer_tpu.obs import get_registry, install_jax_hooks
 from analyzer_tpu.obs.benchdiff import soak_slo_violations
+from analyzer_tpu.obs.tracectx import (
+    enable_tracing,
+    headers as trace_headers,
+    mint as trace_mint,
+    tracing_enabled,
+)
 
 logger = get_logger(__name__)
 
@@ -96,6 +102,13 @@ class SoakConfig:
     # contract, pinned by tests/test_loadgen.py.
     serve_shards: int = 1
     realtime: bool = False  # pace ticks against the wall clock
+    # Causal tracing (obs/tracectx.py): every published match carries a
+    # TraceContext through the broker, the worker's batches tag their
+    # spans, and the artifact gains a `trace` block with the stage
+    # decomposition + dominant stage. The DETERMINISTIC block is
+    # bit-identical with tracing on or off (ids are recorded, never
+    # branched on) — pinned by tests/test_trace.py.
+    trace: bool = False
     max_view_lag_ticks: int = 2  # SLO: served view staleness bound
     min_matches_per_sec: float | None = None  # SLO: absolute wall floor
     max_p99_ms: float | None = None  # SLO: absolute serve-latency bound
@@ -121,6 +134,13 @@ class SoakDriver:
 
         self.cfg = config or SoakConfig()
         cfg = self.cfg
+        # Causal tracing is a process-wide flag; remember the prior state
+        # so close() restores it (a traced soak inside a test session
+        # must not leak tracing into the next test).
+        self._trace_prev: bool | None = None
+        if cfg.trace and not tracing_enabled():
+            self._trace_prev = False
+            enable_tracing(True)
         install_jax_hooks()  # retraces countable before the first compile
         self.vclock = VirtualClock()
         self.broker = InMemoryBroker()
@@ -271,8 +291,14 @@ class SoakDriver:
             afk = bool(self.qrng.random() < self.cfg.afk_rate)
             match = self._build_match(m, winner, afk)
             self.store.add_match(match)
+            # The causal chain's first link: the TraceContext is minted
+            # the moment the match enters the broker and rides the
+            # message headers (None/no headers when tracing is off —
+            # the digests below never see it either way).
+            ctx = trace_mint(match.api_id)
             self.broker.publish(
-                self.worker.config.queue, match.api_id.encode()
+                self.worker.config.queue, match.api_id.encode(),
+                headers=trace_headers(ctx),
             )
             self._match_digest.update(
                 json.dumps(
@@ -405,6 +431,16 @@ class SoakDriver:
             float(reg.counter("jax.retraces_total").value)
             - self._retrace_base
         )
+        # Causal-trace decomposition (obs/traceview.py): the same
+        # per-stage breakdown `cli trace` renders, aggregated over the
+        # soak's batches, so an SLO violation names the dominant stage.
+        # Wall-time derived — it lives OUTSIDE the deterministic block.
+        trace_block = None
+        if tracing_enabled():
+            from analyzer_tpu.obs import get_tracer
+            from analyzer_tpu.obs.traceview import build_model, critical_path
+
+            trace_block = critical_path(build_model(get_tracer().events()))
         lat = np.asarray(latencies_ms, np.float64)
         latency_ms = {
             "p50": round(float(np.percentile(lat, 50)), 3) if lat.size else None,
@@ -456,12 +492,21 @@ class SoakDriver:
             },
             "capture": {"degraded": False},
         }
+        if trace_block is not None:
+            artifact["trace"] = trace_block
+            artifact["slo"]["dominant_stage"] = trace_block["dominant_stage"]
         violations = soak_slo_violations(artifact)
         artifact["slo"]["violations"] = violations
         artifact["slo"]["pass"] = not violations
         if violations:
             reg.counter("soak.slo_violations_total").add(len(violations))
             logger.warning("soak SLO violations: %s", "; ".join(violations))
+            if trace_block is not None and trace_block["dominant_stage"]:
+                logger.warning(
+                    "dominant stage over the soak's batches: %s "
+                    "(artifact `trace` block has the full decomposition)",
+                    trace_block["dominant_stage"],
+                )
         logger.info(
             "soak done: %d matches over %d ticks (%.1f wall s), slo=%s",
             rated, cfg.n_ticks, wall_s,
@@ -473,6 +518,8 @@ class SoakDriver:
         if not self._closed:
             self._closed = True
             self.worker.close()
+            if self._trace_prev is not None:
+                enable_tracing(self._trace_prev)
 
 
 def write_artifact(artifact: dict, path: str) -> None:

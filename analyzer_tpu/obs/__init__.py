@@ -53,6 +53,11 @@ from analyzer_tpu.obs.flight import (
     get_flight_recorder,
     reset_flight_recorder,
 )
+from analyzer_tpu.obs.prof import (
+    DeviceProfiler,
+    get_device_profiler,
+    reset_device_profiler,
+)
 from analyzer_tpu.obs.registry import (
     MetricsRegistry,
     get_registry,
@@ -72,15 +77,33 @@ from analyzer_tpu.obs.snapshot import (
     write_snapshot,
 )
 from analyzer_tpu.obs.server import HealthChecks, ObsServer, connectivity_probe
-from analyzer_tpu.obs.tracer import Tracer, get_tracer, instant, span
+from analyzer_tpu.obs.tracectx import (
+    TraceContext,
+    enable_tracing,
+    tracing_enabled,
+)
+from analyzer_tpu.obs.tracer import (
+    Tracer,
+    bind_trace,
+    current_trace,
+    get_tracer,
+    instant,
+    span,
+)
 
 __all__ = [
+    "DeviceProfiler",
     "FlightRecorder",
     "HealthChecks",
     "MetricsRegistry",
     "ObsServer",
+    "TraceContext",
     "Tracer",
+    "bind_trace",
     "connectivity_probe",
+    "current_trace",
+    "enable_tracing",
+    "get_device_profiler",
     "get_flight_recorder",
     "get_registry",
     "get_tracer",
@@ -90,12 +113,14 @@ __all__ = [
     "maybe_sample_device_memory",
     "prometheus_text",
     "render_summary",
+    "reset_device_profiler",
     "reset_flight_recorder",
     "reset_registry",
     "retrace_counts",
     "sample_device_memory",
     "snapshot",
     "span",
+    "tracing_enabled",
     "track_jit",
     "write_chrome_trace",
     "write_snapshot",

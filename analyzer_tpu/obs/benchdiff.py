@@ -342,6 +342,37 @@ def soak_slo_violations(data: dict) -> list[str]:
     return out
 
 
+#: Causal tracing must stay (nearly) free when enabled: the bench's
+#: ``trace_overhead`` block measures the same end-to-end line with the
+#: trace context bound vs off, and the gate fails a candidate whose
+#: tracing tax exceeds this.
+TRACE_OVERHEAD_MAX_PCT = 2.0
+
+
+def trace_overhead_violations(data: dict) -> list[str]:
+    """The bench family's absolute tracing-tax gate, derived from the
+    candidate alone: a ``trace_overhead`` block whose ``overhead_pct``
+    exceeds :data:`TRACE_OVERHEAD_MAX_PCT` is a violation. Degraded
+    captures and unconverged overhead pairs are excluded (same contract
+    as the delta gate: a known-bad measurement must not train people to
+    ignore CI). No block at all passes — tracing overhead is only
+    gateable where it was measured."""
+    block = data.get("trace_overhead")
+    if not isinstance(block, dict):
+        return []
+    if (data.get("capture") or {}).get("degraded"):
+        return []
+    if not block.get("stable", True):
+        return []
+    pct = block.get("overhead_pct")
+    if pct is None or float(pct) <= TRACE_OVERHEAD_MAX_PCT:
+        return []
+    return [
+        f"trace_overhead: tracing-on run is {float(pct):+.2f}% vs "
+        f"tracing-off (gate: <= {TRACE_OVERHEAD_MAX_PCT:g}%)"
+    ]
+
+
 def find_bench_artifacts(directory: str, family: str = "bench") -> list[str]:
     """``<PREFIX>_*.json`` under ``directory``, name-sorted (the round
     numbering ``r01..rNN`` sorts chronologically by construction). The

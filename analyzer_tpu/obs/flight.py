@@ -103,7 +103,10 @@ class FlightRecorder:
         self.base_dir = base_dir or os.environ.get(ENV_DIR) or None
         self.min_interval_s = min_interval_s
         self._clock = clock
-        self._last_dump_at: float | None = None
+        # Throttle keyed PER REASON: a dead-letter storm's dump must not
+        # suppress a later degradation dump (distinct failure, distinct
+        # artifact) — one shared timestamp did exactly that.
+        self._last_dump_at: dict[str, float] = {}
         self.dumps = 0
         self._handler: _LogCapture | None = None
 
@@ -159,39 +162,51 @@ class FlightRecorder:
 
     # -- the dump ---------------------------------------------------------
     def dump(
-        self, reason: str, config: dict | None = None, force: bool = False
+        self,
+        reason: str,
+        config: dict | None = None,
+        force: bool = False,
+        profile: dict | None = None,
     ) -> str | None:
         """Freezes the current telemetry + ring into an artifact
         directory; returns its path. Returns None (with a breadcrumb)
         when no base_dir is configured or a non-forced dump lands inside
-        the throttle window. Never raises — the callers are failure
-        paths that must finish their actual job (dead-lettering,
-        degradation bookkeeping) no matter what the disk does."""
+        the throttle window — the window is PER REASON, so a dead-letter
+        storm's artifact cannot suppress a later degradation dump.
+        ``profile`` (the device profiler's capture info,
+        ``obs/prof.py``) rides into context.json so the artifact names
+        the jax.profiler capture directory that goes with it. Never
+        raises — the callers are failure paths that must finish their
+        actual job (dead-lettering, degradation bookkeeping) no matter
+        what the disk does."""
         if self.base_dir is None:
             self.note("dump.skipped", reason=reason, why="no base_dir")
             return None
         now = self._clock()
         with self._lock:
+            last = self._last_dump_at.get(reason)
             if (
                 not force
-                and self._last_dump_at is not None
-                and now - self._last_dump_at < self.min_interval_s
+                and last is not None
+                and now - last < self.min_interval_s
             ):
                 throttled = True
             else:
                 throttled = False
-                self._last_dump_at = now
+                self._last_dump_at[reason] = now
         if throttled:
             self.note("dump.suppressed", reason=reason)
             return None
         try:
-            return self._write(reason, config)
+            return self._write(reason, config, profile)
         except Exception as err:  # noqa: BLE001 — failure paths come first
             self.note("dump.failed", reason=reason, error=repr(err))
             logger.exception("flight-recorder dump failed (%s)", reason)
             return None
 
-    def _write(self, reason: str, config: dict | None) -> str:
+    def _write(
+        self, reason: str, config: dict | None, profile: dict | None = None
+    ) -> str:
         stamp = time.strftime("%Y%m%d-%H%M%S")
         safe_reason = "".join(
             c if c.isalnum() or c in "-_" else "_" for c in reason
@@ -220,6 +235,10 @@ class FlightRecorder:
             "python": sys.version.split()[0],
             "jax": getattr(sys.modules.get("jax"), "__version__", None),
             "config": _redact(config) if config else None,
+            # Device-time attribution: where the jax.profiler capture
+            # that pairs with this dump lives (None when no profiler is
+            # armed — obs/prof.py, docs/observability.md).
+            "profile": profile,
             "env": _redact({
                 k: v for k, v in os.environ.items()
                 if k.startswith(_ENV_PREFIXES)

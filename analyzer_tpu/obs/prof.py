@@ -1,0 +1,185 @@
+"""Device-time attribution: opt-in jax.profiler capture windows.
+
+The host-side trace (obs/tracer.py + obs/tracectx.py) decomposes a
+match's journey into queue wait / encode / pack / staging / H2D /
+dispatch / commit — but "dispatch" is an enqueue from the host's point
+of view, and the ROADMAP's rig questions (fused-vs-scan on v5e, tier
+promotion bandwidth, shard spread) need *device* time per dispatch. This
+module arms a process-wide :class:`DeviceProfiler` that captures one
+``jax.profiler`` trace around the NEXT dispatch window after a request:
+
+  * **operator on demand** — ``SIGUSR2`` on a worker requests a capture
+    (force-bypassing the throttle), the next batch's compute runs under
+    the profiler, and the capture directory logs;
+  * **automatic on failure** — dead-letters and pipeline degradation
+    request a throttled capture, so the flight-recorder dump that
+    freezes the host-side story gets device timing for the very next
+    batch; the dump's ``context.json`` names the capture directory
+    (``profile`` block);
+  * **always explicit** — nothing captures unless a profile directory
+    is configured (``--profile-dir`` / ``ANALYZER_TPU_PROFILE_DIR``);
+    unarmed, ``request`` and ``maybe_capture`` are no-ops costing one
+    attribute read per batch.
+
+Captures are whole TensorBoard/Perfetto-loadable trace directories —
+the same artifact ``utils.profiling.trace`` produces, but scoped to one
+dispatch window and triggerable without a code change. The profiler
+start/stop never raise into the dispatch path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+
+from analyzer_tpu.logging_utils import get_logger
+
+logger = get_logger(__name__)
+
+ENV_DIR = "ANALYZER_TPU_PROFILE_DIR"
+
+
+def _start_trace(path: str) -> None:
+    """jax.profiler.start_trace, isolated for tests to stub."""
+    import jax
+
+    jax.profiler.start_trace(path)
+
+
+def _stop_trace() -> None:
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+class DeviceProfiler:
+    def __init__(
+        self,
+        profile_dir: str | None = None,
+        min_interval_s: float = 60.0,
+        clock=time.monotonic,
+    ) -> None:
+        self._lock = threading.Lock()
+        self.profile_dir = profile_dir or os.environ.get(ENV_DIR) or None
+        self.min_interval_s = min_interval_s
+        self._clock = clock
+        # Reason of the pending capture request; claimed (and cleared)
+        # by the next maybe_capture window.
+        self._pending: str | None = None
+        # Per-reason throttle, like the flight recorder's: a dead-letter
+        # storm must not starve an operator's SIGUSR2 (which forces) or
+        # a later degradation capture.
+        self._last_at: dict[str, float] = {}
+        self.captures = 0
+        self.last_capture: str | None = None
+
+    def configure(
+        self,
+        profile_dir: str | None = None,
+        min_interval_s: float | None = None,
+    ) -> "DeviceProfiler":
+        if profile_dir is not None:
+            self.profile_dir = profile_dir
+        if min_interval_s is not None:
+            self.min_interval_s = min_interval_s
+        return self
+
+    @property
+    def armed(self) -> bool:
+        return self.profile_dir is not None
+
+    def request(self, reason: str, force: bool = False) -> bool:
+        """Requests a capture of the next dispatch window. Returns
+        whether the request was accepted (False when unarmed or inside
+        the reason's throttle window). Safe from signal handlers."""
+        if not self.armed:
+            return False
+        now = self._clock()
+        with self._lock:
+            last = self._last_at.get(reason)
+            if not force and last is not None and (
+                now - last < self.min_interval_s
+            ):
+                return False
+            self._last_at[reason] = now
+            self._pending = reason
+        logger.info("device profiler capture requested (%s)", reason)
+        return True
+
+    @contextlib.contextmanager
+    def maybe_capture(self):
+        """Wraps one dispatch window: a no-op unless a request is
+        pending, else the block runs under ``jax.profiler`` into a
+        fresh ``profile-<ts>-<reason>-<pid>`` directory. Profiler
+        errors never propagate into the dispatch path."""
+        if self._pending is None:  # the per-batch fast path: one read
+            yield
+            return
+        with self._lock:
+            reason, self._pending = self._pending, None
+        if reason is None or self.profile_dir is None:
+            yield
+            return
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        safe = "".join(c if c.isalnum() or c in "-_" else "_" for c in reason)
+        path = os.path.join(
+            self.profile_dir, f"profile-{stamp}-{safe}-{os.getpid()}"
+        )
+        started = False
+        try:
+            os.makedirs(path, exist_ok=True)
+            _start_trace(path)
+            started = True
+        except Exception:  # noqa: BLE001 — attribution must not kill the batch
+            logger.exception("device profiler start failed (%s)", reason)
+        try:
+            yield
+        finally:
+            if started:
+                try:
+                    _stop_trace()
+                    self.captures += 1
+                    self.last_capture = path
+                    logger.info(
+                        "device profiler capture (%s) written to %s",
+                        reason, path,
+                    )
+                except Exception:  # noqa: BLE001 — ditto
+                    logger.exception(
+                        "device profiler stop failed (%s)", reason
+                    )
+
+    def capture_info(self) -> dict | None:
+        """The flight-dump context block: None when unarmed, else the
+        directory, capture count, and the latest capture path (None
+        until the first window actually ran)."""
+        if not self.armed:
+            return None
+        return {
+            "dir": self.profile_dir,
+            "captures": self.captures,
+            "last_capture": self.last_capture,
+        }
+
+
+_profiler_lock = threading.Lock()
+_profiler: DeviceProfiler | None = None
+
+
+def get_device_profiler() -> DeviceProfiler:
+    """The process-wide device profiler (created on first use)."""
+    global _profiler
+    with _profiler_lock:
+        if _profiler is None:
+            _profiler = DeviceProfiler()
+        return _profiler
+
+
+def reset_device_profiler(**kwargs) -> DeviceProfiler:
+    """Replaces the process-wide profiler with a fresh one (tests)."""
+    global _profiler
+    with _profiler_lock:
+        _profiler = DeviceProfiler(**kwargs)
+        return _profiler

@@ -178,6 +178,7 @@ STANDARD_COUNTERS = (
     "worker.pipeline_engine_failures_total",
     "sched.pad_steps_total",
     "sched.pad_slots_total",
+    "sched.steps_total",
     # The prefetching device feed (sched/feed.py): starved = the consumer
     # outran the feed (host-bound), backpressure = the feed outran the
     # device (healthy). Pre-declared so "feed never starved" reads as 0,
@@ -212,8 +213,15 @@ STANDARD_COUNTERS = (
     "jax.retraces_total",
     "jax.backend_compiles_total",
     "obs.flight_dumps_total",
+    # Series the registry REFUSED to create because a label family hit
+    # its cardinality cap (MAX_LABEL_VALUES): the canary for a label
+    # minted from an unbounded value (queue names, player ids).
+    "obs.dropped_series_total",
     "serve.queries_total",
     "serve.view_publishes_total",
+    # The query engine's per-version result caches (serve/engine.py).
+    "serve.leaderboard_cache_hits_total",
+    "serve.tier_cache_hits_total",
     # The sharded serve plane (serve/view.py + serve/engine.py): H2D
     # bytes the publish path moved (the patch-vs-rebuild pin), routed
     # per-shard query traffic (per-shard serve.shard.queries_total
@@ -270,44 +278,123 @@ STANDARD_GAUGES = (
     "soak.virtual_seconds",
 )
 
+#: Histogram families the runtime emits (graftlint GL030 resolves
+#: literal ``histogram("...")`` names in service/sched/serve against
+#: this list; labeled series like ``phase_seconds{phase=}`` count as
+#: one family).
+STANDARD_HISTOGRAMS = (
+    "phase_seconds",
+    "sched.pack_occupancy",
+    "serve.microbatch_occupancy",
+    "jax.backend_compile_seconds",
+    "jax.trace_seconds",
+)
+
+#: The span/instant name catalog: every runtime-emitted trace-event name
+#: (docs/observability.md "Span format"). graftlint GL030 resolves
+#: string-literal ``.span("...")`` / ``.instant("...")`` names in
+#: service/, sched/ and serve/ against this tuple — a typo'd span name
+#: would otherwise just vanish from every timeline, silently. Computed
+#: names (``f"phase.{name}"``) are out of scope by design.
+SPAN_CATALOG = (
+    # worker / pipeline batch lifecycle
+    "batch.lifecycle",
+    "batch.encode",
+    "batch.pack",
+    "batch.chain",
+    "batch.dispatch",
+    "batch.compute",
+    "batch.fetch",
+    "batch.write_back",
+    "batch.commit",
+    # the prefetching device feed (producer thread)
+    "feed.materialize",
+    "feed.transfer",
+    # the tiered table's promotion/demotion traffic
+    "tier.promote",
+    "tier.demote",
+    # worker instants
+    "worker.dead_letter",
+    "worker.pipeline_degraded",
+    # causal tracing (obs/tracectx.py): enqueue anchor, batch join,
+    # serve-visible publish
+    "trace.enqueue",
+    "batch.assemble",
+    "view.publish",
+)
+
+#: Distinct labeled series allowed per family (base metric name) before
+#: the registry refuses to mint more. An unbounded label value (player
+#: ids, per-request tokens) would otherwise grow the registry — and
+#: every snapshot, scrape and flight dump serializing it — forever.
+MAX_LABEL_VALUES = 256
+
 
 class MetricsRegistry:
-    """get-or-create instrument store keyed by ``name{labels}``."""
+    """get-or-create instrument store keyed by ``name{labels}``.
 
-    def __init__(self, declare_standard: bool = True) -> None:
+    Label cardinality is CAPPED per family (:data:`MAX_LABEL_VALUES`
+    distinct labeled series per base name): past the cap, the registry
+    stops minting new series — the overflow traffic lands on one shared
+    unregistered instrument per family (call sites keep working, the
+    snapshot stops growing) and every refused mint counts into
+    ``obs.dropped_series_total``, so the condition is visible instead
+    of an unbounded-memory failure mode."""
+
+    def __init__(
+        self,
+        declare_standard: bool = True,
+        max_label_values: int = MAX_LABEL_VALUES,
+    ) -> None:
         self._lock = threading.Lock()
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, Gauge] = {}
         self._histograms: dict[str, Histogram] = {}
+        self.max_label_values = int(max_label_values)
+        # family name -> count of labeled series minted under it.
+        self._family_counts: dict[str, int] = {}
+        # family name -> the shared post-cap overflow instrument (NOT in
+        # the snapshot dicts — it absorbs writes, it is not a series).
+        self._overflow: dict[str, object] = {}
+        # Created directly (the lock is not re-entrant) and always
+        # present: the drop path below increments it under the lock.
+        self._dropped = self._counters.setdefault(
+            "obs.dropped_series_total", Counter()
+        )
         if declare_standard:
             for name in STANDARD_COUNTERS:
                 self.counter(name)
             for name in STANDARD_GAUGES:
                 self.gauge(name)
 
-    def counter(self, name: str, **labels) -> Counter:
+    def _get_or_create(self, store: dict, name: str, labels: dict, factory):
         key = _series_key(name, labels)
         with self._lock:
-            c = self._counters.get(key)
-            if c is None:
-                c = self._counters[key] = Counter()
-            return c
+            inst = store.get(key)
+            if inst is None:
+                if labels:
+                    n = self._family_counts.get(name, 0)
+                    if n >= self.max_label_values:
+                        # Cap hit: count the refusal, route the caller to
+                        # the family's shared overflow instrument.
+                        self._dropped.add(1)
+                        okey = f"{factory.__name__}:{name}"
+                        inst = self._overflow.get(okey)
+                        if inst is None:
+                            inst = self._overflow[okey] = factory()
+                        return inst
+                    self._family_counts[name] = n + 1
+                inst = store[key] = factory()
+            return inst
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get_or_create(self._counters, name, labels, Counter)
 
     def gauge(self, name: str, **labels) -> Gauge:
-        key = _series_key(name, labels)
-        with self._lock:
-            g = self._gauges.get(key)
-            if g is None:
-                g = self._gauges[key] = Gauge()
-            return g
+        return self._get_or_create(self._gauges, name, labels, Gauge)
 
     def histogram(self, name: str, **labels) -> Histogram:
-        key = _series_key(name, labels)
-        with self._lock:
-            h = self._histograms.get(key)
-            if h is None:
-                h = self._histograms[key] = Histogram()
-            return h
+        return self._get_or_create(self._histograms, name, labels, Histogram)
 
     def snapshot(self) -> dict:
         """JSON-ready view of every series: counter values, gauge values,

@@ -27,6 +27,34 @@ import threading
 import time
 from collections import deque
 
+# Causal-trace binding (obs/tracectx.py): the CURRENT trace id for this
+# thread, attached to every event emitted while bound. Lives here (not
+# in tracectx) so _append needs no import and an unbound thread pays one
+# thread-local getattr per event — nothing allocates when tracing is off.
+_tls = threading.local()
+
+
+def current_trace() -> str | None:
+    """The trace id bound to this thread (None when unbound)."""
+    return getattr(_tls, "trace", None)
+
+
+@contextlib.contextmanager
+def bind_trace(trace: str | None):
+    """Binds ``trace`` as this thread's causal context: every span and
+    instant emitted inside the block gains ``args["trace"] = trace``.
+    ``None`` is a no-op, so call sites need no enabled-check of their
+    own. Re-entrant — the previous binding is restored on exit."""
+    if trace is None:
+        yield
+        return
+    prev = getattr(_tls, "trace", None)
+    _tls.trace = trace
+    try:
+        yield
+    finally:
+        _tls.trace = prev
+
 
 class Tracer:
     def __init__(self, maxlen: int = 20_000) -> None:
@@ -40,6 +68,12 @@ class Tracer:
         return (time.perf_counter() - self.epoch_perf) * 1e6
 
     def _append(self, event: dict) -> None:
+        trace = getattr(_tls, "trace", None)
+        if trace is not None:
+            # The causal id rides in args so existing span consumers
+            # (Perfetto, snapshots) need no format change; setdefault
+            # keeps an explicit trace=/batch= arg authoritative.
+            event["args"].setdefault("trace", trace)
         with self._lock:
             if len(self._events) == self._events.maxlen:
                 self.dropped += 1

@@ -1,0 +1,401 @@
+"""Trace analyzer: reconstruct per-match / per-batch timelines from a
+trace-events export.
+
+Input is the Chrome trace-event JSONL the tracer exports (``cli rate
+--trace-events``, ``cli soak --trace-events``, or the ``trace.jsonl``
+inside a flight-recorder dump directory). With causal tracing enabled
+(obs/tracectx.py) those events carry the ids that make reconstruction
+possible:
+
+  * ``trace.enqueue`` instants anchor each match's timeline at the
+    moment it entered the broker;
+  * ``batch.assemble`` instants record which match traces joined which
+    batch (``batch`` id + ``members`` + ``enqueues``);
+  * every span the batch's pipeline emitted — encode, pack, the feed
+    thread's materialize/transfer, dispatch, fetch, commit — carries
+    ``args.trace`` = the batch id;
+  * ``view.publish`` instants mark the version that made the batch's
+    rows serve-visible.
+
+:func:`build_model` joins those into a :class:`TraceModel`;
+:func:`match_report` / :func:`batch_report` decompose one journey into
+the operator-facing stages (queue wait, encode, pack, feed staging,
+H2D, dispatch, fetch, commit, publish lag); :func:`critical_path`
+aggregates a window of batches and names the dominant stage — the
+number a staleness page actually needs. ``cli trace`` renders all
+three; the soak driver embeds :func:`critical_path` into the SOAK
+artifact. Stdlib-only, like the rest of the exposition layer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+#: Span name -> stage bucket of the operator-facing decomposition.
+#: ``batch.compute`` / ``batch.dispatch`` are ENQUEUE cost (dispatch);
+#: device time surfaces host-side in ``batch.fetch``; the tier manager's
+#: promote/demote traffic is feed-thread staging work.
+STAGE_OF = {
+    "batch.encode": "encode",
+    "batch.pack": "pack",
+    "batch.chain": "dispatch",
+    "batch.dispatch": "dispatch",
+    "batch.compute": "dispatch",
+    "feed.materialize": "feed_staging",
+    "tier.promote": "feed_staging",
+    "tier.demote": "feed_staging",
+    "feed.transfer": "h2d",
+    "batch.fetch": "fetch",
+    "batch.write_back": "commit",
+    "batch.commit": "commit",
+}
+
+#: Stage order for reports (queue wait first, publish lag last — the
+#: journey's actual order).
+STAGES = (
+    "queue_wait", "encode", "pack", "feed_staging", "h2d",
+    "dispatch", "fetch", "commit", "publish_lag",
+)
+
+
+class BatchTrace:
+    """One batch's reconstructed record."""
+
+    __slots__ = (
+        "batch_id", "assemble_ts", "members", "enqueues", "stage_us",
+        "commit_end", "publish_ts", "publish_version", "mode",
+    )
+
+    def __init__(self, batch_id: str, assemble_ts: float,
+                 members: list, enqueues: list) -> None:
+        self.batch_id = batch_id
+        self.assemble_ts = assemble_ts
+        self.members = members
+        self.enqueues = enqueues
+        self.stage_us: dict[str, float] = {}
+        self.commit_end: float | None = None
+        self.publish_ts: float | None = None
+        self.publish_version: int | None = None
+        self.mode: str | None = None
+
+
+class TraceModel:
+    """The joined view over one trace export."""
+
+    def __init__(self) -> None:
+        self.batches: dict[str, BatchTrace] = {}
+        self.match_batch: dict[str, str] = {}
+        self.enqueue_ts: dict[str, float] = {}
+
+    def batch_of(self, match_id: str) -> BatchTrace | None:
+        bid = self.match_batch.get(match_id)
+        return self.batches.get(bid) if bid else None
+
+
+def load_events(path: str) -> list[dict]:
+    """Parses a trace-events JSONL file — or, given a flight-recorder
+    dump directory, its ``trace.jsonl``. Raises OSError/ValueError on
+    unreadable or malformed input (a truncated final line is tolerated:
+    a crashed run must still analyze)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "trace.jsonl")
+    events: list[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                # Only the final line may be torn (crash mid-write).
+                remainder = f.read().strip()
+                if remainder:
+                    raise ValueError(
+                        f"{path}:{i + 1}: malformed trace event"
+                    ) from None
+    return events
+
+
+def build_model(events: list[dict]) -> TraceModel:
+    """Joins raw trace events into a :class:`TraceModel`. Events from
+    untraced work (no causal ids — warmup, other runs sharing the ring)
+    are skipped; a bounded ring that dropped a batch's early events
+    yields a partial record, which :func:`verify_chain` reports instead
+    of hiding."""
+    model = TraceModel()
+    # The ring appends in emission order per thread but interleaves
+    # across threads; ts-sorting makes the join order-insensitive.
+    for ev in sorted(events, key=lambda e: e.get("ts", 0.0)):
+        name = ev.get("name")
+        args = ev.get("args") or {}
+        ts = float(ev.get("ts", 0.0))
+        if name == "trace.enqueue":
+            trace = args.get("trace")
+            if trace is not None:
+                model.enqueue_ts.setdefault(str(trace), ts)
+            continue
+        if name == "batch.assemble":
+            bid = args.get("batch")
+            if bid is None:
+                continue
+            members = [str(m) for m in (args.get("members") or [])]
+            bt = BatchTrace(
+                str(bid), ts, members, list(args.get("enqueues") or [])
+            )
+            model.batches[bt.batch_id] = bt
+            for m in members:
+                model.match_batch[m] = bt.batch_id
+            continue
+        trace = args.get("trace")
+        if trace is None or str(trace) not in model.batches:
+            continue
+        bt = model.batches[str(trace)]
+        if name == "view.publish":
+            if bt.publish_ts is None:  # first publish wins: the moment
+                bt.publish_ts = ts     # the rows became serve-visible
+                bt.publish_version = args.get("version")
+            continue
+        if ev.get("ph") != "X":
+            continue
+        if name == "batch.lifecycle":
+            bt.mode = args.get("mode")
+            continue
+        stage = STAGE_OF.get(name)
+        if stage is None:
+            continue
+        dur = float(ev.get("dur", 0.0))
+        bt.stage_us[stage] = bt.stage_us.get(stage, 0.0) + dur
+        if stage == "commit":
+            end = ts + dur
+            if bt.commit_end is None or end > bt.commit_end:
+                bt.commit_end = end
+    return model
+
+
+def _ms(us: float | None) -> float | None:
+    return None if us is None else round(us / 1e3, 3)
+
+
+def batch_report(bt: BatchTrace) -> dict:
+    """One batch's stage decomposition, milliseconds."""
+    waits = [
+        bt.assemble_ts - e
+        for e in bt.enqueues
+        if isinstance(e, (int, float))
+    ]
+    stages: dict[str, float | None] = {
+        "queue_wait": _ms(max(waits)) if waits else None,
+    }
+    for s in STAGES[1:-1]:
+        stages[s] = _ms(bt.stage_us.get(s))
+    stages["publish_lag"] = (
+        _ms(bt.publish_ts - bt.commit_end)
+        if bt.publish_ts is not None and bt.commit_end is not None
+        else None
+    )
+    return {
+        "batch": bt.batch_id,
+        "mode": bt.mode,
+        "matches": len(bt.members),
+        "assemble_us": round(bt.assemble_ts, 1),
+        "stages_ms": stages,
+        "publish_version": bt.publish_version,
+        "end_to_end_ms": (
+            _ms(bt.publish_ts - min(
+                [e for e in bt.enqueues if isinstance(e, (int, float))],
+                default=bt.assemble_ts,
+            ))
+            if bt.publish_ts is not None else None
+        ),
+    }
+
+
+def match_report(model: TraceModel, match_id: str) -> dict | None:
+    """One match's journey: its own queue wait plus its batch's stage
+    decomposition. None when the trace never saw the match."""
+    bt = model.batch_of(match_id)
+    enq = model.enqueue_ts.get(match_id)
+    if enq is None and bt is not None and match_id in bt.members:
+        e = bt.enqueues[bt.members.index(match_id)]
+        enq = float(e) if isinstance(e, (int, float)) else None
+    if bt is None and enq is None:
+        return None
+    report = {
+        "match": match_id,
+        "enqueue_us": None if enq is None else round(enq, 1),
+        "batch": None,
+        "queue_wait_ms": None,
+        "stages_ms": None,
+        "publish_version": None,
+        "end_to_end_ms": None,
+    }
+    if bt is None:
+        return report
+    b = batch_report(bt)
+    report["batch"] = bt.batch_id
+    report["queue_wait_ms"] = (
+        _ms(bt.assemble_ts - enq) if enq is not None else None
+    )
+    stages = dict(b["stages_ms"])
+    stages["queue_wait"] = report["queue_wait_ms"]
+    report["stages_ms"] = stages
+    report["publish_version"] = bt.publish_version
+    if bt.publish_ts is not None and enq is not None:
+        report["end_to_end_ms"] = _ms(bt.publish_ts - enq)
+    return report
+
+
+def verify_chain(model: TraceModel, match_id: str) -> list[str]:
+    """The completeness/monotonicity check the e2e tests gate on:
+    returns human-readable problems (empty = the chain enqueue ->
+    batch -> commit -> publish reconstructs completely with monotone
+    timestamps)."""
+    problems: list[str] = []
+    bt = model.batch_of(match_id)
+    if bt is None:
+        return [f"{match_id}: no batch.assemble names this match"]
+    enq = model.enqueue_ts.get(match_id)
+    if enq is None and match_id in bt.members:
+        e = bt.enqueues[bt.members.index(match_id)]
+        enq = float(e) if isinstance(e, (int, float)) else None
+    if enq is None:
+        problems.append(f"{match_id}: no enqueue timestamp")
+    for stage in ("encode", "dispatch", "commit"):
+        if not bt.stage_us.get(stage):
+            problems.append(
+                f"{match_id}: batch {bt.batch_id} has no {stage} span"
+            )
+    if bt.publish_ts is None or bt.publish_version is None:
+        problems.append(
+            f"{match_id}: batch {bt.batch_id} never published a view "
+            "version"
+        )
+    # Monotone timeline (us, one tracer epoch): enqueue <= assemble;
+    # commit ends before the publish that exposes it.
+    if enq is not None and enq > bt.assemble_ts + 1.0:
+        problems.append(
+            f"{match_id}: enqueue ({enq:.1f}) after batch assembly "
+            f"({bt.assemble_ts:.1f})"
+        )
+    if (
+        bt.publish_ts is not None
+        and bt.commit_end is not None
+        and bt.commit_end > bt.publish_ts + 1.0
+    ):
+        problems.append(
+            f"{match_id}: commit end ({bt.commit_end:.1f}) after view "
+            f"publish ({bt.publish_ts:.1f})"
+        )
+    if enq is not None and bt.publish_ts is not None and (
+        enq > bt.publish_ts
+    ):
+        problems.append(
+            f"{match_id}: enqueue after the publish that served it"
+        )
+    return problems
+
+
+def critical_path(model: TraceModel, window: int | None = None) -> dict:
+    """Aggregate stage decomposition over a window of batches (the last
+    ``window`` by assembly time; None = all): total ms and share per
+    stage, and the DOMINANT stage — what a staleness/p99 page should
+    look at first. Queue wait and publish lag aggregate per batch
+    (max-wait member and commit->publish gap respectively)."""
+    batches = sorted(model.batches.values(), key=lambda b: b.assemble_ts)
+    if window:
+        batches = batches[-window:]
+    totals = {s: 0.0 for s in STAGES}
+    counted = {s: 0 for s in STAGES}
+    matches = 0
+    for bt in batches:
+        matches += len(bt.members)
+        rep = batch_report(bt)["stages_ms"]
+        for s in STAGES:
+            v = rep.get(s)
+            if v is not None:
+                totals[s] += v
+                counted[s] += 1
+    grand = sum(totals.values())
+    dominant = max(totals, key=lambda s: totals[s]) if grand > 0 else None
+    return {
+        "batches": len(batches),
+        "matches": matches,
+        "stages_ms": {s: round(totals[s], 3) for s in STAGES},
+        "stage_share": {
+            s: (round(totals[s] / grand, 4) if grand > 0 else None)
+            for s in STAGES
+        },
+        "batches_counted": counted,
+        "dominant_stage": dominant,
+    }
+
+
+# -- rendering (cli trace) --------------------------------------------------
+
+def _fmt_ms(v) -> str:
+    return "-" if v is None else f"{v:.3f}"
+
+
+def render_stages(stages: dict, indent: str = "  ") -> str:
+    width = max(len(s) for s in STAGES)
+    return "\n".join(
+        f"{indent}{s.ljust(width)}  {_fmt_ms(stages.get(s))} ms"
+        for s in STAGES
+    )
+
+
+def render_match(report: dict) -> str:
+    out = [f"match {report['match']}"]
+    if report["batch"] is None:
+        out.append("  enqueued but never assembled into a batch "
+                   "(still queued, dead-lettered, or outside the ring)")
+        return "\n".join(out) + "\n"
+    out.append(f"  batch {report['batch']}"
+               + (f" ({report.get('mode')})" if report.get("mode") else ""))
+    if report["stages_ms"]:
+        out.append(render_stages(report["stages_ms"]))
+    v = report["publish_version"]
+    out.append(
+        f"  served at view v{v}" if v is not None
+        else "  never became serve-visible in this trace"
+    )
+    if report["end_to_end_ms"] is not None:
+        out.append(f"  end-to-end {report['end_to_end_ms']:.3f} ms "
+                   "(enqueue -> served-visible)")
+    return "\n".join(out) + "\n"
+
+
+def render_batch(report: dict) -> str:
+    out = [
+        f"batch {report['batch']} ({report['matches']} matches"
+        + (f", {report['mode']}" if report.get("mode") else "") + ")"
+    ]
+    out.append(render_stages(report["stages_ms"]))
+    v = report["publish_version"]
+    out.append(
+        f"  served at view v{v}" if v is not None
+        else "  never became serve-visible in this trace"
+    )
+    return "\n".join(out) + "\n"
+
+
+def render_critical_path(cp: dict) -> str:
+    out = [
+        f"critical path over {cp['batches']} batch(es) / "
+        f"{cp['matches']} match(es):"
+    ]
+    grand = sum(v for v in cp["stages_ms"].values())
+    width = max(len(s) for s in STAGES)
+    for s in STAGES:
+        total = cp["stages_ms"][s]
+        share = cp["stage_share"][s]
+        pct = "" if share is None else f"  {100 * share:5.1f}%"
+        out.append(f"  {s.ljust(width)}  {total:10.3f} ms{pct}")
+    out.append(
+        f"  dominant stage: {cp['dominant_stage']}"
+        if cp["dominant_stage"] else "  (no attributable stage time)"
+    )
+    out.append(f"  total attributed: {grand:.3f} ms")
+    return "\n".join(out) + "\n"

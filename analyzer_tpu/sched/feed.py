@@ -47,6 +47,7 @@ import threading
 from collections import deque
 
 from analyzer_tpu.obs import get_registry, get_tracer
+from analyzer_tpu.obs.tracer import bind_trace, current_trace
 
 #: Default ring depth: one slab in flight on the device, one committed
 #: behind it. Depth 3 buys jitter tolerance on hosts where
@@ -159,6 +160,12 @@ class Prefetcher:
         self, producer, depth: int = DEFAULT_DEPTH, name: str = "sched-feed"
     ) -> None:
         self.feed = DeviceFeed(depth)
+        # Causal-trace inheritance: the producer thread stages windows ON
+        # BEHALF of whatever batch/run is bound on the constructing
+        # (consumer) thread, so its feed.materialize/feed.transfer spans
+        # must join that trace — captured here, re-bound in _run (None
+        # when tracing is off or nothing is bound: zero cost).
+        self._trace = current_trace()
         self._thread = threading.Thread(
             target=self._run, args=(producer,), name=name, daemon=True
         )
@@ -166,7 +173,8 @@ class Prefetcher:
 
     def _run(self, producer) -> None:
         try:
-            producer(self.feed.put)
+            with bind_trace(self._trace):
+                producer(self.feed.put)
         except FeedClosedError:
             pass  # consumer aborted first; its exception is the story
         except BaseException as e:  # noqa: BLE001 — re-raised on the consumer

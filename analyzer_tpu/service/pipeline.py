@@ -83,6 +83,7 @@ import numpy as np
 from analyzer_tpu.core.state import MU_LO, SIGMA_HI
 from analyzer_tpu.logging_utils import get_logger
 from analyzer_tpu.obs import get_flight_recorder, get_registry, get_tracer
+from analyzer_tpu.obs.tracer import bind_trace, current_trace
 from analyzer_tpu.sched.runner import _gather_outputs, _scan_chunk
 from analyzer_tpu.service.columnar import finalize
 from analyzer_tpu.utils.host import fetch_tree
@@ -250,6 +251,10 @@ class _Job:
     # strictly AFTER the writer committed — so readers never see a
     # posterior the store might still roll back.
     view_table: object = None
+    # Causal-trace id of the batch (None when tracing is off): the
+    # writer thread re-binds it so batch.fetch/batch.write_back join
+    # the batch's tree, and harvest re-binds it around publish + ack.
+    trace: str | None = None
 
 
 class _Writer(threading.Thread):
@@ -342,15 +347,19 @@ class _Writer(threading.Thread):
                     # Two spans, not one: fetch materializes the async D2H
                     # stream (tunnel-bound), write_back+commit is store
                     # work — the split is exactly the balance the lag
-                    # auto-tuner reasons about (choose_pipeline_lag).
-                    with get_tracer().span(
-                        "batch.fetch", cat="pipeline", seq=job.seq
-                    ):
-                        outs = job.fetch.result()
-                    with get_tracer().span(
-                        "batch.write_back", cat="pipeline", seq=job.seq
-                    ):
-                        finalize(self.store, job.enc, outs)
+                    # auto-tuner reasons about (choose_pipeline_lag). The
+                    # job's batch trace re-binds here so both spans join
+                    # the consumer thread's tree (bind is a no-op when
+                    # tracing was off at submit).
+                    with bind_trace(job.trace):
+                        with get_tracer().span(
+                            "batch.fetch", cat="pipeline", seq=job.seq
+                        ):
+                            outs = job.fetch.result()
+                        with get_tracer().span(
+                            "batch.write_back", cat="pipeline", seq=job.seq
+                        ):
+                            finalize(self.store, job.enc, outs)
                     job.status = "ok"
                 except BaseException as err:  # noqa: BLE001 — policy boundary
                     job.status = "failed"
@@ -493,7 +502,7 @@ class PipelineEngine:
         )
         chunk = w._step_chunk
         ys_chunks = []
-        with dispatch_span:
+        with dispatch_span, w.profiler.maybe_capture():
             for s0 in range(0, sched.n_steps, chunk):
                 arrays = sched.device_arrays(s0, s0 + chunk)
                 state, ys = _scan_chunk(state, arrays, w.rating_config, True,
@@ -568,6 +577,9 @@ class PipelineEngine:
         self.writer.submit(_Job(
             seq=self.seq, msgs=msgs, enc=enc, fetch=fetch,
             view_table=view_table,
+            # Submit runs on the consumer thread inside the batch's
+            # bind (Worker.try_process); capture it for the writer.
+            trace=current_trace(),
         ))
         self.seq += 1
         self._update_inflight()
@@ -606,13 +618,16 @@ class PipelineEngine:
             if job.status == "ok":
                 w.matches_rated += len(job.enc.matches)
                 w.batches_ok += 1
-                if job.view_table is not None:
-                    # Commit is durable (the writer finished this job):
-                    # publish the batch's posteriors to the read plane
-                    # before acking, mirroring the sequential lane's
-                    # commit -> publish -> ack order.
-                    w._publish_view(job.enc, job.view_table)
-                w._ack_batch(job.msgs)
+                with bind_trace(job.trace):
+                    if job.view_table is not None:
+                        # Commit is durable (the writer finished this
+                        # job): publish the batch's posteriors to the
+                        # read plane before acking, mirroring the
+                        # sequential lane's commit -> publish -> ack
+                        # order. The bind makes the view.publish
+                        # instant name this batch's trace.
+                        w._publish_view(job.enc, job.view_table)
+                    w._ack_batch(job.msgs)
             elif job.status == "failed":
                 logger.error("pipelined batch failed: %s", job.error)
                 w.batches_failed += 1

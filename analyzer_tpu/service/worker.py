@@ -33,7 +33,14 @@ import time
 
 from analyzer_tpu.config import RatingConfig, ServiceConfig
 from analyzer_tpu.logging_utils import get_logger
-from analyzer_tpu.obs import get_flight_recorder, get_registry, get_tracer
+from analyzer_tpu.obs import (
+    get_device_profiler,
+    get_flight_recorder,
+    get_registry,
+    get_tracer,
+)
+from analyzer_tpu.obs import tracectx
+from analyzer_tpu.obs.tracer import bind_trace
 from analyzer_tpu.sched import pack_schedule, rate_history
 from analyzer_tpu.service.broker import Broker, Message
 from analyzer_tpu.service.encode import EncodedBatch
@@ -102,6 +109,7 @@ class Worker:
         serve_port: int | None = None,
         serve_host: str | None = None,
         serve_shards: int | None = None,
+        profile_dir: str | None = None,
     ) -> None:
         self.broker = broker
         self.store = store
@@ -177,6 +185,15 @@ class Worker:
         self.flight = get_flight_recorder()
         if flight_dir is not None:
             self.flight.configure(base_dir=flight_dir)
+        # Device-time attribution (obs/prof.py): armed by profile_dir
+        # here or ANALYZER_TPU_PROFILE_DIR; unarmed it costs one
+        # attribute read per batch. SIGUSR2 requests a capture of the
+        # next dispatch window; dead-letters/degradation request one
+        # automatically (throttled) so the flight dump gets device
+        # timing next to the host-side trace.
+        self.profiler = get_device_profiler()
+        if profile_dir is not None:
+            self.profiler.configure(profile_dir=profile_dir)
         # obsd (obs/server.py): the live introspection plane. Readiness
         # combines the pipeline lane's health with duck-typed broker/
         # store connectivity probes — `curl :port/readyz` flips to 503
@@ -340,6 +357,10 @@ class Worker:
             if hasattr(signal, "SIGUSR1"):  # not on Windows
                 previous_handlers[signal.SIGUSR1] = signal.signal(
                     signal.SIGUSR1, self._on_sigusr1
+                )
+            if hasattr(signal, "SIGUSR2"):  # on-demand device capture
+                previous_handlers[signal.SIGUSR2] = signal.signal(
+                    signal.SIGUSR2, self._on_sigusr2
                 )
         try:
             flushes = 0
@@ -606,6 +627,10 @@ class Worker:
         # (throttled; obs/flight.py). The failure policy above already
         # completed, so a dump failure costs nothing but the artifact.
         self.flight.note("dead_letter", messages=len(messages))
+        # Device-time attribution for the failure window: ask for a
+        # (throttled) jax.profiler capture of the NEXT dispatch so the
+        # dump below names device timing next to the host-side trace.
+        self.profiler.request("dead_letter")
         self._flight_dump("dead_letter")
 
     def try_process(self) -> None:
@@ -617,10 +642,16 @@ class Worker:
         self.queue = []
         self._first_message_at = None
         mode = "pipelined" if self.pipeline_enabled else "sequential"
+        # Causal join (obs/tracectx.py, no-op when tracing is off): one
+        # batch.assemble instant maps member match traces -> this batch,
+        # and binding the batch id makes every span below — including
+        # the feed thread's and the pipelined writer's — part of one
+        # reconstructable tree (cli trace).
+        trace = tracectx.assemble(batch)
         # The batch lifecycle span: flush -> (encode/rate/commit or
         # dead-letter). In pipelined mode this covers submission only —
         # commit + ack land in a later harvest (their own spans).
-        with get_tracer().span(
+        with bind_trace(trace), get_tracer().span(
             "batch.lifecycle", cat="worker", messages=len(batch), mode=mode
         ):
             if self.pipeline_enabled:
@@ -684,6 +715,7 @@ class Worker:
             "pipelined mode disabled (%s); using the sequential loop",
             reason,
         )
+        self.profiler.request("pipeline_degraded")
         self._flight_dump("pipeline_degraded")
         set_prefetch = getattr(self.broker, "set_prefetch", None)
         if set_prefetch is not None:
@@ -872,7 +904,7 @@ class Worker:
             sched = self._bucketed_schedule(enc.stream, enc.state.pad_row)
         with tracer.span(
             "batch.compute", cat="worker", matches=n, steps=sched.n_steps
-        ):
+        ), self.profiler.maybe_capture():
             final_state, outs = rate_history(
                 enc.state, sched, self.rating_config, collect=True,
                 steps_per_chunk=self._step_chunk,
@@ -919,6 +951,15 @@ class Worker:
                 ids[row] = pid
             rows = np.asarray(table)[: len(ids)]
             view = self.view_publisher.publish_rows(ids, rows)
+            if tracectx.tracing_enabled():
+                # The served-visible anchor of the causal chain: the
+                # bound batch trace rides in via args (commit happened
+                # strictly before — sequential order, or the pipelined
+                # harvest after the writer finished this job).
+                get_tracer().instant(
+                    "view.publish", cat="trace",
+                    version=view.version, players=view.n_players,
+                )
             logger.debug(
                 "published ratings view v%d (%d players)",
                 view.version, view.n_players,
@@ -949,9 +990,12 @@ class Worker:
     def _flight_dump(self, reason: str, force: bool = False) -> None:
         """One flight-recorder artifact for a failure path. Never raises
         (obs/flight.py owns the throttle + error swallowing); the config
-        capture rides along so the artifact explains the worker's knobs."""
+        capture rides along so the artifact explains the worker's knobs,
+        and the device profiler's capture info names the jax.profiler
+        artifact directory when one is armed."""
         self.flight.dump(
-            reason, config=dataclasses.asdict(self.config), force=force
+            reason, config=dataclasses.asdict(self.config), force=force,
+            profile=self.profiler.capture_info(),
         )
 
     def _on_sigusr1(self, *_args) -> None:
@@ -960,6 +1004,19 @@ class Worker:
         IO here cannot interleave with a batch mid-commit."""
         logger.info("SIGUSR1: %s", self.stats())
         self._flight_dump("sigusr1", force=True)
+
+    def _on_sigusr2(self, *_args) -> None:
+        """SIGUSR2: request a jax.profiler capture of the NEXT batch's
+        dispatch window (no-op + a log line when no --profile-dir is
+        armed). Force-bypasses the throttle — an operator asking twice
+        means it."""
+        if not self.profiler.armed:
+            logger.info(
+                "SIGUSR2: no profile dir armed (--profile-dir / "
+                "ANALYZER_TPU_PROFILE_DIR); ignoring capture request"
+            )
+            return
+        self.profiler.request("sigusr2", force=True)
 
     def _final_snapshot(self) -> None:
         """The graceful-shutdown snapshot: written into the flight
@@ -1102,6 +1159,7 @@ def main(
     flight_dir: str | None = None,
     serve_port: int | None = None,
     serve_shards: int | None = None,
+    profile_dir: str | None = None,
 ) -> Worker:
     """``python -m analyzer_tpu.service.worker`` — the reference's
     ``python3 worker.py`` entry point (``worker.py:219-221``), requiring a
@@ -1118,7 +1176,10 @@ def main(
     ratesrv query-serving plane (docs/serving.md); ``serve_shards`` (or
     ``ANALYZER_TPU_SERVE_SHARDS``) > 1 serves through the sharded plane
     (ShardedViewPublisher + ShardedQueryEngine — bit-identical results,
-    docs/serving.md "Sharded plane")."""
+    docs/serving.md "Sharded plane"); ``profile_dir`` (or
+    ``ANALYZER_TPU_PROFILE_DIR``) arms on-demand jax.profiler capture
+    windows — SIGUSR2, automatic on dead-letter/degradation
+    (docs/observability.md "Device-time attribution")."""
     config = ServiceConfig.from_env()
     if obs_port is None and os.environ.get("ANALYZER_TPU_OBS_PORT"):
         obs_port = int(os.environ["ANALYZER_TPU_OBS_PORT"])
@@ -1148,6 +1209,7 @@ def main(
     worker = Worker(
         broker, store, config, obs_port=obs_port, flight_dir=flight_dir,
         serve_port=serve_port, serve_shards=serve_shards,
+        profile_dir=profile_dir,
     )
     worker.warmup()  # compile before consuming: no first-batch stall
     try:

@@ -67,7 +67,9 @@ ANALYZER_TPU_FUSE_BACKEND — scan | pallas | interpret), BENCH_HOT_ROWS
 (default 0 = untiered; N keeps only an N-row hot set of the table
 device-resident — sched/tier.py — and embeds a `tiered` block: hit
 rate, promotion bytes, min_over_resident vs the resident rate_history
-line, plus an on-rig bit-identity check), BENCH_OBS_PORT
+line, plus an on-rig bit-identity check), BENCH_TRACE_OVERHEAD
+(default 1; 0 skips the tracing-on vs tracing-off `trace_overhead`
+block that `cli benchdiff` gates at <= 2%), BENCH_OBS_PORT
 (serve obsd — /metrics, /statusz — on localhost while the capture runs;
 `cli bench --obs-port` sets the same thing).
 """
@@ -300,6 +302,32 @@ def _bench_main(metrics_out: str | None) -> None:
         f"= {t_stream / head_best:.2f}x device-only time")
     streamed = streamed_stats(s_times, s_stable, head_best)
 
+    # Tracing tax: the SAME end-to-end rate_history line with causal
+    # tracing enabled and a trace bound (so every feed/compute span pays
+    # the id-attach path) vs the tracing-off t_e2e above. benchdiff
+    # gates overhead_pct <= 2% — "zero-allocation when disabled" is a
+    # static property, this keeps "nearly free when enabled" measured.
+    trace_overhead = None
+    if os.environ.get("BENCH_TRACE_OVERHEAD", "1") != "0":
+        from analyzer_tpu.obs.tracectx import bind_trace, enable_tracing
+
+        enable_tracing(True)
+        try:
+            with bind_trace("bench-trace-overhead"):
+                _, t_on, on_times, on_stable = time_runs(run_e2e, 2)
+        finally:
+            enable_tracing(False)
+        overhead_pct = (t_on - t_e2e) / t_e2e * 100.0
+        log(f"tracing-on rate_history: {t_on:.2f}s "
+            f"({overhead_pct:+.2f}% vs tracing-off)")
+        trace_overhead = {
+            "off_s": round(t_e2e, 3),
+            "on_s": round(t_on, 3),
+            "overhead_pct": round(overhead_pct, 2),
+            "repeats_s": [round(t, 3) for t in on_times],
+            "stable": on_stable,
+        }
+
     # Tiered table (BENCH_HOT_ROWS > 0): the SAME rate_history line with
     # only hot_rows of the table device-resident — min_over_resident is
     # the tiering tax benchdiff gates (sched/tier.py, docs/kernels.md).
@@ -342,6 +370,7 @@ def _bench_main(metrics_out: str | None) -> None:
         metrics_out=metrics_out,
         fused=fused_block,
         tiered=tiered_block,
+        trace_overhead=trace_overhead,
     )
 
 
@@ -676,7 +705,8 @@ def emit_metric(rate, capture: dict | None = None,
                 telemetry: dict | None = None,
                 metrics_out: str | None = None,
                 fused: dict | None = None,
-                tiered: dict | None = None):
+                tiered: dict | None = None,
+                trace_overhead: dict | None = None):
     line = {
         "metric": "matches_per_sec_per_chip",
         "value": round(rate, 1),
@@ -700,6 +730,10 @@ def emit_metric(rate, capture: dict | None = None,
         # min_over_resident; benchdiff --family tiered gates the ratio
         # so tier thrash or a silent fall-back-to-untiered fails CI).
         line["tiered"] = tiered
+    if trace_overhead is not None:
+        # The causal-tracing tax (tracing-on vs tracing-off on the same
+        # end-to-end line; `cli benchdiff` gates overhead_pct <= 2%).
+        line["trace_overhead"] = trace_overhead
     if telemetry is not None:
         line["telemetry"] = telemetry
     if metrics_out:

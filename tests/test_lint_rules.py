@@ -1315,3 +1315,92 @@ class TestGL029CrossShardGather:
         from analyzer_tpu.lint.findings import RULES
 
         assert "GL029" in RULES
+
+
+class TestGL030SchemaNames:
+    """GL030 resolves string-literal metric/span names in ``service/``,
+    ``sched/`` and ``serve/`` against the pre-declared schema
+    (``obs.registry.STANDARD_*`` + ``SPAN_CATALOG``) — a typo'd name
+    mints a series no dashboard reads, silently."""
+
+    TYPO_SRC = """
+    from analyzer_tpu.obs import get_registry, get_tracer
+
+    def poll(reg=None):
+        reg = reg or get_registry()
+        reg.counter("worker.matchs_rated_total").add(1)
+        reg.gauge("broker.que_depth").set(3)
+        reg.histogram("sched.pack_occupancyy").observe(0.5)
+        with get_tracer().span("batch.encodee", cat="worker"):
+            pass
+        get_tracer().instant("worker.dead_lettre", cat="worker")
+    """
+
+    CLEAN_SRC = """
+    from analyzer_tpu.obs import get_registry, get_tracer
+
+    def poll(reg=None, queue="analyze"):
+        reg = reg or get_registry()
+        reg.counter("worker.matches_rated_total").add(1)
+        reg.gauge("broker.queue_depth", queue=queue).set(3)
+        reg.histogram("sched.pack_occupancy").observe(0.5)
+        with get_tracer().span("batch.encode", cat="worker"):
+            pass
+        get_tracer().instant("worker.dead_letter", cat="worker")
+    """
+
+    def test_typod_names_fire_per_kind(self):
+        rules = rules_of(self.TYPO_SRC, "analyzer_tpu/service/worker.py")
+        assert rules == ["GL030"] * 5, rules
+
+    def test_schema_names_are_clean(self):
+        for path in (
+            "analyzer_tpu/service/worker.py",
+            "analyzer_tpu/sched/runner.py",
+            "analyzer_tpu/serve/engine.py",
+        ):
+            assert rules_of(self.CLEAN_SRC, path) == [], path
+
+    def test_silent_outside_the_schema_layers(self):
+        for path in (
+            "analyzer_tpu/obs/registry.py",
+            "analyzer_tpu/loadgen/driver.py",
+            "experiments/serve_bench.py",
+            "tests/test_service.py",
+        ):
+            assert "GL030" not in rules_of(self.TYPO_SRC, path), path
+
+    def test_computed_names_are_out_of_scope(self):
+        src = """
+        from analyzer_tpu.obs import get_registry
+
+        def tick(name):
+            get_registry().counter(f"app.{name}_total").add(1)
+            get_registry().counter(name).add(1)
+        """
+        assert rules_of(src, "analyzer_tpu/service/worker.py") == []
+
+    def test_trace_catalog_names_are_known(self):
+        src = """
+        from analyzer_tpu.obs import get_tracer
+
+        def publish(version):
+            get_tracer().instant("view.publish", cat="trace", version=version)
+            get_tracer().instant("batch.assemble", cat="trace")
+        """
+        assert rules_of(src, "analyzer_tpu/service/worker.py") == []
+
+    def test_disable_escape(self):
+        src = """
+        from analyzer_tpu.obs import get_registry
+
+        def once():
+            # graftlint: disable=GL030 — deliberately local debug series
+            get_registry().counter("debug.one_off_total").add(1)
+        """
+        assert rules_of(src, "analyzer_tpu/sched/feed.py") == []
+
+    def test_catalog_has_gl030(self):
+        from analyzer_tpu.lint.findings import RULES
+
+        assert "GL030" in RULES

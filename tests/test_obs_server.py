@@ -309,8 +309,13 @@ class TestFlightRecorder:
             base_dir=str(tmp_path), min_interval_s=3600.0
         )
         assert rec.dump("first") is not None
-        assert rec.dump("second") is None  # inside the throttle window
-        assert rec.dump("third", force=True) is not None
+        # The throttle is PER REASON (tests/test_trace.py pins the
+        # cross-reason independence): the same reason suppresses...
+        assert rec.dump("first") is None
+        # ...a different reason gets its own window...
+        assert rec.dump("second") is not None
+        # ...and force bypasses even the same-reason window.
+        assert rec.dump("first", force=True) is not None
         kinds = [e["kind"] for e in rec.events()]
         assert "dump.suppressed" in kinds
 

@@ -1,0 +1,629 @@
+"""Causal tracing + device-time attribution (ISSUE 10).
+
+Covers: the TraceContext propagation core (mint/headers/bind — zero-op
+when disabled), the registry's label-cardinality guard, the flight
+recorder's per-reason throttle, the device profiler's capture latch,
+the trace analyzer's reconstruction (synthetic events AND a real traced
+smoke soak: every rated match's chain must reconstruct completely with
+monotone timestamps), the determinism pin (tracing on leaves the SOAK
+deterministic block bit-identical), `cli trace`, and the benchdiff
+``trace_overhead`` gate.
+"""
+
+import json
+
+import pytest
+
+from analyzer_tpu.obs import (
+    get_registry,
+    get_tracer,
+    reset_flight_recorder,
+    reset_registry,
+)
+from analyzer_tpu.obs import tracectx
+from analyzer_tpu.obs.tracer import bind_trace, current_trace, reset_tracer
+
+
+@pytest.fixture(autouse=True)
+def fresh_obs():
+    reset_registry()
+    reset_tracer()
+    tracectx.enable_tracing(False)
+    yield
+    tracectx.enable_tracing(False)
+    reset_registry()
+    reset_tracer()
+
+
+class _Msg:
+    def __init__(self, body: bytes, headers=None):
+        self.body = body
+        self.headers = headers
+
+
+# ---------------------------------------------------------------------------
+class TestTraceContext:
+    def test_disabled_is_inert(self):
+        assert tracectx.mint("m1") is None
+        assert tracectx.headers(None) is None
+        assert tracectx.from_headers({"x-trace-id": "m1"}) is None
+        assert tracectx.assemble([_Msg(b"m1")]) is None
+        assert get_tracer().events() == []  # nothing emitted
+
+    def test_mint_emits_enqueue_anchor(self):
+        tracectx.enable_tracing(True)
+        ctx = tracectx.mint("m1")
+        assert ctx is not None and ctx.trace_id == "m1"
+        events = get_tracer().events()
+        assert [e["name"] for e in events] == ["trace.enqueue"]
+        assert events[0]["args"]["trace"] == "m1"
+
+    def test_headers_round_trip(self):
+        tracectx.enable_tracing(True)
+        ctx = tracectx.mint("m2")
+        hdrs = tracectx.headers(ctx)
+        back = tracectx.from_headers(hdrs)
+        assert back.trace_id == "m2"
+        assert back.span_id == ctx.span_id
+        assert abs(back.enqueue_us - ctx.enqueue_us) < 0.11  # 0.1us round
+
+    def test_from_headers_tolerates_untraced_messages(self):
+        tracectx.enable_tracing(True)
+        assert tracectx.from_headers(None) is None
+        assert tracectx.from_headers({}) is None
+        assert tracectx.from_headers({"notify": "x"}) is None
+        assert tracectx.from_headers(
+            {"x-trace-id": "m", "x-enqueue-us": "garbage"}
+        ) is None
+
+    def test_assemble_records_membership(self):
+        tracectx.enable_tracing(True)
+        ctx = tracectx.mint("m3")
+        batch = tracectx.assemble([
+            _Msg(b"m3", tracectx.headers(ctx)),
+            _Msg(b"legacy"),  # no headers: a mixed fleet keeps working
+        ])
+        assert batch.startswith("b")
+        ev = [e for e in get_tracer().events()
+              if e["name"] == "batch.assemble"][0]
+        assert ev["args"]["batch"] == batch
+        assert ev["args"]["members"] == ["m3", "legacy"]
+        assert ev["args"]["enqueues"][0] == pytest.approx(
+            ctx.enqueue_us, abs=0.11
+        )
+        assert ev["args"]["enqueues"][1] is None
+
+    def test_bind_attaches_trace_to_spans_across_threads(self):
+        import threading
+
+        tracectx.enable_tracing(True)
+        tracer = get_tracer()
+        with bind_trace("b1"):
+            with tracer.span("batch.encode", cat="worker"):
+                pass
+            inherited = current_trace()
+
+        def producer():
+            with bind_trace(inherited):
+                with tracer.span("feed.materialize", cat="sched"):
+                    pass
+
+        t = threading.Thread(target=producer)
+        t.start()
+        t.join()
+        with tracer.span("batch.commit", cat="worker"):
+            pass  # OUTSIDE the bind: must stay untagged
+        by_name = {e["name"]: e for e in tracer.events()}
+        assert by_name["batch.encode"]["args"]["trace"] == "b1"
+        assert by_name["feed.materialize"]["args"]["trace"] == "b1"
+        assert "trace" not in by_name["batch.commit"]["args"]
+
+    def test_prefetcher_inherits_the_constructing_threads_trace(self):
+        from analyzer_tpu.sched.feed import Prefetcher
+
+        tracectx.enable_tracing(True)
+        tracer = get_tracer()
+
+        def produce(put):
+            with tracer.span("feed.materialize", cat="sched", start=0):
+                put(1)
+
+        with bind_trace("b9"):
+            with Prefetcher(produce, depth=1) as pf:
+                assert list(pf) == [1]
+        ev = [e for e in tracer.events()
+              if e["name"] == "feed.materialize"][0]
+        assert ev["args"]["trace"] == "b9"
+
+
+# ---------------------------------------------------------------------------
+class TestRegistryCardinality:
+    def test_cap_stops_series_growth_and_counts_drops(self):
+        from analyzer_tpu.obs.registry import MetricsRegistry
+
+        reg = MetricsRegistry(declare_standard=False, max_label_values=4)
+        for i in range(10):
+            reg.gauge("broker.queue_depth", queue=f"q{i}").set(i)
+        snap = reg.snapshot()
+        labeled = [k for k in snap["gauges"] if k.startswith("broker.")]
+        assert len(labeled) == 4
+        assert snap["counters"]["obs.dropped_series_total"] == 6
+
+    def test_overflow_instrument_absorbs_writes(self):
+        from analyzer_tpu.obs.registry import MetricsRegistry
+
+        reg = MetricsRegistry(declare_standard=False, max_label_values=1)
+        reg.counter("x_total", k="a").add(1)
+        over1 = reg.counter("x_total", k="b")
+        over2 = reg.counter("x_total", k="c")
+        over1.add(2)
+        over2.add(3)
+        # One SHARED overflow instrument per family: bounded memory.
+        assert over1 is over2
+        assert over1.value == 5
+        assert "x_total{k=b}" not in reg.snapshot()["counters"]
+
+    def test_unlabeled_series_never_capped(self):
+        from analyzer_tpu.obs.registry import MetricsRegistry
+
+        reg = MetricsRegistry(declare_standard=False, max_label_values=1)
+        for name in ("a_total", "b_total", "c_total"):
+            reg.counter(name).add(1)
+        assert reg.counter("obs.dropped_series_total").value == 0
+
+    def test_default_cap_and_schema_declaration(self):
+        from analyzer_tpu.obs.registry import (
+            MAX_LABEL_VALUES,
+            STANDARD_COUNTERS,
+        )
+
+        assert MAX_LABEL_VALUES == 256
+        assert "obs.dropped_series_total" in STANDARD_COUNTERS
+        assert "obs.dropped_series_total" in (
+            get_registry().snapshot()["counters"]
+        )
+
+
+# ---------------------------------------------------------------------------
+class TestFlightThrottlePerReason:
+    def test_one_reason_cannot_suppress_another(self, tmp_path):
+        clock = {"t": 0.0}
+        rec = reset_flight_recorder(
+            base_dir=str(tmp_path), min_interval_s=30.0,
+            clock=lambda: clock["t"],
+        )
+        assert rec.dump("dead_letter") is not None
+        # Same reason inside the window: suppressed.
+        clock["t"] = 5.0
+        assert rec.dump("dead_letter") is None
+        # DIFFERENT reason inside the window: its own throttle, dumps.
+        clock["t"] = 6.0
+        assert rec.dump("degradation") is not None
+        # Both reasons clear independently.
+        clock["t"] = 40.0
+        assert rec.dump("dead_letter") is not None
+        kinds = [e["kind"] for e in rec.events()]
+        assert kinds.count("dump.suppressed") == 1
+
+    def test_force_bypasses_the_reason_window(self, tmp_path):
+        clock = {"t": 0.0}
+        rec = reset_flight_recorder(
+            base_dir=str(tmp_path), min_interval_s=30.0,
+            clock=lambda: clock["t"],
+        )
+        assert rec.dump("sigusr1", force=True) is not None
+        assert rec.dump("sigusr1", force=True) is not None
+
+    def test_profile_block_lands_in_context(self, tmp_path):
+        rec = reset_flight_recorder(base_dir=str(tmp_path))
+        path = rec.dump(
+            "dead_letter",
+            profile={"dir": "/p", "captures": 1, "last_capture": "/p/x"},
+        )
+        with open(f"{path}/context.json", encoding="utf-8") as f:
+            ctx = json.load(f)
+        assert ctx["profile"]["last_capture"] == "/p/x"
+
+
+# ---------------------------------------------------------------------------
+class TestDeviceProfiler:
+    def _stubbed(self, monkeypatch, tmp_path, **kw):
+        from analyzer_tpu.obs import prof
+
+        calls = []
+        monkeypatch.setattr(prof, "_start_trace", lambda p: calls.append(("start", p)))
+        monkeypatch.setattr(prof, "_stop_trace", lambda: calls.append(("stop",)))
+        return prof.DeviceProfiler(profile_dir=str(tmp_path), **kw), calls
+
+    def test_unarmed_is_inert(self):
+        from analyzer_tpu.obs.prof import DeviceProfiler
+
+        p = DeviceProfiler(profile_dir=None)
+        assert not p.armed
+        assert p.request("dead_letter") is False
+        with p.maybe_capture():
+            pass
+        assert p.captures == 0 and p.capture_info() is None
+
+    def test_latch_captures_exactly_the_next_window(self, monkeypatch, tmp_path):
+        p, calls = self._stubbed(monkeypatch, tmp_path)
+        assert p.request("sigusr2", force=True)
+        with p.maybe_capture():
+            pass
+        with p.maybe_capture():  # latch cleared: second window is free
+            pass
+        assert [c[0] for c in calls] == ["start", "stop"]
+        assert p.captures == 1
+        assert p.last_capture is not None and "sigusr2" in p.last_capture
+        info = p.capture_info()
+        assert info["captures"] == 1 and info["dir"] == str(tmp_path)
+
+    def test_throttle_is_per_reason_and_force_bypasses(self, monkeypatch, tmp_path):
+        clock = {"t": 0.0}
+        p, _ = self._stubbed(
+            monkeypatch, tmp_path, min_interval_s=60.0,
+            clock=lambda: clock["t"],
+        )
+        assert p.request("dead_letter") is True
+        clock["t"] = 10.0
+        assert p.request("dead_letter") is False  # throttled
+        assert p.request("pipeline_degraded") is True  # own window
+        assert p.request("dead_letter", force=True) is True
+
+    def test_start_failure_never_breaks_the_window(self, monkeypatch, tmp_path):
+        from analyzer_tpu.obs import prof
+
+        def boom(_p):
+            raise RuntimeError("no backend")
+
+        monkeypatch.setattr(prof, "_start_trace", boom)
+        p = prof.DeviceProfiler(profile_dir=str(tmp_path))
+        p.request("sigusr2", force=True)
+        ran = []
+        with p.maybe_capture():
+            ran.append(True)
+        assert ran == [True] and p.captures == 0
+
+    def test_worker_dead_letter_requests_capture(self, monkeypatch, tmp_path):
+        from analyzer_tpu.config import RatingConfig, ServiceConfig
+        from analyzer_tpu.obs import prof
+        from analyzer_tpu.service import InMemoryBroker, InMemoryStore, Worker
+
+        monkeypatch.setattr(prof, "_start_trace", lambda p: None)
+        monkeypatch.setattr(prof, "_stop_trace", lambda: None)
+        prof.reset_device_profiler(profile_dir=str(tmp_path))
+        try:
+            broker = InMemoryBroker()
+            worker = Worker(
+                broker, InMemoryStore(),
+                ServiceConfig(batch_size=2, idle_timeout=0.0), RatingConfig(),
+            )
+            broker.publish("analyze", b"missing-match")
+            worker.queue = broker.get("analyze", 2)
+            worker._dead_letter(worker.queue)
+            assert worker.profiler._pending == "dead_letter"
+        finally:
+            prof.reset_device_profiler()
+
+
+# ---------------------------------------------------------------------------
+def _synthetic_events():
+    """A hand-built two-batch event stream on one timeline (us)."""
+    pid, tid = 1, 1
+
+    def span(name, ts, dur, trace, **extra):
+        return {"name": name, "cat": "x", "ph": "X", "ts": ts, "dur": dur,
+                "pid": pid, "tid": tid, "args": {"trace": trace, **extra}}
+
+    def instant(name, ts, **args):
+        return {"name": name, "cat": "trace", "ph": "i", "s": "t", "ts": ts,
+                "pid": pid, "tid": tid, "args": args}
+
+    return [
+        instant("trace.enqueue", 100.0, trace="m1", span=1),
+        instant("trace.enqueue", 150.0, trace="m2", span=2),
+        instant("batch.assemble", 1000.0, batch="b1",
+                members=["m1", "m2"], enqueues=[100.0, 150.0]),
+        span("batch.encode", 1000.0, 400.0, "b1"),
+        span("batch.pack", 1400.0, 100.0, "b1"),
+        span("feed.materialize", 1500.0, 50.0, "b1"),
+        span("feed.transfer", 1550.0, 250.0, "b1"),
+        span("batch.compute", 1800.0, 2000.0, "b1"),
+        span("batch.fetch", 3800.0, 300.0, "b1"),
+        span("batch.commit", 4100.0, 500.0, "b1"),
+        instant("view.publish", 4800.0, version=7, trace="b1"),
+        # an untraced span (warmup): must be ignored
+        {"name": "batch.compute", "cat": "x", "ph": "X", "ts": 10.0,
+         "dur": 5.0, "pid": pid, "tid": tid, "args": {}},
+    ]
+
+
+class TestTraceview:
+    def test_match_report_decomposes_all_stages(self):
+        from analyzer_tpu.obs.traceview import build_model, match_report
+
+        model = build_model(_synthetic_events())
+        rep = match_report(model, "m1")
+        s = rep["stages_ms"]
+        assert rep["batch"] == "b1"
+        assert s["queue_wait"] == pytest.approx(0.9)
+        assert s["encode"] == pytest.approx(0.4)
+        assert s["pack"] == pytest.approx(0.1)
+        assert s["feed_staging"] == pytest.approx(0.05)
+        assert s["h2d"] == pytest.approx(0.25)
+        assert s["dispatch"] == pytest.approx(2.0)
+        assert s["fetch"] == pytest.approx(0.3)
+        assert s["commit"] == pytest.approx(0.5)
+        assert s["publish_lag"] == pytest.approx(0.2)  # 4800 - 4600
+        assert rep["publish_version"] == 7
+        assert rep["end_to_end_ms"] == pytest.approx(4.7)  # 4800 - 100
+
+    def test_verify_chain_flags_missing_links(self):
+        from analyzer_tpu.obs.traceview import build_model, verify_chain
+
+        events = _synthetic_events()
+        model = build_model(events)
+        assert verify_chain(model, "m1") == []
+        assert verify_chain(model, "m2") == []
+        assert verify_chain(model, "ghost") != []
+        # Drop the publish: the chain must report incompleteness.
+        partial = build_model(
+            [e for e in events if e["name"] != "view.publish"]
+        )
+        assert any("publish" in p for p in verify_chain(partial, "m1"))
+
+    def test_critical_path_names_the_dominant_stage(self):
+        from analyzer_tpu.obs.traceview import build_model, critical_path
+
+        cp = critical_path(build_model(_synthetic_events()))
+        assert cp["batches"] == 1 and cp["matches"] == 2
+        assert cp["dominant_stage"] == "dispatch"
+        assert cp["stage_share"]["dispatch"] > 0.4
+
+    def test_load_events_tolerates_a_torn_tail(self, tmp_path):
+        from analyzer_tpu.obs.traceview import load_events
+
+        p = tmp_path / "t.jsonl"
+        p.write_text('{"name": "x", "ts": 1, "args": {}}\n{"name": "tr')
+        assert len(load_events(str(p))) == 1
+
+    def test_load_events_reads_a_flight_dump_dir(self, tmp_path):
+        from analyzer_tpu.obs.traceview import load_events
+
+        (tmp_path / "trace.jsonl").write_text(
+            '{"name": "x", "ts": 1, "args": {}}\n'
+        )
+        assert len(load_events(str(tmp_path))) == 1
+
+
+# ---------------------------------------------------------------------------
+SOAK_KW = dict(
+    seed=5, duration_s=4.0, qps=16.0, query_qps=4.0, n_players=120,
+    batch_size=32, use_http=False,
+)
+
+
+def _run_soak(trace: bool):
+    from analyzer_tpu.loadgen import SoakConfig, SoakDriver
+
+    reset_registry()
+    reset_tracer()
+    driver = SoakDriver(SoakConfig(trace=trace, **SOAK_KW))
+    try:
+        artifact = driver.run()
+        events = get_tracer().events()
+    finally:
+        driver.close()
+    return artifact, events
+
+
+@pytest.fixture(scope="module")
+def traced_soak():
+    """(traced artifact, traced events, untraced artifact) — three data
+    points, one module-scoped pair of smoke soaks."""
+    from analyzer_tpu.obs.tracectx import enable_tracing
+
+    try:
+        art_on, events = _run_soak(trace=True)
+        art_off, _ = _run_soak(trace=False)
+    finally:
+        enable_tracing(False)
+    return art_on, events, art_off
+
+
+class TestSoakTraceEndToEnd:
+    def test_every_rated_match_reconstructs_completely(self, traced_soak):
+        from analyzer_tpu.obs.traceview import build_model, verify_chain
+
+        art, events, _ = traced_soak
+        model = build_model(events)
+        det = art["deterministic"]
+        assert det["matches_rated"] == det["matches_published"] > 0
+        assert len(model.match_batch) == det["matches_rated"]
+        problems = [
+            p for mid in model.match_batch for p in verify_chain(model, mid)
+        ]
+        assert problems == []
+
+    def test_timestamps_monotone_along_each_chain(self, traced_soak):
+        from analyzer_tpu.obs.traceview import build_model
+
+        _, events, _ = traced_soak
+        model = build_model(events)
+        for mid, bid in model.match_batch.items():
+            bt = model.batches[bid]
+            enq = model.enqueue_ts[mid]
+            assert enq <= bt.assemble_ts + 1.0
+            assert bt.commit_end is not None
+            assert bt.commit_end <= bt.publish_ts + 1.0
+            assert enq < bt.publish_ts
+
+    def test_artifact_trace_block_names_dominant_stage(self, traced_soak):
+        from analyzer_tpu.obs.traceview import STAGES
+
+        art, _, art_off = traced_soak
+        block = art["trace"]
+        assert set(block["stages_ms"]) == set(STAGES)
+        assert block["dominant_stage"] in STAGES
+        assert block["matches"] == art["deterministic"]["matches_rated"]
+        assert art["slo"]["dominant_stage"] == block["dominant_stage"]
+        assert "trace" not in art_off  # untraced runs carry no block
+
+    def test_deterministic_block_bit_identical_with_tracing(self, traced_soak):
+        art_on, _, art_off = traced_soak
+        a = json.dumps(art_on["deterministic"], sort_keys=True)
+        b = json.dumps(art_off["deterministic"], sort_keys=True)
+        assert a == b
+
+    def test_soak_slos_stay_green_under_tracing(self, traced_soak):
+        art, _, _ = traced_soak
+        assert art["slo"]["pass"], art["slo"]["violations"]
+        assert art["deterministic"]["retraces_steady"] == 0
+
+
+# ---------------------------------------------------------------------------
+class TestPipelinedTracePropagation:
+    def test_writer_and_harvest_spans_join_the_batch_tree(self):
+        from analyzer_tpu.config import RatingConfig, ServiceConfig
+        from analyzer_tpu.service import InMemoryBroker, InMemoryStore, Worker
+        from analyzer_tpu.obs.traceview import build_model, verify_chain
+        from tests.test_service import mk_match
+
+        tracectx.enable_tracing(True)
+        broker = InMemoryBroker()
+        store = InMemoryStore()
+        worker = Worker(
+            broker, store, ServiceConfig(batch_size=4, idle_timeout=0.0),
+            RatingConfig(), pipeline=True, serve_port=0,
+        )
+        try:
+            for i in range(4):
+                mid = f"p{i}"
+                store.add_match(mk_match(mid, created_at=i))
+                ctx = tracectx.mint(mid)
+                broker.publish("analyze", mid.encode(),
+                               headers=tracectx.headers(ctx))
+            assert worker.poll()
+            worker.drain()
+        finally:
+            worker.close()
+        model = build_model(get_tracer().events())
+        assert sorted(model.match_batch) == ["p0", "p1", "p2", "p3"]
+        for mid in model.match_batch:
+            assert verify_chain(model, mid) == [], mid
+        bt = model.batches[model.match_batch["p0"]]
+        assert bt.mode == "pipelined"
+        # commit came from the WRITER thread's batch.write_back span.
+        assert bt.stage_us.get("commit", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+class TestCliTrace:
+    @pytest.fixture(scope="class")
+    def trace_file(self, tmp_path_factory, traced_soak):
+        path = tmp_path_factory.mktemp("trace") / "events.jsonl"
+        _, events, _ = traced_soak
+        with open(path, "w", encoding="utf-8") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+        return str(path)
+
+    def test_critical_path_report(self, trace_file, capsys):
+        from analyzer_tpu.cli import main
+
+        assert main(["trace", trace_file]) == 0
+        out = capsys.readouterr().out
+        assert "dominant stage:" in out
+        assert "queue_wait" in out and "publish_lag" in out
+
+    def test_match_timeline(self, trace_file, capsys):
+        from analyzer_tpu.cli import main
+
+        assert main(["trace", trace_file, "--match", "soak-00000000",
+                     "--json"]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["problems"] == []
+        assert rep["publish_version"] is not None
+        assert rep["stages_ms"]["queue_wait"] is not None
+        assert rep["end_to_end_ms"] > 0
+
+    def test_batch_timeline(self, trace_file, capsys):
+        from analyzer_tpu.cli import main
+
+        assert main(["trace", trace_file, "--match", "soak-00000000",
+                     "--json"]) == 0
+        bid = json.loads(capsys.readouterr().out)["batch"]
+        assert main(["trace", trace_file, "--batch", bid]) == 0
+        assert f"batch {bid}" in capsys.readouterr().out
+
+    def test_unknown_match_exits_1(self, trace_file, capsys):
+        from analyzer_tpu.cli import main
+
+        assert main(["trace", trace_file, "--match", "nope"]) == 1
+
+    def test_untraced_artifact_exits_2(self, tmp_path, capsys):
+        from analyzer_tpu.cli import main
+
+        p = tmp_path / "plain.jsonl"
+        p.write_text('{"name": "batch.compute", "ph": "X", "ts": 1, '
+                     '"dur": 1, "args": {}}\n')
+        assert main(["trace", str(p)]) == 2
+        assert "tracing enabled" in capsys.readouterr().err
+
+    def test_missing_file_exits_2(self, capsys):
+        from analyzer_tpu.cli import main
+
+        assert main(["trace", "/nonexistent/trace.jsonl"]) == 2
+
+
+# ---------------------------------------------------------------------------
+class TestTraceOverheadGate:
+    BASE = {
+        "metric": "matches_per_sec_per_chip", "value": 1000.0,
+        "unit": "matches/s", "capture": {"degraded": False},
+    }
+
+    def _write(self, tmp_path, name, **extra):
+        p = tmp_path / name
+        p.write_text(json.dumps({**self.BASE, **extra}))
+        return str(p)
+
+    def test_violation_strings(self):
+        from analyzer_tpu.obs.benchdiff import trace_overhead_violations
+
+        ok = {**self.BASE, "trace_overhead": {
+            "off_s": 1.0, "on_s": 1.01, "overhead_pct": 1.0, "stable": True}}
+        bad = {**self.BASE, "trace_overhead": {
+            "off_s": 1.0, "on_s": 1.05, "overhead_pct": 5.0, "stable": True}}
+        unstable = {**self.BASE, "trace_overhead": {
+            "off_s": 1.0, "on_s": 1.05, "overhead_pct": 5.0, "stable": False}}
+        assert trace_overhead_violations(ok) == []
+        assert trace_overhead_violations(self.BASE) == []  # no block
+        assert trace_overhead_violations(unstable) == []  # not gateable
+        v = trace_overhead_violations(bad)
+        assert len(v) == 1
+        assert "trace_overhead" in v[0] and "2" in v[0]
+
+    def test_cli_gate_fails_past_two_pct(self, tmp_path, capsys):
+        from analyzer_tpu.cli import main
+
+        a = self._write(tmp_path, "BENCH_r01.json")
+        b = self._write(
+            tmp_path, "BENCH_r02.json",
+            trace_overhead={"off_s": 1.0, "on_s": 1.06,
+                            "overhead_pct": 6.0, "stable": True},
+        )
+        assert main(["benchdiff", a, b]) == 1
+        captured = capsys.readouterr()
+        assert "TRACE OVERHEAD VIOLATION" in captured.out
+
+    def test_cli_gate_passes_within_budget(self, tmp_path, capsys):
+        from analyzer_tpu.cli import main
+
+        a = self._write(tmp_path, "BENCH_r01.json")
+        b = self._write(
+            tmp_path, "BENCH_r02.json",
+            trace_overhead={"off_s": 1.0, "on_s": 1.01,
+                            "overhead_pct": 1.0, "stable": True},
+        )
+        assert main(["benchdiff", a, b]) == 0
