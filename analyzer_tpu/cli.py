@@ -869,6 +869,8 @@ def cmd_bench(args) -> int:
         os.environ["BENCH_FUSE_WINDOW"] = str(args.fuse_window)
     if getattr(args, "hot_rows", None):
         os.environ["BENCH_HOT_ROWS"] = str(args.hot_rows)
+    if getattr(args, "ingest", False):
+        os.environ["BENCH_INGEST"] = "1"
     bench.main(
         metrics_out=getattr(args, "metrics_out", None),
         obs_port=getattr(args, "obs_port", None),
@@ -923,8 +925,9 @@ def cmd_benchdiff(args) -> int:
         )
         return 2
     try:
+        a_raw = load_bench(a_path)
         b_raw = load_bench(b_path)
-        a = family_configs(bench_configs(load_bench(a_path)), args.family)
+        a = family_configs(bench_configs(a_raw), args.family)
         b = family_configs(bench_configs(b_raw), args.family)
     except (OSError, ValueError) as err:
         print(f"error: {err}", file=sys.stderr)
@@ -957,6 +960,22 @@ def cmd_benchdiff(args) -> int:
             "untiered?)", file=sys.stderr,
         )
         return 1
+    if args.family == "ingest":
+        # The vanished-block contract for the ingest plane: a baseline
+        # captured with the native columnar decoder and a candidate
+        # without it means the decode silently fell back to the python
+        # codec — the exact regression this family exists to catch, and
+        # one a delta gate would merely report as "slower".
+        a_native = bool((a_raw.get("ingest") or {}).get("native"))
+        b_native = bool((b_raw.get("ingest") or {}).get("native"))
+        if a_native and not b_native:
+            print(
+                f"error: {os.path.basename(b_path)} has no native "
+                f"columnar-decode capture but {os.path.basename(a_path)} "
+                "does (silent fallback to the python codec?)",
+                file=sys.stderr,
+            )
+            return 1
     if args.family == "serve":
         # Same vanished-block contract for the shard plane: a baseline
         # with sharded.* configs and a candidate without them means the
@@ -1259,7 +1278,7 @@ def cmd_soak(args) -> int:
     from analyzer_tpu.loadgen.driver import write_artifact
 
     for flag in ("duration", "qps", "tick", "players", "batch_size",
-                 "polls_per_tick", "serve_shards"):
+                 "polls_per_tick", "serve_shards", "broker_partitions"):
         if getattr(args, flag) <= 0:
             print(f"error: --{flag.replace('_', '-')} must be positive",
                   file=sys.stderr)
@@ -1267,6 +1286,17 @@ def cmd_soak(args) -> int:
     if args.query_qps < 0:
         print("error: --query-qps must be >= 0 (0 = no read traffic)",
               file=sys.stderr)
+        return 2
+    if args.backfill_qps < 0:
+        print("error: --backfill-qps must be >= 0", file=sys.stderr)
+        return 2
+    if args.backfill_qps > 0 and not args.priority_lanes:
+        print("error: --backfill-qps needs --priority-lanes (backfill "
+              "traffic rides the backfill lane)", file=sys.stderr)
+        return 2
+    if args.forbid_dominant_stages and not (args.trace or args.trace_events):
+        print("error: --forbid-dominant-stage needs --trace (the check "
+              "reads the trace block's critical path)", file=sys.stderr)
         return 2
     _obs_begin(args)
     server = _obs_serve(args)
@@ -1285,10 +1315,14 @@ def cmd_soak(args) -> int:
         warmup=not args.no_warmup,
         use_http=not args.in_process,
         serve_shards=args.serve_shards,
+        broker_partitions=args.broker_partitions,
+        priority_lanes=args.priority_lanes,
+        backfill_qps=args.backfill_qps,
         realtime=args.realtime,
         max_view_lag_ticks=args.max_view_lag_ticks,
         min_matches_per_sec=args.min_matches_per_sec,
         max_p99_ms=args.max_p99_ms,
+        forbid_dominant_stages=tuple(args.forbid_dominant_stages),
     )
     driver = SoakDriver(cfg)
     try:
@@ -1540,6 +1574,15 @@ def main(argv=None) -> int:
         "hit rate, promotion bytes, min_over_resident — that "
         "`cli benchdiff --family tiered` gates",
     )
+    s.add_argument(
+        "--ingest", action="store_true",
+        help="capture the wire-speed ingest line instead (BENCH_INGEST "
+        "env): columnar windowed decode into pinned arena slabs + "
+        "per-window H2D through the prefetch ring; emits the "
+        "INGEST_BENCH_*.json artifact `cli benchdiff --family ingest` "
+        "gates (bytes/s, queue-to-H2D p99, arena hit rate — "
+        "docs/ingest.md)",
+    )
     s.set_defaults(fn=cmd_bench)
 
     s = sub.add_parser(
@@ -1568,7 +1611,7 @@ def main(argv=None) -> int:
         "than PCT percent (default: 5)",
     )
     s.add_argument(
-        "--family", choices=("bench", "serve", "tiered", "soak"),
+        "--family", choices=("bench", "serve", "tiered", "soak", "ingest"),
         default="bench",
         help="artifact family for --against-latest scans: bench "
         "(BENCH_*.json, the write path), serve (SERVE_BENCH_*.json — "
@@ -1578,7 +1621,10 @@ def main(argv=None) -> int:
         "candidate that silently dropped its tiered block fails), or "
         "soak (SOAK_*.json from `cli soak` — throughput/p99 regression "
         "PLUS the absolute SLOs: zero dead-letters, flat steady-state "
-        "retraces, bounded view staleness, drained backlog); "
+        "retraces, bounded view staleness, drained backlog), or ingest "
+        "(INGEST_BENCH_*.json from `cli bench --ingest` — decoded "
+        "bytes/s, queue-to-H2D p99, arena hit rate; a candidate whose "
+        "decode silently fell back to the python codec fails); "
         "explicit two-path diffs auto-detect from the metric name",
     )
     s.set_defaults(fn=cmd_benchdiff)
@@ -1710,6 +1756,34 @@ def main(argv=None) -> int:
         "(ShardedViewPublisher + ShardedQueryEngine); the deterministic "
         "block is bit-identical to --serve-shards 1 for the same seed "
         "(docs/serving.md \"Sharded plane\")",
+    )
+    s.add_argument(
+        "--broker-partitions", type=int, default=1, metavar="S",
+        help="partition the analyze queue by player-shard (row %% S, the "
+        "serve plane's mesh layout invariant): per-partition depth/"
+        "dead-letter accounting, global delivery order preserved — the "
+        "deterministic block is bit-identical to the single-queue run "
+        "(docs/ingest.md \"Partition math\")",
+    )
+    s.add_argument(
+        "--priority-lanes", action="store_true",
+        help="live-vs-backfill priority lanes on the broker, with the "
+        "admission controller arbitrating backfill behind live traffic "
+        "on feed-starvation + tier-promotion telemetry "
+        "(docs/ingest.md \"Lane arbitration\")",
+    )
+    s.add_argument(
+        "--backfill-qps", type=float, default=0.0, metavar="QPS",
+        help="re-publish already-rated matches on the backfill lane at "
+        "this rate (requires --priority-lanes) — the re-rate/replay "
+        "ingest shape of ROADMAP item 4",
+    )
+    s.add_argument(
+        "--forbid-dominant-stage", action="append", default=[],
+        metavar="STAGE", dest="forbid_dominant_stages",
+        help="SLO: fail when the trace block's critical-path dominant "
+        "stage is STAGE (repeatable; e.g. queue_wait encode — the "
+        "ingest-edge gate; needs --trace)",
     )
     s.add_argument(
         "--realtime", action="store_true",

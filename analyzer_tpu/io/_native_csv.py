@@ -3,6 +3,11 @@
 Compiled/loaded via the shared helper (``analyzer_tpu.native_build``):
 ImportError on ANY build or load failure so the caller's pure-python
 parser engages instead.
+
+Two surfaces: :func:`parse_stream_csv`, the whole-file two-pass loader,
+and :func:`parse_csv_window`, the wire-speed ingest entry that decodes
+up to a slab's worth of rows into caller-provided (reusable, pinned)
+column buffers and resumes from a byte cursor (docs/ingest.md).
 """
 
 from __future__ import annotations
@@ -32,6 +37,25 @@ _lib.parse_stream_csv.argtypes = [
     ctypes.POINTER(ctypes.c_int64),
 ]
 _lib.parse_stream_csv.restype = ctypes.c_int64
+_lib.parse_csv_window.argtypes = [
+    ctypes.c_char_p,
+    ctypes.c_int64,
+    ctypes.c_char_p,
+    ctypes.c_int64,
+    ctypes.c_int64,
+    ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_int64),
+    ctypes.POINTER(ctypes.c_int32),
+    ctypes.POINTER(ctypes.c_int32),
+    ctypes.POINTER(ctypes.c_int32),
+    ctypes.POINTER(ctypes.c_uint8),
+    ctypes.POINTER(ctypes.c_int64),
+]
+_lib.parse_csv_window.restype = ctypes.c_int64
+
+
+def _i32(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
 
 
 def parse_stream_csv(data: bytes, mode_names: list[str], max_team: int):
@@ -66,11 +90,60 @@ def parse_stream_csv(data: bytes, mode_names: list[str], max_team: int):
     afk = np.zeros(n, np.uint8)
     n2 = _lib.parse_stream_csv(
         data, len(data), modes, len(mode_names), t, n,
-        player_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-        winner.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-        mode_id.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        _i32(player_idx), _i32(winner), _i32(mode_id),
         afk.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         tmax_ptr,
     )
     assert n2 == n, (n2, n)  # same bytes, same grammar — cannot differ
     return player_idx, winner, mode_id, afk.astype(bool)
+
+
+class WindowDecodeError(ValueError):
+    """A malformed row inside :func:`parse_csv_window`'s grammar,
+    attributed to the WINDOW-RELATIVE row index (the caller adds its
+    stream offset for the absolute poison row) and the byte offset of
+    the offending row."""
+
+    def __init__(self, row: int, byte_offset: int) -> None:
+        super().__init__(
+            f"malformed CSV row at window row {row} (byte {byte_offset})"
+        )
+        self.row = row
+        self.byte_offset = byte_offset
+
+
+def parse_csv_window(
+    data: bytes,
+    modes_blob: bytes,
+    n_modes: int,
+    max_team: int,
+    cursor: np.ndarray,
+    player_idx: np.ndarray,
+    winner: np.ndarray,
+    mode_id: np.ndarray,
+    afk: np.ndarray,
+) -> int:
+    """Decodes up to ``player_idx.shape[0]`` rows of ``data`` starting at
+    byte ``cursor[0]`` into the caller's column slabs (C-contiguous
+    int32 [W, 2, max_team] / int32 [W] / int32 [W] / uint8 [W] — the
+    pinned staging arena's reusable buffers; unused team slots are
+    written -1 by the scanner, so slabs need NO reset between windows).
+    Advances ``cursor`` in place and returns rows decoded (0 = end of
+    stream). Raises :class:`WindowDecodeError` on a malformed row, with
+    ``cursor`` left at the offending row's first byte.
+
+    ``modes_blob`` is the pre-encoded '\\n'-joined mode-name list —
+    encoded ONCE per stream by the caller, not per window (the whole
+    point of this entry is no per-window python staging work)."""
+    cap = int(player_idx.shape[0])
+    tmax = np.zeros(1, np.int64)
+    n = _lib.parse_csv_window(
+        data, len(data), modes_blob, n_modes, max_team, cap,
+        cursor.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        _i32(player_idx), _i32(winner), _i32(mode_id),
+        afk.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        tmax.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    if n < 0:
+        raise WindowDecodeError(int(-n - 1), int(cursor[0]))
+    return int(n)

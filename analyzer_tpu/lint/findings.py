@@ -77,6 +77,13 @@ RULES: dict[str, str] = {
         "analyzer_tpu/service/, sched/ or serve/ — a typo'd name "
         "silently mints a series no dashboard reads"
     ),
+    "GL031": (
+        "per-row Python loop (for over a non-literal range/enumerate "
+        "with subscript stores) or unpinned staging (np.frombuffer, "
+        "bytes .decode) in the ingest decode hot path (io/ loaders + "
+        "sched/feed.py) — decode whole windows through the columnar "
+        "decoder (io/ingest.py) into PinnedArena slabs"
+    ),
 }
 
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")

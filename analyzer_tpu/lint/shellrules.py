@@ -87,6 +87,20 @@ round-trip the shard plane exists to kill. Test files are exempt; a
 deliberate whole-table fetch elsewhere carries a line-scoped disable
 with a reason.
 
+GL031 is PATH-SCOPED to the ingest decode hot path — the ``io/``
+loaders (``csv_codec.py``, ``_native_csv.py``, ``ingest.py``) and the
+feed producer (``sched/feed.py``): a ``for`` over a non-literal
+``range``/``enumerate`` that stores through subscripts is the per-row
+python decode shape the columnar decoder exists to kill (one native
+window decode replaces ~10^4 interpreter iterations), and
+``np.frombuffer``/``bytes.decode`` staging builds throwaway host
+buffers where the pinned arena slab should be the decode target.
+Loops over LITERAL bounds (``for team in range(2)``) are exempt —
+they are unrolled constant structure, not per-row work; test files
+are exempt; the csv-module fallback parser carries a line-scoped
+disable with a reason (it exists precisely for bytes the fast grammar
+refuses).
+
 GL030 is PATH-SCOPED to ``analyzer_tpu/service/``, ``sched/`` and
 ``serve/``: every STRING-LITERAL metric name handed to
 ``counter()``/``gauge()``/``histogram()`` and every literal span name
@@ -167,6 +181,19 @@ _GL029_TRANSFERS = (
     "numpy.asarray", "numpy.array", "jax.numpy.array", "jax.device_put",
 )
 
+#: Files where GL031 applies: the ingest decode hot path — the io/
+#: stream loaders and the feed producer (docs/ingest.md).
+_GL031_FILES = (
+    "analyzer_tpu/io/csv_codec.py",
+    "analyzer_tpu/io/_native_csv.py",
+    "analyzer_tpu/io/ingest.py",
+    "analyzer_tpu/sched/feed.py",
+)
+
+#: Unpinned staging calls GL031 flags: each builds a throwaway host
+#: buffer on the decode path where an arena slab should be the target.
+_GL031_STAGING = ("numpy.frombuffer",)
+
 #: Wall-clock reads GL028 bans in loadgen decision paths. Pacing and
 #: measured-latency reads carry line-scoped disables with reasons.
 _GL028_CLOCKS = {
@@ -226,6 +253,7 @@ class ShellRules:
         loadgen_layer = self._in_loadgen_layer()
         serve_layer = self._in_serve_layer()
         schema_layer = self._in_schema_layer()
+        ingest_layer = self._in_ingest_layer()
         tests = self._in_tests()
         pallas_home = self._in_pallas_home()
         table_home = self._in_table_home()
@@ -237,6 +265,9 @@ class ShellRules:
                 self._check_try(node)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._check_defaults(node)
+            elif isinstance(node, ast.For):
+                if ingest_layer and not tests:
+                    self._check_per_row_loop(node)
             elif isinstance(node, ast.Call):
                 if timed_layer:
                     self._check_raw_clock(node)
@@ -248,6 +279,8 @@ class ShellRules:
                     self._check_cross_shard_gather(node, merge_ranges)
                 if schema_layer and not tests:
                     self._check_schema_name(node)
+                if ingest_layer and not tests:
+                    self._check_unpinned_staging(node)
                 if not tests:
                     self._check_interpret_literal(node)
                 if not (tests or table_home):
@@ -301,6 +334,10 @@ class ShellRules:
     def _in_schema_layer(self) -> bool:
         path = self.path.replace("\\", "/")
         return any(frag in path for frag in _GL030_DIRS)
+
+    def _in_ingest_layer(self) -> bool:
+        path = self.path.replace("\\", "/")
+        return any(path.endswith(frag) for frag in _GL031_FILES)
 
     def _merge_helper_ranges(self) -> tuple:
         """(start, end) line spans of the designated merge helpers —
@@ -369,6 +406,62 @@ class ShellRules:
                     "bit-identity discipline (docs/kernels.md)",
                 )
                 return
+
+    def _check_per_row_loop(self, node: ast.For) -> None:
+        """GL031 (loop half): a ``for`` over a non-literal ``range``/
+        ``enumerate`` whose body stores through subscripts is per-row
+        python decode work on the ingest hot path — the shape the native
+        columnar window decoder replaces wholesale. Literal bounds
+        (``for team in range(2)``) are constant structure, exempt."""
+        it = node.iter
+        if not isinstance(it, ast.Call) or not isinstance(it.func, ast.Name):
+            return
+        if it.func.id not in ("range", "enumerate"):
+            return
+        if it.args and all(isinstance(a, ast.Constant) for a in it.args):
+            return  # literal bounds: unrolled structure, not per-row work
+        for sub in ast.walk(node):
+            targets = ()
+            if isinstance(sub, ast.Assign):
+                targets = sub.targets
+            elif isinstance(sub, ast.AugAssign):
+                targets = (sub.target,)
+            if any(isinstance(t, ast.Subscript) for t in targets):
+                self._flag(
+                    "GL031", node,
+                    "per-row Python loop in the ingest decode hot path — "
+                    "one native window decode (io/ingest.py "
+                    "ColumnarDecoder) replaces thousands of interpreter "
+                    "iterations; keep per-row work out of the wire path",
+                )
+                return
+
+    def _check_unpinned_staging(self, node: ast.Call) -> None:
+        """GL031 (staging half): ``np.frombuffer`` or a ``.decode()``
+        method call on the ingest hot path builds a throwaway host
+        buffer/str where the pinned arena slab should be the decode
+        target (sched/feed.py PinnedArena)."""
+        resolved = self.imports.resolve(node.func)
+        if resolved in _GL031_STAGING:
+            self._flag(
+                "GL031", node,
+                f"`{resolved}` staging in the ingest decode hot path — "
+                "unpinned throwaway buffers; decode into a PinnedArena "
+                "slab (sched/feed.py) the H2D edge commits directly",
+            )
+            return
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr == "decode"
+            and not isinstance(f.value, ast.Constant)
+        ):
+            self._flag(
+                "GL031", node,
+                "bytes .decode() staging in the ingest decode hot path — "
+                "per-message str materialization; route ids/columns "
+                "through the columnar decoder's typed slabs instead",
+            )
 
     def _check_interpret_literal(self, node: ast.Call) -> None:
         """GL026 (interpret half): a LITERAL ``interpret=True`` on a

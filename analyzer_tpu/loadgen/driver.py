@@ -109,9 +109,32 @@ class SoakConfig:
     # bit-identical with tracing on or off (ids are recorded, never
     # branched on) — pinned by tests/test_trace.py.
     trace: bool = False
+    # > 1 runs the ingest edge through the PartitionedBroker: the
+    # analyze queue splits into partitions by player-shard (row % S —
+    # the serve plane's mesh layout invariant; the driver stamps
+    # x-partition from each match's first team-A row), with
+    # per-partition depth/dead-letter accounting. The deterministic
+    # block is BIT-IDENTICAL to the single-queue run per (seed, config)
+    # — the broker's seq-merged delivery contract, pinned by
+    # tests/test_ingest.py.
+    broker_partitions: int = 1
+    # Priority lanes (live vs backfill) on the partitioned broker, with
+    # the AdmissionController arbitrating backfill behind live traffic.
+    # Lanes alone are also deterministic-block-invariant (live-only
+    # traffic is never reordered).
+    priority_lanes: bool = False
+    # Backfill/replay traffic (requires priority_lanes): re-publishes
+    # already-rated match ids on the backfill lane at this rate — the
+    # zero-downtime re-rate workload's ingest shape (ROADMAP item 4).
+    # Re-rating is idempotent per match; backfill rides OUTSIDE
+    # matches_published so the drain SLO still means "live work done".
+    backfill_qps: float = 0.0
     max_view_lag_ticks: int = 2  # SLO: served view staleness bound
     min_matches_per_sec: float | None = None  # SLO: absolute wall floor
     max_p99_ms: float | None = None  # SLO: absolute serve-latency bound
+    # SLO: stages that must NOT dominate the critical path (benchdiff's
+    # queue_wait check, wired to the trace block — requires trace=True).
+    forbid_dominant_stages: tuple = ()
 
     @property
     def n_ticks(self) -> int:
@@ -143,7 +166,20 @@ class SoakDriver:
             enable_tracing(True)
         install_jax_hooks()  # retraces countable before the first compile
         self.vclock = VirtualClock()
-        self.broker = InMemoryBroker()
+        if cfg.broker_partitions > 1 or cfg.priority_lanes:
+            from analyzer_tpu.service.broker import PartitionedBroker
+
+            self.broker = PartitionedBroker(
+                partitions=cfg.broker_partitions, lanes=cfg.priority_lanes,
+            )
+        else:
+            self.broker = InMemoryBroker()
+        if cfg.backfill_qps > 0 and not cfg.priority_lanes:
+            raise ValueError(
+                "backfill_qps needs priority_lanes=True — backfill "
+                "traffic without a lane would contend with live matches "
+                "head-on, which is exactly what lanes exist to prevent"
+            )
         self.store = InMemoryStore()
         self.rating_config = RatingConfig()
         service_cfg = ServiceConfig(
@@ -177,6 +213,8 @@ class SoakDriver:
             np.random.SeedSequence(entropy=cfg.seed, spawn_key=(2,))
         )
         self._seq = 0
+        self._backfill_cursor = 0
+        self._backfill_published = 0
         self._player_cache: dict[int, object] = {}
         self._match_digest = hashlib.sha256()
         self._query_digest = hashlib.sha256()
@@ -296,9 +334,18 @@ class SoakDriver:
             # message headers (None/no headers when tracing is off —
             # the digests below never see it either way).
             ctx = trace_mint(match.api_id)
+            headers = dict(trace_headers(ctx) or {})
+            if self.cfg.broker_partitions > 1:
+                # Home-shard routing: the first team-A row's shard under
+                # the mesh layout invariant (row % S — the same function
+                # the serve plane routes lookups by). Header-routed so
+                # the broker never has to parse match payloads.
+                headers["x-partition"] = (
+                    int(m.team_a_rows[0]) % self.cfg.broker_partitions
+                )
             self.broker.publish(
                 self.worker.config.queue, match.api_id.encode(),
-                headers=trace_headers(ctx),
+                headers=headers or None,
             )
             self._match_digest.update(
                 json.dumps(
@@ -319,6 +366,25 @@ class SoakDriver:
             )
         reg.counter("soak.matches_published_total").add(len(formed))
         return len(formed)
+
+    def _publish_backfill(self, n: int) -> int:
+        """Re-publishes ``n`` already-stored match ids on the backfill
+        lane (cycling oldest-first) — the replay/re-rate ingest shape.
+        Deterministic: a pure cursor walk over the match sequence, no
+        draws. No-op until live matches exist."""
+        if self._seq == 0:
+            return 0
+        sent = 0
+        for _ in range(n):
+            mid = f"soak-{self._backfill_cursor % self._seq:08d}"
+            self._backfill_cursor += 1
+            self.broker.publish(
+                self.worker.config.queue, mid.encode(),
+                headers={"x-lane": "backfill"},
+            )
+            sent += 1
+        self._backfill_published += sent
+        return sent
 
     # -- query workload ----------------------------------------------------
     def _issue_queries(self, n: int, latencies_ms: list,
@@ -366,6 +432,10 @@ class SoakDriver:
         self.prepare()
         match_shaper = TrafficShaper(cfg.qps, cfg.tick_s)
         query_shaper = TrafficShaper(cfg.query_qps, cfg.tick_s)
+        backfill_shaper = (
+            TrafficShaper(cfg.backfill_qps, cfg.tick_s)
+            if cfg.backfill_qps > 0 else None
+        )
         published = 0
         query_counts: dict[str, int] = {}
         latencies_ms: list[float] = []
@@ -386,7 +456,9 @@ class SoakDriver:
             # Staleness in ticks: a tick with work still pending and no
             # new published version ages the view; a publish (or a fully
             # drained loop) resets it. Deterministic — purely counters.
-            if version != last_version or (depth == 0 and rated == published):
+            # (>=: backfill re-rates push rated past published — a fully
+            # drained loop is still "fresh"; == and >= agree otherwise.)
+            if version != last_version or (depth == 0 and rated >= published):
                 lag_ticks = 0
             else:
                 lag_ticks += 1
@@ -397,8 +469,28 @@ class SoakDriver:
 
         for tick in range(cfg.n_ticks):
             self.vclock.advance(cfg.tick_s)
-            published += self._publish_matches(match_shaper.due())
-            for _ in range(cfg.polls_per_tick):
+            # Arrivals are PACED across the tick's poll slots instead of
+            # burst-published at the tick edge: a tick is the virtual
+            # clock's granularity, not a claim that a second's worth of
+            # matches lands in one instant — and a burst would charge
+            # the whole backlog's wall time to `queue_wait`, swamping
+            # the stage decomposition with a driver artifact. Slot
+            # sizing is a pure function of (due, polls_per_tick):
+            # deterministic, leftovers land on the earliest slots.
+            due = match_shaper.due()
+            backfill_due = (
+                backfill_shaper.due() if backfill_shaper is not None else 0
+            )
+            polls = max(1, cfg.polls_per_tick)
+            for p in range(polls):
+                share = due // polls + (1 if p < due % polls else 0)
+                if share:
+                    published += self._publish_matches(share)
+                bf_share = backfill_due // polls + (
+                    1 if p < backfill_due % polls else 0
+                )
+                if bf_share:
+                    self._publish_backfill(bf_share)
                 self.worker.poll()
             self._issue_queries(query_shaper.due(), latencies_ms, query_counts)
             sample(tick)
@@ -472,6 +564,7 @@ class SoakDriver:
                 ),
                 "retraces_steady": retraces_steady,
                 "drained": drained,
+                "backfill_published": self._backfill_published,
                 "trajectory": trajectory,
             },
             "slo": {
@@ -481,6 +574,9 @@ class SoakDriver:
                     "max_view_lag_ticks": cfg.max_view_lag_ticks,
                     "min_matches_per_sec": cfg.min_matches_per_sec,
                     "max_p99_ms": cfg.max_p99_ms,
+                    "forbid_dominant_stages": list(
+                        cfg.forbid_dominant_stages
+                    ) or None,
                 },
             },
             "latency_ms": latency_ms,

@@ -111,6 +111,41 @@ def bench_configs(data: dict) -> list[BenchConfig]:
                 )
             )
         return out
+    if str(data["metric"]).startswith("ingest."):
+        # Ingest family (``INGEST_BENCH_*``, metric
+        # ``ingest.bytes_per_sec``): decoded bytes/s (higher), the
+        # queue-to-H2D per-window latency p99 (lower — the time from a
+        # window's decode completing to its device slab being ready),
+        # and the staging arena's slab hit rate (higher — a collapse
+        # means steady-state allocation churn came back). A candidate
+        # whose decode silently fell back to the python codec drops
+        # ``ingest.native`` — the --family ingest gate in ``cli
+        # benchdiff`` fails that outright rather than diffing the
+        # (much slower) fallback numbers as a mere regression.
+        ingest = data.get("ingest") or {}
+        i_degraded = degraded or not ingest.get("stable", True)
+        out[0] = dataclasses.replace(out[0], degraded=i_degraded)
+        latency = data.get("latency_ms") or {}
+        if latency.get("p99") is not None:
+            out.append(
+                BenchConfig(
+                    name="ingest.queue_to_h2d_p99_ms",
+                    value=float(latency["p99"]),
+                    higher_is_better=False,
+                    degraded=i_degraded,
+                )
+            )
+        arena = data.get("arena") or {}
+        if arena.get("hit_rate") is not None:
+            out.append(
+                BenchConfig(
+                    name="ingest.arena_hit_rate",
+                    value=float(arena["hit_rate"]),
+                    higher_is_better=True,
+                    degraded=i_degraded,
+                )
+            )
+        return out
     if str(data["metric"]).startswith("serve."):
         latency = data.get("latency_ms") or {}
         if latency.get("p99") is not None:
@@ -263,6 +298,7 @@ FAMILIES = {
     "serve": "SERVE_BENCH",
     "tiered": "BENCH",
     "soak": "SOAK",
+    "ingest": "INGEST_BENCH",
 }
 
 
@@ -280,6 +316,8 @@ def family_configs(
         return [c for c in configs if c.name.startswith("tiered.")]
     if family == "soak":
         return [c for c in configs if c.name.startswith("soak.")]
+    if family == "ingest":
+        return [c for c in configs if c.name.startswith("ingest.")]
     return configs
 
 
@@ -339,6 +377,27 @@ def soak_slo_violations(data: dict) -> list[str]:
         out.append(
             f"serve p99 {p99} ms above the configured cap {p99_cap} ms"
         )
+    forbidden = thr.get("forbid_dominant_stages") or []
+    if forbidden:
+        # The ingest-plane SLO (docs/ingest.md): the critical-path
+        # decomposition (PR 10's trace block) must not name a forbidden
+        # stage — e.g. queue_wait/encode dominating at 2000 qps means
+        # the ingest edge, not the device, is the bottleneck. The check
+        # is only evaluable when the soak ran traced; an artifact that
+        # ASKED for the gate but carries no trace block fails loudly
+        # instead of green-by-omission.
+        dominant = (data.get("trace") or {}).get("dominant_stage")
+        if dominant is None:
+            out.append(
+                "forbid_dominant_stages configured but the artifact has "
+                "no trace block (run the soak with --trace)"
+            )
+        elif dominant in forbidden:
+            out.append(
+                f"dominant critical-path stage {dominant!r} is in the "
+                f"forbidden set {sorted(forbidden)} — the ingest edge is "
+                "the bottleneck (docs/ingest.md runbook)"
+            )
     return out
 
 

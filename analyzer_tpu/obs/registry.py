@@ -240,6 +240,23 @@ STANDARD_COUNTERS = (
     "soak.matches_published_total",
     "soak.queries_sent_total",
     "soak.slo_violations_total",
+    # The wire-speed ingest plane (io/ingest.py + sched/feed.py arena,
+    # docs/ingest.md): columnar windows decoded (bytes/rows/windows),
+    # streams the fast path refused (quoted grammar / no native
+    # scanner), arena slab allocations vs freelist reuses (their ratio
+    # is the benchdiff-gated hit rate), and H2D commits off the arena.
+    "ingest.bytes_decoded_total",
+    "ingest.rows_decoded_total",
+    "ingest.windows_total",
+    "ingest.fallbacks_total",
+    "ingest.arena_allocs_total",
+    "ingest.arena_reuses_total",
+    "ingest.h2d_commits_total",
+    # The partitioned broker's priority lanes (service/broker.py):
+    # backfill messages admitted behind live traffic, and messages the
+    # admission controller held back for host headroom.
+    "broker.backfill_admitted_total",
+    "broker.backfill_throttled_total",
 )
 STANDARD_GAUGES = (
     "worker.pipeline_lag",
@@ -276,6 +293,13 @@ STANDARD_GAUGES = (
     # virtual clock has advanced (loadgen/driver.py).
     "soak.qps_target",
     "soak.virtual_seconds",
+    # The ingest staging arena's resident bytes (sched/feed.py
+    # PinnedArena — decode slabs + the tiered table's cold tier).
+    "ingest.arena_bytes",
+    # Partition count of the partitioned broker (1 = single queue);
+    # per-partition broker.queue_depth{queue=,partition=,lane=} series
+    # appear on first sample, bounded by the label-cardinality cap.
+    "broker.partitions",
 )
 
 #: Histogram families the runtime emits (graftlint GL030 resolves
@@ -321,6 +345,10 @@ SPAN_CATALOG = (
     "trace.enqueue",
     "batch.assemble",
     "view.publish",
+    # the wire-speed ingest plane: one columnar window's decode into an
+    # arena slab, and its H2D commit off that slab (docs/ingest.md)
+    "ingest.decode",
+    "ingest.commit",
 )
 
 #: Distinct labeled series allowed per family (base metric name) before

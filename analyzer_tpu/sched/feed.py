@@ -46,6 +46,8 @@ from __future__ import annotations
 import threading
 from collections import deque
 
+import numpy as np
+
 from analyzer_tpu.obs import get_registry, get_tracer
 from analyzer_tpu.obs.tracer import bind_trace, current_trace
 
@@ -53,6 +55,207 @@ from analyzer_tpu.obs.tracer import bind_trace, current_trace
 #: behind it. Depth 3 buys jitter tolerance on hosts where
 #: materialization time varies window to window, at one more slab of HBM.
 DEFAULT_DEPTH = 2
+
+#: Page alignment for arena buffers: DMA engines transfer aligned pages
+#: without a bounce copy, and the pinned_host staging path wants its
+#: source page-aligned either way.
+ARENA_ALIGNMENT = 4096
+
+
+class PinnedArena:
+    """Reusable page-aligned host staging buffers for the ingest plane
+    (docs/ingest.md "Arena layout").
+
+    Two allocation surfaces share one allocator (and one telemetry
+    stream): :meth:`take`/:meth:`give` lease fixed-shape slabs the
+    columnar decoder (``io/ingest.py``) writes whole match windows into
+    — steady state is ~100% reuse, pinned by the arena-hit-rate gate of
+    ``cli benchdiff --family ingest`` — and :meth:`empty` hands out
+    long-lived buffers (the tiered table's cold tier, ``sched/tier.py``)
+    from the same aligned allocator.
+
+    :meth:`commit` is the H2D edge: on a backend that exposes a
+    ``pinned_host`` memory space (TPU), the slab stages through pinned
+    memory so the device transfer is real async DMA; on CPU it degrades
+    to a plain ``jnp.asarray`` with identical semantics. A committed
+    slab is released back to the freelist only once its device array
+    reports ready (``_deferred``), so a reused buffer can never be
+    overwritten under an in-flight transfer.
+
+    Telemetry (docs/observability.md catalog): ``ingest.arena_allocs_
+    total`` / ``ingest.arena_reuses_total`` counters (their ratio is the
+    hit rate), ``ingest.h2d_commits_total``, and the ``ingest.arena_
+    bytes`` gauge.
+    """
+
+    def __init__(self, name: str = "ingest") -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        # (shape, dtype str) -> [buffer, ...] free slabs.
+        self._free: dict[tuple, list] = {}
+        # id(view) -> (key, base array) for every live lease/alloc — the
+        # base reference keeps the aligned parent alive.
+        self._live: dict[int, tuple] = {}
+        # (device array, buffer) pairs whose H2D may still be in flight.
+        self._deferred: list = []
+        self._nbytes = 0
+        self._transfer = None  # resolved lazily on first commit
+        reg = get_registry()
+        self._allocs = reg.counter("ingest.arena_allocs_total")
+        self._reuses = reg.counter("ingest.arena_reuses_total")
+        self._commits = reg.counter("ingest.h2d_commits_total")
+        self._bytes_gauge = reg.gauge("ingest.arena_bytes")
+
+    @staticmethod
+    def _aligned(shape, dtype) -> tuple[np.ndarray, np.ndarray]:
+        """(base, view): a C-contiguous ``shape``/``dtype`` view whose
+        data pointer is ARENA_ALIGNMENT-aligned."""
+        dt = np.dtype(dtype)
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+        base = np.empty(nbytes + ARENA_ALIGNMENT, np.uint8)
+        off = (-base.ctypes.data) % ARENA_ALIGNMENT
+        view = base[off:off + nbytes].view(dt).reshape(shape)
+        return base, view
+
+    def _new(self, key) -> np.ndarray:
+        shape, dtype = key
+        base, view = self._aligned(shape, dtype)
+        self._allocs.add(1)
+        self._nbytes += view.nbytes
+        self._bytes_gauge.set(self._nbytes)
+        self._live[id(view)] = (key, base)
+        return view
+
+    def empty(self, shape, dtype) -> np.ndarray:
+        """A long-lived aligned buffer (never enters the freelist) —
+        the tiered table's cold tier and other resident host state."""
+        with self._lock:
+            return self._new((tuple(shape), np.dtype(dtype).str))
+
+    def take(self, shape, dtype) -> np.ndarray:
+        """Leases a slab (freelist hit, or a counted fresh allocation).
+        Contents are UNDEFINED — the decoder overwrites every used slot
+        and pads the rest itself."""
+        key = (tuple(shape), np.dtype(dtype).str)
+        with self._lock:
+            self._drain_deferred()
+            free = self._free.get(key)
+            if free:
+                buf = free.pop()
+                self._reuses.add(1)
+                return buf
+            return self._new(key)
+
+    def give(self, buf: np.ndarray) -> None:
+        """Returns a leased slab to the freelist for reuse."""
+        with self._lock:
+            entry = self._live.get(id(buf))
+            if entry is None:
+                return  # not ours (or already given) — ignore
+            key, _base = entry
+            self._free.setdefault(key, []).append(buf)
+
+    def give_when_done(self, buf: np.ndarray, device_array) -> None:
+        """Like :meth:`give`, but defers the freelist return until
+        ``device_array``'s transfer reports ready — the safe release
+        for a slab whose H2D commit may still be reading it."""
+        with self._lock:
+            if id(buf) not in self._live:
+                return
+            self._deferred.append((device_array, buf))
+
+    def _drain_deferred(self) -> None:
+        # Lock held. is_ready() is a non-blocking completion probe; a
+        # backend without it transfers synchronously (CPU), so the slab
+        # is already safe to reuse.
+        still = []
+        for dev, buf in self._deferred:
+            ready = getattr(dev, "is_ready", None)
+            if ready is None or ready():
+                key, _base = self._live[id(buf)]
+                self._free.setdefault(key, []).append(buf)
+            else:
+                still.append((dev, buf))
+        self._deferred = still
+
+    # -- H2D edge ---------------------------------------------------------
+    def _resolve_transfer(self):
+        import jax
+
+        dev = jax.devices()[0]
+        kinds = set()
+        try:
+            kinds = {m.kind for m in dev.addressable_memories()}
+        except Exception:  # noqa: BLE001 — older jax: no memory-space API
+            pass
+        if "pinned_host" in kinds:
+            pinned = jax.sharding.SingleDeviceSharding(
+                dev, memory_kind="pinned_host"
+            )
+            device = jax.sharding.SingleDeviceSharding(dev)
+
+            def transfer(x):
+                staged = jax.device_put(x, pinned)  # host -> pinned page
+                return jax.device_put(staged, device)  # async DMA H2D
+
+            return transfer, True
+        import jax.numpy as jnp
+
+        return jnp.asarray, False
+
+    @property
+    def pinned(self) -> bool:
+        """True when commits stage through a real ``pinned_host`` memory
+        space (resolved on first commit; False before and on CPU)."""
+        if self._transfer is None:
+            return False
+        return self._transfer[1]
+
+    def commit(self, buf):
+        """Issues the (async where the backend allows) H2D transfer of
+        ``buf`` and returns the device array. The caller keeps ownership
+        of the slab — pair with :meth:`give_when_done` to recycle it."""
+        if self._transfer is None:
+            self._transfer = self._resolve_transfer()
+        self._commits.add(1)
+        return self._transfer[0](buf)
+
+    def stats(self) -> dict:
+        """JSON-ready arena counters (the bench artifact's ``arena``
+        block): allocations, reuses, hit rate, resident bytes."""
+        allocs = self._allocs.value
+        reuses = self._reuses.value
+        total = allocs + reuses
+        return {
+            "allocs": int(allocs),
+            "reuses": int(reuses),
+            "hit_rate": round(reuses / total, 4) if total else None,
+            "bytes": int(self._nbytes),
+            "pinned": self.pinned,
+        }
+
+
+_arena_lock = threading.Lock()
+_arena: PinnedArena | None = None
+
+
+def get_arena() -> PinnedArena:
+    """The process-wide staging arena (created on first use) — shared by
+    the columnar decoder's window slabs and the tiered table's cold
+    tier, so one allocator owns all pinned host staging memory."""
+    global _arena
+    with _arena_lock:
+        if _arena is None:
+            _arena = PinnedArena()
+        return _arena
+
+
+def reset_arena() -> PinnedArena:
+    """Replaces the process-wide arena with a fresh one (tests)."""
+    global _arena
+    with _arena_lock:
+        _arena = PinnedArena()
+        return _arena
 
 
 class FeedClosedError(RuntimeError):
@@ -213,6 +416,25 @@ def stage_chunk(sched, start: int, stop: int):
         pidx, _mask, winner, mode_id, afk = sched.host_window(start, stop)
     with tracer.span("feed.transfer", cat="sched", start=start):
         return compact_device_window(pidx, winner, mode_id, afk)
+
+
+def stage_ingest_window(win, arena: PinnedArena | None = None):
+    """The ingest plane's H2D edge (docs/ingest.md): commits one
+    :class:`analyzer_tpu.io.ingest.DecodedWindow`'s column slabs to the
+    device (``ingest.commit`` span; async DMA through the pinned staging
+    path where the backend has one) and recycles the slabs back to the
+    arena once their transfers report ready. The FULL fixed-width slabs
+    are committed — window shape is static, so every window reuses one
+    compiled transfer shape — and the live row count rides alongside.
+
+    Returns ``(rows, player_idx, winner, mode_id, afk)`` device arrays.
+    """
+    arena = arena or get_arena()
+    tracer = get_tracer()
+    with tracer.span("ingest.commit", cat="ingest", rows=win.rows):
+        devs = tuple(arena.commit(buf) for buf in win.slabs)
+    win.release(devs)
+    return (win.rows,) + devs
 
 
 class FusedChunk:

@@ -167,9 +167,19 @@ class TierManager:
         self.n_players = state.pad_row
         # Entry-point fetch of the authoritative table: the cold tier
         # starts as the caller's full state. One sync at run start, the
-        # tiered sibling of the untiered path's jnp.copy.
+        # tiered sibling of the untiered path's jnp.copy. The tier lives
+        # in a page-aligned buffer from the process staging arena
+        # (sched/feed.py PinnedArena — the same allocator as the ingest
+        # decode slabs), so demotion D2H and promotion H2D run against
+        # DMA-friendly pinned pages where the backend supports them;
+        # values are copied in, so bit-identity is untouched.
+        from analyzer_tpu.sched.feed import get_arena
+
         # graftlint: disable=GL025 — one intentional run-entry D2H fetch
-        self._host_table = np.array(state.table, np.float32)
+        src = np.array(state.table, np.float32)
+        self._host_table = get_arena().empty(src.shape, np.float32)
+        self._host_table[...] = src
+        del src
         self.capacity = _pow2(max(hot_rows, MIN_HOT_ROWS))
         self.hot_pad = self.capacity
         self._pad_vals = self._host_table[self.pad_row].copy()

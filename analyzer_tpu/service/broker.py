@@ -12,8 +12,11 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import zlib
 from collections import deque
 from typing import Iterator, Protocol
+
+from analyzer_tpu.obs import get_registry
 
 
 @dataclasses.dataclass
@@ -104,6 +107,244 @@ class InMemoryBroker:
 
     def qsize(self, queue: str) -> int:
         return len(self.queues.get(queue, ()))
+
+
+#: Priority-lane names (docs/ingest.md "Lane arbitration"): live match
+#: traffic always outranks backfill/replay; the admission controller
+#: decides how much backfill the host has headroom for.
+LANE_LIVE = "live"
+LANE_BACKFILL = "backfill"
+_LANES = (LANE_LIVE, LANE_BACKFILL)
+
+
+def partition_of(body: bytes, headers: dict | None, partitions: int) -> int:
+    """The partition routing function. Publishers that know the match's
+    home shard set an ``x-partition`` header from the mesh layout
+    invariant (``row % S`` of a participating player — the same function
+    the serve plane routes lookups by); headerless messages hash the
+    body (crc32 — stable across processes and runs, unlike ``hash()``)
+    so partitioning never depends on publisher cooperation."""
+    if headers and "x-partition" in headers:
+        return int(headers["x-partition"]) % partitions
+    return zlib.crc32(body) % partitions
+
+
+class AdmissionController:
+    """Decides how many backfill messages a consumer poll may admit
+    (docs/ingest.md "Lane arbitration").
+
+    Strict live priority: any ready live message zeroes the backfill
+    quota. With live drained, admission is gated on HOST headroom, read
+    from the telemetry the pipeline already emits: a growing
+    ``feed.starved_total`` means the device is outrunning the host —
+    adding backfill decode/encode work would push live latency up — and
+    a burst of ``tier.promotions_total`` means the H2D lane is busy
+    moving hot-set pages, the same bandwidth a backfill batch's
+    transfers would contend with. Either signal halves the open window
+    instead of closing it (backfill must not starve forever); quiet
+    telemetry admits the full remaining window. Decisions are pure
+    functions of counter deltas, so a soak's admission sequence is
+    deterministic per (seed, config)."""
+
+    def __init__(
+        self,
+        registry=None,
+        starve_threshold: int = 1,
+        promote_threshold: int = 256,
+    ) -> None:
+        self._registry = registry
+        self.starve_threshold = int(starve_threshold)
+        self.promote_threshold = int(promote_threshold)
+        self._last_starved: float | None = None
+        self._last_promotes: float | None = None
+
+    def quota(self, live_ready: int, limit: int) -> int:
+        """Backfill messages admissible now, given ``live_ready`` live
+        messages still waiting and ``limit`` slots of consumer room."""
+        if limit <= 0:
+            return 0
+        reg = self._registry or get_registry()
+        starved = reg.counter("feed.starved_total").value
+        promotes = reg.counter("tier.promotions_total").value
+        d_starved = (
+            0.0 if self._last_starved is None else starved - self._last_starved
+        )
+        d_promotes = (
+            0.0 if self._last_promotes is None
+            else promotes - self._last_promotes
+        )
+        self._last_starved = starved
+        self._last_promotes = promotes
+        if live_ready > 0:
+            return 0
+        if (
+            d_starved >= self.starve_threshold
+            or d_promotes >= self.promote_threshold
+        ):
+            return max(1, limit // 2)
+        return limit
+
+
+class PartitionedBroker:
+    """In-memory broker partitioned by player-shard with priority lanes
+    — the wire-speed ingest edge (docs/ingest.md "Partition math").
+
+    Each logical queue is ``partitions`` x ``(live, backfill)`` physical
+    deques. Publish routes by :func:`partition_of` and stamps a
+    per-logical-queue sequence number; ``get`` k-way-merges partition
+    heads by that sequence, so with live-only traffic the delivery
+    order — and every delivery tag — is EXACTLY
+    :class:`InMemoryBroker`'s for the same publish sequence. That is
+    the soak bit-identity contract: partitioning changes where messages
+    WAIT (per-partition depth, backpressure, dead-letter attribution),
+    never what order they are consumed in. Lanes are the one sanctioned
+    reordering: backfill is admitted behind live by the
+    :class:`AdmissionController`.
+
+    Dead-lettering inherits partitioning for free: the worker
+    republishes a poison message to ``<queue>_failed`` with its
+    original headers, so the failed queue's per-partition depths name
+    WHICH shard's traffic is poisoned (``partition_depths``).
+
+    On AMQP the same layout maps to ``<queue>.p<k>`` physical queues;
+    this in-memory implementation is the contract the adapter would
+    have to meet (per-partition ``message_count``, seq-merged delivery).
+    """
+
+    def __init__(
+        self,
+        partitions: int = 1,
+        lanes: bool = False,
+        admission: AdmissionController | None = None,
+    ) -> None:
+        if partitions < 1:
+            raise ValueError(f"partitions must be >= 1, got {partitions}")
+        self.partitions = int(partitions)
+        self.lanes = bool(lanes)
+        self.admission = admission or (AdmissionController() if lanes else None)
+        # queue -> [partition][lane] -> deque[(seq, Message)]
+        self.queues: dict[str, list[dict[str, deque]]] = {}
+        self.topics: list[tuple[str, str, bytes]] = []
+        self._seq: dict[str, itertools.count] = {}
+        self._unacked: dict[int, tuple[str, int, str, int, Message]] = {}
+        self._tags = itertools.count(1)
+        reg = get_registry()
+        reg.gauge("broker.partitions").set(self.partitions)
+        self._admitted = reg.counter("broker.backfill_admitted_total")
+        self._throttled = reg.counter("broker.backfill_throttled_total")
+
+    def declare_queue(self, name: str) -> None:
+        if name not in self.queues:
+            self.queues[name] = [
+                {lane: deque() for lane in _LANES}
+                for _ in range(self.partitions)
+            ]
+            self._seq[name] = itertools.count()
+
+    def publish(self, queue: str, body: bytes, headers: dict | None = None) -> None:
+        self.declare_queue(queue)
+        h = dict(headers or {})
+        lane = h.get("x-lane", LANE_LIVE) if self.lanes else LANE_LIVE
+        if lane not in _LANES:
+            lane = LANE_LIVE
+        p = partition_of(body, h, self.partitions)
+        self.queues[queue][p][lane].append(
+            (next(self._seq[queue]), Message(body=body, headers=h))
+        )
+
+    def publish_topic(self, exchange: str, routing_key: str, body: bytes) -> None:
+        self.topics.append((exchange, routing_key, body))
+
+    def _pop_merged(self, queue: str, lane: str, limit: int, out: list) -> None:
+        """Moves up to ``limit - len(out)`` messages of ``lane`` into
+        ``out`` in global sequence order (smallest head across the
+        partitions first — requeued messages keep their original seq,
+        so a redelivery outranks everything published after it)."""
+        parts = self.queues[queue]
+        while len(out) < limit:
+            best = None
+            for p in range(self.partitions):
+                q = parts[p][lane]
+                if q and (best is None or q[0][0] < parts[best][lane][0][0]):
+                    best = p
+            if best is None:
+                return
+            seq, msg = parts[best][lane].popleft()
+            msg = dataclasses.replace(msg, delivery_tag=next(self._tags))
+            self._unacked[msg.delivery_tag] = (queue, best, lane, seq, msg)
+            out.append(msg)
+
+    def get(self, queue: str, limit: int) -> list[Message]:
+        self.declare_queue(queue)
+        out: list[Message] = []
+        self._pop_merged(queue, LANE_LIVE, limit, out)
+        room = limit - len(out)
+        if self.lanes and room > 0:
+            live_left = self.lane_size(queue, LANE_LIVE)
+            quota = (
+                self.admission.quota(live_left, room)
+                if self.admission is not None else room
+            )
+            quota = min(quota, room)
+            before = len(out)
+            self._pop_merged(queue, LANE_BACKFILL, before + quota, out)
+            admitted = len(out) - before
+            if admitted:
+                self._admitted.add(admitted)
+            waiting = self.lane_size(queue, LANE_BACKFILL)
+            if waiting and quota < room:
+                self._throttled.add(min(waiting, room - quota))
+        return out
+
+    def ack(self, delivery_tag: int) -> None:
+        self._unacked.pop(delivery_tag, None)
+
+    def nack(self, delivery_tag: int, requeue: bool = False) -> None:
+        entry = self._unacked.pop(delivery_tag, None)
+        if entry and requeue:
+            queue, p, lane, seq, msg = entry
+            self.queues[queue][p][lane].appendleft((seq, msg))
+
+    def requeue_unacked(self) -> None:
+        """Simulates a consumer crash: the broker redelivers everything
+        (each message back at its partition/lane head, original seq —
+        the merge restores global order). Returned highest-seq-first so
+        every deque stays seq-ascending head to tail."""
+        entries = sorted(self._unacked.values(), key=lambda e: -e[3])
+        for queue, p, lane, seq, msg in entries:
+            self.queues[queue][p][lane].appendleft((seq, msg))
+        self._unacked.clear()
+
+    def set_prefetch(self, prefetch: int) -> None:
+        """No delivery bound to adjust in memory; recorded for tests."""
+        self.prefetch = int(prefetch)
+
+    def lane_size(self, queue: str, lane: str) -> int:
+        """Ready depth of one lane across every partition."""
+        parts = self.queues.get(queue)
+        if parts is None:
+            return 0
+        return sum(len(parts[p][lane]) for p in range(self.partitions))
+
+    def qsize(self, queue: str) -> int:
+        """AGGREGATE ready depth across all partitions and lanes — the
+        number a single-queue broker would report, so existing
+        ``broker.queue_depth`` consumers (worker gauge, soak sampler)
+        keep meaning the same thing."""
+        return sum(self.lane_size(queue, lane) for lane in _LANES)
+
+    def partition_depths(self, queue: str) -> dict[int, dict[str, int]]:
+        """Per-partition, per-lane ready depths — the skew surface the
+        worker samples into ``broker.queue_depth{queue=,partition=,
+        lane=}`` series (bounded by the registry's label-cardinality
+        cap) and /statusz renders for the hot-partition runbook."""
+        parts = self.queues.get(queue)
+        if parts is None:
+            return {}
+        return {
+            p: {lane: len(parts[p][lane]) for lane in _LANES}
+            for p in range(self.partitions)
+        }
 
 
 def make_pika_broker(uri: str, prefetch: int = 0):

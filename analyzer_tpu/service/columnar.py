@@ -24,6 +24,14 @@ stale snapshot value could regress a player row committed by an
 in-flight predecessor batch (its chain patch fixes device priors, not
 loaded python attributes). Touched-only writes are also what the
 reference's ORM flush does: automap never UPDATEs unmodified attributes.
+
+This lane is also the SEMANTICS CONTRACT for the wire-speed columnar
+ingest decoder (``io/ingest.py``, docs/ingest.md): the decoder's
+windowed output is bit-identical to the codec path's arrays, so every
+gate this module applies downstream — AFK/validity, unsupported-mode
+skips, the poison attribution above, the write set — is identical
+whichever path the bytes arrived through (pinned by the differential
+tests in ``tests/test_ingest.py``).
 """
 
 from __future__ import annotations

@@ -298,10 +298,17 @@ class Worker:
         """Samples the broker's ready depth into the
         ``broker.queue_depth{queue=}`` gauge (plus the unlabeled
         process gauge) so soak/production backpressure is visible on
-        /statusz. Throttled on the worker clock — on AMQP the depth is
-        a passive-declare round trip, which a 100 Hz poll loop must not
-        pay per iteration. Best-effort: a broker blip here must not
-        take down the consume loop."""
+        /statusz. On a partitioned broker the ``{queue=}`` series is
+        the AGGREGATE across every partition and lane (``qsize`` owns
+        that sum — a per-partition broker whose gauge reported one
+        partition's depth would hide a hot-partition backlog behind a
+        small number), and each partition/lane additionally emits its
+        own ``broker.queue_depth{queue=,partition=,lane=}`` series so
+        /statusz shows the SKEW, bounded by the registry's
+        label-cardinality cap. Throttled on the worker clock — on AMQP
+        the depth is a passive-declare round trip, which a 100 Hz poll
+        loop must not pay per iteration. Best-effort: a broker blip
+        here must not take down the consume loop."""
         qsize = getattr(self.broker, "qsize", None)
         if qsize is None:
             return
@@ -320,6 +327,20 @@ class Worker:
         reg = get_registry()
         reg.gauge("broker.queue_depth").set(depth)
         reg.gauge("broker.queue_depth", queue=self.config.queue).set(depth)
+        partition_depths = getattr(self.broker, "partition_depths", None)
+        if partition_depths is None:
+            return
+        try:
+            per_part = partition_depths(self.config.queue)
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            logger.debug("broker partition_depths probe failed", exc_info=True)
+            return
+        for part, lanes in per_part.items():
+            for lane, lane_depth in lanes.items():
+                reg.gauge(
+                    "broker.queue_depth",
+                    queue=self.config.queue, partition=part, lane=lane,
+                ).set(lane_depth)
 
     def request_stop(self) -> None:
         """Asks the consume loop to exit after the current batch. Safe

@@ -1,0 +1,685 @@
+"""Wire-speed ingest plane (docs/ingest.md): columnar window decode vs
+the codec path byte-for-byte, pinned-arena reuse, partitioned-broker
+ordering/lanes/admission, per-partition depth sampling, the benchdiff
+``ingest`` family, and the soak's dominant-stage SLO."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from analyzer_tpu.config import RatingConfig, ServiceConfig
+from analyzer_tpu.io.csv_codec import load_stream_csv, save_stream_csv
+from analyzer_tpu.io.ingest import (
+    ColumnarDecoder,
+    DEFAULT_WINDOW_ROWS,
+    IngestDecodeError,
+    decode_stream_csv,
+)
+from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
+from analyzer_tpu.obs import get_registry
+from analyzer_tpu.obs.registry import reset_registry
+from analyzer_tpu.sched.feed import (
+    ARENA_ALIGNMENT,
+    PinnedArena,
+    get_arena,
+    reset_arena,
+    stage_ingest_window,
+)
+from analyzer_tpu.service.broker import (
+    AdmissionController,
+    InMemoryBroker,
+    LANE_BACKFILL,
+    LANE_LIVE,
+    PartitionedBroker,
+    partition_of,
+)
+
+CFG = RatingConfig()
+
+
+def _csv_bytes(n_matches=300, seed=12, **kw):
+    players = synthetic_players(60, seed=seed)
+    s = synthetic_stream(n_matches, players, seed=seed, **kw)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "s.csv")
+        save_stream_csv(path, s)
+        with open(path, "rb") as f:
+            return f.read(), s
+
+
+# ---------------------------------------------------------------------------
+class TestColumnarDecoder:
+    """Differential: the windowed decoder's output is BYTE-IDENTICAL to
+    the codec path's for any stream the fast grammar accepts."""
+
+    def _parity(self, data, window_rows):
+        import io as _io
+
+        from analyzer_tpu.io.csv_codec import _parse
+
+        ref = _parse(_io.StringIO(data.decode()))
+        got = decode_stream_csv(data, window_rows=window_rows,
+                                arena=PinnedArena())
+        assert got is not None
+        np.testing.assert_array_equal(got.player_idx, ref.player_idx)
+        np.testing.assert_array_equal(got.winner, ref.winner)
+        np.testing.assert_array_equal(got.mode_id, ref.mode_id)
+        np.testing.assert_array_equal(got.afk, ref.afk)
+        assert got.player_idx.dtype == np.int32
+        assert got.afk.dtype == bool
+
+    def test_parity_with_python_parser_incl_gating_rows(self):
+        data, _ = _csv_bytes(300, afk_rate=0.2, unsupported_rate=0.1)
+        self._parity(data, window_rows=64)
+
+    @pytest.mark.parametrize("window_rows", [1, 7, 300, 4096])
+    def test_window_size_invariant(self, window_rows):
+        data, _ = _csv_bytes(120)
+        self._parity(data, window_rows)
+
+    def test_parity_with_whole_file_loader(self, tmp_path):
+        data, stream = _csv_bytes(200)
+        path = str(tmp_path / "s.csv")
+        with open(path, "wb") as f:
+            f.write(data)
+        full = load_stream_csv(path)
+        got = decode_stream_csv(data, arena=PinnedArena())
+        np.testing.assert_array_equal(got.player_idx, full.player_idx)
+        np.testing.assert_array_equal(got.winner, full.winner)
+        np.testing.assert_array_equal(got.mode_id, full.mode_id)
+        np.testing.assert_array_equal(got.afk, full.afk)
+
+    def test_no_header_no_trailing_newline_blank_lines(self):
+        raw = b"0,ranked,1,0,1;2;3,4;5;6\n\n1,casual_aral,0,1,7;8;9,10;11;12"
+        got = decode_stream_csv(raw, arena=PinnedArena())
+        assert got.n_matches == 2
+        assert got.winner.tolist() == [1, 0]
+        assert got.afk.tolist() == [False, True]
+        assert got.player_idx[1, 1].tolist() == [10, 11, 12]
+
+    def test_empty_and_header_only(self):
+        for raw in (b"", b"match_id,mode,winner,afk,team0,team1\n"):
+            got = decode_stream_csv(raw, arena=PinnedArena())
+            assert got is not None and got.n_matches == 0
+
+    def test_quoted_fields_fall_back(self):
+        raw = b'0,"ranked",0,0,1;2;3,4;5;6\n'
+        assert decode_stream_csv(raw, arena=PinnedArena()) is None
+        dec = ColumnarDecoder(raw, arena=PinnedArena())
+        assert not dec.available
+        with pytest.raises(RuntimeError):
+            next(dec.windows())
+
+    def test_malformed_row_names_absolute_row(self):
+        good = b"0,ranked,1,0,1;2;3,4;5;6\n" * 5
+        bad = good + b"5,ranked,z,0,1;2;3,4;5;6\n"
+        dec = ColumnarDecoder(bad, window_rows=2, arena=PinnedArena())
+        seen = 0
+        with pytest.raises(IngestDecodeError) as err:
+            for win in dec.windows():
+                seen += win.rows
+                win.release()
+        assert seen == 5  # the valid prefix decoded before the poison
+        assert err.value.row == 5  # absolute stream row, not window-relative
+
+    def test_out_of_int32_ids_poison_the_window(self):
+        raw = b"0,ranked,1,0,3000000000;2;3,4;5;6\n"
+        dec = ColumnarDecoder(raw, arena=PinnedArena())
+        with pytest.raises(IngestDecodeError):
+            list(dec.windows())
+
+    def test_decode_counters_move(self):
+        reset_registry()
+        data, _ = _csv_bytes(100)
+        decode_stream_csv(data, window_rows=32, arena=PinnedArena())
+        reg = get_registry()
+        assert reg.counter("ingest.rows_decoded_total").value == 100
+        assert reg.counter("ingest.bytes_decoded_total").value > 0
+        assert reg.counter("ingest.windows_total").value == 4
+
+
+# ---------------------------------------------------------------------------
+class TestPinnedArena:
+    def test_page_alignment(self):
+        arena = PinnedArena()
+        for shape, dtype in (((64, 2, 16), np.int32), ((7,), np.uint8),
+                             ((33, 16), np.float32)):
+            buf = arena.take(shape, dtype)
+            assert buf.ctypes.data % ARENA_ALIGNMENT == 0
+            assert buf.shape == shape and buf.dtype == dtype
+            assert buf.flags.c_contiguous
+        long_lived = arena.empty((10, 16), np.float32)
+        assert long_lived.ctypes.data % ARENA_ALIGNMENT == 0
+
+    def test_steady_state_allocation_is_flat(self):
+        reset_registry()
+        arena = PinnedArena()
+        reg = get_registry()
+        for _ in range(50):
+            a = arena.take((16, 2, 16), np.int32)
+            b = arena.take((16,), np.int32)
+            arena.give(a)
+            arena.give(b)
+        assert reg.counter("ingest.arena_allocs_total").value == 2
+        assert reg.counter("ingest.arena_reuses_total").value == 98
+        assert arena.stats()["hit_rate"] > 0.9
+
+    def test_commit_round_trips_values(self):
+        arena = PinnedArena()
+        buf = arena.take((8,), np.int32)
+        buf[:] = np.arange(8)
+        dev = arena.commit(buf)
+        np.testing.assert_array_equal(np.asarray(dev), np.arange(8))
+
+    def test_deferred_release_returns_to_freelist(self):
+        reset_registry()
+        arena = PinnedArena()
+        buf = arena.take((8,), np.int32)
+        dev = arena.commit(buf)
+        arena.give_when_done(buf, dev)
+        buf2 = arena.take((8,), np.int32)  # drains the deferred entry
+        assert buf2 is buf  # recycled, not reallocated
+        assert get_registry().counter("ingest.arena_allocs_total").value == 1
+
+    def test_empty_buffers_can_be_pooled_if_given(self):
+        # empty() buffers are tracked by the same allocator, so an
+        # (unusual) give() pools them like any slab; long-lived callers
+        # simply never call give().
+        arena = PinnedArena()
+        cold = arena.empty((4, 16), np.float32)
+        arena.give(cold)
+        other = arena.take((4, 16), np.float32)
+        assert other is cold
+
+    def test_stats_shape(self):
+        st = PinnedArena().stats()
+        assert set(st) == {"allocs", "reuses", "hit_rate", "bytes", "pinned"}
+        assert st["pinned"] is False  # unresolved until the first commit
+
+
+# ---------------------------------------------------------------------------
+class TestStageIngestWindow:
+    def test_commits_values_and_recycles_slabs(self):
+        reset_registry()
+        data, _ = _csv_bytes(100)
+        arena = PinnedArena()
+        import io as _io
+
+        from analyzer_tpu.io.csv_codec import _parse
+
+        ref = _parse(_io.StringIO(data.decode()))
+        t = ref.player_idx.shape[2]
+        rows_seen = 0
+        for win in ColumnarDecoder(data, window_rows=32,
+                                   arena=arena).windows():
+            n, pidx, winner, mode_id, afk = stage_ingest_window(win, arena)
+            np.testing.assert_array_equal(
+                np.asarray(pidx)[:n, :, :t],
+                ref.player_idx[rows_seen:rows_seen + n],
+            )
+            np.testing.assert_array_equal(
+                np.asarray(winner)[:n], ref.winner[rows_seen:rows_seen + n]
+            )
+            rows_seen += n
+        assert rows_seen == 100
+        # 4 windows through at most 2 slab generations (decode-ahead +
+        # in-flight) — steady state reuses, never grows.
+        assert get_registry().counter(
+            "ingest.arena_allocs_total"
+        ).value <= 8
+        assert get_registry().counter("ingest.h2d_commits_total").value == 16
+
+
+# ---------------------------------------------------------------------------
+class TestPartitionOf:
+    def test_header_routing_and_fallback(self):
+        assert partition_of(b"x", {"x-partition": 5}, 4) == 1
+        import zlib
+
+        assert partition_of(b"abc", None, 8) == zlib.crc32(b"abc") % 8
+        # stable across calls
+        assert partition_of(b"abc", {}, 8) == partition_of(b"abc", None, 8)
+
+
+class TestPartitionedBroker:
+    def _publish_seq(self, broker, n=20, queue="analyze"):
+        for i in range(n):
+            broker.publish(queue, f"m{i:03d}".encode(),
+                           headers={"x-partition": i % 7})
+
+    def test_delivery_order_and_tags_match_single_queue(self):
+        part = PartitionedBroker(partitions=4)
+        mono = InMemoryBroker()
+        for i in range(20):
+            body = f"m{i:03d}".encode()
+            part.publish("analyze", body, headers={"x-partition": i % 7})
+            mono.publish("analyze", body, headers={"x-partition": i % 7})
+        for limit in (3, 1, 7, 20):
+            a = part.get("analyze", limit)
+            b = mono.get("analyze", limit)
+            assert [m.body for m in a] == [m.body for m in b]
+            assert [m.delivery_tag for m in a] == [m.delivery_tag for m in b]
+
+    def test_qsize_aggregates_and_partition_depths_split(self):
+        broker = PartitionedBroker(partitions=3)
+        self._publish_seq(broker, 9)
+        assert broker.qsize("analyze") == 9
+        depths = broker.partition_depths("analyze")
+        assert sorted(depths) == [0, 1, 2]
+        assert sum(d[LANE_LIVE] for d in depths.values()) == 9
+        assert all(d[LANE_BACKFILL] == 0 for d in depths.values())
+
+    def test_nack_requeue_preserves_global_order(self):
+        broker = PartitionedBroker(partitions=2)
+        self._publish_seq(broker, 6)
+        got = broker.get("analyze", 3)
+        broker.nack(got[0].delivery_tag, requeue=True)
+        broker.ack(got[1].delivery_tag)
+        broker.ack(got[2].delivery_tag)
+        # the requeued head outranks everything not yet delivered
+        rest = broker.get("analyze", 10)
+        assert [m.body for m in rest] == [
+            b"m000", b"m003", b"m004", b"m005"
+        ]
+
+    def test_requeue_unacked_crash_redelivery(self):
+        broker = PartitionedBroker(partitions=3)
+        self._publish_seq(broker, 5)
+        broker.get("analyze", 5)
+        broker.requeue_unacked()
+        again = broker.get("analyze", 5)
+        assert [m.body for m in again] == [
+            f"m{i:03d}".encode() for i in range(5)
+        ]
+
+    def test_dead_letter_partition_attribution(self):
+        broker = PartitionedBroker(partitions=4)
+        broker.publish("analyze", b"poison", headers={"x-partition": 2})
+        msg = broker.get("analyze", 1)[0]
+        # the worker's failure policy: republish with original headers
+        broker.publish("analyze_failed", msg.body, msg.headers)
+        broker.nack(msg.delivery_tag, requeue=False)
+        depths = broker.partition_depths("analyze_failed")
+        assert depths[2][LANE_LIVE] == 1
+        assert sum(d[LANE_LIVE] for p, d in depths.items() if p != 2) == 0
+
+    def test_invalid_partition_count(self):
+        with pytest.raises(ValueError):
+            PartitionedBroker(partitions=0)
+
+    def test_unknown_lane_routes_live(self):
+        broker = PartitionedBroker(partitions=1, lanes=True)
+        broker.publish("analyze", b"x", headers={"x-lane": "mystery"})
+        assert broker.lane_size("analyze", LANE_LIVE) == 1
+
+
+class TestPriorityLanes:
+    def _broker(self, admission=None):
+        return PartitionedBroker(
+            partitions=2, lanes=True,
+            admission=admission or AdmissionController(),
+        )
+
+    def test_live_strictly_outranks_backfill(self):
+        broker = self._broker()
+        broker.publish("analyze", b"b0", headers={"x-lane": LANE_BACKFILL})
+        broker.publish("analyze", b"l0", headers={})
+        broker.publish("analyze", b"l1", headers={})
+        got = broker.get("analyze", 10)
+        assert [m.body for m in got] == [b"l0", b"l1", b"b0"]
+
+    def test_backfill_waits_while_live_fills_the_window(self):
+        broker = self._broker()
+        for i in range(4):
+            broker.publish("analyze", f"l{i}".encode())
+        broker.publish("analyze", b"b0", headers={"x-lane": LANE_BACKFILL})
+        got = broker.get("analyze", 2)  # live still waiting after this
+        assert [m.body for m in got] == [b"l0", b"l1"]
+        assert broker.lane_size("analyze", LANE_BACKFILL) == 1
+
+    def test_starvation_throttles_admission(self):
+        reset_registry()
+        ctl = AdmissionController(starve_threshold=1)
+        broker = self._broker(admission=ctl)
+        for i in range(8):
+            broker.publish("analyze", f"b{i}".encode(),
+                           headers={"x-lane": LANE_BACKFILL})
+        ctl.quota(0, 1)  # anchor the counter baseline
+        get_registry().counter("feed.starved_total").add(3)  # host behind
+        got = broker.get("analyze", 8)
+        assert len(got) == 4  # halved window, not zero (no starvation)
+        assert get_registry().counter(
+            "broker.backfill_throttled_total"
+        ).value > 0
+        # quiet telemetry afterwards: the full window opens again
+        got2 = broker.get("analyze", 8)
+        assert len(got2) == 4
+
+    def test_promotion_burst_throttles_admission(self):
+        reset_registry()
+        ctl = AdmissionController(promote_threshold=10)
+        ctl.quota(0, 1)
+        get_registry().counter("tier.promotions_total").add(50)
+        assert ctl.quota(0, 8) == 4
+
+    def test_live_ready_zeroes_quota(self):
+        reset_registry()
+        assert AdmissionController().quota(3, 8) == 0
+
+
+# ---------------------------------------------------------------------------
+class TestWorkerDepthSampling:
+    """The satellite bugfix: broker.queue_depth{queue=} aggregates the
+    partitions, and per-partition/lane series ride alongside."""
+
+    def _worker(self, broker):
+        from analyzer_tpu.service.store import InMemoryStore
+        from analyzer_tpu.service.worker import Worker
+
+        clock = iter(range(0, 10_000, 10))
+        return Worker(
+            broker, InMemoryStore(),
+            ServiceConfig(pipeline=False),
+            CFG, clock=lambda: float(next(clock)),
+        )
+
+    def test_aggregate_and_per_partition_series(self):
+        reset_registry()
+        broker = PartitionedBroker(partitions=3, lanes=True)
+        worker = self._worker(broker)
+        for i in range(6):
+            broker.publish("analyze", f"m{i}".encode(),
+                           headers={"x-partition": i % 3})
+        broker.publish("analyze", b"bf", headers={
+            "x-partition": 1, "x-lane": LANE_BACKFILL,
+        })
+        worker._sample_queue_depth()
+        reg = get_registry()
+        assert reg.gauge("broker.queue_depth").value == 7
+        assert reg.gauge("broker.queue_depth", queue="analyze").value == 7
+        assert reg.gauge(
+            "broker.queue_depth", queue="analyze", partition=1,
+            lane=LANE_LIVE,
+        ).value == 2
+        assert reg.gauge(
+            "broker.queue_depth", queue="analyze", partition=1,
+            lane=LANE_BACKFILL,
+        ).value == 1
+        assert reg.gauge(
+            "broker.queue_depth", queue="analyze", partition=2,
+            lane=LANE_BACKFILL,
+        ).value == 0
+
+    def test_single_queue_broker_unchanged(self):
+        reset_registry()
+        broker = InMemoryBroker()
+        worker = self._worker(broker)
+        broker.publish("analyze", b"x")
+        worker._sample_queue_depth()
+        assert get_registry().gauge(
+            "broker.queue_depth", queue="analyze"
+        ).value == 1
+
+
+# ---------------------------------------------------------------------------
+class TestTierColdArena:
+    """Satellite: the tiered table's cold tier lives in the shared
+    pinned arena; placement only — bit-identity and telemetry names
+    are pinned by tests/test_tier.py and re-smoked here."""
+
+    def test_cold_tier_is_arena_allocated_and_aligned(self):
+        from analyzer_tpu.core.state import PlayerState
+        from analyzer_tpu.sched.tier import TierManager
+
+        reset_registry()
+        reset_arena()
+        state = PlayerState.create(50, cfg=CFG)
+        tm = TierManager(state, hot_rows=16)
+        assert tm._host_table.ctypes.data % ARENA_ALIGNMENT == 0
+        assert get_registry().counter("ingest.arena_allocs_total").value >= 1
+        np.testing.assert_array_equal(
+            tm._host_table, np.asarray(state.table)
+        )
+
+    def test_tiered_run_still_bit_identical(self):
+        from analyzer_tpu.core.state import PlayerState
+        from analyzer_tpu.sched import pack_schedule, rate_history
+
+        players = synthetic_players(40, seed=9)
+        stream = synthetic_stream(120, players, seed=9)
+        state = PlayerState.create(40, cfg=CFG)
+        sched = pack_schedule(stream, pad_row=state.pad_row)
+        plain, _ = rate_history(state, sched, CFG)
+        tiered, _ = rate_history(state, sched, CFG, hot_rows=16)
+        np.testing.assert_array_equal(
+            np.asarray(plain.table), np.asarray(tiered.table)
+        )
+
+
+# ---------------------------------------------------------------------------
+def _ingest_artifact(**over):
+    art = {
+        "metric": "ingest.bytes_per_sec",
+        "value": 5.0e8,
+        "unit": "bytes/s",
+        "latency_ms": {"p50": 0.2, "p90": 0.6, "p99": 1.4},
+        "ingest": {"native": True, "stable": True},
+        "arena": {"hit_rate": 0.99},
+        "capture": {"degraded": False},
+    }
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(art.get(k), dict):
+            art[k] = {**art[k], **v}
+        else:
+            art[k] = v
+    return art
+
+
+class TestBenchdiffIngestFamily:
+    def test_configs_and_polarity(self):
+        from analyzer_tpu.obs.benchdiff import bench_configs, family_configs
+
+        cfgs = family_configs(bench_configs(_ingest_artifact()), "ingest")
+        by = {c.name: c for c in cfgs}
+        assert by["ingest.bytes_per_sec"].higher_is_better
+        assert not by["ingest.queue_to_h2d_p99_ms"].higher_is_better
+        assert by["ingest.arena_hit_rate"].higher_is_better
+        assert len(cfgs) == 3
+
+    def test_family_prefix_registered(self):
+        from analyzer_tpu.obs.benchdiff import FAMILIES, find_bench_artifacts
+
+        assert FAMILIES["ingest"] == "INGEST_BENCH"
+
+    def _write(self, tmp_path, name, art):
+        p = str(tmp_path / name)
+        with open(p, "w") as f:
+            json.dump(art, f)
+        return p
+
+    def test_gate_passes_and_fails_on_regression(self, tmp_path, capsys):
+        from analyzer_tpu.cli import main
+
+        a = self._write(tmp_path, "INGEST_BENCH_r01.json", _ingest_artifact())
+        b_ok = self._write(
+            tmp_path, "INGEST_BENCH_r02.json", _ingest_artifact(value=5.1e8)
+        )
+        assert main(["benchdiff", "--family", "ingest", a, b_ok]) == 0
+        b_bad = self._write(
+            tmp_path, "INGEST_BENCH_r03.json", _ingest_artifact(value=3.0e8)
+        )
+        assert main(["benchdiff", "--family", "ingest", a, b_bad]) == 1
+        b_lat = self._write(
+            tmp_path, "INGEST_BENCH_r04.json",
+            _ingest_artifact(latency_ms={"p50": 0.2, "p90": 0.6, "p99": 9.0}),
+        )
+        assert main(["benchdiff", "--family", "ingest", a, b_lat]) == 1
+        capsys.readouterr()
+
+    def test_vanished_native_block_exits_1(self, tmp_path, capsys):
+        from analyzer_tpu.cli import main
+
+        a = self._write(tmp_path, "INGEST_BENCH_r01.json", _ingest_artifact())
+        # same (even better) numbers, but the decode fell back to python
+        b = self._write(
+            tmp_path, "INGEST_BENCH_r02.json",
+            _ingest_artifact(value=6.0e8, ingest={"native": False}),
+        )
+        assert main(["benchdiff", "--family", "ingest", a, b]) == 1
+        err = capsys.readouterr().err
+        assert "python codec" in err
+
+    def test_degraded_capture_not_gated(self, tmp_path, capsys):
+        from analyzer_tpu.cli import main
+
+        a = self._write(tmp_path, "INGEST_BENCH_r01.json", _ingest_artifact())
+        b = self._write(
+            tmp_path, "INGEST_BENCH_r02.json",
+            _ingest_artifact(value=1.0e8, capture={"degraded": True}),
+        )
+        assert main(["benchdiff", "--family", "ingest", a, b]) == 0
+        capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+def _soak_artifact(dominant=None, forbid=None, trace_present=True):
+    det = {
+        "dead_letters": 0, "retraces_steady": 0, "view_lag_ticks_max": 0,
+        "drained": True, "queue_depth_final": 0,
+        "matches_published": 10, "matches_rated": 10,
+    }
+    art = {
+        "metric": "soak.matches_per_sec", "value": 100.0,
+        "deterministic": det,
+        "slo": {"thresholds": {"forbid_dominant_stages": forbid}},
+        "latency_ms": {"p99": 1.0},
+    }
+    if trace_present:
+        art["trace"] = {"dominant_stage": dominant}
+    return art
+
+
+class TestDominantStageSLO:
+    def test_forbidden_stage_violates(self):
+        from analyzer_tpu.obs.benchdiff import soak_slo_violations
+
+        art = _soak_artifact(dominant="queue_wait",
+                             forbid=["queue_wait", "encode"])
+        v = soak_slo_violations(art)
+        assert len(v) == 1 and "queue_wait" in v[0]
+
+    def test_other_stage_passes(self):
+        from analyzer_tpu.obs.benchdiff import soak_slo_violations
+
+        art = _soak_artifact(dominant="dispatch",
+                             forbid=["queue_wait", "encode"])
+        assert soak_slo_violations(art) == []
+
+    def test_gate_without_trace_block_fails_loudly(self):
+        from analyzer_tpu.obs.benchdiff import soak_slo_violations
+
+        art = _soak_artifact(forbid=["queue_wait"], trace_present=False)
+        v = soak_slo_violations(art)
+        assert len(v) == 1 and "no trace block" in v[0]
+
+    def test_unconfigured_gate_ignores_trace(self):
+        from analyzer_tpu.obs.benchdiff import soak_slo_violations
+
+        art = _soak_artifact(dominant="queue_wait", forbid=None)
+        assert soak_slo_violations(art) == []
+
+
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def lane_parity_artifacts():
+    """Three smoke soaks: single-queue baseline, partitioned, and
+    partitioned+lanes — the lane-ordering determinism pin."""
+    from analyzer_tpu.loadgen import SoakConfig, SoakDriver
+
+    base = dict(
+        seed=3, duration_s=3.0, tick_s=1.0, qps=10.0, query_qps=6.0,
+        n_players=100, batch_size=32, polls_per_tick=4,
+    )
+    arts = []
+    for extra in (
+        {},
+        {"broker_partitions": 3},
+        {"broker_partitions": 2, "priority_lanes": True},
+    ):
+        driver = SoakDriver(SoakConfig(**{**base, **extra}))
+        try:
+            arts.append(driver.run())
+        finally:
+            driver.close()
+    return arts
+
+
+class TestSoakLaneOrderingDeterminism:
+    def test_partitioned_soak_bit_identical_to_single_queue(
+        self, lane_parity_artifacts
+    ):
+        a, b, _ = lane_parity_artifacts
+        assert json.dumps(a["deterministic"], sort_keys=True) == json.dumps(
+            b["deterministic"], sort_keys=True
+        )
+
+    def test_lanes_bit_identical_to_single_queue(self, lane_parity_artifacts):
+        a, _, c = lane_parity_artifacts
+        assert json.dumps(a["deterministic"], sort_keys=True) == json.dumps(
+            c["deterministic"], sort_keys=True
+        )
+
+    def test_slos_green_under_partitions(self, lane_parity_artifacts):
+        for art in lane_parity_artifacts:
+            assert art["slo"]["pass"], art["slo"]["violations"]
+
+
+class TestSoakBackfill:
+    def test_backfill_requires_lanes(self):
+        from analyzer_tpu.loadgen import SoakConfig, SoakDriver
+
+        with pytest.raises(ValueError):
+            SoakDriver(SoakConfig(backfill_qps=1.0))
+
+    def test_backfill_rides_the_lane_and_drains(self):
+        from analyzer_tpu.loadgen import SoakConfig, SoakDriver
+
+        driver = SoakDriver(SoakConfig(
+            seed=3, duration_s=3.0, qps=8.0, query_qps=2.0, n_players=80,
+            batch_size=32, broker_partitions=2, priority_lanes=True,
+            backfill_qps=4.0,
+        ))
+        try:
+            art = driver.run()
+        finally:
+            driver.close()
+        det = art["deterministic"]
+        assert det["backfill_published"] > 0
+        assert det["matches_rated"] >= det["matches_published"]
+        assert art["slo"]["pass"], art["slo"]["violations"]
+
+
+@pytest.mark.slow
+class TestIngestRateSmoke:
+    """The acceptance criterion: a 2000 qps smoke soak's critical path
+    is NOT dominated by the ingest stages (queue_wait/encode)."""
+
+    def test_2000qps_dominant_stage_is_not_ingest(self):
+        from analyzer_tpu.loadgen import SoakConfig, SoakDriver
+
+        driver = SoakDriver(SoakConfig(
+            seed=7, duration_s=2.0, qps=2000.0, query_qps=2.0,
+            n_players=2000, batch_size=500, polls_per_tick=6,
+            trace=True, use_http=False,
+            forbid_dominant_stages=("queue_wait", "encode"),
+        ))
+        try:
+            art = driver.run()
+        finally:
+            driver.close()
+        assert art["trace"]["dominant_stage"] not in ("queue_wait", "encode")
+        assert art["slo"]["pass"], art["slo"]["violations"]

@@ -1404,3 +1404,97 @@ class TestGL030SchemaNames:
         from analyzer_tpu.lint.findings import RULES
 
         assert "GL030" in RULES
+
+
+class TestGL031IngestHotPath:
+    """GL031 keeps per-row python loops and unpinned staging buffers out
+    of the ingest decode hot path (the io/ loaders + sched/feed.py) —
+    the wire path decodes whole windows into PinnedArena slabs
+    (docs/ingest.md)."""
+
+    LOOP_SRC = """
+    import numpy as np
+
+    def load(rows):
+        out = np.zeros(len(rows), np.int32)
+        for i, r in enumerate(rows):
+            out[i] = int(r[2])
+        return out
+    """
+
+    STAGING_SRC = """
+    import numpy as np
+
+    def stage(data, msg):
+        ids = np.frombuffer(data, np.int32)
+        name = msg.decode()
+        return ids, name
+    """
+
+    CLEAN_SRC = """
+    import numpy as np
+
+    def decode(windows):
+        parts = [w.player_idx for w in windows]
+        for team in range(2):  # literal bounds: constant structure
+            parts[team] = parts[team]
+        return np.concatenate(parts)
+    """
+
+    def test_per_row_loop_fires_in_scope(self):
+        for path in (
+            "analyzer_tpu/io/csv_codec.py",
+            "analyzer_tpu/io/ingest.py",
+            "analyzer_tpu/sched/feed.py",
+        ):
+            assert rules_of(self.LOOP_SRC, path) == ["GL031"], path
+
+    def test_staging_fires_per_call(self):
+        assert rules_of(
+            self.STAGING_SRC, "analyzer_tpu/io/ingest.py"
+        ) == ["GL031"] * 2
+
+    def test_literal_bounds_and_non_range_loops_are_clean(self):
+        assert rules_of(self.CLEAN_SRC, "analyzer_tpu/io/ingest.py") == []
+
+    def test_silent_outside_the_ingest_path(self):
+        for path in (
+            "analyzer_tpu/io/synthetic.py",   # generators, not the wire path
+            "analyzer_tpu/io/dbgen.py",
+            "analyzer_tpu/service/worker.py",
+            "analyzer_tpu/sched/runner.py",
+            "experiments/db_ingest.py",
+        ):
+            assert "GL031" not in rules_of(self.LOOP_SRC, path), path
+            assert "GL031" not in rules_of(self.STAGING_SRC, path), path
+
+    def test_tests_are_exempt(self):
+        assert rules_of(self.LOOP_SRC, "tests/test_ingest.py") == []
+
+    def test_read_only_loop_is_clean(self):
+        # A loop that never stores through a subscript (a writer
+        # building csv text) is not the decode shape GL031 targets.
+        src = """
+        def save(stream, w):
+            for i in range(stream.n_matches):
+                w.writerow([i, int(stream.winner[i])])
+        """
+        assert rules_of(src, "analyzer_tpu/io/csv_codec.py") == []
+
+    def test_disable_escape(self):
+        src = """
+        import numpy as np
+
+        def fallback(rows):
+            out = np.zeros(len(rows), np.int32)
+            # graftlint: disable=GL031 — permissive fallback, not the hot path
+            for i, r in enumerate(rows):
+                out[i] = int(r[2])
+            return out
+        """
+        assert rules_of(src, "analyzer_tpu/io/csv_codec.py") == []
+
+    def test_catalog_has_gl031(self):
+        from analyzer_tpu.lint.findings import RULES
+
+        assert "GL031" in RULES
