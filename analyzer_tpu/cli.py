@@ -1006,6 +1006,20 @@ def cmd_benchdiff(args) -> int:
                 "overhead gate", file=sys.stderr,
             )
             rc = 1
+        # Same absolute contract for the live SLO plane: the bench's
+        # watchdog_overhead block (sampler+watchdog+audit on vs off on
+        # the same e2e line) must stay <= WATCHDOG_OVERHEAD_MAX_PCT.
+        from analyzer_tpu.obs.benchdiff import watchdog_overhead_violations
+
+        wd_overhead = watchdog_overhead_violations(b_raw)
+        for v in wd_overhead:
+            print(f"WATCHDOG OVERHEAD VIOLATION: {v}")
+        if wd_overhead:
+            print(
+                f"error: {os.path.basename(b_path)} fails the SLO-plane "
+                "overhead gate", file=sys.stderr,
+            )
+            rc = 1
     rows = diff_configs(a, b, args.regress_pct)
     sys.stdout.write(render_diff(a_path, b_path, rows))
     if any(r.regressed and r.gated for r in rows):
@@ -1039,6 +1053,61 @@ def cmd_metrics(args) -> int:
     else:
         json.dump(snap, sys.stdout, indent=1, sort_keys=True)
         sys.stdout.write("\n")
+    return 0
+
+
+def cmd_history(args) -> int:
+    """Telemetry history rings (obs/history.py): trend-render or dump
+    the tiered time series — from a live worker's ``/historyz``
+    (``--url``), from a saved ``history.json`` / flight-dump directory,
+    or from this process's own sampler (mostly empty outside a run —
+    useful to see the series list)."""
+    import os
+
+    payload = None
+    if args.url:
+        import urllib.request
+
+        url = args.url.rstrip("/") + "/historyz"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                payload = json.load(resp)
+        except OSError as err:
+            print(f"error: cannot fetch {url}: {err}", file=sys.stderr)
+            return 2
+    elif args.artifact:
+        path = args.artifact
+        if os.path.isdir(path):
+            path = os.path.join(path, "history.json")
+        try:
+            with open(path, encoding="utf-8") as f:
+                payload = json.load(f)
+        except (OSError, ValueError) as err:
+            print(f"error: cannot read history: {err}", file=sys.stderr)
+            return 2
+    else:
+        from analyzer_tpu.obs.history import get_history
+
+        payload = get_history().to_json()
+    series = payload.get("series", {})
+    if args.series:
+        series = {
+            name: s for name, s in series.items()
+            if any(name.startswith(p) for p in args.series)
+        }
+        payload = dict(payload, series=series)
+    if args.json:
+        json.dump(payload, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+        return 0
+    from analyzer_tpu.obs.history import render_history
+
+    last_t = payload.get("last_sample_t")
+    print(
+        f"history: {len(series)} series, {payload.get('samples', 0)} "
+        f"samples, last_t={last_t}"
+    )
+    sys.stdout.write(render_history(payload, tier=args.tier))
     return 0
 
 
@@ -1278,7 +1347,8 @@ def cmd_soak(args) -> int:
     from analyzer_tpu.loadgen.driver import write_artifact
 
     for flag in ("duration", "qps", "tick", "players", "batch_size",
-                 "polls_per_tick", "serve_shards", "broker_partitions"):
+                 "polls_per_tick", "serve_shards", "broker_partitions",
+                 "audit_sample_denom"):
         if getattr(args, flag) <= 0:
             print(f"error: --{flag.replace('_', '-')} must be positive",
                   file=sys.stderr)
@@ -1323,6 +1393,9 @@ def cmd_soak(args) -> int:
         min_matches_per_sec=args.min_matches_per_sec,
         max_p99_ms=args.max_p99_ms,
         forbid_dominant_stages=tuple(args.forbid_dominant_stages),
+        slo_plane=not args.no_slo_plane,
+        audit=args.audit,
+        audit_sample_denom=args.audit_sample_denom,
     )
     driver = SoakDriver(cfg)
     try:
@@ -1381,6 +1454,8 @@ def cmd_worker(args) -> int:
         obs_port=args.obs_port, flight_dir=args.flight_dir,
         serve_port=args.serve_port, serve_shards=args.serve_shards,
         profile_dir=args.profile_dir,
+        audit=True if args.audit else None,
+        slo_plane=not args.no_slo_plane,
     )
     return 0
 
@@ -1690,6 +1765,35 @@ def main(argv=None) -> int:
     s.set_defaults(fn=cmd_metrics)
 
     s = sub.add_parser(
+        "history",
+        help="render telemetry history rings (live /historyz, a saved "
+        "history.json / flight dump, or this process)",
+    )
+    s.add_argument(
+        "artifact", nargs="?",
+        help="a history.json file or a flight-dump directory "
+        "(default: this process's sampler)",
+    )
+    s.add_argument(
+        "--url", metavar="URL",
+        help="fetch from a live worker's obsd endpoint "
+        "(e.g. http://127.0.0.1:9100 — /historyz is appended)",
+    )
+    s.add_argument(
+        "--series", action="append", default=[], metavar="PREFIX",
+        help="only series whose name starts with PREFIX (repeatable)",
+    )
+    s.add_argument(
+        "--tier", choices=["raw", "10s", "1m"], default="raw",
+        help="downsampling tier to render (default: raw)",
+    )
+    s.add_argument(
+        "--json", action="store_true",
+        help="dump the (filtered) payload as JSON instead of trends",
+    )
+    s.set_defaults(fn=cmd_history)
+
+    s = sub.add_parser(
         "soak",
         help="closed-loop matchmaking soak with SLO gates "
         "(analyzer_tpu/loadgen; artifact for benchdiff --family soak)",
@@ -1817,6 +1921,23 @@ def main(argv=None) -> int:
         help="write the span ring as Chrome trace-event JSONL after the "
         "soak (implies --trace; the `cli trace` input)",
     )
+    s.add_argument(
+        "--audit", action="store_true",
+        help="continuous shadow audit: a seeded-hash sample of the "
+        "soak's served queries replays through the bit-exact oracle off "
+        "the hot path; one mismatch fails the soak's SLO gate "
+        "(docs/observability.md \"Shadow audit\")",
+    )
+    s.add_argument(
+        "--audit-sample-denom", type=int, default=4, metavar="N",
+        help="audit 1-in-N served queries (default: 4; 1 = every query)",
+    )
+    s.add_argument(
+        "--no-slo-plane", action="store_true",
+        help="disable the history sampler + SLO watchdog (the "
+        "bit-identity AB knob; the deterministic block is identical "
+        "either way)",
+    )
     s.set_defaults(fn=cmd_soak)
 
     s = sub.add_parser("worker", help="broker-consuming service loop")
@@ -1858,6 +1979,19 @@ def main(argv=None) -> int:
         "dispatch; dead-letters/degradation capture automatically "
         "(throttled) and the flight dump names the capture directory "
         "(docs/observability.md \"Device-time attribution\")",
+    )
+    s.add_argument(
+        "--audit", action="store_true",
+        help="continuous shadow audit of served queries against the "
+        "bit-exact oracle (needs --serve-port; also ANALYZER_TPU_AUDIT; "
+        "audit.mismatches_total is a zero-tolerance SLO — "
+        "docs/observability.md \"Shadow audit\")",
+    )
+    s.add_argument(
+        "--no-slo-plane", action="store_true",
+        help="disable the live SLO plane (history rings + burn-rate "
+        "watchdog + audit) — on by default; /historyz and /sloz then "
+        "serve empty",
     )
     s.set_defaults(fn=cmd_worker)
 

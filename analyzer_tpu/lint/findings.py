@@ -84,6 +84,13 @@ RULES: dict[str, str] = {
         "sched/feed.py) — decode whole windows through the columnar "
         "decoder (io/ingest.py) into PinnedArena slabs"
     ),
+    "GL032": (
+        "live SLO plane hygiene: an SLO Objective(...) whose literal "
+        "metric name does not resolve to the pre-declared STANDARD "
+        "schema (a typo'd metric silently never burns), or a wall-clock "
+        "read (time.*, datetime.now) inside obs/history.py / obs/slo.py "
+        "— the plane is clock-injected so the soak stays deterministic"
+    ),
 }
 
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")

@@ -101,6 +101,20 @@ are exempt; the csv-module fallback parser carries a line-scoped
 disable with a reason (it exists precisely for bytes the fast grammar
 refuses).
 
+GL032 guards the live SLO plane (``obs/history.py`` + ``obs/slo.py``,
+``docs/observability.md`` "History rings / SLO engine"). Two halves:
+(1) an ``Objective(...)`` construction whose LITERAL ``metric`` /
+``metric_b`` does not resolve to the pre-declared STANDARD schema
+flags ANYWHERE outside tests — at runtime a typo'd metric name simply
+never has history, so the objective never burns: the exact
+silent-green failure an SLO engine must not have; (2) the plane's two
+modules are CLOCK-INJECTED (every ``sample``/``check`` takes ``now``
+from the caller — the worker's clock, which under the soak is the
+VirtualClock), so any wall-clock read inside them
+(``time.*``, ``datetime.now``) flags — one stray ``time.monotonic()``
+would silently decouple burn windows from the injected clock and break
+the soak's bit-identical-with-plane-on contract.
+
 GL030 is PATH-SCOPED to ``analyzer_tpu/service/``, ``sched/`` and
 ``serve/``: every STRING-LITERAL metric name handed to
 ``counter()``/``gauge()``/``histogram()`` and every literal span name
@@ -194,8 +208,16 @@ _GL031_FILES = (
 #: buffer on the decode path where an arena slab should be the target.
 _GL031_STAGING = ("numpy.frombuffer",)
 
+#: Files where GL032's wall-clock ban applies: the live SLO plane's
+#: clock-injected modules (timestamps are always passed in).
+_GL032_FILES = (
+    "analyzer_tpu/obs/history.py",
+    "analyzer_tpu/obs/slo.py",
+)
+
 #: Wall-clock reads GL028 bans in loadgen decision paths. Pacing and
 #: measured-latency reads carry line-scoped disables with reasons.
+#: (GL032 reuses the same needle set for the SLO plane's modules.)
 _GL028_CLOCKS = {
     "time.time",
     "time.monotonic",
@@ -254,6 +276,7 @@ class ShellRules:
         serve_layer = self._in_serve_layer()
         schema_layer = self._in_schema_layer()
         ingest_layer = self._in_ingest_layer()
+        slo_plane_layer = self._in_slo_plane_layer()
         tests = self._in_tests()
         pallas_home = self._in_pallas_home()
         table_home = self._in_table_home()
@@ -281,7 +304,10 @@ class ShellRules:
                     self._check_schema_name(node)
                 if ingest_layer and not tests:
                     self._check_unpinned_staging(node)
+                if slo_plane_layer:
+                    self._check_slo_plane_clock(node)
                 if not tests:
+                    self._check_objective_metric(node)
                     self._check_interpret_literal(node)
                 if not (tests or table_home):
                     self._check_table_transfer(node)
@@ -338,6 +364,10 @@ class ShellRules:
     def _in_ingest_layer(self) -> bool:
         path = self.path.replace("\\", "/")
         return any(path.endswith(frag) for frag in _GL031_FILES)
+
+    def _in_slo_plane_layer(self) -> bool:
+        path = self.path.replace("\\", "/")
+        return any(path.endswith(frag) for frag in _GL032_FILES)
 
     def _merge_helper_ranges(self) -> tuple:
         """(start, end) line spans of the designated merge helpers —
@@ -620,6 +650,68 @@ class ShellRules:
             "metric mints a series no dashboard reads; declare it in "
             "the schema (and docs/observability.md) or fix the typo",
         )
+
+    def _check_slo_plane_clock(self, node: ast.Call) -> None:
+        """GL032 (clock half): a wall-clock read inside the SLO plane's
+        clock-injected modules (obs/history.py, obs/slo.py) — every
+        timestamp there is passed in by the caller, so a stray
+        ``time.monotonic()`` would silently decouple burn windows from
+        the injected clock (and break the soak's bit-identity-with-
+        plane-on contract)."""
+        resolved = self.imports.resolve(node.func)
+        if resolved in _GL028_CLOCKS:
+            self._flag(
+                "GL032", node,
+                f"wall-clock read `{resolved}` in the clock-injected SLO "
+                "plane (obs/history.py, obs/slo.py) — take `now` from "
+                "the caller (the worker's clock / the soak's "
+                "VirtualClock); this module must never own a clock",
+            )
+
+    def _check_objective_metric(self, node: ast.Call) -> None:
+        """GL032 (schema half): an ``Objective(...)`` construction whose
+        LITERAL metric name is not in the pre-declared STANDARD schema.
+        A typo'd metric fails nothing at runtime — the objective simply
+        never has history to burn on, the silent-green failure mode an
+        SLO engine exists to prevent. Positional arg 3 (``metric``) and
+        the ``metric``/``metric_b`` keywords are checked; computed
+        names are out of scope, like GL030."""
+        f = node.func
+        name = (
+            f.attr if isinstance(f, ast.Attribute)
+            else f.id if isinstance(f, ast.Name) else None
+        )
+        if name != "Objective":
+            return
+        candidates = []
+        if len(node.args) >= 3:
+            candidates.append(node.args[2])
+        for kw in node.keywords:
+            if kw.arg in ("metric", "metric_b"):
+                candidates.append(kw.value)
+        from analyzer_tpu.obs.registry import (
+            STANDARD_COUNTERS,
+            STANDARD_GAUGES,
+            STANDARD_HISTOGRAMS,
+        )
+
+        schema = set(STANDARD_COUNTERS) | set(STANDARD_GAUGES) | set(
+            STANDARD_HISTOGRAMS
+        )
+        for arg in candidates:
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue
+            metric = arg.value
+            if not metric or metric in schema:
+                continue
+            self._flag(
+                "GL032", arg,
+                f'SLO objective metric "{metric}" is not in the '
+                "pre-declared STANDARD schema (obs.registry) — a typo'd "
+                "metric has no history rings and the objective silently "
+                "never burns; declare the series or fix the name",
+            )
 
     def _check_soak_determinism(self, node: ast.Call) -> None:
         """GL028: unseeded randomness or wall-clock reads inside

@@ -56,7 +56,10 @@ from analyzer_tpu.loadgen.shaper import (
 )
 from analyzer_tpu.logging_utils import get_logger
 from analyzer_tpu.obs import get_registry, install_jax_hooks
-from analyzer_tpu.obs.benchdiff import soak_slo_violations
+# THE shared SLO owner (obs/slo.py): the driver's verdict, the
+# `cli benchdiff --family soak` gate, and the live watchdog all walk
+# the same declarative objective table — none of the three can drift.
+from analyzer_tpu.obs.slo import soak_violations
 from analyzer_tpu.obs.tracectx import (
     enable_tracing,
     headers as trace_headers,
@@ -135,6 +138,19 @@ class SoakConfig:
     # SLO: stages that must NOT dominate the critical path (benchdiff's
     # queue_wait check, wired to the trace block — requires trace=True).
     forbid_dominant_stages: tuple = ()
+    # The live SLO plane (obs/history.py + obs/slo.py): history sampler
+    # + watchdog riding the worker's poll loop on the VIRTUAL clock.
+    # The deterministic block is BIT-IDENTICAL with the plane on or off
+    # per (seed, config) — nothing in it branches into the rating path
+    # (pinned by tests/test_slo_plane.py). Off = the AB knob.
+    slo_plane: bool = True
+    # Continuous shadow audit (obs/audit.py): a seeded-hash sample of
+    # the soak's served queries replays through the bit-exact oracle
+    # off the hot path; the artifact gains an `audit` block (outside
+    # the deterministic block) and audit mismatches gate the soak
+    # verdict zero-tolerance. Also deterministic-block-invariant.
+    audit: bool = False
+    audit_sample_denom: int = 4
 
     @property
     def n_ticks(self) -> int:
@@ -192,6 +208,8 @@ class SoakDriver:
             self.broker, self.store, service_cfg, self.rating_config,
             clock=self.vclock.monotonic, pipeline=False, serve_port=0,
             serve_shards=cfg.serve_shards,
+            slo_plane=cfg.slo_plane, audit=cfg.audit,
+            audit_seed=cfg.seed, audit_sample_denom=cfg.audit_sample_denom,
         )
         self.players = synthetic_players(cfg.n_players, seed=cfg.seed)
         self.outcomes = OutcomeModel(
@@ -517,6 +535,11 @@ class SoakDriver:
             for _ in range(cfg.polls_per_tick):
                 self.worker.poll()
             sample(cfg.n_ticks + extra)
+        # Flush the shadow-audit backlog: every sampled query must be
+        # oracle-replayed before the artifact reads the mismatch count
+        # (worker.drain also covers this on the production exit path).
+        if self.worker.auditor is not None:
+            self.worker.auditor.drain()
         wall_s = time.perf_counter() - wall_t0  # graftlint: disable=GL028 — measured-block wall clock, not a decision input
 
         retraces_steady = (
@@ -591,7 +614,18 @@ class SoakDriver:
         if trace_block is not None:
             artifact["trace"] = trace_block
             artifact["slo"]["dominant_stage"] = trace_block["dominant_stage"]
-        violations = soak_slo_violations(artifact)
+        if self.worker.auditor is not None:
+            # The shadow audit's evidence (OUTSIDE the deterministic
+            # block — offered counts include engine-internal retries):
+            # sampled/checked/mismatch counters plus the first bounded
+            # mismatch records. soak_violations gates mismatches == 0.
+            artifact["audit"] = self.worker.auditor.stats()
+            if self.worker.auditor.mismatches:
+                artifact["audit"]["examples"] = [
+                    {k: m[k] for k in ("kind", "key", "version")}
+                    for m in self.worker.auditor.mismatches[:8]
+                ]
+        violations = soak_violations(artifact)
         artifact["slo"]["violations"] = violations
         artifact["slo"]["pass"] = not violations
         if violations:

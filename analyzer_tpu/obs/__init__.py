@@ -44,9 +44,15 @@ The LIVE half (this PR's obsd plane — everything above is post-hoc):
 Metric name catalog: docs/observability.md.
 """
 
+from analyzer_tpu.obs.audit import ShadowAuditor
 from analyzer_tpu.obs.devicemem import (
     maybe_sample as maybe_sample_device_memory,
     sample_device_memory,
+)
+from analyzer_tpu.obs.history import (
+    HistorySampler,
+    get_history,
+    reset_history,
 )
 from analyzer_tpu.obs.flight import (
     FlightRecorder,
@@ -77,6 +83,12 @@ from analyzer_tpu.obs.snapshot import (
     write_snapshot,
 )
 from analyzer_tpu.obs.server import HealthChecks, ObsServer, connectivity_probe
+from analyzer_tpu.obs.slo import (
+    Objective,
+    Watchdog,
+    get_watchdog,
+    reset_watchdog,
+)
 from analyzer_tpu.obs.tracectx import (
     TraceContext,
     enable_tracing,
@@ -95,18 +107,24 @@ __all__ = [
     "DeviceProfiler",
     "FlightRecorder",
     "HealthChecks",
+    "HistorySampler",
     "MetricsRegistry",
+    "Objective",
     "ObsServer",
+    "ShadowAuditor",
     "TraceContext",
     "Tracer",
+    "Watchdog",
     "bind_trace",
     "connectivity_probe",
     "current_trace",
     "enable_tracing",
     "get_device_profiler",
     "get_flight_recorder",
+    "get_history",
     "get_registry",
     "get_tracer",
+    "get_watchdog",
     "install_jax_hooks",
     "instant",
     "jax_hooks_installed",
@@ -115,7 +133,9 @@ __all__ = [
     "render_summary",
     "reset_device_profiler",
     "reset_flight_recorder",
+    "reset_history",
     "reset_registry",
+    "reset_watchdog",
     "retrace_counts",
     "sample_device_memory",
     "snapshot",

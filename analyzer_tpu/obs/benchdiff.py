@@ -322,83 +322,21 @@ def family_configs(
 
 
 def soak_slo_violations(data: dict) -> list[str]:
-    """The soak family's ABSOLUTE gate, re-derived from the artifact's
-    deterministic block (never trusted from a precomputed pass flag):
-    zero dead letters, flat steady-state retraces, bounded view
-    staleness, a drained backlog, every published match rated — plus
+    """The soak family's ABSOLUTE gate: zero dead letters, flat
+    steady-state retraces, bounded view staleness, a drained backlog,
+    every published match rated, zero shadow-audit mismatches — plus
     the optional absolute throughput/latency floors the soak was
     configured with (``slo.thresholds``). Returns human-readable
     violation strings; empty means the artifact passes.
 
-    Shared owner: ``SoakDriver`` computes its artifact's ``slo`` block
-    through this same function, so the driver's verdict and the CI
-    gate's can never drift."""
-    det = data.get("deterministic")
-    if not isinstance(det, dict):
-        return ["artifact has no deterministic block (not a SOAK capture?)"]
-    thr = (data.get("slo") or {}).get("thresholds") or {}
-    out: list[str] = []
-    dead = det.get("dead_letters", 0)
-    if dead:
-        out.append(f"dead_letters: {dead} (SLO: 0)")
-    retraces = det.get("retraces_steady", 0)
-    if retraces:
-        out.append(
-            f"retraces_steady: {retraces:g} post-warmup retraces (SLO: flat)"
-        )
-    max_lag = thr.get("max_view_lag_ticks", 2)
-    lag = det.get("view_lag_ticks_max", 0)
-    if lag > max_lag:
-        out.append(
-            f"view_lag_ticks_max: {lag} > {max_lag} (served view went stale "
-            "while commits were pending)"
-        )
-    if not det.get("drained", True) or det.get("queue_depth_final", 0):
-        out.append(
-            f"backlog not drained: {det.get('queue_depth_final', '?')} "
-            "message(s) left after the drain window"
-        )
-    published = det.get("matches_published", 0)
-    rated = det.get("matches_rated", 0)
-    if rated < published:
-        out.append(
-            f"matches_rated {rated} < matches_published {published} "
-            "(ingest lost work)"
-        )
-    floor = thr.get("min_matches_per_sec")
-    if floor is not None and float(data.get("value", 0.0)) < floor:
-        out.append(
-            f"matches_per_sec {data.get('value')} below the configured "
-            f"floor {floor}"
-        )
-    p99_cap = thr.get("max_p99_ms")
-    p99 = (data.get("latency_ms") or {}).get("p99")
-    if p99_cap is not None and p99 is not None and p99 > p99_cap:
-        out.append(
-            f"serve p99 {p99} ms above the configured cap {p99_cap} ms"
-        )
-    forbidden = thr.get("forbid_dominant_stages") or []
-    if forbidden:
-        # The ingest-plane SLO (docs/ingest.md): the critical-path
-        # decomposition (PR 10's trace block) must not name a forbidden
-        # stage — e.g. queue_wait/encode dominating at 2000 qps means
-        # the ingest edge, not the device, is the bottleneck. The check
-        # is only evaluable when the soak ran traced; an artifact that
-        # ASKED for the gate but carries no trace block fails loudly
-        # instead of green-by-omission.
-        dominant = (data.get("trace") or {}).get("dominant_stage")
-        if dominant is None:
-            out.append(
-                "forbid_dominant_stages configured but the artifact has "
-                "no trace block (run the soak with --trace)"
-            )
-        elif dominant in forbidden:
-            out.append(
-                f"dominant critical-path stage {dominant!r} is in the "
-                f"forbidden set {sorted(forbidden)} — the ingest edge is "
-                "the bottleneck (docs/ingest.md runbook)"
-            )
-    return out
+    Since the live SLO plane landed this is a thin delegate to the ONE
+    declarative objective table (``obs/slo.py STANDARD_OBJECTIVES``):
+    ``SoakDriver``'s verdict, this CI gate, and the live watchdog all
+    walk the same objective set — doctor one objective and all three
+    consumers trip (pinned by tests/test_slo_plane.py)."""
+    from analyzer_tpu.obs.slo import soak_violations
+
+    return soak_violations(data)
 
 
 #: Causal tracing must stay (nearly) free when enabled: the bench's
@@ -429,6 +367,36 @@ def trace_overhead_violations(data: dict) -> list[str]:
     return [
         f"trace_overhead: tracing-on run is {float(pct):+.2f}% vs "
         f"tracing-off (gate: <= {TRACE_OVERHEAD_MAX_PCT:g}%)"
+    ]
+
+
+#: The live SLO plane must stay (nearly) free when armed: the bench's
+#: ``watchdog_overhead`` block measures the same end-to-end line with
+#: the history sampler + watchdog + shadow-audit drain riding the chunk
+#: boundaries vs off, and the gate fails a candidate whose plane tax
+#: exceeds this — same contract as the tracing gate above.
+WATCHDOG_OVERHEAD_MAX_PCT = 2.0
+
+
+def watchdog_overhead_violations(data: dict) -> list[str]:
+    """The bench family's absolute SLO-plane-tax gate, derived from the
+    candidate alone: a ``watchdog_overhead`` block whose
+    ``overhead_pct`` exceeds :data:`WATCHDOG_OVERHEAD_MAX_PCT` is a
+    violation. Degraded captures and unconverged pairs are excluded; no
+    block at all passes — the tax is only gateable where measured."""
+    block = data.get("watchdog_overhead")
+    if not isinstance(block, dict):
+        return []
+    if (data.get("capture") or {}).get("degraded"):
+        return []
+    if not block.get("stable", True):
+        return []
+    pct = block.get("overhead_pct")
+    if pct is None or float(pct) <= WATCHDOG_OVERHEAD_MAX_PCT:
+        return []
+    return [
+        f"watchdog_overhead: SLO-plane-on run is {float(pct):+.2f}% vs "
+        f"off (gate: <= {WATCHDOG_OVERHEAD_MAX_PCT:g}%)"
     ]
 
 

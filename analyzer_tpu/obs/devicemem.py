@@ -19,6 +19,14 @@ nbytes, attributed per device (a sharded array splits evenly across its
 device set). The sampler throttles itself (``maybe_sample``) because
 ``live_arrays`` walks every live buffer — fine per batch, wasteful per
 chunk on a deep schedule.
+
+These gauges also ride the telemetry history rings: the worker
+registers :func:`maybe_sample` as a pre-sample probe on the history
+sampler (``obs/history.py``), so ``device.hbm_bytes_in_use``,
+``device.live_buffers`` and ``tier.host_bytes`` are refreshed ahead of
+every history row — HBM growth and cold-tier growth become trends an
+operator can see (and the ``bounded-memory-growth`` burn-rate SLO in
+``obs/slo.py`` can alarm on), not two numbers to subtract by hand.
 """
 
 from __future__ import annotations

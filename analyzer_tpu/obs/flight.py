@@ -10,6 +10,9 @@ handler on the package root), batch notes from the worker, and arbitrary
 
   ``snapshot.json``   the full metrics snapshot (counters/gauges/
                       histograms/retraces/spans) at dump time;
+  ``history.json``    the telemetry history rings (obs/history.py) —
+                      the trajectory INTO the incident, not just the
+                      moment of it;
   ``trace.jsonl``     the span ring as Chrome trace-event JSONL
                       (Perfetto-loadable — the failure's timeline);
   ``events.log``      the recent-events ring, one JSON object per line,
@@ -222,6 +225,16 @@ class FlightRecorder:
         os.makedirs(path)
         write_snapshot(os.path.join(path, "snapshot.json"))
         write_chrome_trace(os.path.join(path, "trace.jsonl"))
+        # The trajectory INTO the incident (obs/history.py): the
+        # snapshot above is the moment, history.json is how the process
+        # got there — the first thing a paged operator should plot.
+        from analyzer_tpu.obs.history import get_history
+
+        with open(
+            os.path.join(path, "history.json"), "w", encoding="utf-8"
+        ) as f:
+            json.dump(get_history().to_json(), f, indent=1, sort_keys=True)
+            f.write("\n")
         with open(
             os.path.join(path, "events.log"), "w", encoding="utf-8"
         ) as f:

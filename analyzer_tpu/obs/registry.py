@@ -257,6 +257,18 @@ STANDARD_COUNTERS = (
     # admission controller held back for host headroom.
     "broker.backfill_admitted_total",
     "broker.backfill_throttled_total",
+    # The live SLO plane (obs/history.py + obs/slo.py + obs/audit.py,
+    # docs/observability.md): history-ring samples taken, SLO burn
+    # onsets and recoveries seen by the watchdog, and the shadow
+    # audit's sampled / oracle-replayed / DIVERGED query counts —
+    # audit.mismatches_total is the zero-tolerance objective
+    # (zero-audit-mismatches): one increment is a correctness incident.
+    "history.samples_total",
+    "slo.burns_total",
+    "slo.recoveries_total",
+    "audit.sampled_total",
+    "audit.checked_total",
+    "audit.mismatches_total",
 )
 STANDARD_GAUGES = (
     "worker.pipeline_lag",
@@ -300,6 +312,14 @@ STANDARD_GAUGES = (
     # per-partition broker.queue_depth{queue=,partition=,lane=} series
     # appear on first sample, bounded by the label-cardinality cap.
     "broker.partitions",
+    # The live SLO plane: series the history sampler tracks, objectives
+    # currently burning (0 = healthy), per-objective burn state
+    # (slo.state{objective=} series appear on first transition), and
+    # the shadow audit's pending replay backlog.
+    "history.series",
+    "slo.burning",
+    "slo.state",
+    "audit.backlog",
 )
 
 #: Histogram families the runtime emits (graftlint GL030 resolves

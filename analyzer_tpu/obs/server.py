@@ -14,7 +14,15 @@ Everything the snapshot artifact exposes post-hoc (``--metrics-out``,
   ``GET /metrics``         Prometheus text exposition (``prometheus_text``);
   ``GET /statusz``         human summary: ``render_summary`` plus the
                            owner's ``status_provider()`` dict (worker
-                           ``stats()``);
+                           ``stats()``), the served view's version AND
+                           age, and trend sparklines from the history
+                           rings;
+  ``GET /historyz``        the telemetry history rings as JSON
+                           (``obs/history.py`` — ``?series=<prefix>``
+                           filters by name prefix, ``?tier=raw|10s|1m``
+                           picks one downsampling tier);
+  ``GET /sloz``            the SLO watchdog's objective table and
+                           burn states (``obs/slo.py``);
   ``GET /debug/snapshot``  the full JSON snapshot, spans included.
 
 Served through the shared :mod:`analyzer_tpu.obs.httpd` plumbing (route
@@ -112,6 +120,8 @@ class ObsServer:
                 "/readyz": self._route_readyz,
                 "/metrics": lambda params: text_body(prometheus_text()),
                 "/statusz": lambda params: text_body(self._statusz()),
+                "/historyz": self._route_historyz,
+                "/sloz": self._route_sloz,
                 "/debug/snapshot": self._route_snapshot,
             },
             port=port,
@@ -137,6 +147,29 @@ class ObsServer:
         body = json.dumps(snapshot(max_spans=None), indent=1, sort_keys=True)
         return 200, body + "\n", "application/json"
 
+    def _route_historyz(self, params) -> tuple[int, str, str]:
+        from analyzer_tpu.obs.history import TIERS, get_history
+
+        prefix = params.get("series")
+        tier = params.get("tier")
+        if tier is not None and tier not in {t for t, _, _ in TIERS}:
+            return text_body(
+                f"unknown tier {tier!r} (raw|10s|1m)\n", 400
+            )
+        body = json.dumps(
+            get_history().to_json(prefix=prefix, tier=tier),
+            indent=1, sort_keys=True,
+        )
+        return 200, body + "\n", "application/json"
+
+    def _route_sloz(self, params) -> tuple[int, str, str]:
+        from analyzer_tpu.obs.slo import get_watchdog
+
+        body = json.dumps(
+            get_watchdog().status(), indent=1, sort_keys=True
+        )
+        return 200, body + "\n", "application/json"
+
     def _readyz(self) -> tuple[int, str]:
         results = self.health.run()
         failing = {n: d for n, (ok, d) in results.items() if not ok}
@@ -148,9 +181,24 @@ class ObsServer:
             lines = ["ok (no checks registered)"]
         return (503 if failing else 200), "\n".join(lines) + "\n"
 
+    #: Series whose trends /statusz renders when the history sampler
+    #: has data for them (the page-one signals; everything else is one
+    #: /historyz query away).
+    STATUSZ_TRENDS = (
+        "worker.matches_rated_total",
+        "worker.dead_letters_total",
+        "broker.queue_depth",
+        "serve.view_age_seconds",
+        "feed.starved_total",
+        "tier.host_bytes",
+        "device.live_buffers",
+        "audit.mismatches_total",
+    )
+
     def _statusz(self) -> str:
         snap = snapshot(max_spans=self._max_statusz_spans)
         out = [render_summary(snap)]
+        out.extend(self._statusz_history())
         if self.status_provider is not None:
             try:
                 status = self.status_provider()
@@ -167,6 +215,56 @@ class ObsServer:
                 for n, (ok, d) in sorted(ready.items())
             )
         return "\n".join(out) + "\n"
+
+    def _statusz_history(self) -> list[str]:
+        """The history-derived /statusz sections: the served view's
+        version WITH its age (staleness is the #1 page — the operator
+        must never compute it by hand from two scrapes), and trend
+        sparklines for the page-one series. Empty before the first
+        sample; never raises into the status page."""
+        from analyzer_tpu.obs.history import get_history
+        from analyzer_tpu.obs.slo import get_watchdog
+
+        try:
+            history = get_history()
+            out: list[str] = []
+            vv = history.last_change("serve.view_version")
+            if vv is not None and vv[1]:
+                t_change, version = vv
+                age = history.latest("serve.view_age_seconds")
+                last_t = history.last_sample_t
+                # Age from the ring: prefer the sampled age gauge (set
+                # from the publisher's own clock), fall back to "how
+                # long has the version sat unchanged" in sampler time.
+                if age is not None:
+                    age_s = age[1]
+                elif last_t is not None:
+                    age_s = last_t - t_change
+                else:
+                    age_s = 0.0
+                out.append(
+                    f"serve view: v{int(version)} age={age_s:.1f}s"
+                )
+            burning = get_watchdog().burning
+            if burning:
+                out.append("SLO BURNING: " + ", ".join(burning))
+            trends = []
+            for name in self.STATUSZ_TRENDS:
+                line = history.sparkline(name)
+                if line is None:
+                    continue
+                latest = history.latest(name)
+                trends.append(
+                    f"  {name:<36} {line}  last={latest[1]:g}"
+                )
+            if trends:
+                out.append("trends (oldest -> newest; /historyz for data):")
+                out.extend(trends)
+            return out
+        except Exception:  # noqa: BLE001 — statusz must render during
+            # the incident it exists to explain
+            logger.exception("statusz history section failed")
+            return []
 
     def close(self) -> None:
         """Stops serving and joins the thread. Idempotent."""

@@ -229,6 +229,7 @@ class _Pending:
 
     __slots__ = (
         "kind", "payload", "done", "value", "error", "t_submit", "t_done",
+        "audit",
     )
 
     def __init__(self, kind: str, payload) -> None:
@@ -239,9 +240,20 @@ class _Pending:
         self.error: BaseException | None = None
         self.t_submit = time.monotonic()
         self.t_done: float | None = None
+        # (auditor, view) when a shadow auditor is attached — set by
+        # _execute before the microbatch runs, consumed in resolve().
+        self.audit = None
 
     def resolve(self, value) -> None:
         self.value = value
+        # Shadow-audit offer BEFORE done.set(): once a caller observes
+        # the response, the sampling decision has already been recorded
+        # — the audit's sampled set is synchronous with the traffic, so
+        # a drain at any quiesce point sees a deterministic count (the
+        # soak's artifact `audit` block relies on exactly this).
+        if self.audit is not None:
+            auditor, view = self.audit
+            auditor.offer(self.kind, self.payload, value, view)
         self.t_done = time.monotonic()
         self.done.set()
 
@@ -299,6 +311,12 @@ class QueryEngine:
         )
         self.clock = clock
         self.queries_total = 0
+        # Shadow audit (obs/audit.py): when attached, every successfully
+        # resolved response is OFFERED at the end of its microbatch (one
+        # seeded hash + a bounded append for the sampled few — the
+        # oracle replay itself runs off the hot path in the auditor's
+        # drain). Topology-blind: the sharded engine shares _execute.
+        self.auditor = None
         self._pending: deque[_Pending] = deque()
         self._lock = threading.Lock()
         self._wake = threading.Event()
@@ -499,6 +517,9 @@ class QueryEngine:
             reg.counter("serve.queries_total").add(len(group))
             reg.counter("serve.queries_total", kind=kind).add(len(group))
             self.queries_total += len(group)
+            if self.auditor is not None:
+                for req in group:
+                    req.audit = (self.auditor, view)
             try:
                 getattr(self, "_run_" + kind)(view, group)
             except Exception as err:  # noqa: BLE001 — a kernel-level

@@ -110,6 +110,11 @@ class Worker:
         serve_host: str | None = None,
         serve_shards: int | None = None,
         profile_dir: str | None = None,
+        slo_plane: bool = True,
+        audit: bool | None = None,
+        audit_sample_denom: int | None = None,
+        audit_seed: int = 0,
+        history_interval_s: float = 1.0,
     ) -> None:
         self.broker = broker
         self.store = store
@@ -262,6 +267,59 @@ class Worker:
                 self.obs_server.health.register(
                     "serve.view", self._serve_view_health
                 )
+        # The live SLO plane (docs/observability.md "History rings /
+        # SLO engine / Shadow audit"): the history sampler records the
+        # registry into trend rings on THIS worker's clock (vclock-
+        # deterministic under the soak), the watchdog evaluates the
+        # declarative objective table as multi-window burn rates over
+        # those rings — flipping /readyz degraded and capturing a
+        # flight dump + device profile at first burn — and the shadow
+        # auditor replays a seeded-hash sample of served queries
+        # through the bit-exact oracle off the hot path. One throttled
+        # _slo_tick per poll; slo_plane=False disables all three (the
+        # bit-identity AB knob).
+        self.history = None
+        self.watchdog = None
+        self.auditor = None
+        self._history_interval_s = float(history_interval_s)
+        self._history_sampled_at: float | None = None
+        if slo_plane:
+            from analyzer_tpu.obs.history import get_history
+            from analyzer_tpu.obs.slo import get_watchdog
+
+            self.history = get_history()
+            from analyzer_tpu.obs.devicemem import maybe_sample
+
+            # HBM + cold-tier gauges refresh ahead of every sample so
+            # memory growth is trend-visible (the leak burn-rate SLO's
+            # data source).
+            self.history.add_probe(maybe_sample)
+            self.watchdog = get_watchdog()
+            self.watchdog.on_burn = self._on_slo_burn
+            if self.obs_server is not None:
+                self.obs_server.health.register(
+                    "slo.watchdog", self.watchdog.healthy
+                )
+            if audit is None:
+                audit = bool(
+                    os.environ.get("ANALYZER_TPU_AUDIT", "") not in ("", "0")
+                )
+            if audit and self.query_engine is not None:
+                from analyzer_tpu.obs.audit import (
+                    DEFAULT_SAMPLE_DENOM,
+                    ShadowAuditor,
+                )
+
+                self.auditor = ShadowAuditor(
+                    cfg=self.rating_config,
+                    tier_edges=self.query_engine.tier_edges,
+                    seed=audit_seed,
+                    sample_denom=(
+                        audit_sample_denom if audit_sample_denom is not None
+                        else DEFAULT_SAMPLE_DENOM
+                    ),
+                )
+                self.query_engine.auditor = self.auditor
 
     # -- micro-batcher ----------------------------------------------------
     def poll(self) -> bool:
@@ -275,6 +333,7 @@ class Worker:
                 self._first_message_at = self.clock()
             self.queue.extend(got)
         self._sample_queue_depth()
+        self._slo_tick()
         full = len(self.queue) >= self.config.batch_size
         idle = (
             self._first_message_at is not None
@@ -341,6 +400,54 @@ class Worker:
                     "broker.queue_depth",
                     queue=self.config.queue, partition=part, lane=lane,
                 ).set(lane_depth)
+
+    def _slo_tick(self) -> None:
+        """One throttled pass of the live SLO plane: refresh the serve
+        gauges the sampler reads, record a history sample at THIS
+        worker's clock, drain a bounded slice of the shadow-audit
+        backlog (the oracle replay runs here — the consumer loop's
+        idle shoulder — never on the serving path), and evaluate the
+        watchdog. Behavior-neutral by construction: nothing here
+        branches into the rating path, so the soak's deterministic
+        block is bit-identical with the plane on or off (pinned)."""
+        if self.history is None:
+            return
+        now = self.clock()
+        if (
+            self._history_sampled_at is not None
+            and now - self._history_sampled_at < self._history_interval_s
+        ):
+            return
+        self._history_sampled_at = now
+        try:
+            if self.view_publisher is not None:
+                reg = get_registry()
+                reg.gauge("serve.view_version").set(self.view_publisher.version)
+                age = self.view_publisher.view_age_s()
+                if age is not None:
+                    reg.gauge("serve.view_age_seconds").set(round(age, 3))
+            if self.auditor is not None:
+                self.auditor.drain(limit=64)
+            self.history.sample(now)
+            if self.watchdog is not None:
+                self.watchdog.check(now)
+        except Exception:  # noqa: BLE001 — the SLO plane must never
+            # take down the consume loop it observes.
+            logger.exception("SLO plane tick failed")
+
+    def _on_slo_burn(self, objective, burn) -> None:
+        """First-burn evidence capture: the flight recorder freezes the
+        trajectory INTO the burn (history.json rides the dump) and the
+        device profiler arms a capture of the next dispatch window —
+        both throttled, both no-ops when unarmed."""
+        logger.warning(
+            "SLO burn: %s — %s", objective.name, burn.detail
+        )
+        self.flight.note(
+            "slo.burn", objective=objective.name, detail=burn.detail
+        )
+        self.profiler.request("slo_burn")
+        self._flight_dump(f"slo-{objective.name}")
 
     def request_stop(self) -> None:
         """Asks the consume loop to exit after the current batch. Safe
@@ -747,9 +854,14 @@ class Worker:
 
     def drain(self) -> None:
         """Blocks until every in-flight pipelined batch has committed (or
-        its failure policy has been applied). No-op in sequential mode."""
+        its failure policy has been applied). No-op in sequential mode.
+        Also drains the shadow-audit backlog: a bounded-run exit must
+        not leave sampled queries unreplayed (the soak's
+        ``audit.mismatches_total == 0`` acceptance reads after this)."""
         if self._engine is not None:
             self._engine.drain()
+        if self.auditor is not None:
+            self.auditor.drain()
 
     def close(self) -> None:
         """Releases the pipelined engine (writer thread + its cloned
@@ -760,6 +872,12 @@ class Worker:
         if self._engine is not None:
             self._engine.close()
             self._engine = None
+        if self.auditor is not None:
+            self.auditor.drain()
+        if self.watchdog is not None and self.watchdog.on_burn == self._on_slo_burn:
+            # The watchdog is process-wide; a closed worker must not
+            # keep receiving burn callbacks through it.
+            self.watchdog.on_burn = None
         if self.serve_server is not None:
             self.serve_server.close()
             self.serve_server = None
@@ -1111,6 +1229,20 @@ class Worker:
                 self.query_engine.stats()
                 if self.query_engine is not None else None
             ),
+            # The live SLO plane's digest (None when slo_plane=False):
+            # what's burning, plus the shadow audit's counters when
+            # auditing is on — /sloz and /historyz carry the detail.
+            "slo": (
+                {
+                    "burning": self.watchdog.burning,
+                    "history_samples": self.history.samples,
+                    "audit": (
+                        self.auditor.stats()
+                        if self.auditor is not None else None
+                    ),
+                }
+                if self.watchdog is not None else None
+            ),
         }
 
     @property
@@ -1181,6 +1313,8 @@ def main(
     serve_port: int | None = None,
     serve_shards: int | None = None,
     profile_dir: str | None = None,
+    audit: bool | None = None,
+    slo_plane: bool = True,
 ) -> Worker:
     """``python -m analyzer_tpu.service.worker`` — the reference's
     ``python3 worker.py`` entry point (``worker.py:219-221``), requiring a
@@ -1200,7 +1334,12 @@ def main(
     docs/serving.md "Sharded plane"); ``profile_dir`` (or
     ``ANALYZER_TPU_PROFILE_DIR``) arms on-demand jax.profiler capture
     windows — SIGUSR2, automatic on dead-letter/degradation
-    (docs/observability.md "Device-time attribution")."""
+    (docs/observability.md "Device-time attribution"); ``audit`` (or
+    ``ANALYZER_TPU_AUDIT=1``) turns on the continuous shadow audit of
+    served queries against the bit-exact oracle; ``slo_plane=False``
+    disables the history sampler + SLO watchdog + audit entirely
+    (docs/observability.md "History rings / SLO engine / Shadow
+    audit")."""
     config = ServiceConfig.from_env()
     if obs_port is None and os.environ.get("ANALYZER_TPU_OBS_PORT"):
         obs_port = int(os.environ["ANALYZER_TPU_OBS_PORT"])
@@ -1230,7 +1369,7 @@ def main(
     worker = Worker(
         broker, store, config, obs_port=obs_port, flight_dir=flight_dir,
         serve_port=serve_port, serve_shards=serve_shards,
-        profile_dir=profile_dir,
+        profile_dir=profile_dir, audit=audit, slo_plane=slo_plane,
     )
     worker.warmup()  # compile before consuming: no first-batch stall
     try:

@@ -1498,3 +1498,125 @@ class TestGL031IngestHotPath:
         from analyzer_tpu.lint.findings import RULES
 
         assert "GL031" in RULES
+
+
+class TestGL032SloPlane:
+    """GL032 guards the live SLO plane: Objective(...) metric literals
+    must resolve to the pre-declared STANDARD schema (a typo'd metric
+    silently never burns), and the clock-injected modules
+    (obs/history.py, obs/slo.py) must never read a wall clock."""
+
+    TYPO_OBJECTIVE_SRC = """
+    from analyzer_tpu.obs.slo import Objective
+
+    DOCTORED = (
+        Objective("zero-dead-letters", "counter_zero",
+                  "worker.dead_lettres_total"),
+        Objective("ratio", "ratio_min", "tier.hits_total",
+                  metric_b="tier.missess_total"),
+    )
+    """
+
+    CLEAN_OBJECTIVE_SRC = """
+    from analyzer_tpu.obs.slo import Objective
+
+    MINE = (
+        Objective("zero-dead-letters", "counter_zero",
+                  "worker.dead_letters_total"),
+        Objective("hit-rate", "ratio_min", "tier.hits_total",
+                  metric_b="tier.misses_total"),
+        Objective("drain-only", "artifact"),
+    )
+    """
+
+    WALL_CLOCK_SRC = """
+    import time
+
+    def sample_all(history):
+        history.sample(time.monotonic())
+    """
+
+    def test_typod_metric_fires_everywhere_outside_tests(self):
+        for path in (
+            "analyzer_tpu/obs/slo.py",
+            "analyzer_tpu/loadgen/driver.py",
+            "experiments/serve_bench.py",
+        ):
+            assert rules_of(self.TYPO_OBJECTIVE_SRC, path) == ["GL032"] * 2, path
+
+    def test_schema_metrics_and_artifact_objectives_clean(self):
+        assert rules_of(
+            self.CLEAN_OBJECTIVE_SRC, "analyzer_tpu/obs/slo.py"
+        ) == []
+
+    def test_tests_exempt_from_schema_half(self):
+        assert rules_of(
+            self.TYPO_OBJECTIVE_SRC, "tests/test_slo_plane.py"
+        ) == []
+
+    def test_computed_metric_out_of_scope(self):
+        src = """
+        from analyzer_tpu.obs.slo import Objective
+
+        def make(name):
+            return Objective("dyn", "counter_zero", name)
+        """
+        assert rules_of(src, "analyzer_tpu/obs/slo.py") == []
+
+    def test_wall_clock_fires_only_in_plane_modules(self):
+        for path in (
+            "analyzer_tpu/obs/history.py",
+            "analyzer_tpu/obs/slo.py",
+        ):
+            assert "GL032" in rules_of(self.WALL_CLOCK_SRC, path), path
+        for path in (
+            "analyzer_tpu/obs/flight.py",       # other obs modules own clocks
+            "analyzer_tpu/obs/devicemem.py",
+        ):
+            assert "GL032" not in rules_of(self.WALL_CLOCK_SRC, path), path
+
+    def test_every_wall_clock_needle_fires(self):
+        src = """
+        import time
+        import datetime
+
+        def bad():
+            time.time()
+            time.perf_counter()
+            time.sleep(1)
+            datetime.datetime.now()
+        """
+        assert rules_of(src, "analyzer_tpu/obs/history.py") == ["GL032"] * 4
+
+    def test_shipping_plane_modules_are_clean(self):
+        # The real modules must hold the discipline the rule enforces.
+        for mod in ("analyzer_tpu/obs/history.py", "analyzer_tpu/obs/slo.py"):
+            with open(os.path.join(_REPO, mod), encoding="utf-8") as f:
+                assert rules_of(f.read(), mod) == [], mod
+
+    def test_standard_objectives_resolve_at_runtime_too(self):
+        # The runtime analog of the lint: every live objective's metric
+        # names a pre-declared series (a schema drift would otherwise
+        # silently disarm the watchdog).
+        from analyzer_tpu.obs.registry import (
+            STANDARD_COUNTERS,
+            STANDARD_GAUGES,
+            STANDARD_HISTOGRAMS,
+        )
+        from analyzer_tpu.obs.slo import LIVE_KINDS, STANDARD_OBJECTIVES
+
+        schema = (
+            set(STANDARD_COUNTERS) | set(STANDARD_GAUGES)
+            | set(STANDARD_HISTOGRAMS)
+        )
+        for obj in STANDARD_OBJECTIVES:
+            if obj.kind not in LIVE_KINDS:
+                continue
+            assert obj.metric in schema, obj.name
+            if obj.metric_b is not None:
+                assert obj.metric_b in schema, obj.name
+
+    def test_catalog_has_gl032(self):
+        from analyzer_tpu.lint.findings import RULES
+
+        assert "GL032" in RULES

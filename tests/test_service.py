@@ -645,6 +645,7 @@ class TestStats:
         "measured_rtt_ms",
         "measured_host_ms",
         "serve",
+        "slo",
     }
 
     #: The serving plane's nested keys when serve_port is on (ISSUE 4).

@@ -1,0 +1,874 @@
+"""Live SLO plane tests (ISSUE 12): telemetry history rings
+(obs/history.py), the declarative SLO engine + burn-rate watchdog
+(obs/slo.py), and the continuous shadow audit (obs/audit.py).
+
+The load-bearing pins:
+
+  * the soak's deterministic block is BIT-IDENTICAL with the whole
+    plane (history + watchdog + audit) on vs off per (seed, config);
+  * ONE objective table, three consumers — doctoring one objective
+    trips the SoakDriver verdict, the benchdiff soak gate, AND the
+    live watchdog;
+  * watchdog burn/recover transitions are pinned on an injected
+    (virtual) clock;
+  * the shadow audit's sample set is a pure function of (seed,
+    traffic), and a doctored served response is caught bit-for-bit;
+  * a flight dump taken after an injected burn carries history.json
+    with the trajectory into it.
+"""
+
+import glob
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analyzer_tpu.config import RatingConfig, ServiceConfig
+from analyzer_tpu.obs import (
+    get_registry,
+    reset_flight_recorder,
+    reset_history,
+    reset_registry,
+    reset_watchdog,
+)
+from analyzer_tpu.obs.history import (
+    HistorySampler,
+    get_history,
+    render_history,
+    render_sparkline,
+)
+from analyzer_tpu.obs.slo import (
+    STANDARD_OBJECTIVES,
+    Objective,
+    Watchdog,
+    evaluate_live,
+    soak_violations,
+)
+from analyzer_tpu.obs.tracer import reset_tracer
+from analyzer_tpu.service import InMemoryBroker, InMemoryStore, Worker
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    reset_registry()
+    reset_tracer()
+    reset_flight_recorder()
+    reset_history()
+    reset_watchdog()
+    yield
+    reset_registry()
+    reset_tracer()
+    reset_flight_recorder()
+    reset_history()
+    reset_watchdog()
+
+
+def http_get(url: str) -> tuple[int, str]:
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode("utf-8")
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode("utf-8")
+
+
+# ---------------------------------------------------------------------------
+# History rings
+# ---------------------------------------------------------------------------
+
+
+class TestHistoryRings:
+    def _sampler(self):
+        reg = get_registry()
+        return HistorySampler(registry=reg), reg
+
+    def test_counter_and_gauge_series_record(self):
+        h, reg = self._sampler()
+        c = reg.counter("worker.matches_rated_total")
+        g = reg.gauge("feed.depth")
+        for t in range(10):
+            c.add(2)
+            g.set(t)
+            h.sample(float(t))
+        raw = h.series("worker.matches_rated_total")
+        assert len(raw) == 10
+        assert raw[0][1] == 2.0 and raw[-1][1] == 20.0
+        assert h.latest("feed.depth") == (9.0, 9.0)
+        assert h.samples == 10
+        assert reg.counter("history.samples_total").value == 10
+
+    def test_histogram_quantiles_become_series(self):
+        h, reg = self._sampler()
+        hist = reg.histogram("phase_seconds", phase="rate")
+        for v in range(100):
+            hist.observe(v / 100.0)
+        h.sample(1.0)
+        assert h.latest("phase_seconds{phase=rate}:p99") is not None
+
+    def test_tiered_downsampling_last_min_max(self):
+        h, reg = self._sampler()
+        g = reg.gauge("broker.queue_depth")
+        for t in range(0, 60):
+            g.set(100 - t if t == 30 else t)  # one spike down at t=30
+            h.sample(float(t))
+        ten = h.series("broker.queue_depth", "10s")
+        assert len(ten) == 6
+        # bucket [30,40): last=39, min=min(70,31..39)=31, max=70
+        b3 = ten[3]
+        assert b3[0] == 30.0 and b3[1] == 39.0 and b3[3] == 70.0
+        one_m = h.series("broker.queue_depth", "1m")
+        assert len(one_m) == 1 and one_m[0][3] == 70.0
+
+    def test_raw_ring_is_bounded(self):
+        h, reg = self._sampler()
+        c = reg.counter("worker.acks_total")
+        for t in range(600):
+            c.add(1)
+            h.sample(float(t))
+        raw = h.series("worker.acks_total")
+        assert len(raw) == 512  # TIERS raw capacity
+        assert raw[-1][0] == 599.0 and raw[0][0] == 88.0
+
+    def test_window_delta_and_max(self):
+        h, reg = self._sampler()
+        c = reg.counter("worker.dead_letters_total")
+        g = reg.gauge("serve.view_age_seconds")
+        for t in range(0, 100):
+            if t == 90:
+                c.add(5)
+            g.set(3.0 if t == 95 else 0.5)
+            h.sample(float(t))
+        delta, span = h.window_delta("worker.dead_letters_total", 30, 99.0)
+        assert delta == 5.0 and 29.0 <= span <= 31.0
+        # outside the window: no delta
+        delta2, _ = h.window_delta("worker.dead_letters_total", 5, 80.0)
+        assert delta2 == 0.0
+        assert h.window_max("serve.view_age_seconds", 30, 99.0) == 3.0
+        assert h.window_max("serve.view_age_seconds", 2, 99.0) == 0.5
+
+    def test_window_falls_back_to_coarser_tiers(self):
+        h, reg = self._sampler()
+        c = reg.counter("worker.batches_ok_total")
+        for t in range(0, 2000):  # raw ring covers only the last 512
+            c.add(1)
+            h.sample(float(t))
+        got = h.window_delta("worker.batches_ok_total", 1800, 1999.0)
+        assert got is not None
+        delta, span = got
+        # 10s buckets cover 3600s: the whole window is reachable.
+        assert delta >= 1700
+
+    def test_unknown_series_and_insufficient_history(self):
+        h, _reg = self._sampler()
+        assert h.window_delta("nope", 60, 1.0) is None
+        assert h.window_max("nope", 60, 1.0) is None
+        assert h.series("nope") == []
+        assert h.latest("nope") is None
+
+    def test_last_change_tracks_value_transitions(self):
+        h, reg = self._sampler()
+        g = reg.gauge("serve.view_version")
+        for t in range(10):
+            g.set(1 if t < 6 else 2)
+            h.sample(float(t))
+        t_change, value = h.last_change("serve.view_version")
+        assert value == 2 and t_change == 6.0
+
+    def test_probes_run_before_sample_and_never_raise(self):
+        h, reg = self._sampler()
+        calls = []
+
+        def probe():
+            calls.append(1)
+            reg.gauge("tier.host_bytes").set(123)
+
+        def bad_probe():
+            raise RuntimeError("boom")
+
+        h.add_probe(probe)
+        h.add_probe(bad_probe)
+        h.sample(1.0)
+        assert calls == [1]
+        assert h.latest("tier.host_bytes") == (1.0, 123.0)
+        h.remove_probe(probe)
+        h.sample(2.0)
+        assert calls == [1]
+
+    def test_series_cap_bounds_the_structure(self):
+        reg = get_registry()
+        h = HistorySampler(registry=reg, max_series=5)
+        for t in range(3):
+            h.sample(float(t))
+        assert len(h.names()) == 5
+
+    def test_to_json_filters_and_renders(self):
+        h, reg = self._sampler()
+        reg.counter("worker.acks_total").add(1)
+        for t in range(5):
+            reg.counter("worker.acks_total").add(1)
+            h.sample(float(t))
+        payload = h.to_json(prefix="worker.acks")
+        assert list(payload["series"]) == ["worker.acks_total"]
+        assert payload["series"]["worker.acks_total"]["kind"] == "counter"
+        only_raw = h.to_json(prefix="worker.acks", tier="raw")
+        assert list(only_raw["series"]["worker.acks_total"]["rings"]) == ["raw"]
+        text = render_history(payload)
+        assert "worker.acks_total" in text and "delta=+4" in text
+
+    def test_sparkline_shapes(self):
+        assert render_sparkline([]) == ""
+        assert render_sparkline([1.0, 1.0, 1.0]) == "▁▁▁"
+        line = render_sparkline([0, 1, 2, 3, 4, 5, 6, 7])
+        assert line == "▁▂▃▄▅▆▇█"
+
+
+# ---------------------------------------------------------------------------
+# SLO engine + watchdog
+# ---------------------------------------------------------------------------
+
+
+def _fill(h, reg, t0=0, t1=400, step=1.0):
+    t = float(t0)
+    while t < t1:
+        h.sample(t)
+        t += step
+
+
+class TestWatchdog:
+    def test_burn_and_recover_pinned_on_injected_clock(self):
+        reg = get_registry()
+        h = HistorySampler(registry=reg)
+        onsets = []
+        wd = Watchdog(
+            history=h, on_burn=lambda obj, burn: onsets.append(obj.name)
+        )
+        _fill(h, reg, 0, 400)
+        assert wd.check(399.0) and wd.burning == []
+        # one dead letter: zero-tolerance burn over the 60s window
+        reg.counter("worker.dead_letters_total").add(1)
+        h.sample(400.0)
+        wd.check(400.0)
+        assert wd.burning == ["zero-dead-letters"]
+        assert onsets == ["zero-dead-letters"]
+        ok, detail = wd.healthy()
+        assert not ok and "zero-dead-letters" in detail
+        assert reg.counter("slo.burns_total").value == 1
+        # re-checks while burning do NOT re-fire on_burn
+        h.sample(410.0)
+        wd.check(410.0)
+        assert onsets == ["zero-dead-letters"]
+        # the window slides past the increment: recovery, exactly once
+        for t in range(420, 480, 10):
+            h.sample(float(t))
+        wd.check(470.0)
+        assert wd.burning == []
+        assert reg.counter("slo.recoveries_total").value == 1
+        assert wd.healthy()[0]
+
+    def test_counter_rate_needs_every_window(self):
+        reg = get_registry()
+        h = HistorySampler(registry=reg)
+        obj = Objective(
+            "storm", "counter_rate", "jax.retraces_total", threshold=0.1,
+            windows=(60.0, 300.0),
+        )
+        c = reg.counter("jax.retraces_total")
+        _fill(h, reg, 0, 300)
+        # a short burst: hot in the 60s window, cold over 300s
+        for t in range(300, 320):
+            c.add(1)
+            h.sample(float(t))
+        burn = evaluate_live(obj, h, 319.0)
+        assert not burn.burning  # 20/300s < 0.1/s on the long window
+        # sustained: both windows hot
+        for t in range(320, 620):
+            c.add(1)
+            h.sample(float(t))
+        assert evaluate_live(obj, h, 619.0).burning
+
+    def test_gauge_growth_is_the_leak_shape(self):
+        reg = get_registry()
+        h = HistorySampler(registry=reg)
+        obj = Objective(
+            "leak", "gauge_growth", "device.live_buffers", threshold=10.0,
+            windows=(60.0, 300.0),
+        )
+        g = reg.gauge("device.live_buffers")
+        for t in range(0, 400):
+            g.set(t * 20)  # +20 buffers/s, monotone
+            h.sample(float(t))
+        assert evaluate_live(obj, h, 399.0).burning
+        # a sawtooth (GC) does not burn the long window
+        for t in range(400, 800):
+            g.set((t % 60) * 20)
+            h.sample(float(t))
+        assert not evaluate_live(obj, h, 799.0).burning
+
+    def test_ratio_min_volume_guard(self):
+        reg = get_registry()
+        h = HistorySampler(registry=reg)
+        obj = Objective(
+            "hit-floor", "ratio_min", "tier.hits_total",
+            metric_b="tier.misses_total", threshold=0.5, min_volume=1000.0,
+            windows=(60.0, 300.0),
+        )
+        hits = reg.counter("tier.hits_total")
+        misses = reg.counter("tier.misses_total")
+        _fill(h, reg, 0, 300)
+        # low volume, bad ratio: guarded, no burn
+        misses.add(10)
+        h.sample(300.0)
+        assert not evaluate_live(obj, h, 300.0).burning
+        # high volume, bad ratio: burns
+        for t in range(301, 400):
+            hits.add(4)
+            misses.add(16)
+            h.sample(float(t))
+        assert evaluate_live(obj, h, 399.0).burning
+        # high volume, good ratio: recovers
+        for t in range(400, 800):
+            hits.add(40)
+            h.sample(float(t))
+        assert not evaluate_live(obj, h, 799.0).burning
+
+    def test_no_history_is_not_burning(self):
+        h = HistorySampler(registry=get_registry())
+        wd = Watchdog(history=h)
+        assert all(not b.burning for b in wd.check(0.0))
+
+    def test_status_payload_shape(self):
+        reg = get_registry()
+        h = HistorySampler(registry=reg)
+        wd = Watchdog(history=h)
+        _fill(h, reg, 0, 120)
+        wd.check(119.0)
+        status = wd.status()
+        names = {o["name"] for o in status["objectives"]}
+        assert "zero-dead-letters" in names and "drained-backlog" in names
+        by_name = {o["name"]: o for o in status["objectives"]}
+        assert by_name["zero-dead-letters"]["state"] == "ok"
+        assert by_name["drained-backlog"]["state"] == "untracked"
+        assert status["burning"] == [] and status["checks"] == 1
+
+
+class TestOneEngineThreeConsumers:
+    """THE acceptance pin: doctor one objective and the SoakDriver
+    verdict, the benchdiff soak gate, and the live watchdog all trip —
+    because all three walk the same module-level objective table."""
+
+    DOCTORED = STANDARD_OBJECTIVES + (
+        Objective(
+            "doctored-zero-batches", "counter_zero",
+            "worker.batches_ok_total", artifact_check="zero:batches_ok",
+            description="trips on ANY healthy work — the canary",
+        ),
+    )
+
+    def _healthy_artifact(self):
+        return {
+            "metric": "soak.matches_per_sec", "value": 50.0,
+            "latency_ms": {"p99": 5.0},
+            "deterministic": {
+                "matches_published": 40, "matches_rated": 40,
+                "batches_ok": 4, "dead_letters": 0,
+                "view_lag_ticks_max": 0, "queue_depth_final": 0,
+                "retraces_steady": 0, "drained": True,
+            },
+            "slo": {"thresholds": {"max_view_lag_ticks": 2}},
+            "capture": {"degraded": False},
+        }
+
+    def test_all_three_trip_on_the_doctored_table(self, monkeypatch):
+        import analyzer_tpu.obs.slo as slo_mod
+        from analyzer_tpu.obs.benchdiff import soak_slo_violations
+
+        art = self._healthy_artifact()
+        # Consumer 1+2 baseline: healthy artifact passes the shared set.
+        assert soak_violations(art) == []
+        assert soak_slo_violations(art) == []
+        reg = get_registry()
+        h = HistorySampler(registry=reg)
+        wd = Watchdog(history=h)
+        reg.counter("worker.batches_ok_total").add(4)
+        _fill(h, reg, 0, 120)
+        wd.check(119.0)
+        assert wd.burning == []  # consumer 3 baseline
+
+        monkeypatch.setattr(
+            slo_mod, "STANDARD_OBJECTIVES", self.DOCTORED
+        )
+        # Consumer 1: the driver's verdict function.
+        v1 = soak_violations(art)
+        # Consumer 2: the CI gate's delegate (obs.benchdiff).
+        v2 = soak_slo_violations(art)
+        assert v1 == v2 and len(v1) == 1 and "batches_ok" in v1[0]
+        # Consumer 3: the live watchdog (objectives resolve at check
+        # time, so the doctored table is picked up mid-flight).
+        reg.counter("worker.batches_ok_total").add(1)
+        h.sample(120.0)
+        wd.check(120.0)
+        assert wd.burning == ["doctored-zero-batches"]
+
+    def test_artifact_messages_unchanged(self):
+        # The historical message formats ride through the objective
+        # table verbatim (operator muscle memory + old pins).
+        art = self._healthy_artifact()
+        art["deterministic"]["dead_letters"] = 2
+        art["deterministic"]["retraces_steady"] = 3
+        art["deterministic"]["view_lag_ticks_max"] = 5
+        art["deterministic"]["drained"] = False
+        art["deterministic"]["queue_depth_final"] = 7
+        art["deterministic"]["matches_rated"] = 30
+        v = "\n".join(soak_violations(art))
+        assert "dead_letters: 2 (SLO: 0)" in v
+        assert "retraces_steady" in v
+        assert "view_lag_ticks_max: 5 > 2" in v
+        assert "backlog not drained: 7" in v
+        assert "ingest lost work" in v
+
+    def test_audit_mismatches_gate_artifact_mode(self):
+        art = self._healthy_artifact()
+        art["audit"] = {"mismatches": 0, "checked": 30}
+        assert soak_violations(art) == []
+        art["audit"]["mismatches"] = 1
+        v = soak_violations(art)
+        assert len(v) == 1 and "audit mismatches" in v[0]
+
+
+# ---------------------------------------------------------------------------
+# Shadow audit
+# ---------------------------------------------------------------------------
+
+
+def _serving_rig(audit=True, denom=1, seed=0):
+    broker = InMemoryBroker()
+    store = InMemoryStore()
+    worker = Worker(
+        broker, store, ServiceConfig(batch_size=4, idle_timeout=0.0),
+        RatingConfig(), serve_port=0, audit=audit, audit_seed=seed,
+        audit_sample_denom=denom,
+    )
+    return broker, store, worker
+
+
+def _publish_population(worker, n=24):
+    from analyzer_tpu.core.state import PlayerState
+
+    state = PlayerState.create(n, cfg=worker.rating_config)
+    ids = [f"p{i:03d}" for i in range(n)]
+    worker.view_publisher.publish_rows(ids, np.asarray(state.table)[:n])
+    return ids
+
+
+class TestShadowAudit:
+    def test_sample_set_is_deterministic_per_seed(self):
+        from analyzer_tpu.obs.audit import query_key, sampled
+
+        keys = [query_key("ratings", (f"p{i}",)) for i in range(500)]
+        picks_a = [k for k in keys if sampled(k, seed=7, denom=8)]
+        picks_b = [k for k in keys if sampled(k, seed=7, denom=8)]
+        picks_c = [k for k in keys if sampled(k, seed=8, denom=8)]
+        assert picks_a == picks_b
+        assert picks_a != picks_c
+        # roughly 1-in-8, and denom=1 samples everything
+        assert 20 <= len(picks_a) <= 130
+        assert all(sampled(k, seed=0, denom=1) for k in keys[:10])
+
+    def test_served_responses_verify_bit_for_bit(self):
+        _b, _s, worker = _serving_rig()
+        try:
+            ids = _publish_population(worker)
+            eng = worker.query_engine
+            eng.get_ratings(ids[:5])
+            eng.win_probability(ids[:3], ids[3:6])
+            eng.leaderboard(10)
+            eng.tier_histogram()
+            eng.percentile(10.0)
+            aud = worker.auditor
+            assert aud.sampled == 5
+            checked = aud.drain()
+            assert checked == 5
+            assert aud.mismatch_count == 0
+            assert get_registry().counter("audit.mismatches_total").value == 0
+            assert get_registry().counter("audit.checked_total").value == 5
+        finally:
+            worker.close()
+
+    def test_doctored_response_is_caught(self):
+        _b, _s, worker = _serving_rig()
+        try:
+            ids = _publish_population(worker)
+            view = worker.view_publisher.current()
+            resp = worker.query_engine.get_ratings(ids[:3])
+            worker.auditor.drain()
+            base = worker.auditor.mismatch_count
+            doctored = json.loads(json.dumps(resp))
+            doctored["ratings"][0]["seed_mu"] += 0.5
+            worker.auditor.offer("ratings", tuple(ids[:3]), doctored, view)
+            worker.auditor.drain()
+            assert worker.auditor.mismatch_count == base + 1
+            assert get_registry().counter(
+                "audit.mismatches_total"
+            ).value == base + 1
+            rec = worker.auditor.mismatches[-1]
+            assert rec["kind"] == "ratings" and rec["version"] == view.version
+            # the flight ring carries the breadcrumb
+            from analyzer_tpu.obs import get_flight_recorder
+
+            kinds = [e["kind"] for e in get_flight_recorder().events()]
+            assert "audit.mismatch" in kinds
+        finally:
+            worker.close()
+
+    def test_audit_rides_the_sharded_plane_unchanged(self):
+        broker = InMemoryBroker()
+        worker = Worker(
+            broker, InMemoryStore(),
+            ServiceConfig(batch_size=4, idle_timeout=0.0),
+            RatingConfig(), serve_port=0, serve_shards=4,
+            audit=True, audit_sample_denom=1,
+        )
+        try:
+            ids = _publish_population(worker)
+            eng = worker.query_engine
+            eng.get_ratings(ids[:6])
+            eng.leaderboard(10)
+            eng.tier_histogram()
+            worker.auditor.drain()
+            assert worker.auditor.checked == 3
+            assert worker.auditor.mismatch_count == 0
+        finally:
+            worker.close()
+
+    def test_worker_tick_drains_off_the_hot_path(self):
+        _b, _s, worker = _serving_rig()
+        try:
+            ids = _publish_population(worker)
+            worker.query_engine.get_ratings(ids[:2])
+            assert worker.auditor.backlog == 1
+            worker.poll()  # the SLO tick drains
+            assert worker.auditor.backlog == 0
+            assert worker.auditor.checked == 1
+            stats = worker.stats()
+            assert stats["slo"]["audit"]["checked"] == 1
+            assert stats["slo"]["audit"]["mismatches"] == 0
+        finally:
+            worker.close()
+
+    def test_audit_off_by_default_and_without_serving(self):
+        broker = InMemoryBroker()
+        w1 = Worker(
+            broker, InMemoryStore(),
+            ServiceConfig(batch_size=2, idle_timeout=0.0), RatingConfig(),
+        )
+        assert w1.auditor is None and w1.history is not None
+        w2 = Worker(
+            InMemoryBroker(), InMemoryStore(),
+            ServiceConfig(batch_size=2, idle_timeout=0.0), RatingConfig(),
+            slo_plane=False,
+        )
+        assert w2.history is None and w2.watchdog is None
+        assert w2.stats()["slo"] is None
+
+
+# ---------------------------------------------------------------------------
+# obsd endpoints + statusz + flight dump
+# ---------------------------------------------------------------------------
+
+
+class TestEndpoints:
+    def test_historyz_and_sloz(self):
+        broker = InMemoryBroker()
+        worker = Worker(
+            broker, InMemoryStore(),
+            ServiceConfig(batch_size=2, idle_timeout=0.0), RatingConfig(),
+            obs_port=0,
+        )
+        try:
+            worker.poll()  # one SLO tick: sample + watchdog check
+            base = worker.obs_server.url
+            code, body = http_get(base + "/historyz")
+            assert code == 200
+            payload = json.loads(body)
+            assert payload["samples"] >= 1
+            assert "worker.matches_rated_total" in payload["series"]
+            code, body = http_get(base + "/historyz?series=feed.&tier=raw")
+            assert code == 200
+            filtered = json.loads(body)
+            assert filtered["series"] and all(
+                k.startswith("feed.") for k in filtered["series"]
+            )
+            code, _ = http_get(base + "/historyz?tier=2h")
+            assert code == 400
+            code, body = http_get(base + "/sloz")
+            assert code == 200
+            sloz = json.loads(body)
+            assert sloz["burning"] == []
+            assert any(
+                o["name"] == "zero-dead-letters" for o in sloz["objectives"]
+            )
+        finally:
+            worker.close()
+
+    def test_readyz_degrades_while_burning_and_recovers(self):
+        from analyzer_tpu.loadgen.shaper import VirtualClock
+
+        vclock = VirtualClock()
+        broker = InMemoryBroker()
+        worker = Worker(
+            broker, InMemoryStore(),
+            ServiceConfig(batch_size=2, idle_timeout=0.0), RatingConfig(),
+            clock=vclock.monotonic, obs_port=0,
+        )
+        try:
+            base = worker.obs_server.url
+            for _ in range(120):
+                vclock.advance(1.0)
+                worker.poll()
+            code, _ = http_get(base + "/readyz")
+            assert code == 200
+            get_registry().counter("worker.dead_letters_total").add(1)
+            vclock.advance(1.0)
+            worker.poll()
+            code, body = http_get(base + "/readyz")
+            assert code == 503 and "slo.watchdog" in body
+            assert "zero-dead-letters" in body
+            for _ in range(90):  # slide the 60s window past the burn
+                vclock.advance(1.0)
+                worker.poll()
+            code, _ = http_get(base + "/readyz")
+            assert code == 200
+            assert get_registry().counter("slo.recoveries_total").value >= 1
+        finally:
+            worker.close()
+
+    def test_statusz_shows_view_age_and_trends(self):
+        from analyzer_tpu.loadgen.shaper import VirtualClock
+
+        vclock = VirtualClock()
+        broker = InMemoryBroker()
+        worker = Worker(
+            broker, InMemoryStore(),
+            ServiceConfig(batch_size=2, idle_timeout=0.0), RatingConfig(),
+            clock=vclock.monotonic, obs_port=0, serve_port=0,
+        )
+        try:
+            _publish_population(worker)
+            for _ in range(4):
+                vclock.advance(1.0)
+                worker.poll()
+            _code, body = http_get(worker.obs_server.url + "/statusz")
+            # the satellite: version AND age, side by side
+            assert "serve view: v1 age=" in body
+            assert "trends (oldest -> newest" in body
+        finally:
+            worker.close()
+
+    def test_flight_dump_carries_history_after_injected_burn(self, tmp_path):
+        from analyzer_tpu.loadgen.shaper import VirtualClock
+
+        reset_flight_recorder(base_dir=str(tmp_path), min_interval_s=0.0)
+        vclock = VirtualClock()
+        broker = InMemoryBroker()
+        worker = Worker(
+            broker, InMemoryStore(),
+            ServiceConfig(batch_size=2, idle_timeout=0.0), RatingConfig(),
+            clock=vclock.monotonic,
+        )
+        try:
+            for _ in range(90):
+                vclock.advance(1.0)
+                worker.poll()
+            # inject the burn: a dead letter lands in the history, the
+            # watchdog's next check fires on_burn -> flight dump
+            get_registry().counter("worker.dead_letters_total").add(2)
+            vclock.advance(1.0)
+            worker.poll()
+            dumps = glob.glob(str(tmp_path / "flight-*slo-zero-dead-letters*"))
+            assert dumps, os.listdir(tmp_path)
+            with open(os.path.join(dumps[0], "history.json")) as f:
+                hist = json.load(f)
+            series = hist["series"]["worker.dead_letters_total"]
+            raw = series["rings"]["raw"]
+            # the trajectory INTO the incident: flat, then the jump
+            assert raw[0][1] == 0.0 and raw[-1][1] == 2.0
+            assert hist["samples"] >= 90
+            # the ring knows the burn is in the events too
+            with open(os.path.join(dumps[0], "events.log")) as f:
+                kinds = [json.loads(line)["kind"] for line in f]
+            assert "slo.burn" in kinds
+        finally:
+            worker.close()
+
+
+# ---------------------------------------------------------------------------
+# Soak integration: bit-identity + audited acceptance
+# ---------------------------------------------------------------------------
+
+
+def _soak_cfg(**kw):
+    from analyzer_tpu.loadgen import SoakConfig
+
+    base = dict(
+        seed=5, duration_s=3.0, tick_s=1.0, qps=10.0, query_qps=6.0,
+        n_players=100, batch_size=32, use_http=False,
+    )
+    base.update(kw)
+    return SoakConfig(**base)
+
+
+def _run_soak(cfg):
+    from analyzer_tpu.loadgen import SoakDriver
+
+    reset_registry()
+    reset_history()
+    reset_watchdog()
+    driver = SoakDriver(cfg)
+    try:
+        return driver.run()
+    finally:
+        driver.close()
+
+
+@pytest.fixture(scope="module")
+def soak_plane_pair():
+    """One soak with the FULL plane (history + watchdog + audit-every-
+    query) and one with the plane off, same (seed, config otherwise)."""
+    on = _run_soak(_soak_cfg(slo_plane=True, audit=True,
+                             audit_sample_denom=1))
+    off = _run_soak(_soak_cfg(slo_plane=False))
+    return on, off
+
+
+class TestSoakPlaneBitIdentity:
+    def test_deterministic_block_identical_plane_on_vs_off(
+        self, soak_plane_pair
+    ):
+        on, off = soak_plane_pair
+        assert json.dumps(on["deterministic"], sort_keys=True) == json.dumps(
+            off["deterministic"], sort_keys=True
+        )
+
+    def test_audited_soak_acceptance(self, soak_plane_pair):
+        on, _ = soak_plane_pair
+        assert on["slo"]["pass"], on["slo"]["violations"]
+        audit = on["audit"]
+        # denom=1: EVERY served query (matchmaker reads + workload)
+        # replayed through the oracle, zero divergence.
+        assert audit["sampled"] == audit["offered"] > 0
+        assert audit["checked"] == audit["sampled"]
+        assert audit["mismatches"] == 0 and audit["backlog"] == 0
+
+    def test_plane_off_artifact_has_no_audit_block(self, soak_plane_pair):
+        _, off = soak_plane_pair
+        assert "audit" not in off
+
+    def test_sampled_set_reproducible_across_runs(self, soak_plane_pair):
+        on, _ = soak_plane_pair
+        repeat = _run_soak(_soak_cfg(slo_plane=True, audit=True,
+                                     audit_sample_denom=4))
+        again = _run_soak(_soak_cfg(slo_plane=True, audit=True,
+                                    audit_sample_denom=4))
+        # the seeded-hash sample is a pure function of (seed, traffic)
+        assert repeat["audit"]["sampled"] == again["audit"]["sampled"]
+        assert 0 < repeat["audit"]["sampled"] < on["audit"]["sampled"]
+        assert json.dumps(repeat["deterministic"], sort_keys=True) == (
+            json.dumps(on["deterministic"], sort_keys=True)
+        )
+
+
+# ---------------------------------------------------------------------------
+# benchdiff: watchdog_overhead gate + cli history
+# ---------------------------------------------------------------------------
+
+
+class TestWatchdogOverheadGate:
+    def _line(self, pct, stable=True, degraded=False):
+        return {
+            "metric": "matches_per_sec_per_chip", "value": 1000.0,
+            "capture": {"degraded": degraded},
+            "watchdog_overhead": {
+                "off_s": 1.0, "on_s": 1.0 + pct / 100.0,
+                "overhead_pct": pct, "stable": stable,
+            },
+        }
+
+    def test_gate_semantics(self):
+        from analyzer_tpu.obs.benchdiff import watchdog_overhead_violations
+
+        assert watchdog_overhead_violations(self._line(1.5)) == []
+        v = watchdog_overhead_violations(self._line(3.5))
+        assert v and "watchdog_overhead" in v[0]
+        # excluded: degraded capture, unstable pair, absent block
+        assert watchdog_overhead_violations(
+            self._line(9.0, degraded=True)
+        ) == []
+        assert watchdog_overhead_violations(
+            self._line(9.0, stable=False)
+        ) == []
+        assert watchdog_overhead_violations({"metric": "x"}) == []
+
+    def test_cli_benchdiff_gates_watchdog_overhead(self, tmp_path, capsys):
+        from analyzer_tpu import cli
+
+        (tmp_path / "BENCH_r01.json").write_text(
+            json.dumps(self._line(0.5))
+        )
+        (tmp_path / "BENCH_r02.json").write_text(
+            json.dumps(self._line(4.0))
+        )
+        rc = cli.main([
+            "benchdiff", "--against-latest", "--dir", str(tmp_path),
+        ])
+        out = capsys.readouterr()
+        assert rc == 1
+        assert "WATCHDOG OVERHEAD VIOLATION" in out.out
+
+
+class TestCliHistory:
+    def test_render_and_json_from_saved_history(self, tmp_path, capsys):
+        from analyzer_tpu import cli
+
+        reg = get_registry()
+        h = HistorySampler(registry=reg)
+        c = reg.counter("worker.matches_rated_total")
+        for t in range(20):
+            c.add(3)
+            h.sample(float(t))
+        path = tmp_path / "history.json"
+        path.write_text(json.dumps(h.to_json()))
+        rc = cli.main(["history", str(path), "--series", "worker.matches_r"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "worker.matches_rated_total" in out and "delta=+57" in out
+        rc = cli.main([
+            "history", str(path), "--series", "worker.matches_r", "--json",
+        ])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert list(payload["series"]) == ["worker.matches_rated_total"]
+
+    def test_reads_a_flight_dump_directory(self, tmp_path, capsys):
+        from analyzer_tpu import cli
+
+        reset_flight_recorder(base_dir=str(tmp_path), min_interval_s=0.0)
+        reg = get_registry()
+        h = get_history()
+        reg.counter("worker.acks_total").add(5)
+        h.sample(1.0)
+        h.sample(2.0)
+        from analyzer_tpu.obs import get_flight_recorder
+
+        dump = get_flight_recorder().dump("test")
+        rc = cli.main(["history", dump, "--series", "worker.acks"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "worker.acks_total" in out
+
+    def test_missing_artifact_errors(self, tmp_path, capsys):
+        from analyzer_tpu import cli
+
+        rc = cli.main(["history", str(tmp_path / "nope.json")])
+        assert rc == 2
+        assert "cannot read history" in capsys.readouterr().err
