@@ -22,7 +22,11 @@ Subcommands:
   soak    closed-loop matchmaking soak: matchmake from the served
           ratings, rate through the worker, query /v1/* concurrently,
           gate SLOs; emits SOAK_*.json for benchdiff --family soak
-          (deterministic per seed — docs/OPERATIONS.md)
+          (deterministic per seed — docs/OPERATIONS.md); --migrate
+          runs a full re-rate under the live load as the judge
+  migrate zero-downtime global re-rate: streamed decode->assign->scan
+          backfill into a staging view lineage while the live lineage
+          serves, atomic cutover, checkpoint/resume (docs/migration.md)
   query   one query against a running serve endpoint (HTTP client)
   lint    graftlint static analysis (JAX hazards + native ABI, docs/lint.md)
   metrics runtime telemetry snapshots (docs/observability.md): render a
@@ -871,6 +875,8 @@ def cmd_bench(args) -> int:
         os.environ["BENCH_HOT_ROWS"] = str(args.hot_rows)
     if getattr(args, "ingest", False):
         os.environ["BENCH_INGEST"] = "1"
+    if getattr(args, "migrate", False):
+        os.environ["BENCH_MIGRATE"] = "1"
     bench.main(
         metrics_out=getattr(args, "metrics_out", None),
         obs_port=getattr(args, "obs_port", None),
@@ -973,6 +979,23 @@ def cmd_benchdiff(args) -> int:
                 f"error: {os.path.basename(b_path)} has no native "
                 f"columnar-decode capture but {os.path.basename(a_path)} "
                 "does (silent fallback to the python codec?)",
+                file=sys.stderr,
+            )
+            return 1
+    if args.family == "migrate":
+        # The vanished-block contract for the migration engine: a
+        # baseline captured with the STREAMED backfill (decode->assign->
+        # scan overlapped) and a candidate whose capture fell back to
+        # the offline re-rate shape means the streaming front half
+        # silently disengaged — the exact regression this family exists
+        # to catch, and one a delta gate would merely call "slower".
+        a_streamed = bool((a_raw.get("migrate") or {}).get("streamed"))
+        b_streamed = bool((b_raw.get("migrate") or {}).get("streamed"))
+        if a_streamed and not b_streamed:
+            print(
+                f"error: {os.path.basename(b_path)} has no streamed "
+                f"backfill capture but {os.path.basename(a_path)} does "
+                "(silent fall-back to the offline re-rate?)",
                 file=sys.stderr,
             )
             return 1
@@ -1348,7 +1371,7 @@ def cmd_soak(args) -> int:
 
     for flag in ("duration", "qps", "tick", "players", "batch_size",
                  "polls_per_tick", "serve_shards", "broker_partitions",
-                 "audit_sample_denom"):
+                 "audit_sample_denom", "migrate_matches"):
         if getattr(args, flag) <= 0:
             print(f"error: --{flag.replace('_', '-')} must be positive",
                   file=sys.stderr)
@@ -1396,6 +1419,8 @@ def cmd_soak(args) -> int:
         slo_plane=not args.no_slo_plane,
         audit=args.audit,
         audit_sample_denom=args.audit_sample_denom,
+        migrate=args.migrate,
+        migrate_matches=args.migrate_matches,
     )
     driver = SoakDriver(cfg)
     try:
@@ -1427,6 +1452,126 @@ def cmd_soak(args) -> int:
             print(f"SLO VIOLATION: {v}", file=sys.stderr)
         return 1
     return 0
+
+
+def cmd_migrate(args) -> int:
+    """Zero-downtime global re-rate (docs/migration.md): the streamed
+    decode->assign->scan backfill engine rates a CSV history while a
+    live lineage keeps serving, publishes into a staging view lineage,
+    and cuts traffic over atomically at the end. Checkpointed and
+    resumable: a killed backfill restarts from its last window-boundary
+    watermark and produces a bit-identical final table."""
+    from analyzer_tpu.config import RatingConfig
+    from analyzer_tpu.core.state import PlayerState
+    from analyzer_tpu.migrate import LineageManager, run_migration
+    from analyzer_tpu.serve import ViewPublisher
+    from analyzer_tpu.utils import PhaseTimer
+
+    if args.resume and not args.checkpoint:
+        print("error: --resume requires --checkpoint", file=sys.stderr)
+        return 2
+    if args.checkpoint_every and not args.checkpoint:
+        print("error: --checkpoint-every requires --checkpoint",
+              file=sys.stderr)
+        return 2
+    for flag in ("checkpoint_every", "stop_after_steps", "prefetch_depth",
+                 "window_rows", "batch_size"):
+        val = getattr(args, flag)
+        if val is not None and val <= 0:
+            print(f"error: --{flag.replace('_', '-')} must be positive",
+                  file=sys.stderr)
+            return 2
+    if args.hot_rows < 0:
+        print("error: --hot-rows must be >= 0 (0 = untiered)", file=sys.stderr)
+        return 2
+    _obs_begin(args)
+    server = _obs_serve(args)
+    timer = PhaseTimer()
+    try:
+        cfg = RatingConfig.from_env()
+        with timer.phase("load"):
+            with open(args.csv, "rb") as f:
+                data = f.read()
+        state = None
+        if not args.resume:
+            n_players = args.players
+            if n_players is None:
+                # No --players: probe the stream for its row ceiling
+                # (one decode pass — pass --players to skip it).
+                from analyzer_tpu.io.ingest import decode_stream_csv
+
+                with timer.phase("probe"):
+                    probe = decode_stream_csv(data)
+                    if probe is None:
+                        import io as _io
+
+                        from analyzer_tpu.io.csv_codec import load_stream_csv
+
+                        probe = load_stream_csv(
+                            _io.StringIO(data.decode("utf-8"))
+                        )
+                    n_players = (
+                        int(probe.player_idx.max()) + 1
+                        if probe.n_matches else 0
+                    )
+                    del probe
+                print(
+                    f"probed {n_players} players (pass --players to skip "
+                    "the probe)", file=sys.stderr,
+                )
+            state = PlayerState.create(n_players, cfg=cfg)
+        else:
+            n_players = None  # the checkpoint carries the table
+        # The in-process live lineage: primed from --from-checkpoint
+        # when serving continuity from an existing table matters, else
+        # empty (the cutover publishes version 1).
+        live = ViewPublisher()
+        if args.from_checkpoint:
+            from analyzer_tpu.io.checkpoint import load_checkpoint
+
+            live.publish_state(load_checkpoint(args.from_checkpoint).state)
+        lineage = LineageManager(live)
+        engine_kw = {}
+        if args.window_rows:
+            engine_kw["window_rows"] = args.window_rows
+        with timer.phase("migrate"):
+            report = run_migration(
+                state, data, cfg,
+                lineage=lineage,
+                checkpoint=args.checkpoint,
+                resume=args.resume,
+                checkpoint_every=args.checkpoint_every,
+                stop_after=args.stop_after_steps,
+                do_cutover=not args.no_cutover,
+                batch_size=args.batch_size,
+                prefetch_depth=args.prefetch_depth,
+                kernel=args.kernel,
+                fuse_window=args.fuse_window,
+                hot_rows=args.hot_rows,
+                **engine_kw,
+            )
+        if report.finished:
+            _obs_write(args)
+        stats = report.stats
+        print(json.dumps({
+            "matches": stats.get("matches"),
+            "supersteps": stats.get("n_steps"),
+            "batch_size": stats.get("batch_size"),
+            "occupancy": round(stats.get("occupancy", 0.0), 3),
+            "streamed": stats.get("streamed"),
+            "stopped": stats.get("stopped", False),
+            "ttfd_s": (
+                round(stats["ttfd_s"], 4)
+                if stats.get("ttfd_s") is not None else None
+            ),
+            "cutover_pause_ms": report.cutover_pause_ms,
+            "lineage_live_version": live.version,
+            "phases": {k: round(v, 3) for k, v in timer.report().items()},
+        }))
+        return 0
+    finally:
+        if server is not None:
+            server.close()
 
 
 def cmd_worker(args) -> int:
@@ -1658,6 +1803,14 @@ def main(argv=None) -> int:
         "gates (bytes/s, queue-to-H2D p99, arena hit rate — "
         "docs/ingest.md)",
     )
+    s.add_argument(
+        "--migrate", action="store_true",
+        help="capture the zero-downtime migration line instead "
+        "(BENCH_MIGRATE env): streamed backfill matches/s, live serve "
+        "p99 under the concurrent migration, cutover pause ms; emits "
+        "the MIGRATE_BENCH_*.json artifact `cli benchdiff --family "
+        "migrate` gates (docs/migration.md)",
+    )
     s.set_defaults(fn=cmd_bench)
 
     s = sub.add_parser(
@@ -1686,7 +1839,8 @@ def main(argv=None) -> int:
         "than PCT percent (default: 5)",
     )
     s.add_argument(
-        "--family", choices=("bench", "serve", "tiered", "soak", "ingest"),
+        "--family",
+        choices=("bench", "serve", "tiered", "soak", "ingest", "migrate"),
         default="bench",
         help="artifact family for --against-latest scans: bench "
         "(BENCH_*.json, the write path), serve (SERVE_BENCH_*.json — "
@@ -1699,7 +1853,11 @@ def main(argv=None) -> int:
         "retraces, bounded view staleness, drained backlog), or ingest "
         "(INGEST_BENCH_*.json from `cli bench --ingest` — decoded "
         "bytes/s, queue-to-H2D p99, arena hit rate; a candidate whose "
-        "decode silently fell back to the python codec fails); "
+        "decode silently fell back to the python codec fails), or "
+        "migrate (MIGRATE_BENCH_*.json from `cli bench --migrate` — "
+        "backfill matches/s, live serve p99 under concurrent migration, "
+        "cutover pause ms; a candidate whose backfill silently fell "
+        "back to the offline re-rate fails); "
         "explicit two-path diffs auto-detect from the metric name",
     )
     s.set_defaults(fn=cmd_benchdiff)
@@ -1938,7 +2096,84 @@ def main(argv=None) -> int:
         "bit-identity AB knob; the deterministic block is identical "
         "either way)",
     )
+    s.add_argument(
+        "--migrate", action="store_true",
+        help="run a full zero-downtime re-rate UNDER the live soak "
+        "load: the streamed backfill engine rates a seeded synthetic "
+        "history into a staging lineage (admission-arbitrated against "
+        "live traffic) while the soak serves, then cuts over "
+        "atomically after the measured window; the artifact gains a "
+        "`migration` block and the deterministic block is unchanged "
+        "per (seed, config) (docs/migration.md)",
+    )
+    s.add_argument(
+        "--migrate-matches", type=int, default=400, metavar="N",
+        help="matches in the migrated synthetic history (default: 400)",
+    )
     s.set_defaults(fn=cmd_soak)
+
+    s = sub.add_parser(
+        "migrate",
+        help="zero-downtime streamed re-rate: decode->assign->scan "
+        "overlapped, dual-lineage serve cutover, checkpoint/resume "
+        "(docs/migration.md)",
+    )
+    s.add_argument("--csv", required=True, help="match history CSV")
+    s.add_argument(
+        "--players", type=int, metavar="N",
+        help="player-table rows (default: probed from the stream with "
+        "one extra decode pass)",
+    )
+    s.add_argument(
+        "--checkpoint", metavar="PATH",
+        help="migration snapshot path (.npz; written at window "
+        "boundaries with the schedule fingerprint)",
+    )
+    s.add_argument(
+        "--resume", action="store_true",
+        help="resume from --checkpoint's watermark (the front half "
+        "re-derives the identical schedule from the bytes and skips "
+        "device work below it; final table bit-identical)",
+    )
+    s.add_argument(
+        "--checkpoint-every", type=int, metavar="STEPS",
+        help="snapshot every N supersteps mid-backfill",
+    )
+    s.add_argument(
+        "--stop-after-steps", type=int, metavar="STEPS",
+        help="stop at the window boundary at/after this superstep "
+        "(bounded runs; a snapshot is written there when --checkpoint "
+        "is set; no cutover happens)",
+    )
+    s.add_argument(
+        "--from-checkpoint", metavar="PATH",
+        help="prime the live lineage from this snapshot (serving "
+        "continuity while the backfill runs); default: empty live "
+        "lineage",
+    )
+    s.add_argument(
+        "--no-cutover", action="store_true",
+        help="skip the final atomic cutover (inspect the staging "
+        "lineage only)",
+    )
+    s.add_argument("--batch-size", type=int, metavar="B")
+    s.add_argument(
+        "--window-rows", type=int, metavar="N",
+        help="decode window rows (default 4096; io/ingest.py)",
+    )
+    s.add_argument("--prefetch-depth", type=int, metavar="N")
+    s.add_argument(
+        "--kernel", choices=("reference", "fused"),
+        default=os.environ.get("BENCH_KERNEL", "reference"),
+    )
+    s.add_argument("--fuse-window", type=int, metavar="K",
+                   default=int(os.environ.get("BENCH_FUSE_WINDOW", 0)) or None)
+    s.add_argument("--hot-rows", type=int, metavar="N",
+                   default=int(os.environ.get("BENCH_HOT_ROWS", 0)))
+    s.add_argument("--obs-port", type=int, metavar="PORT")
+    s.add_argument("--metrics-out", metavar="PATH")
+    s.add_argument("--trace-events", metavar="PATH")
+    s.set_defaults(fn=cmd_migrate)
 
     s = sub.add_parser("worker", help="broker-consuming service loop")
     s.add_argument(

@@ -91,6 +91,17 @@ RULES: dict[str, str] = {
         "read (time.*, datetime.now) inside obs/history.py / obs/slo.py "
         "— the plane is clock-injected so the soak stays deterministic"
     ),
+    "GL033": (
+        "dual-lineage migration hygiene inside analyzer_tpu/migrate/: a "
+        "view-publish call (publish_rows/publish_state/"
+        "publish_state_patch/publish_shard_patches/maybe_publish_state/"
+        "warm_patch_buckets) on a receiver not named as the staging "
+        "lineage, a cutover_from call outside the designated cutover "
+        "entry, or a read of mutable publisher internals (._view/"
+        "._staging) — backfill code may reach the live lineage only "
+        "through the atomic cutover, or a torn migration serves silently "
+        "wrong ratings"
+    ),
 }
 
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")

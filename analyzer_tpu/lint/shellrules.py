@@ -215,6 +215,31 @@ _GL032_FILES = (
     "analyzer_tpu/obs/slo.py",
 )
 
+#: Directories where GL033 applies: the migration engine — the one
+#: package whose code runs a backfill NEXT TO a live serve plane
+#: (docs/migration.md "Lineage protocol").
+_GL033_DIRS = ("analyzer_tpu/migrate/",)
+
+#: View-publish entry points GL033 polices: inside migrate/, each may
+#: target only a staging-named lineage (the live lineage is reached
+#: solely through the cutover entry).
+_GL033_PUBLISH = (
+    "publish_rows",
+    "publish_state",
+    "publish_state_patch",
+    "publish_shard_patches",
+    "maybe_publish_state",
+    "warm_patch_buckets",
+)
+
+#: Mutable publisher internals backfill code must never touch — it
+#: consumes immutable snapshots (current()) or public properties only.
+_GL033_INTERNALS = ("_view", "_staging")
+
+#: The designated cutover entry's function name: cutover_from calls are
+#: legal only inside a function of this name (migrate/lineage.py).
+_GL033_CUTOVER_FN = "cutover"
+
 #: Wall-clock reads GL028 bans in loadgen decision paths. Pacing and
 #: measured-latency reads carry line-scoped disables with reasons.
 #: (GL032 reuses the same needle set for the SLO plane's modules.)
@@ -277,11 +302,16 @@ class ShellRules:
         schema_layer = self._in_schema_layer()
         ingest_layer = self._in_ingest_layer()
         slo_plane_layer = self._in_slo_plane_layer()
+        migrate_layer = self._in_migrate_layer()
         tests = self._in_tests()
         pallas_home = self._in_pallas_home()
         table_home = self._in_table_home()
         merge_ranges = (
             self._merge_helper_ranges() if serve_layer and not tests else ()
+        )
+        cutover_ranges = (
+            self._cutover_entry_ranges() if migrate_layer and not tests
+            else ()
         )
         for node in ast.walk(self.tree):
             if isinstance(node, ast.Try):
@@ -306,11 +336,26 @@ class ShellRules:
                     self._check_unpinned_staging(node)
                 if slo_plane_layer:
                     self._check_slo_plane_clock(node)
+                if migrate_layer and not tests:
+                    self._check_lineage_publish(node, cutover_ranges)
                 if not tests:
                     self._check_objective_metric(node)
                     self._check_interpret_literal(node)
                 if not (tests or table_home):
                     self._check_table_transfer(node)
+            elif isinstance(node, ast.Attribute):
+                if (
+                    migrate_layer
+                    and not tests
+                    and node.attr in _GL033_INTERNALS
+                ):
+                    self._flag(
+                        "GL033", node,
+                        f"read of mutable publisher internal `.{node.attr}` "
+                        "in backfill code — a torn migration is a silent "
+                        "correctness bug; consume the immutable current() "
+                        "snapshot or the public version property instead",
+                    )
             elif isinstance(node, (ast.Import, ast.ImportFrom)):
                 if not obs_layer:
                     self._check_server_import(node)
@@ -368,6 +413,24 @@ class ShellRules:
     def _in_slo_plane_layer(self) -> bool:
         path = self.path.replace("\\", "/")
         return any(path.endswith(frag) for frag in _GL032_FILES)
+
+    def _in_migrate_layer(self) -> bool:
+        path = self.path.replace("\\", "/")
+        return any(frag in path for frag in _GL033_DIRS)
+
+    def _cutover_entry_ranges(self) -> tuple:
+        """(start, end) line spans of functions named ``cutover`` — the
+        designated dual-lineage cutover entries, the only places in
+        migrate/ sanctioned to call ``cutover_from`` on a live
+        publisher (GL033)."""
+        out = []
+        for node in ast.walk(self.tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == _GL033_CUTOVER_FN
+            ):
+                out.append((node.lineno, node.end_lineno or node.lineno))
+        return tuple(out)
 
     def _merge_helper_ranges(self) -> tuple:
         """(start, end) line spans of the designated merge helpers —
@@ -712,6 +775,47 @@ class ShellRules:
                 "metric has no history rings and the objective silently "
                 "never burns; declare the series or fix the name",
             )
+
+    def _check_lineage_publish(self, node: ast.Call, cutover_ranges) -> None:
+        """GL033 (publish + cutover halves): inside migrate/, a view-
+        publish call must target a STAGING-named lineage (any name in the
+        receiver chain containing ``staging`` or ``backfill``), and
+        ``cutover_from`` may be called only inside the designated
+        ``cutover`` entry — the structural guarantee that backfill code
+        cannot displace the views live traffic is served from except
+        through the one atomic, audited swap."""
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            return
+        if f.attr == "cutover_from":
+            if any(lo <= node.lineno <= hi for lo, hi in cutover_ranges):
+                return
+            self._flag(
+                "GL033", node,
+                "cutover_from called outside the designated cutover "
+                "entry — the live lineage swap must go through "
+                "migrate.lineage.cutover so it is counted, measured and "
+                "single-sited",
+            )
+            return
+        if f.attr not in _GL033_PUBLISH:
+            return
+        names = [
+            n.id.lower() for n in ast.walk(f.value)
+            if isinstance(n, ast.Name)
+        ] + [
+            n.attr.lower() for n in ast.walk(f.value)
+            if isinstance(n, ast.Attribute)
+        ]
+        if any("staging" in n or "backfill" in n for n in names):
+            return
+        self._flag(
+            "GL033", node,
+            f"`{f.attr}` on a non-staging lineage in backfill code — "
+            "migrate/ may publish only into the staging lineage; the "
+            "live lineage is reached through migrate.lineage.cutover "
+            "(the atomic swap), never by direct publish",
+        )
 
     def _check_soak_determinism(self, node: ast.Call) -> None:
         """GL028: unseeded randomness or wall-clock reads inside

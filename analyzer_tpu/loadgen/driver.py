@@ -151,6 +151,19 @@ class SoakConfig:
     # verdict zero-tolerance. Also deterministic-block-invariant.
     audit: bool = False
     audit_sample_denom: int = 4
+    # Zero-downtime migration under live load (ROADMAP item 4, the
+    # `cli soak --migrate` judge): a seeded synthetic history streams
+    # through the backfill engine (analyzer_tpu/migrate) into a STAGING
+    # view lineage while the soak's live plane keeps serving —
+    # admission-arbitrated against the live backlog — and traffic cuts
+    # over atomically AFTER the measured window. The deterministic
+    # block is BIT-IDENTICAL with the migration on or off per (seed,
+    # config): the backfill publishes only into the staging lineage,
+    # its compile ladder warms in prepare() before the retrace base is
+    # read, and the cutover happens after every deterministic value is
+    # captured (pinned by tests/test_migrate.py).
+    migrate: bool = False
+    migrate_matches: int = 400
 
     @property
     def n_ticks(self) -> int:
@@ -237,6 +250,13 @@ class SoakDriver:
         self._match_digest = hashlib.sha256()
         self._query_digest = hashlib.sha256()
         self._closed = False
+        # Migration rig (cfg.migrate): filled by _prepare_migration.
+        self._mig_data: bytes | None = None
+        self._mig_state0 = None
+        self._mig_reference = None  # the from-scratch re-rate's table
+        self._mig_result: dict = {}
+        self._mig_thread = None
+        self._mig_lineage = None
 
     # -- rig preparation ---------------------------------------------------
     def prepare(self) -> None:
@@ -265,6 +285,15 @@ class SoakDriver:
             self.worker.warmup()
             self.worker.query_engine.warmup()
             self._warm_publish_buckets(ids, rows)
+        if cfg.migrate:
+            # Build the migration history AND run the backfill engine
+            # once to completion on a throwaway staging lineage: this is
+            # simultaneously the compile warmup for every shape the
+            # concurrent run will hit (it runs BEFORE the retrace base
+            # below, so the flat-steady-retraces SLO still means what it
+            # says) and the from-scratch reference table the acceptance
+            # check pins the migrated lineage against bit for bit.
+            self._prepare_migration()
         self._retrace_base = float(
             get_registry().counter("jax.retraces_total").value
         )
@@ -404,6 +433,151 @@ class SoakDriver:
         self._backfill_published += sent
         return sent
 
+    # -- zero-downtime migration under load (cfg.migrate) ------------------
+    def _migration_state(self):
+        """A fresh pre-migration player table — what a from-scratch
+        season re-rate starts from (same construction as prepare())."""
+        from analyzer_tpu.core.state import PlayerState
+
+        return PlayerState.create(
+            self.cfg.n_players,
+            rank_points_ranked=self.players.rank_points_ranked,
+            rank_points_blitz=self.players.rank_points_blitz,
+            skill_tier=self.players.skill_tier,
+            cfg=self.rating_config,
+        )
+
+    def _prepare_migration(self) -> None:
+        """Synthesizes the seeded migration history, then runs the
+        backfill engine once (throwaway staging lineage) — the compile
+        warmup AND the bit-identity reference table."""
+        import os
+        import tempfile
+
+        import numpy as np
+
+        from analyzer_tpu.io.csv_codec import save_stream_csv
+        from analyzer_tpu.io.synthetic import synthetic_stream
+        from analyzer_tpu.migrate import rate_backfill
+        from analyzer_tpu.serve import ViewPublisher
+
+        cfg = self.cfg
+        stream = synthetic_stream(
+            cfg.migrate_matches, self.players, seed=cfg.seed + 7,
+        )
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, "migration.csv")
+            save_stream_csv(path, stream)
+            with open(path, "rb") as f:
+                self._mig_data = f.read()
+        self._mig_state0 = self._migration_state()
+        warm_staging = ViewPublisher()
+        ref, _ = rate_backfill(
+            self._mig_state0, self._mig_data, self.rating_config,
+            staging=warm_staging,
+        )
+        self._mig_reference = np.asarray(ref.table)
+
+    def _run_migration(self) -> None:
+        """The concurrent backfill (its own thread, WALL time — it lives
+        entirely outside the deterministic block): streams the history
+        into the staging lineage under the admission controller, gated
+        on the soak's live backlog."""
+        import time as _time
+
+        from analyzer_tpu.loadgen.matchmaker import player_id
+        from analyzer_tpu.migrate import LineageManager, rate_backfill
+        from analyzer_tpu.service.broker import AdmissionController
+
+        queue = self.worker.config.queue
+
+        def live_backlog() -> int:
+            return self.broker.qsize(queue) + len(self.worker.queue)
+
+        self._mig_lineage = LineageManager(self.worker.view_publisher)
+        staging = self._mig_lineage.begin()
+        stats: dict = {}
+        t0 = _time.perf_counter()  # graftlint: disable=GL028 — measured-block wall anchor, not a decision input
+        try:
+            final, _ = rate_backfill(
+                self._migration_state(), self._mig_data,
+                self.rating_config,
+                staging=staging,
+                ids=[player_id(i) for i in range(self.cfg.n_players)],
+                admission=AdmissionController(),
+                live_backlog=live_backlog,
+                stats_out=stats,
+            )
+        except BaseException as e:  # noqa: BLE001 — surfaced in the artifact
+            self._mig_result.update(error=repr(e), stats=stats)
+            self._mig_lineage.abort()
+            return
+        wall = _time.perf_counter() - t0  # graftlint: disable=GL028 — measured-block wall clock, not a decision input
+        import numpy as np
+
+        self._mig_result.update(
+            table=np.asarray(final.table), stats=stats, wall_s=wall,
+        )
+
+    def _finish_migration(self) -> dict:
+        """Joins the backfill, verifies the migrated lineage bit-for-bit
+        against the from-scratch reference, and performs the atomic
+        cutover. Called strictly AFTER the artifact's deterministic
+        block is built — nothing here can perturb it. Returns the
+        artifact's ``migration`` block (wall-derived, like `measured`)."""
+        import numpy as np
+
+        if self._mig_thread is not None:
+            self._mig_thread.join(timeout=600)
+        res = self._mig_result
+        block: dict = {
+            "ran": True,
+            "matches": self.cfg.migrate_matches,
+            "error": res.get("error"),
+        }
+        if "error" in res or "table" not in res:
+            block["finished"] = False
+            return block
+        stats = res["stats"]
+        pre_version = self.worker.view_publisher.version
+        bit_identical = bool(
+            np.array_equal(res["table"], self._mig_reference, equal_nan=True)
+        )
+        view = self._mig_lineage.cutover()
+        served = np.asarray(view.table)
+        cutover_identical = bool(
+            np.array_equal(
+                served[: view.n_players],
+                res["table"][: view.n_players],
+                equal_nan=True,
+            )
+        )
+        wall = res["wall_s"]
+        block.update(
+            finished=True,
+            streamed=bool(stats.get("streamed")),
+            bit_identical=bit_identical,
+            cutover_serves_migrated_table=cutover_identical,
+            backfill_wall_s=round(wall, 3),
+            backfill_matches_per_sec=(
+                round(stats.get("matches", 0) / wall, 1) if wall > 0 else None
+            ),
+            ttfd_s=(
+                round(stats["ttfd_s"], 4)
+                if stats.get("ttfd_s") is not None else None
+            ),
+            supersteps=stats.get("n_steps"),
+            occupancy=round(stats.get("occupancy", 0.0), 3),
+            cutover_pause_ms=round(
+                (self._mig_lineage.cutover_pause_s or 0.0) * 1e3, 3
+            ),
+            lineage_versions={
+                "pre_cutover_live": pre_version,
+                "post_cutover_live": view.version,
+            },
+        )
+        return block
+
     # -- query workload ----------------------------------------------------
     def _issue_queries(self, n: int, latencies_ms: list,
                        counts: dict) -> None:
@@ -448,6 +622,18 @@ class SoakDriver:
         reg = get_registry()
         reg.gauge("soak.qps_target").set(cfg.qps)
         self.prepare()
+        if cfg.migrate:
+            # The backfill runs CONCURRENTLY with the whole soak on its
+            # own (wall-clock) thread, publishing only into the staging
+            # lineage — live serving, the digests, and every counter in
+            # the deterministic block are untouched until the cutover,
+            # which happens after that block is captured.
+            import threading
+
+            self._mig_thread = threading.Thread(
+                target=self._run_migration, name="soak-migrate", daemon=True
+            )
+            self._mig_thread.start()
         match_shaper = TrafficShaper(cfg.qps, cfg.tick_s)
         query_shaper = TrafficShaper(cfg.query_qps, cfg.tick_s)
         backfill_shaper = (
@@ -625,7 +811,37 @@ class SoakDriver:
                     {k: m[k] for k in ("kind", "key", "version")}
                     for m in self.worker.auditor.mismatches[:8]
                 ]
+        if cfg.migrate:
+            # Deterministic block is captured above; the cutover (and
+            # its version bump) happens only now. The migration's own
+            # acceptance — finished, streamed (no silent fall-back to
+            # the offline re-rate), bit-identical to the from-scratch
+            # reference — gates the soak verdict like any SLO.
+            artifact["migration"] = self._finish_migration()
         violations = soak_violations(artifact)
+        mig = artifact.get("migration")
+        if mig is not None:
+            if not mig.get("finished"):
+                violations.append(
+                    "migration: backfill did not finish "
+                    f"({mig.get('error') or 'timed out'})"
+                )
+            else:
+                if not mig.get("streamed"):
+                    violations.append(
+                        "migration: engine fell back to the offline "
+                        "(non-streamed) re-rate path"
+                    )
+                if not mig.get("bit_identical"):
+                    violations.append(
+                        "migration: migrated lineage is NOT bit-identical "
+                        "to the from-scratch re-rate"
+                    )
+                if not mig.get("cutover_serves_migrated_table"):
+                    violations.append(
+                        "migration: post-cutover live view does not serve "
+                        "the migrated table"
+                    )
         artifact["slo"]["violations"] = violations
         artifact["slo"]["pass"] = not violations
         if violations:

@@ -1,0 +1,48 @@
+"""Zero-downtime global re-rate: the streaming backfill engine and the
+dual-lineage serve cutover (docs/migration.md, ROADMAP item 4).
+
+Three pieces compose a live rating migration:
+
+  * :mod:`analyzer_tpu.migrate.engine` — the streaming front half:
+    columnar CSV decode windows (``io/ingest.py``) feed an INCREMENTAL
+    first-fit assigner (:mod:`analyzer_tpu.migrate.assign`) on one
+    front-half thread while the device feed stages and the scan
+    dispatches — decode, assignment, H2D and compute all overlap, so
+    time-to-first-dispatch is O(one decode window) instead of O(file);
+  * :mod:`analyzer_tpu.migrate.lineage` — the dual-lineage serve
+    protocol: the backfill publishes into a STAGING view lineage while
+    the live lineage keeps serving, and :func:`~analyzer_tpu.migrate.
+    lineage.cutover` swaps the migrated table in as the live lineage's
+    next version atomically (``serve/view.py cutover_from`` — the one
+    entry graftlint GL033 sanctions);
+  * :mod:`analyzer_tpu.migrate.progress` — the /statusz surface:
+    watermark, progress %, and an ETA derived from the history rings'
+    backfill rate (``Worker.stats()``'s ``migration`` block).
+"""
+
+from analyzer_tpu.migrate.assign import IncrementalAssigner
+from analyzer_tpu.migrate.engine import (
+    MigrationReport,
+    migration_fingerprint,
+    rate_backfill,
+    run_migration,
+)
+from analyzer_tpu.migrate.lineage import LineageManager, cutover
+from analyzer_tpu.migrate.progress import (
+    MigrationProgress,
+    get_migration_progress,
+    reset_migration_progress,
+)
+
+__all__ = [
+    "IncrementalAssigner",
+    "LineageManager",
+    "MigrationProgress",
+    "MigrationReport",
+    "cutover",
+    "get_migration_progress",
+    "migration_fingerprint",
+    "rate_backfill",
+    "reset_migration_progress",
+    "run_migration",
+]

@@ -1,0 +1,180 @@
+"""Incremental capacity-aware first-fit assignment — the streaming
+front half's scheduler (docs/migration.md "Streaming front half").
+
+``sched.superstep.assign_batches`` consumes a COMPLETE stream: the
+native loop takes the whole arrays with the GIL released, and the python
+fallback iterates ``range(n)``. The migration engine's whole point is
+that no complete stream ever exists — matches become visible one decode
+window at a time — so this module carries the first-fit recurrence as
+RESTARTABLE state: :meth:`IncrementalAssigner.feed` consumes exactly the
+newly decoded slice ``[lo, hi)`` and leaves the per-player frontier, the
+batch fill counts, and the union-find next-free index ready for the next
+window. Feeding the windows in stream order produces assignments
+IDENTICAL to the one-shot python loop over the concatenated stream
+(pinned by tests/test_migrate.py) — the decomposition into windows is
+invisible to the result, so the emitted schedule is a pure function of
+(stream bytes, capacity) regardless of decode timing.
+
+One deliberate divergence from the offline packer: NON-RATABLE matches
+(unsupported mode, AFK) are assigned inline as capacity-consuming,
+dependency-free entries (first-fit from batch 0) instead of being held
+back and backfilled into other batches' padding slots. Holding them back
+requires knowing the whole stream's filler population up front — exactly
+what streaming forbids — and consuming them inline keeps occupancy high
+without it. They read and write no rating state, so the final table and
+every per-match output are bit-identical to any other placement
+(``sched.runner.rate_stream``'s filler-placement argument); only the
+slot a filler's gate outputs are computed in moves.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: Periodic progress-publish interval (matches) inside one feed() slice —
+#: same cadence contract as the one-shot python loop's
+#: ``sched.superstep._PY_PROGRESS_EVERY``.
+PROGRESS_EVERY = 2048
+
+
+class IncrementalAssigner:
+    """Restartable first-fit over a growing stream.
+
+    ``out_batch`` / ``out_slot`` are the caller's preallocated int64
+    buffers (sentinel-prefilled — the streamed feed's cross-thread
+    visibility protocol, ``sched.runner.rate_stream``); ``progress`` is
+    the shared ``[2]`` int64 publish array (``progress[0]`` = matches
+    final, ``progress[1]`` = batches used, written by :meth:`finish`).
+    ``on_progress`` is the condition-variable wakeup hook.
+    """
+
+    def __init__(
+        self,
+        capacity: int,
+        out_batch: np.ndarray,
+        out_slot: np.ndarray,
+        progress: np.ndarray | None = None,
+        on_progress=None,
+    ) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.out_batch = out_batch
+        self.out_slot = out_slot
+        self.progress = progress
+        self.on_progress = on_progress
+        self.n_assigned = 0
+        # last[p] = batch of p's most recent ratable match, -1 if none.
+        self._last = np.full(1024, -1, dtype=np.int64)
+        self._fill: list[int] = []
+        self._next_free: list[int] = []
+        self._max_batch = -1
+
+    # -- first-fit internals (the one-shot loop's, carried as state) ------
+    def _ensure(self, b: int) -> None:
+        fill, nxt = self._fill, self._next_free
+        while len(fill) <= b:
+            fill.append(0)
+            nxt.append(len(nxt))
+
+    def _find(self, b: int) -> int:
+        self._ensure(b)
+        nxt = self._next_free
+        root = b
+        while True:
+            self._ensure(root)
+            if nxt[root] == root:
+                break
+            root = nxt[root]
+        while nxt[b] != root:
+            b, nxt[b] = nxt[b], root
+        return root
+
+    def _grow_players(self, top: int) -> None:
+        if top < self._last.size:
+            return
+        size = self._last.size
+        while size <= top:
+            size *= 2
+        bigger = np.full(size, -1, dtype=np.int64)
+        bigger[: self._last.size] = self._last
+        self._last = bigger
+
+    def _publish(self, upto: int) -> None:
+        if self.progress is not None:
+            # Entries [0, upto) are final; the GIL orders the out-buffer
+            # stores before this publish (same contract as the one-shot
+            # python loop's periodic publish).
+            self.progress[0] = upto
+        if self.on_progress is not None:
+            self.on_progress()
+
+    # -- public surface ---------------------------------------------------
+    def feed(
+        self,
+        player_idx: np.ndarray,
+        mode_id: np.ndarray,
+        afk: np.ndarray,
+        lo: int,
+        hi: int,
+    ) -> None:
+        """Assigns matches ``[lo, hi)`` of the accumulated stream buffers
+        (``player_idx [cap, 2, T]``, per-match scalars). Must be fed in
+        stream order with no gaps; publishes progress at the end of the
+        slice and every :data:`PROGRESS_EVERY` matches within it."""
+        if hi <= lo:
+            return
+        if lo != self.n_assigned:
+            raise ValueError(
+                f"feed slices must be contiguous: expected lo="
+                f"{self.n_assigned}, got {lo}"
+            )
+        cap = self.capacity
+        last = self._last
+        fill = self._fill
+        out_b, out_s = self.out_batch, self.out_slot
+        for i in range(lo, hi):
+            if i > lo and not (i & (PROGRESS_EVERY - 1)):
+                self._publish(i)
+            ratable = mode_id[i] >= 0 and not afk[i]
+            if ratable:
+                players = player_idx[i].ravel()
+                players = players[players >= 0]
+                if players.size:
+                    top = int(players.max())
+                    if top >= last.size:
+                        self._grow_players(top)
+                        last = self._last
+                    floor_b = int(last[players].max()) + 1
+                else:
+                    floor_b = 0
+            else:
+                players = None
+                floor_b = 0  # dependency-free: first batch with room
+            b = self._find(floor_b)
+            out_b[i] = b
+            out_s[i] = fill[b]
+            fill[b] += 1
+            if fill[b] == cap:
+                self._ensure(b + 1)
+                self._next_free[b] = b + 1
+            if b > self._max_batch:
+                self._max_batch = b
+            if ratable and players is not None and players.size:
+                last[players] = b
+        self.n_assigned = hi
+        self._publish(hi)
+
+    @property
+    def batches_used(self) -> int:
+        """Batches holding at least one match so far."""
+        return self._max_batch + 1
+
+    def finish(self) -> None:
+        """Publishes the final (n, batches-used) pair — the completion
+        record the feed's tail logic reads after the join."""
+        if self.progress is not None:
+            self.progress[0] = self.n_assigned
+            self.progress[1] = self.batches_used
+        if self.on_progress is not None:
+            self.on_progress()

@@ -1,0 +1,756 @@
+"""The streaming backfill engine: decode -> assign -> stage -> scan, all
+overlapped on the existing feed ring (docs/migration.md).
+
+``sched.runner.rate_stream`` overlaps ASSIGNMENT with the device scan
+but still requires the whole decoded stream up front — a CSV re-rate
+pays the full columnar (or python) decode as a sequential prefix, with
+the decoded arrays materialized whole-file before the first assignment
+step runs. This engine moves the overlap one stage upstream, completing
+ROADMAP item 5's remainder:
+
+  * a FRONT-HALF thread iterates :class:`analyzer_tpu.io.ingest.
+    ColumnarDecoder` windows — each window decodes natively into pinned
+    arena slabs, appends into preallocated stream buffers (sized once
+    from the byte stream's newline count: steady-state host allocations
+    are flat at arena-ring size), and feeds the incremental first-fit
+    assigner (:mod:`analyzer_tpu.migrate.assign`), publishing progress
+    through the same sentinel-buffer + condition-variable handshake as
+    ``rate_stream``;
+  * the FEED thread scatters newly assigned slots into the slot->match
+    map, materializes each complete window, and issues its async device
+    transfer (``sched/feed.py`` ring — residency/tier staging included);
+  * the CONSUMER dispatches committed slabs to the scan — reference,
+    fused, and tiered kernels all supported — publishing throttled view
+    snapshots into the STAGING lineage and pausing under the
+    :class:`~analyzer_tpu.service.broker.AdmissionController`'s verdict
+    so a live plane's commits keep their headroom.
+
+Time-to-first-dispatch is O(one decode window + spc batches of
+assignment) instead of O(file). Determinism: the emitted schedule is a
+pure function of (bytes, batch_size, steps_per_chunk) — window
+boundaries are fixed multiples of ``steps_per_chunk``, the assigner is
+sequential over stream order, and non-ratable matches are consumed
+inline (see ``migrate/assign.py`` on why, and why results are
+bit-identical to every other placement). The final table and collected
+outputs are bit-identical to ``rate_stream`` over the same decoded
+stream (pinned by tests/test_migrate.py), and a resumed run
+(``start_step`` from a checkpoint) reproduces the uninterrupted run's
+table bit for bit — the front half re-derives the identical schedule
+from the bytes and skips device work below the watermark.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analyzer_tpu.core.state import MAX_TEAM_SIZE
+from analyzer_tpu.io.ingest import ColumnarDecoder, DEFAULT_WINDOW_ROWS
+from analyzer_tpu.migrate.assign import IncrementalAssigner
+from analyzer_tpu.migrate.progress import get_migration_progress
+from analyzer_tpu.obs import (
+    get_registry,
+    get_tracer,
+    maybe_sample_device_memory,
+)
+from analyzer_tpu.sched.feed import (
+    DEFAULT_DEPTH,
+    FeedStageError,
+    Prefetcher,
+    stage_fused_windows,
+)
+from analyzer_tpu.sched.residency import resolve_fuse
+from analyzer_tpu.sched.runner import (
+    _dispatch_fused_chunk,
+    _gather_outputs,
+    _scan_chunk,
+)
+from analyzer_tpu.sched.superstep import (
+    MatchStream,
+    choose_batch_size_streamed,
+    compact_device_window,
+    materialize_gather_window,
+    materialize_scalar_window,
+)
+from analyzer_tpu.sched.tier import TierManager
+from analyzer_tpu.utils.host import fetch_tree
+
+
+def migration_fingerprint(data: bytes, batch_size: int, spc: int) -> str:
+    """Identity of one migration's emitted schedule: the schedule is a
+    pure function of (bytes, batch size, window size), so this is what a
+    mid-run checkpoint stores and a resume verifies — a changed input
+    file or chunking policy fails loudly instead of double-applying."""
+    h = hashlib.sha1()
+    h.update(b"migrate-v1")
+    h.update(hashlib.sha256(data).digest())
+    h.update(np.asarray((batch_size, spc), np.int64).tobytes())
+    return h.hexdigest()
+
+
+class _StreamView:
+    """MatchStream-shaped window over the growing decode buffers — the
+    materializers only gather rows below the assigned frontier, so the
+    full-capacity buffers are safe to expose while the front half is
+    still appending past it (disjoint regions, plain GIL stores)."""
+
+    __slots__ = ("player_idx", "winner", "mode_id", "afk")
+
+    def __init__(self, player_idx, winner, mode_id, afk) -> None:
+        self.player_idx = player_idx
+        self.winner = winner
+        self.mode_id = mode_id
+        self.afk = afk
+
+    @property
+    def n_matches(self) -> int:
+        return self.player_idx.shape[0]
+
+    @property
+    def team_size(self) -> int:
+        return self.player_idx.shape[2]
+
+
+def _decode_fallback(data: bytes):
+    """The python-codec whole-stream decode (quoted grammar, or no
+    native scanner) — counted, and surfaced in the bench artifact as
+    ``streamed: false`` so the migrate family's vanished-block gate
+    catches a silent fall-back to the offline re-rate shape."""
+    import io as _io
+
+    from analyzer_tpu.io.csv_codec import load_stream_csv
+
+    get_registry().counter("migrate.fallbacks_total").add(1)
+    return load_stream_csv(_io.StringIO(data.decode("utf-8")))
+
+
+def rate_backfill(
+    state,
+    data: bytes,
+    cfg,
+    collect: bool = False,
+    batch_size: int | None = None,
+    steps_per_chunk: int | None = None,
+    team_size: int | None = None,
+    window_rows: int = DEFAULT_WINDOW_ROWS,
+    mode_names=None,
+    arena=None,
+    prefetch_depth: int | None = None,
+    kernel: str = "reference",
+    fuse_window: int | None = None,
+    fuse_max_rows: int | None = None,
+    fuse_backend: str | None = None,
+    hot_rows: int = 0,
+    staging=None,
+    ids=None,
+    on_chunk=None,
+    start_step: int = 0,
+    stop_after: int | None = None,
+    expected_fingerprint: str | None = None,
+    fingerprint_out: dict | None = None,
+    admission=None,
+    live_backlog=None,
+    throttle_poll_s: float = 0.002,
+    poll_interval: float = 0.002,
+    stats_out: dict | None = None,
+):
+    """Rates a raw CSV byte stream with decode, assignment, staging and
+    the device scan fully overlapped. Returns ``(state, outputs)`` like
+    the sched runners.
+
+    ``staging`` is the STAGING-lineage view publisher the backfill
+    publishes throttled snapshots into (plus an unthrottled final
+    publish carrying ``ids`` when given) — never a live lineage;
+    graftlint GL033 makes that structural. ``admission`` (an
+    :class:`~analyzer_tpu.service.broker.AdmissionController`) +
+    ``live_backlog`` (zero-arg callable: live messages waiting) gate
+    every window dispatch: a non-zero live backlog or busy host
+    telemetry pauses the consumer, which backpressures the feed ring and
+    with it the backfill's staging and H2D traffic — the in-process form
+    of the broker's backfill lane arbitration (decode itself runs ahead
+    into the preallocated buffers: host-memory-bounded and cheap next to
+    the scan). Give the engine its OWN controller instance — ``quota``
+    consumes telemetry deltas, so sharing a broker's controller would
+    halve both consumers' signal.
+
+    ``start_step``/``stop_after``/``expected_fingerprint`` are the
+    resume protocol: the front half always re-derives the full schedule
+    from the bytes (cheap host work), windows at or below ``start_step``
+    skip staging and dispatch entirely, and the fingerprint — published
+    into ``fingerprint_out['fingerprint']`` before the first dispatch —
+    is verified against the checkpoint's so a changed input fails loudly.
+    ``stop_after`` ends the run at a window boundary at or after that
+    step (the kill point of the resume tests).
+
+    ``kernel``/``fuse_*``/``hot_rows``/``prefetch_depth``/``collect``/
+    ``on_chunk`` mirror :func:`analyzer_tpu.sched.runner.rate_stream`.
+    On a stream the columnar decoder cannot take (quoted fields, no
+    native scanner) the engine falls back to the non-streamed path —
+    python decode then ``rate_stream`` — preserving results; the
+    fall-back is counted and resume is refused there (the streamed
+    schedule is the resume contract).
+    """
+    fuse = resolve_fuse(kernel, fuse_window, fuse_max_rows, fuse_backend)
+    if hot_rows < 0:
+        raise ValueError(f"hot_rows must be >= 0, got {hot_rows}")
+    if start_step and collect:
+        raise ValueError(
+            "collect=True is not supported on a resumed run — per-match "
+            "outputs below the resume watermark were produced (and "
+            "discarded) by the interrupted run; collect on the full run "
+            "or re-rate from scratch"
+        )
+    team = team_size or MAX_TEAM_SIZE
+    prog = get_migration_progress()
+    prog.begin(resumed_from=start_step)
+    reg = get_registry()
+    tracer = get_tracer()
+    t_start = time.perf_counter()
+
+    decoder = ColumnarDecoder(
+        data, mode_names, max_team=team, window_rows=window_rows,
+        arena=arena,
+    )
+    if not decoder.available:
+        if start_step or expected_fingerprint:
+            raise ValueError(
+                "cannot resume a migration on the python-codec fallback "
+                "path (the streamed schedule is the resume contract); "
+                "repair the stream for the columnar grammar or re-rate "
+                "from scratch"
+            )
+        stream = _decode_fallback(data)
+        from analyzer_tpu.sched.runner import rate_stream
+
+        stats: dict = {}
+        state, outs = rate_stream(
+            state, stream, cfg, collect=collect, batch_size=batch_size,
+            steps_per_chunk=steps_per_chunk,
+            view_publisher=staging, on_chunk=on_chunk,
+            prefetch_depth=prefetch_depth, kernel=kernel,
+            fuse_window=fuse_window, fuse_max_rows=fuse_max_rows,
+            fuse_backend=fuse_backend, hot_rows=hot_rows,
+            stats_out=stats,
+        )
+        if staging is not None and ids is not None:
+            staging.publish_state(state, ids=ids)
+        stats.update(streamed=False, matches=stream.n_matches)
+        if stats_out is not None:
+            stats_out.update(stats)
+        prog.finish()
+        return state, outs
+
+    pad_row = state.pad_row
+    tier = TierManager(state, hot_rows) if hot_rows else None
+    if tier is not None and fuse is not None:
+        fuse = tier.clamp_fuse(fuse)
+    state = tier.hot_state() if tier is not None \
+        else jax.tree.map(jnp.copy, state)
+
+    # One allocation per column, sized from the byte stream's newline
+    # count (an upper bound on rows — header and trailing newline only
+    # overshoot): steady-state host allocations stay flat while the
+    # decode slabs themselves recycle through the arena ring.
+    n_bound = data.count(b"\n") + 1
+    pidx_buf = np.full((n_bound, 2, team), -1, np.int32)
+    winner_buf = np.zeros(n_bound, np.int32)
+    mode_buf = np.zeros(n_bound, np.int32)
+    afk_buf = np.zeros(n_bound, bool)
+    view_stream = _StreamView(pidx_buf, winner_buf, mode_buf, afk_buf)
+
+    n_decoded = [0]
+
+    def append(win) -> tuple[int, int]:
+        lo = n_decoded[0]
+        hi = lo + win.rows
+        if hi > n_bound:  # the newline bound is an invariant of the grammar
+            raise RuntimeError(
+                f"decoded {hi} rows past the {n_bound}-row byte bound"
+            )
+        pidx_buf[lo:hi] = win.player_idx
+        winner_buf[lo:hi] = win.winner
+        mode_buf[lo:hi] = win.mode_id
+        afk_buf[lo:hi] = win.afk
+        win.release()
+        if hi > lo and int(pidx_buf[lo:hi].max()) >= pad_row:
+            raise ValueError(
+                f"stream references player row {int(pidx_buf[lo:hi].max())} "
+                f"but the player table only has rows 0..{pad_row - 1}"
+            )
+        n_decoded[0] = hi
+        prog.note_decoded(hi)
+        return lo, hi
+
+    # Window 0 decodes on THIS thread: the batch-size choice needs a
+    # prefix, and the choice is deterministic as a pure function of the
+    # first decode window (documented divergence from rate_stream's
+    # n/8 prefix — the whole stream length is unknown here).
+    win_iter = decoder.windows()
+    first = next(win_iter, None)
+    if first is not None:
+        append(first)
+    n0 = n_decoded[0]
+    if n0 == 0:
+        if stats_out is not None:
+            stats_out.update(
+                n_steps=0, batch_size=0, occupancy=0.0, matches=0,
+                streamed=True, ttfd_s=None,
+            )
+        if tier is not None:
+            state = tier.finish(state.table)
+        if staging is not None:
+            staging.publish_state(state, ids=ids)
+        prog.finish()
+        return state, (
+            _gather_outputs([], np.empty(0, np.int32), 0, team)
+            if collect else None
+        )
+    if batch_size is None:
+        b = choose_batch_size_streamed(
+            MatchStream(
+                pidx_buf[:n0], winner_buf[:n0], mode_buf[:n0], afk_buf[:n0]
+            ),
+            prefix=n0,
+        )
+    else:
+        b = batch_size
+    spc = steps_per_chunk or min(8192, max(256, -(-n_bound // b) // 8 or 1))
+    fingerprint = migration_fingerprint(data, b, spc)
+    if fingerprint_out is not None:
+        fingerprint_out["fingerprint"] = fingerprint
+    if expected_fingerprint is not None and fingerprint != expected_fingerprint:
+        raise ValueError(
+            "checkpoint was taken mid-migration but the derived schedule "
+            "no longer matches (stream bytes, batch size, or chunking "
+            "changed); re-rate from scratch or fix the input"
+        )
+
+    if start_step and start_step % spc:
+        # Mid-run checkpoints are only ever taken at window boundaries
+        # (multiples of spc); anything else would make the first resumed
+        # window straddle the watermark and double-apply its prefix.
+        raise ValueError(
+            f"start_step {start_step} is not a window boundary "
+            f"(steps_per_chunk={spc}); resume from the checkpoint's own "
+            "step cursor"
+        )
+    sentinel = np.iinfo(np.int64).min
+    progress = np.zeros(2, np.int64)
+    out_b = np.full(n_bound, sentinel, np.int64)
+    out_s = np.full(n_bound, sentinel, np.int64)
+    worker_err: list[BaseException] = []
+    cv = threading.Condition()
+    assigner_done = [False]
+    stop_flag = [False]
+
+    def notify_progress():
+        with cv:
+            cv.notify_all()
+
+    assigner = IncrementalAssigner(
+        b, out_b, out_s, progress, on_progress=notify_progress
+    )
+
+    def front():
+        """The front-half thread: decode window -> append -> assign,
+        repeating until the stream is exhausted (or the run stopped)."""
+        try:
+            if n_decoded[0]:
+                assigner.feed(pidx_buf, mode_buf, afk_buf, 0, n_decoded[0])
+                prog.note_assigned(assigner.n_assigned)
+            for win in win_iter:
+                if stop_flag[0]:  # bounded run ended: stop decoding
+                    win.release()
+                    break
+                lo, hi = append(win)
+                assigner.feed(pidx_buf, mode_buf, afk_buf, lo, hi)
+                prog.note_assigned(assigner.n_assigned)
+            assigner.finish()
+        except BaseException as e:  # noqa: BLE001 — re-raised on the consumer
+            worker_err.append(e)
+        finally:
+            with cv:
+                assigner_done[0] = True
+                cv.notify_all()
+
+    front_thread = threading.Thread(
+        target=front, name="migrate-front", daemon=True
+    )
+    front_thread.start()
+
+    cap_steps = max(-(-n_bound // b) + 2, 2)
+    slot_map = np.full(cap_steps * b, -1, np.int32)
+    fill_count = np.zeros(cap_steps, np.int32)
+    done_m = 0
+    emitted = 0  # windows below start_step advance this without staging
+    watermark = 0
+    outs = [] if collect else None
+
+    def grow(min_steps: int) -> None:
+        nonlocal slot_map, fill_count, cap_steps
+        if min_steps <= cap_steps:
+            return
+        while cap_steps < min_steps:
+            cap_steps *= 2
+        bigger = np.full(cap_steps * b, -1, np.int32)
+        bigger[: slot_map.size] = slot_map
+        slot_map = bigger
+        bigger_c = np.zeros(cap_steps, np.int32)
+        bigger_c[: fill_count.size] = fill_count
+        fill_count = bigger_c
+
+    def scatter_new(p: int) -> None:
+        """Consumes assignment entries [done_m, p), trimming at the
+        first not-yet-visible (sentinel) entry — rate_stream's weak-
+        ordering discipline verbatim; every entry here is >= 0 (fillers
+        are assigned inline), so no liveness mask is needed."""
+        nonlocal done_m, watermark
+        if p <= done_m:
+            return
+        nb = out_b[done_m:p]
+        ns = out_s[done_m:p]
+        unwritten = np.flatnonzero((nb == sentinel) | (ns == sentinel))
+        if unwritten.size:
+            p = done_m + int(unwritten[0])
+            if p <= done_m:
+                return
+            nb = out_b[done_m:p]
+            ns = out_s[done_m:p]
+        grow(int(nb.max()) + 1)
+        slot_map[nb * b + ns] = np.arange(done_m, p, dtype=np.int32)
+        counts = np.bincount(nb)
+        fill_count[: counts.size] += counts.astype(np.int32)
+        while watermark < cap_steps and fill_count[watermark] >= b:
+            watermark += 1
+        done_m = p
+
+    def stage(e0: int, e1: int):
+        mi = slot_map[e0 * b : e1 * b].reshape(e1 - e0, b)
+        with tracer.span("feed.materialize", cat="sched", start=e0):
+            pidx, _mask = materialize_gather_window(
+                view_stream, mi, pad_row, team
+            )
+            winner, mode_id, afk = materialize_scalar_window(view_stream, mi)
+        if fuse is not None:
+            return stage_fused_windows(
+                pidx, winner, mode_id, afk, pad_row, fuse,
+                match_idx=mi if collect else None, start=e0, tier=tier,
+            )
+        if tier is not None:
+            with tracer.span("feed.transfer", cat="sched", start=e0):
+                return tier.stage_windows(pidx, winner, mode_id, afk)
+        with tracer.span("feed.transfer", cat="sched", start=e0):
+            return compact_device_window(pidx, winner, mode_id, afk)
+
+    def stage_checked(e0: int, e1: int):
+        try:
+            return stage(e0, e1)
+        except Exception as e:
+            raise FeedStageError(e0, e1) from e
+
+    result: dict = {}
+
+    def emit_ready(put) -> bool:
+        """Emits every window the watermark covers; returns whether any
+        advanced. Windows wholly below ``start_step`` skip staging and
+        dispatch (resume); ``stop_after`` ends emission at the first
+        boundary at or past it (the bounded-run kill point)."""
+        nonlocal emitted
+        advanced = False
+        while watermark - emitted >= spc:
+            if stop_after is not None and emitted >= stop_after:
+                result["stopped"] = True
+                return advanced
+            e1 = emitted + spc
+            if e1 <= start_step:
+                emitted = e1
+            else:
+                put((emitted, e1, stage_checked(emitted, e1)))
+                emitted = e1
+            advanced = True
+        return advanced
+
+    def produce(put) -> None:
+        nonlocal emitted
+        while True:
+            done = assigner_done[0]  # read BEFORE consuming progress
+            scatter_new(int(progress[0]))
+            advanced = emit_ready(put)
+            if result.get("stopped"):
+                return
+            if done:
+                break
+            if not advanced:
+                with cv:
+                    if not assigner_done[0] and done_m == int(progress[0]):
+                        cv.wait(poll_interval)
+        front_thread.join()
+        if worker_err:
+            raise RuntimeError(
+                "streaming decode/assignment failed"
+            ) from worker_err[0]
+        scatter_new(int(progress[0]))
+        n_final = int(progress[0])
+        s_total = max(int(progress[1]), 1)
+        grow(s_total)
+        while emitted < s_total:
+            if stop_after is not None and emitted >= stop_after:
+                result["stopped"] = True
+                return
+            e1 = min(emitted + spc, s_total)
+            if e1 <= start_step:
+                emitted = e1
+                continue
+            put((emitted, e1, stage_checked(emitted, e1)))
+            emitted = e1
+        result["s_total"] = s_total
+        result["n"] = n_final
+
+    def admit() -> None:
+        """The dispatch-side admission gate: live backlog or busy host
+        telemetry pauses the consumer (and through ring backpressure,
+        the backfill's decode + H2D) until the controller opens a slot.
+        The controller never returns a zero quota on a drained live
+        plane, so the backfill cannot starve forever."""
+        if admission is None:
+            return
+        while True:
+            ready = int(live_backlog()) if live_backlog is not None else 0
+            if admission.quota(ready, 1) > 0:
+                return
+            reg.counter("migrate.throttled_total").add(1)
+            time.sleep(throttle_poll_s)
+
+    pending = None
+    fused_flat = [] if (fuse is not None and collect) else None
+    ttfd_s = None
+    try:
+        with Prefetcher(
+            produce, depth=prefetch_depth or DEFAULT_DEPTH,
+            name="migrate-feed",
+        ) as pf:
+            for e0, e1, staged in pf:
+                admit()
+                if ttfd_s is None:
+                    ttfd_s = time.perf_counter() - t_start
+                with tracer.span("batch.compute", cat="sched", start=e0):
+                    if fuse is not None:
+                        state, ys = _dispatch_fused_chunk(
+                            state, staged, cfg, collect, fuse.backend,
+                            tier=tier,
+                        )
+                        if fused_flat is not None:
+                            fused_flat.append(staged.flat)
+                    elif tier is not None:
+                        state, ys = tier.dispatch_chunk(
+                            state, staged, cfg, collect
+                        )
+                    else:
+                        state, ys = _scan_chunk(
+                            state, staged, cfg, collect, pad_row
+                        )
+                if collect:
+                    try:
+                        ys.copy_to_host_async()
+                    except AttributeError:  # pragma: no cover — older jax
+                        pass
+                    if pending is not None:
+                        with tracer.span("batch.fetch", cat="sched", start=e0):
+                            outs.append(fetch_tree(pending))
+                    pending = ys
+                del staged
+                if staging is not None:
+                    if tier is not None:
+                        tier.maybe_publish_view(staging, state.table)
+                    else:
+                        staging.maybe_publish_state(state)
+                if on_chunk is not None:
+                    on_chunk(
+                        tier.full_state(state.table) if tier is not None
+                        else state, e1,
+                    )
+                reg.counter("migrate.steps_total").add(e1 - e0)
+                reg.counter("migrate.windows_total").add(1)
+                prog.note_dispatched(e1, 0)
+                total = int(progress[1])
+                if assigner_done[0] and total:
+                    prog.set_total_steps(total)
+                maybe_sample_device_memory()
+    finally:
+        stop_flag[0] = True
+        with cv:
+            cv.notify_all()
+        front_thread.join()
+    if pending is not None:
+        with tracer.span("batch.fetch", cat="sched", start=emitted):
+            outs.append(fetch_tree(pending))
+
+    stopped = bool(result.get("stopped"))
+    n_final = result.get("n", int(progress[0]))
+    s_total = result.get("s_total", emitted)
+    if not stopped:
+        reg.counter("migrate.matches_total").add(n_final)
+    if s_total:
+        prog.set_total_steps(s_total)
+    if tier is not None:
+        state = tier.finish(state.table)
+    if staging is not None and not stopped:
+        prog.note_publishing()
+        staging.publish_state(state, ids=ids)
+    occupancy = n_final / (s_total * b) if s_total else 0.0
+    if stats_out is not None:
+        stats_out.update(
+            n_steps=s_total,
+            batch_size=b,
+            occupancy=occupancy,
+            matches=n_final,
+            streamed=True,
+            stopped=stopped,
+            emitted_steps=emitted,
+            ttfd_s=ttfd_s,
+            fingerprint=fingerprint,
+            window_rows=window_rows,
+        )
+    if stopped:
+        # A bounded run's partial state: usable only through the
+        # checkpoint the caller's on_chunk took at the stop boundary.
+        prog.note_dispatched(emitted, 0)
+        return state, None
+    prog.finish()
+    if not collect:
+        return state, None
+    if fused_flat is not None:
+        flat_idx = (
+            np.concatenate(fused_flat).reshape(-1)
+            if fused_flat else np.empty(0, np.int32)
+        )
+    else:
+        flat_idx = slot_map[: s_total * b]
+    return state, _gather_outputs(outs, flat_idx, n_final, team)
+
+
+@dataclasses.dataclass
+class MigrationReport:
+    """One migration run's outcome (``run_migration``)."""
+
+    state: object
+    outputs: object
+    stats: dict
+    view: object = None
+    cutover_pause_ms: float | None = None
+    finished: bool = True
+
+
+def run_migration(
+    state,
+    data: bytes,
+    cfg,
+    lineage=None,
+    ids=None,
+    checkpoint: str | None = None,
+    resume: bool = False,
+    checkpoint_every: int | None = None,
+    stop_after: int | None = None,
+    do_cutover: bool = True,
+    **engine_kw,
+) -> MigrationReport:
+    """The orchestrated migration: checkpoint/resume glue around
+    :func:`rate_backfill`, staging-lineage publish, and the atomic
+    cutover (``cli migrate``'s core, reused by the soak and the bench).
+
+    ``lineage`` is a :class:`~analyzer_tpu.migrate.lineage.
+    LineageManager` over the LIVE plane's publisher; ``begin`` runs
+    here, the backfill publishes into the staging lineage, and — when
+    the run finished and ``do_cutover`` — traffic cuts over atomically.
+    A bounded (``stop_after``) or failed run never touches the live
+    lineage (the staging lineage is simply dropped); the checkpoint
+    written at the stop boundary is the resume point.
+    """
+    from analyzer_tpu.io.checkpoint import (
+        CheckpointWriter,
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    prog = get_migration_progress()
+    start_step = 0
+    expected_fp = None
+    if resume:
+        if not checkpoint:
+            raise ValueError("resume=True requires a checkpoint path")
+        ck = load_checkpoint(checkpoint)
+        state = ck.state
+        start_step = ck.step_cursor
+        expected_fp = ck.schedule_fingerprint
+    staging = None
+    if lineage is not None:
+        staging = lineage.begin()
+    writer = (
+        CheckpointWriter(checkpoint)
+        if checkpoint and (checkpoint_every or stop_after is not None)
+        else None
+    )
+    fp_holder: dict = {}
+    last_saved = [start_step]
+
+    def on_chunk(st, next_step):
+        if writer is None:
+            return
+        due = (
+            checkpoint_every is not None
+            and next_step - last_saved[0] >= checkpoint_every
+        )
+        at_stop = stop_after is not None and next_step >= stop_after
+        if not (due or at_stop):
+            return
+        last_saved[0] = next_step
+        writer.save(
+            st, cursor=0, step_cursor=next_step,
+            schedule_fingerprint=fp_holder.get("fingerprint"),
+        )
+
+    stats: dict = {}
+    try:
+        final_state, outputs = rate_backfill(
+            state, data, cfg,
+            staging=staging, ids=ids,
+            start_step=start_step, stop_after=stop_after,
+            expected_fingerprint=expected_fp,
+            fingerprint_out=fp_holder,
+            on_chunk=on_chunk if writer is not None else None,
+            stats_out=stats,
+            **engine_kw,
+        )
+    except BaseException as e:
+        prog.fail(repr(e))
+        if lineage is not None:
+            lineage.abort()
+        raise
+    finally:
+        if writer is not None:
+            writer.close()
+    finished = not stats.get("stopped", False)
+    if checkpoint and finished:
+        save_checkpoint(
+            checkpoint, final_state, cursor=stats.get("matches", 0),
+            step_cursor=0,
+            schedule_fingerprint=fp_holder.get("fingerprint"),
+        )
+    view = None
+    pause_ms = None
+    if lineage is not None:
+        if finished and do_cutover:
+            view = lineage.cutover()
+            pause_ms = round((lineage.cutover_pause_s or 0.0) * 1e3, 3)
+        elif not finished:
+            lineage.abort()
+    return MigrationReport(
+        state=final_state, outputs=outputs, stats=stats, view=view,
+        cutover_pause_ms=pause_ms, finished=finished,
+    )

@@ -146,6 +146,40 @@ def bench_configs(data: dict) -> list[BenchConfig]:
                 )
             )
         return out
+    if str(data["metric"]).startswith("migrate."):
+        # Migrate family (``MIGRATE_BENCH_*``, metric
+        # ``migrate.matches_per_sec``): backfill throughput under live
+        # serve load (higher), the live plane's client-observed p99
+        # DURING the migration (lower — the whole point of the
+        # admission-arbitrated backfill is that this number holds), and
+        # the cutover pause (lower — readers must never notice the
+        # swap). A candidate that silently fell back to the offline
+        # (non-streamed) re-rate drops ``migrate.streamed`` — the
+        # --family migrate gate in ``cli benchdiff`` fails that outright
+        # rather than diffing a different engine's numbers.
+        migrate = data.get("migrate") or {}
+        m_degraded = degraded or not migrate.get("stable", True)
+        out[0] = dataclasses.replace(out[0], degraded=m_degraded)
+        latency = data.get("latency_ms") or {}
+        if latency.get("p99") is not None:
+            out.append(
+                BenchConfig(
+                    name="migrate.live_p99_ms",
+                    value=float(latency["p99"]),
+                    higher_is_better=False,
+                    degraded=m_degraded,
+                )
+            )
+        if migrate.get("cutover_pause_ms") is not None:
+            out.append(
+                BenchConfig(
+                    name="migrate.cutover_pause_ms",
+                    value=float(migrate["cutover_pause_ms"]),
+                    higher_is_better=False,
+                    degraded=m_degraded,
+                )
+            )
+        return out
     if str(data["metric"]).startswith("serve."):
         latency = data.get("latency_ms") or {}
         if latency.get("p99") is not None:
@@ -299,6 +333,7 @@ FAMILIES = {
     "tiered": "BENCH",
     "soak": "SOAK",
     "ingest": "INGEST_BENCH",
+    "migrate": "MIGRATE_BENCH",
 }
 
 
@@ -318,6 +353,8 @@ def family_configs(
         return [c for c in configs if c.name.startswith("soak.")]
     if family == "ingest":
         return [c for c in configs if c.name.startswith("ingest.")]
+    if family == "migrate":
+        return [c for c in configs if c.name.startswith("migrate.")]
     return configs
 
 

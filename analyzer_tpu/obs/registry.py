@@ -269,6 +269,24 @@ STANDARD_COUNTERS = (
     "audit.sampled_total",
     "audit.checked_total",
     "audit.mismatches_total",
+    # The zero-downtime migration engine (analyzer_tpu/migrate,
+    # docs/migration.md): supersteps/windows/matches the backfill
+    # dispatched (migrate.steps_total feeds the /statusz ETA through
+    # the history rings), dispatch pauses the admission controller
+    # imposed for live headroom, engine fall-backs to the non-streamed
+    # path (the benchdiff migrate family's vanished-block gate), resumed
+    # runs, and atomic lineage cutovers (mirrored by the serve-plane
+    # counter below). Pre-declared so "no migration ran" reads 0.
+    "migrate.steps_total",
+    "migrate.windows_total",
+    "migrate.matches_total",
+    "migrate.throttled_total",
+    "migrate.fallbacks_total",
+    "migrate.resumes_total",
+    "migrate.cutovers_total",
+    # Dual-lineage cutovers performed by the serve plane (serve/view.py
+    # cutover_from — the designated entry graftlint GL033 pins).
+    "serve.view_cutovers_total",
 )
 STANDARD_GAUGES = (
     "worker.pipeline_lag",
@@ -320,6 +338,13 @@ STANDARD_GAUGES = (
     "slo.burning",
     "slo.state",
     "audit.backlog",
+    # The migration engine's live progress (analyzer_tpu/migrate):
+    # whether a backfill is running, its dispatched-superstep watermark,
+    # and the total once the assigner finished (0 until known) — the
+    # /statusz progress-% pair.
+    "migrate.active",
+    "migrate.watermark_steps",
+    "migrate.total_steps",
 )
 
 #: Histogram families the runtime emits (graftlint GL030 resolves

@@ -195,6 +195,10 @@ class ViewPublisher:
         self._version = 0
         self.min_publish_interval_s = min_publish_interval_s
         self._last_publish: float | None = None
+        # Set by a cutover CONSUMING this publisher as a staging lineage:
+        # its buffers were adopted by the live lineage, so further
+        # publishes here would tear the adopted state (_swap refuses).
+        self._retired = False
 
     # -- read side --------------------------------------------------------
     def current(self) -> RatingsView | None:
@@ -395,6 +399,39 @@ class ViewPublisher:
             self.publish_rows(page, rows)
         return len(pages)
 
+    def cutover_from(self, staging: "ViewPublisher") -> RatingsView:
+        """THE dual-lineage cutover entry (docs/migration.md): adopts the
+        ``staging`` publisher's latest view as this (live) lineage's next
+        version — one ``_swap`` under the live writer lock, the staging
+        lineage's device table reused BY REFERENCE (zero H2D). Readers
+        resolving ``current()`` observe a monotone version sequence with
+        no torn or missing view: they serve the old lineage until the
+        single reference assignment inside ``_swap``, and the new view's
+        table is the staging lineage's immutable published buffer.
+
+        The staging publisher is CONSUMED: its id map and staging buffer
+        transfer to the live lineage (so later live publishes — merge or
+        table mode — continue from the migrated state), and it is marked
+        retired; any further publish into it raises instead of tearing
+        the adopted buffers. The two publisher locks are taken
+        SEQUENTIALLY (staging snapshot first, then the live swap), never
+        nested — no ordering hazard. graftlint GL033 pins this as the
+        ONLY path by which backfill code may reach a live lineage."""
+        with staging._lock:
+            view = staging._view
+            if view is None:
+                raise ValueError(
+                    "staging lineage has no published view to cut over to"
+                )
+            row_of, ids, buf = staging._row_of, staging._ids, staging._staging
+            staging._retired = True
+        with self._lock:
+            self._row_of = row_of
+            self._ids = ids
+            self._staging = buf
+            get_registry().counter("serve.view_cutovers_total").add(1)
+            return self._swap(view.table, view.n_players)
+
     def _grow(self, alloc: int) -> None:
         if alloc + 1 <= self._staging.shape[0]:
             return
@@ -405,6 +442,12 @@ class ViewPublisher:
     def _swap(self, table, n_players: int) -> RatingsView:
         """Builds the next version and swaps the reference (the one
         atomic publication point). Caller holds the writer lock."""
+        if self._retired:
+            raise RuntimeError(
+                "publisher was retired by a lineage cutover (its buffers "
+                "now back the live lineage); publish into the live "
+                "publisher instead"
+            )
         self._version += 1
         view = RatingsView(
             self._version, table, n_players, self._row_of, self._ids
@@ -540,6 +583,7 @@ class ShardedViewPublisher:
         self._version = 0
         self.min_publish_interval_s = min_publish_interval_s
         self._last_publish: float | None = None
+        self._retired = False  # see ViewPublisher: consumed by a cutover
 
     # -- read side --------------------------------------------------------
     def current(self) -> ShardedRatingsView | None:
@@ -755,6 +799,39 @@ class ShardedViewPublisher:
             self.publish_rows(page, rows)
         return len(pages)
 
+    def cutover_from(self, staging: "ShardedViewPublisher") -> ShardedRatingsView:
+        """The sharded mirror of :meth:`ViewPublisher.cutover_from`: all
+        ``S`` per-shard tables of the staging lineage's latest view are
+        adopted by reference under ONE new version, so a reader can never
+        mix pre- and post-cutover shards (the single-reference contract
+        of :class:`ShardedRatingsView`). Topologies must match — a
+        cross-shard-count cutover would need a re-split, which is a
+        ``publish_state`` of the migrated table, not a reference swap."""
+        if staging.n_shards != self.n_shards:
+            raise ValueError(
+                f"cannot cut over a {staging.n_shards}-shard staging "
+                f"lineage into a {self.n_shards}-shard live plane; "
+                "publish_state the migrated table instead"
+            )
+        with staging._lock:
+            view = staging._view
+            if view is None:
+                raise ValueError(
+                    "staging lineage has no published view to cut over to"
+                )
+            row_of, ids = staging._row_of, staging._ids
+            bufs, alloc = staging._staging, staging._local_alloc
+            staging._retired = True
+        with self._lock:
+            self._row_of = row_of
+            self._ids = ids
+            self._staging = bufs
+            self._local_alloc = alloc
+            get_registry().counter("serve.view_cutovers_total").add(1)
+            return self._swap(
+                [shard.table for shard in view.shards], view.n_players
+            )
+
     # -- internals --------------------------------------------------------
     def _device_of(self, d: int):
         if self._devices is None:
@@ -794,6 +871,12 @@ class ShardedViewPublisher:
     def _swap(self, tables, n_players: int) -> ShardedRatingsView:
         """Builds the next version — ALL shards under one number — and
         swaps the single reference. Caller holds the writer lock."""
+        if self._retired:
+            raise RuntimeError(
+                "publisher was retired by a lineage cutover (its buffers "
+                "now back the live lineage); publish into the live "
+                "publisher instead"
+            )
         self._version += 1
         shards = [
             RatingsView(

@@ -347,6 +347,243 @@ class PartitionedBroker:
         }
 
 
+def physical_queue(queue: str, partition: int, lane: str) -> str:
+    """The partition x lane -> physical AMQP queue naming contract
+    (docs/ingest.md "Partition math"): logical queue ``q`` with ``P``
+    partitions and priority lanes maps onto ``q.p<k>.{live,backfill}``
+    physical queues. The in-memory :class:`PartitionedBroker` documents
+    the delivery semantics this layout must reproduce; the adapter that
+    reproduces them over any real broker is
+    :class:`AmqpPartitionedBroker`."""
+    return f"{queue}.p{partition}.{lane}"
+
+
+class AmqpPartitionedBroker:
+    """:class:`PartitionedBroker`'s layout mapped onto PHYSICAL queues of
+    an underlying broker — the backfill lane on a real AMQP server.
+
+    ``base`` is any :class:`Broker` (the pika adapter in production; an
+    :class:`InMemoryBroker` standing in for the AMQP server under test —
+    the stub-backed parity suite, tests/test_migrate.py). Every logical
+    queue becomes ``partitions x 2`` physical queues named by
+    :func:`physical_queue`; publish routes by :func:`partition_of` and
+    the ``x-lane`` header and stamps a per-logical-queue ``x-seq``
+    header, and ``get`` k-way-merges the partition heads by that seq —
+    live lane first, backfill admitted behind it by the
+    :class:`AdmissionController`, exactly the in-memory contract.
+
+    Two honest deviations from the in-memory broker, both inherent to a
+    real server: (1) the seq merge is exact over messages the server has
+    DELIVERED — a partition whose smaller-seq message is still in
+    network flight can be overtaken within one poll (at-least-once
+    consumers already tolerate reordering at that granularity); (2)
+    ``x-seq`` is stamped per publishing process — multiple publishers
+    interleave by arrival, like any AMQP fan-in. Messages with no
+    ``x-seq`` (a foreign publisher) merge by arrival order.
+
+    Delivery tags are the base broker's own, so ack/nack/redelivery
+    semantics — including the pika adapter's reconnect discipline —
+    pass straight through.
+    """
+
+    def __init__(
+        self,
+        base,
+        partitions: int = 1,
+        lanes: bool = False,
+        admission: AdmissionController | None = None,
+    ) -> None:
+        if partitions < 1:
+            raise ValueError(f"partitions must be >= 1, got {partitions}")
+        self.base = base
+        self.partitions = int(partitions)
+        self.lanes = bool(lanes)
+        self.admission = admission or (AdmissionController() if lanes else None)
+        self._declared: set[str] = set()
+        self._seq: dict[str, itertools.count] = {}
+        self._arrival = itertools.count(1 << 60)  # foreign-publisher order
+        # (logical queue, partition, lane) -> locally buffered heads
+        # (pulled from the base broker, not yet merged out).
+        self._heads: dict[tuple, deque[Message]] = {}
+        reg = get_registry()
+        reg.gauge("broker.partitions").set(self.partitions)
+        self._admitted = reg.counter("broker.backfill_admitted_total")
+        self._throttled = reg.counter("broker.backfill_throttled_total")
+
+    def _lanes_of(self) -> tuple:
+        return _LANES if self.lanes else (LANE_LIVE,)
+
+    def declare_queue(self, name: str) -> None:
+        if name in self._declared:
+            return
+        self._declared.add(name)
+        self._seq.setdefault(name, itertools.count())
+        for p in range(self.partitions):
+            for lane in _LANES:
+                # Both lanes always exist physically: a backfill
+                # publisher must never race queue creation mid-migration.
+                self.base.declare_queue(physical_queue(name, p, lane))
+
+    def publish(self, queue: str, body: bytes, headers: dict | None = None) -> None:
+        self.declare_queue(queue)
+        h = dict(headers or {})
+        lane = h.get("x-lane", LANE_LIVE) if self.lanes else LANE_LIVE
+        if lane not in _LANES:
+            lane = LANE_LIVE
+        p = partition_of(body, h, self.partitions)
+        h["x-seq"] = next(self._seq[queue])
+        self.base.publish(physical_queue(queue, p, lane), body, headers=h)
+
+    def publish_topic(self, exchange: str, routing_key: str, body: bytes) -> None:
+        self.base.publish_topic(exchange, routing_key, body)
+
+    def _head(self, queue: str, p: int, lane: str) -> deque:
+        return self._heads.setdefault((queue, p, lane), deque())
+
+    def _pull(self, queue: str, lane: str, limit: int) -> None:
+        """Tops up each partition's local head buffer from the base
+        broker so the merge can see every partition's frontier. Each
+        buffer is kept seq-sorted: a nacked-with-requeue message
+        re-enters at the BASE queue's head, so a later pull can hand it
+        back while larger-seq messages already sit buffered — the sort
+        restores the per-partition ascending order the k-way merge
+        assumes (a redelivery outranks everything published after it,
+        the in-memory broker's contract)."""
+        for p in range(self.partitions):
+            buf = self._head(queue, p, lane)
+            want = limit - len(buf)
+            if want > 0:
+                got = self.base.get(physical_queue(queue, p, lane), want)
+                if got:
+                    buf.extend(got)
+                    if len(buf) > len(got) or len(got) > 1:
+                        ordered = sorted(buf, key=self._seq_of)
+                        buf.clear()
+                        buf.extend(ordered)
+
+    def _seq_of(self, msg: Message) -> int:
+        seq = (msg.headers or {}).get("x-seq")
+        if seq is None:
+            # Foreign publisher: assign (and STAMP — the number must be
+            # stable across repeated sorts/merges) an arrival-order seq.
+            seq = next(self._arrival)
+            if msg.headers is None:
+                msg.headers = {}
+            msg.headers["x-seq"] = seq
+        return int(seq)
+
+    def _pop_merged(self, queue: str, lane: str, limit: int, out: list) -> None:
+        """Moves up to ``limit - len(out)`` buffered messages of ``lane``
+        into ``out`` in global x-seq order (smallest head across the
+        partitions first) — the in-memory broker's merge, over the
+        heads the server has delivered."""
+        self._pull(queue, lane, limit)
+        while len(out) < limit:
+            best = None
+            best_seq = None
+            for p in range(self.partitions):
+                buf = self._heads.get((queue, p, lane))
+                if not buf:
+                    continue
+                seq = self._seq_of(buf[0])
+                if best_seq is None or seq < best_seq:
+                    best, best_seq = p, seq
+            if best is None:
+                return
+            out.append(self._heads[(queue, best, lane)].popleft())
+
+    def get(self, queue: str, limit: int) -> list[Message]:
+        self.declare_queue(queue)
+        out: list[Message] = []
+        self._pop_merged(queue, LANE_LIVE, limit, out)
+        room = limit - len(out)
+        if self.lanes and room > 0:
+            live_left = self.lane_size(queue, LANE_LIVE)
+            quota = (
+                self.admission.quota(live_left, room)
+                if self.admission is not None else room
+            )
+            quota = min(quota, room)
+            before = len(out)
+            self._pop_merged(queue, LANE_BACKFILL, before + quota, out)
+            admitted = len(out) - before
+            if admitted:
+                self._admitted.add(admitted)
+            waiting = self.lane_size(queue, LANE_BACKFILL)
+            if waiting and quota < room:
+                self._throttled.add(min(waiting, room - quota))
+        return out
+
+    def ack(self, delivery_tag: int) -> None:
+        self.base.ack(delivery_tag)
+
+    def nack(self, delivery_tag: int, requeue: bool = False) -> None:
+        self.base.nack(delivery_tag, requeue=requeue)
+
+    def requeue_unacked(self) -> None:
+        """Crash simulation passthrough (stub-backed tests); a real AMQP
+        base redelivers on channel death instead."""
+        requeue = getattr(self.base, "requeue_unacked", None)
+        if requeue is not None:
+            requeue()
+
+    def set_prefetch(self, prefetch: int) -> None:
+        set_prefetch = getattr(self.base, "set_prefetch", None)
+        if set_prefetch is not None:
+            set_prefetch(int(prefetch))
+
+    def lane_size(self, queue: str, lane: str) -> int:
+        """Ready depth of one lane across every partition: the base
+        broker's per-physical-queue depth plus locally buffered heads."""
+        total = 0
+        for p in range(self.partitions):
+            total += self.base.qsize(physical_queue(queue, p, lane))
+            total += len(self._heads.get((queue, p, lane), ()))
+        return total
+
+    def qsize(self, queue: str) -> int:
+        """Aggregate ready depth across partitions and lanes — the same
+        single number a one-queue broker reports (worker gauge, soak
+        sampler)."""
+        return sum(self.lane_size(queue, lane) for lane in _LANES)
+
+    def partition_depths(self, queue: str) -> dict[int, dict[str, int]]:
+        """Per-partition, per-lane ready depths — the /statusz skew
+        surface, same shape as :meth:`PartitionedBroker.partition_depths`."""
+        if queue not in self._declared:
+            return {}
+        return {
+            p: {
+                lane: (
+                    self.base.qsize(physical_queue(queue, p, lane))
+                    + len(self._heads.get((queue, p, lane), ()))
+                )
+                for lane in _LANES
+            }
+            for p in range(self.partitions)
+        }
+
+
+def make_partitioned_pika_broker(
+    uri: str,
+    partitions: int = 1,
+    lanes: bool = False,
+    prefetch: int = 0,
+    admission: AdmissionController | None = None,
+):
+    """The production composition: :class:`AmqpPartitionedBroker` over
+    the pika adapter — ``<queue>.p<k>.{live,backfill}`` physical queues
+    on a real RabbitMQ, with the in-memory broker's partition/lane
+    delivery contract. Raises ImportError when pika is absent, like
+    :func:`make_pika_broker`."""
+    return AmqpPartitionedBroker(
+        make_pika_broker(uri, prefetch=prefetch),
+        partitions=partitions,
+        lanes=lanes,
+        admission=admission,
+    )
+
+
 def make_pika_broker(uri: str, prefetch: int = 0):
     """RabbitMQ adapter; raises ImportError when pika is absent.
 

@@ -1229,6 +1229,11 @@ class Worker:
                 self.query_engine.stats()
                 if self.query_engine is not None else None
             ),
+            # The migration block (ROADMAP item 4's "progress exposed on
+            # /statusz"): None until a backfill has run in this process,
+            # else phase, lineage versions, watermark/progress % and the
+            # history-ring-derived ETA (analyzer_tpu/migrate/progress.py).
+            "migration": self._migration_block(),
             # The live SLO plane's digest (None when slo_plane=False):
             # what's burning, plus the shadow audit's counters when
             # auditing is on — /sloz and /historyz carry the detail.
@@ -1244,6 +1249,17 @@ class Worker:
                 if self.watchdog is not None else None
             ),
         }
+
+    def _migration_block(self) -> dict | None:
+        """The ``stats()['migration']`` block: the process-wide migration
+        progress record, with the ETA derived from THIS worker's history
+        rings and clock (virtual under the soak). None when no migration
+        has run — scrapers key on presence, not on worker flavor."""
+        from analyzer_tpu.migrate.progress import get_migration_progress
+
+        return get_migration_progress().snapshot(
+            history=self.history, now=self.clock()
+        )
 
     @property
     def pipeline_degraded(self) -> bool:

@@ -141,6 +141,8 @@ def main(metrics_out: str | None = None, obs_port: int | None = None) -> None:
     try:
         if os.environ.get("BENCH_INGEST") == "1":
             _bench_ingest_main(metrics_out)
+        elif os.environ.get("BENCH_MIGRATE") == "1":
+            _bench_migrate_main(metrics_out)
         else:
             _bench_main(metrics_out)
     finally:
@@ -430,6 +432,176 @@ def _bench_main(metrics_out: str | None) -> None:
         trace_overhead=trace_overhead,
         watchdog_overhead=watchdog_overhead,
     )
+
+
+def _bench_migrate_main(metrics_out: str | None) -> None:
+    """The zero-downtime migration capture (BENCH_MIGRATE=1;
+    docs/migration.md): the streamed backfill engine re-rates a CSV
+    history into a staging lineage while a live serve plane answers
+    queries from the main thread, then traffic cuts over atomically.
+    Emits the ``MIGRATE_BENCH_*`` artifact ``cli benchdiff --family
+    migrate`` gates: backfill matches/s (headline), the live plane's
+    client-observed p99 DURING the migration, and the cutover pause.
+    A run whose engine silently fell back to the offline (non-streamed)
+    re-rate reports ``migrate.streamed: false`` — the gate fails that
+    outright.
+
+    Knobs: BENCH_MIGRATE_MATCHES (default 50k), BENCH_MIGRATE_PLAYERS
+    (default matches//3), BENCH_MIGRATE_WINDOW (decode window rows,
+    default 4096), BENCH_REPEATS (default 3)."""
+    import tempfile
+    import threading
+
+    from analyzer_tpu.config import RatingConfig
+    from analyzer_tpu.core.state import PlayerState
+    from analyzer_tpu.io.csv_codec import save_stream_csv
+    from analyzer_tpu.io.ingest import decode_stream_csv
+    from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
+    from analyzer_tpu.migrate import LineageManager, rate_backfill
+    from analyzer_tpu.obs import install_jax_hooks
+    from analyzer_tpu.sched.feed import get_arena
+    from analyzer_tpu.sched.runner import rate_stream
+    from analyzer_tpu.serve import QueryEngine, ViewPublisher
+
+    install_jax_hooks()
+    n_matches = int(os.environ.get("BENCH_MIGRATE_MATCHES", 50_000))
+    n_players = int(
+        os.environ.get("BENCH_MIGRATE_PLAYERS", max(n_matches // 3, 100))
+    )
+    window_rows = int(os.environ.get("BENCH_MIGRATE_WINDOW", 4096))
+    repeats = int(os.environ.get("BENCH_REPEATS", 3))
+    cfg = RatingConfig()
+
+    t0 = time.perf_counter()
+    players = synthetic_players(n_players, seed=42)
+    stream = synthetic_stream(
+        n_matches, players, seed=42, max_activity_share=1e-4
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "migrate_bench.csv")
+        save_stream_csv(path, stream)
+        with open(path, "rb") as f:
+            data = f.read()
+    log(f"generate+write: {time.perf_counter() - t0:.2f}s -> "
+        f"{len(data)} CSV bytes, {n_matches} matches")
+
+    state0 = PlayerState.create(n_players, cfg=cfg)
+    live = ViewPublisher()
+    live.publish_state(state0)
+    engine = QueryEngine(live, cfg=cfg)  # inline mode: caller-thread p99
+    engine.warmup(live.current())
+
+    # From-scratch (non-streamed) reference for the bit-identity report.
+    dec = decode_stream_csv(data)
+    streamed_possible = dec is not None
+    ref_table = None
+    if streamed_possible:
+        t0 = time.perf_counter()
+        ref, _ = rate_stream(state0, dec, cfg)
+        ref_table = np.asarray(ref.table)
+        log(f"non-streamed reference re-rate: {time.perf_counter() - t0:.2f}s")
+
+    # Idle-baseline serve latency (context next to the under-migration
+    # p99 the family gates).
+    idle_lat = []
+    ids = [str(i) for i in range(0, min(n_players, 64), 8)]
+    for _ in range(200):
+        t = time.perf_counter()
+        engine.get_ratings(ids[:8])
+        idle_lat.append((time.perf_counter() - t) * 1e3)
+    idle_p99 = float(np.percentile(np.asarray(idle_lat), 99))
+
+    # Warmup migration (compiles the engine's scan ladder).
+    warm_staging = ViewPublisher()
+    rate_backfill(
+        state0, data, cfg, staging=warm_staging, window_rows=window_rows
+    )
+
+    times: list[float] = []
+    lat_ms: list[float] = []
+    cutover_ms: list[float] = []
+    ttfd: list[float] = []
+    bit_identical = True
+    streamed = False
+    for r in range(repeats):
+        lineage = LineageManager(live)
+        staging = lineage.begin()
+        stats: dict = {}
+        done = threading.Event()
+        box: dict = {}
+
+        def run_backfill(staging=staging, stats=stats, box=box, done=done):
+            try:
+                final, _ = rate_backfill(
+                    state0, data, cfg, staging=staging,
+                    window_rows=window_rows, stats_out=stats,
+                )
+                box["table"] = np.asarray(final.table)
+            except BaseException as e:  # noqa: BLE001 — reported below
+                box["error"] = e
+            finally:
+                done.set()
+
+        t0 = time.perf_counter()
+        th = threading.Thread(target=run_backfill, daemon=True)
+        th.start()
+        while not done.is_set():
+            t = time.perf_counter()
+            engine.get_ratings(ids[:8])
+            lat_ms.append((time.perf_counter() - t) * 1e3)
+            time.sleep(0.001)
+        th.join()
+        wall = time.perf_counter() - t0
+        if "error" in box:
+            raise box["error"]
+        times.append(wall)
+        if stats.get("ttfd_s") is not None:
+            ttfd.append(stats["ttfd_s"])
+        if ref_table is not None and not np.array_equal(
+            box["table"], ref_table, equal_nan=True
+        ):
+            bit_identical = False
+        view = lineage.cutover()
+        cutover_ms.append((lineage.cutover_pause_s or 0.0) * 1e3)
+        log(f"repeat {r}: {wall:.3f}s ({n_matches / wall:.0f} matches/s), "
+            f"cutover {cutover_ms[-1]:.3f} ms, live v{view.version}")
+        streamed = bool(stats.get("streamed"))
+
+    best = min(times)
+    stable = _tail_stable(times, repeats)
+    lat = np.asarray(lat_ms, np.float64)
+    latency_ms = {
+        k: round(float(np.percentile(lat, q)), 3) if lat.size else None
+        for k, q in (("p50", 50), ("p90", 90), ("p99", 99))
+    }
+    line = {
+        "metric": "migrate.matches_per_sec",
+        "value": round(n_matches / best, 1),
+        "unit": "matches/s",
+        "latency_ms": latency_ms,
+        "migrate": {
+            "streamed": streamed and streamed_possible,
+            "matches": n_matches,
+            "players": n_players,
+            "window_rows": window_rows,
+            "csv_bytes": len(data),
+            "repeats_s": [round(t, 4) for t in times],
+            "stable": stable,
+            "bit_identical": bit_identical if ref_table is not None else None,
+            "ttfd_s": round(min(ttfd), 4) if ttfd else None,
+            "cutover_pause_ms": round(min(cutover_ms), 3),
+            "idle_p99_ms": round(idle_p99, 3),
+            "queries_during_migration": len(lat_ms),
+        },
+        "arena": get_arena().stats(),
+        "capture": {"degraded": not stable},
+    }
+    if metrics_out:
+        from analyzer_tpu.obs import write_snapshot
+
+        write_snapshot(metrics_out)
+        log(f"wrote metrics snapshot to {metrics_out}")
+    print(json.dumps(line))
 
 
 def _bench_ingest_main(metrics_out: str | None) -> None:

@@ -1620,3 +1620,93 @@ class TestGL032SloPlane:
         from analyzer_tpu.lint.findings import RULES
 
         assert "GL032" in RULES
+
+
+class TestGL033MigrationLineage:
+    """GL033 makes the dual-lineage discipline structural: inside
+    analyzer_tpu/migrate/, view publishes may target only staging-named
+    lineages, cutover_from is callable only inside the designated
+    ``cutover`` entry, and mutable publisher internals (._view/._staging)
+    are untouchable — a torn migration is a silent correctness bug."""
+
+    LIVE_PUBLISH_SRC = """
+    def backfill(live, state):
+        live.publish_state(state)
+    """
+
+    STAGING_PUBLISH_SRC = """
+    def backfill(staging, state):
+        staging.publish_state(state)
+        staging.maybe_publish_state(state)
+    """
+
+    def test_live_publish_fires_in_migrate_only(self):
+        assert rules_of(
+            self.LIVE_PUBLISH_SRC, "analyzer_tpu/migrate/engine.py"
+        ) == ["GL033"]
+        for path in (
+            "analyzer_tpu/service/worker.py",
+            "analyzer_tpu/loadgen/driver.py",
+            "tests/test_migrate.py",
+        ):
+            assert "GL033" not in rules_of(self.LIVE_PUBLISH_SRC, path), path
+
+    def test_staging_named_receivers_clean(self):
+        assert rules_of(
+            self.STAGING_PUBLISH_SRC, "analyzer_tpu/migrate/engine.py"
+        ) == []
+
+    def test_attribute_chain_receiver_resolves(self):
+        src = """
+        def go(lineage, state):
+            lineage.staging.publish_rows(["a"], state)   # staging: ok
+            lineage.live.publish_rows(["a"], state)      # live: flagged
+        """
+        assert rules_of(src, "analyzer_tpu/migrate/engine.py") == ["GL033"]
+
+    def test_every_publish_method_polices(self):
+        src = """
+        def go(live, x):
+            live.publish_rows(["a"], x)
+            live.publish_state(x)
+            live.publish_state_patch([0], x, 1, lambda: x)
+            live.publish_shard_patches([], 1, lambda: [])
+            live.maybe_publish_state(x)
+            live.warm_patch_buckets(64)
+        """
+        assert rules_of(
+            src, "analyzer_tpu/migrate/engine.py"
+        ) == ["GL033"] * 6
+
+    def test_cutover_from_only_inside_cutover_entry(self):
+        bad = """
+        def swap(live, staging):
+            return live.cutover_from(staging)
+        """
+        good = """
+        def cutover(live, staging):
+            return live.cutover_from(staging)
+        """
+        assert rules_of(bad, "analyzer_tpu/migrate/lineage.py") == ["GL033"]
+        assert rules_of(good, "analyzer_tpu/migrate/lineage.py") == []
+
+    def test_mutable_internals_read_fires(self):
+        src = """
+        def peek(live):
+            return live._view, live._staging
+        """
+        assert rules_of(
+            src, "analyzer_tpu/migrate/engine.py"
+        ) == ["GL033"] * 2
+        assert rules_of(src, "analyzer_tpu/serve/view.py") == []
+
+    def test_shipping_migrate_package_is_clean(self):
+        pkg = os.path.join(_REPO, "analyzer_tpu", "migrate")
+        findings, errors = lint_paths([pkg])
+        assert errors == []
+        assert [f.rule for f in findings] == []
+
+    def test_catalog_has_gl033(self):
+        from analyzer_tpu.lint.findings import RULES
+
+        assert "GL033" in RULES

@@ -1,0 +1,786 @@
+"""Zero-downtime global re-rate (docs/migration.md): the streaming
+decode->assign->scan backfill engine's bit-identity and overlap
+contracts, checkpoint/resume, the dual-lineage cutover's atomicity and
+version monotonicity, the AMQP partition x lane queue mapping, the soak
+--migrate judge, and the benchdiff ``migrate`` family."""
+
+import json
+import os
+import tempfile
+import threading
+
+import numpy as np
+import pytest
+
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.core.state import PlayerState
+from analyzer_tpu.io.csv_codec import save_stream_csv
+from analyzer_tpu.io.ingest import ColumnarDecoder, decode_stream_csv
+from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
+from analyzer_tpu.migrate import (
+    IncrementalAssigner,
+    LineageManager,
+    migration_fingerprint,
+    rate_backfill,
+    run_migration,
+)
+from analyzer_tpu.migrate.progress import reset_migration_progress
+from analyzer_tpu.obs import get_registry
+from analyzer_tpu.sched.feed import PinnedArena
+from analyzer_tpu.sched.runner import rate_stream
+from analyzer_tpu.sched.superstep import MatchStream, assign_batches
+from analyzer_tpu.serve import ShardedViewPublisher, ViewPublisher
+from analyzer_tpu.service.broker import (
+    AdmissionController,
+    AmqpPartitionedBroker,
+    InMemoryBroker,
+    LANE_BACKFILL,
+    LANE_LIVE,
+    physical_queue,
+)
+
+CFG = RatingConfig()
+
+
+def _csv_bytes(n_matches=400, n_players=80, seed=11, **kw):
+    players = synthetic_players(n_players, seed=seed)
+    s = synthetic_stream(n_matches, players, seed=seed, **kw)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "s.csv")
+        save_stream_csv(path, s)
+        with open(path, "rb") as f:
+            return f.read(), s
+
+
+def _state(n_players=80):
+    return PlayerState.create(n_players, cfg=CFG)
+
+
+# ---------------------------------------------------------------------------
+class TestIncrementalAssigner:
+    """The restartable first-fit: feeding windows in stream order must be
+    invisible to the result."""
+
+    def test_windowed_feeds_match_one_shot_on_ratable_stream(self):
+        players = synthetic_players(50, seed=5)
+        raw = synthetic_stream(600, players, seed=5)
+        keep = raw.ratable  # filler-free: the exact-equality case
+        s = MatchStream(
+            raw.player_idx[keep], raw.winner[keep],
+            raw.mode_id[keep], raw.afk[keep],
+        )
+        assert s.ratable.all()
+        b = 8
+        ref_b, ref_s = assign_batches(s, b)
+        out_b = np.full(s.n_matches, -1, np.int64)
+        out_s = np.full(s.n_matches, -1, np.int64)
+        inc = IncrementalAssigner(b, out_b, out_s)
+        for lo in range(0, s.n_matches, 97):  # deliberately odd windows
+            inc.feed(
+                s.player_idx, s.mode_id, s.afk,
+                lo, min(lo + 97, s.n_matches),
+            )
+        inc.finish()
+        np.testing.assert_array_equal(out_b, ref_b)
+        np.testing.assert_array_equal(out_s, ref_s)
+
+    def test_window_decomposition_is_invisible(self):
+        players = synthetic_players(40, seed=9)
+        s = synthetic_stream(300, players, seed=9, afk_rate=0.2)
+        outs = []
+        for step in (1, 64, 300):
+            out_b = np.full(s.n_matches, -1, np.int64)
+            out_s = np.full(s.n_matches, -1, np.int64)
+            inc = IncrementalAssigner(4, out_b, out_s)
+            for lo in range(0, s.n_matches, step):
+                inc.feed(
+                    s.player_idx, s.mode_id, s.afk,
+                    lo, min(lo + step, s.n_matches),
+                )
+            inc.finish()
+            outs.append((out_b, out_s, inc.batches_used))
+        for got in outs[1:]:
+            np.testing.assert_array_equal(got[0], outs[0][0])
+            np.testing.assert_array_equal(got[1], outs[0][1])
+            assert got[2] == outs[0][2]
+
+    def test_non_contiguous_feed_rejected(self):
+        s = synthetic_stream(50, synthetic_players(10, seed=1), seed=1)
+        inc = IncrementalAssigner(
+            4, np.full(50, -1, np.int64), np.full(50, -1, np.int64)
+        )
+        inc.feed(s.player_idx, s.mode_id, s.afk, 0, 10)
+        with pytest.raises(ValueError, match="contiguous"):
+            inc.feed(s.player_idx, s.mode_id, s.afk, 20, 30)
+
+    def test_chronology_and_conflict_freedom_with_fillers(self):
+        # Fillers consume capacity inline; ratable matches must still
+        # land in strictly increasing batches per player.
+        players = synthetic_players(30, seed=3)
+        s = synthetic_stream(400, players, seed=3, afk_rate=0.3)
+        out_b = np.full(s.n_matches, -1, np.int64)
+        out_s = np.full(s.n_matches, -1, np.int64)
+        inc = IncrementalAssigner(8, out_b, out_s)
+        inc.feed(s.player_idx, s.mode_id, s.afk, 0, s.n_matches)
+        inc.finish()
+        assert (out_b >= 0).all()  # every match (fillers too) placed
+        last = {}
+        for i in np.flatnonzero(s.ratable):
+            for p in s.player_idx[i].ravel():
+                if p < 0:
+                    continue
+                assert out_b[i] > last.get(int(p), -1)
+                last[int(p)] = out_b[i]
+        # capacity respected
+        counts = np.bincount(out_b)
+        assert counts.max() <= 8
+
+
+# ---------------------------------------------------------------------------
+PARITY_CASES = [
+    ("reference", 0),
+    ("fused", 0),
+    ("reference", 32),
+    ("fused", 32),
+]
+
+
+class TestBackfillParity:
+    """The engine's whole-stream result is bit-identical to the
+    non-streaming path — every kernel, tiered and untiered."""
+
+    @pytest.mark.parametrize("kernel,hot_rows", PARITY_CASES)
+    def test_bit_identical_to_rate_stream(self, kernel, hot_rows):
+        data, _ = _csv_bytes(500, seed=13, afk_rate=0.1)
+        dec = decode_stream_csv(data)
+        if dec is None:
+            pytest.skip("native columnar decoder unavailable")
+        ref, ref_out = rate_stream(
+            _state(), dec, CFG, collect=True, kernel=kernel,
+            hot_rows=hot_rows, fuse_window=4,
+        )
+        got, got_out = rate_backfill(
+            _state(), data, CFG, collect=True, kernel=kernel,
+            hot_rows=hot_rows, fuse_window=4, window_rows=128,
+            steps_per_chunk=4,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.table), np.asarray(got.table)
+        )
+        np.testing.assert_array_equal(ref_out.updated, got_out.updated)
+        np.testing.assert_array_equal(ref_out.quality, got_out.quality)
+        np.testing.assert_array_equal(ref_out.any_afk, got_out.any_afk)
+        # Prior-snapshot fields are placement-dependent on filler rows
+        # (same contract as rate_stream vs the offline packer); on every
+        # UPDATED row they must match bit for bit.
+        upd = ref_out.updated
+        np.testing.assert_array_equal(
+            ref_out.shared_mu[upd], got_out.shared_mu[upd]
+        )
+        np.testing.assert_array_equal(
+            ref_out.delta[upd], got_out.delta[upd]
+        )
+
+    def test_deterministic_per_bytes_and_params(self):
+        data, _ = _csv_bytes(300, seed=17)
+        runs = []
+        for _ in range(2):
+            stats: dict = {}
+            st, _ = rate_backfill(
+                _state(), data, CFG, window_rows=64, steps_per_chunk=4,
+                stats_out=stats,
+            )
+            runs.append((np.asarray(st.table), stats))
+        np.testing.assert_array_equal(runs[0][0], runs[1][0])
+        for key in ("n_steps", "batch_size", "occupancy", "fingerprint"):
+            assert runs[0][1][key] == runs[1][1][key], key
+
+    def test_batch_size_independence(self):
+        # The final table is b-independent (chronology fixes priors);
+        # the streamed prefix choice therefore cannot change results.
+        data, _ = _csv_bytes(300, seed=19)
+        t1 = np.asarray(
+            rate_backfill(_state(), data, CFG, batch_size=4)[0].table
+        )
+        t2 = np.asarray(
+            rate_backfill(_state(), data, CFG, batch_size=16)[0].table
+        )
+        np.testing.assert_array_equal(t1, t2)
+
+    def test_fallback_path_on_quoted_grammar(self):
+        data, stream = _csv_bytes(200, seed=23)
+        data = data + b'"quoted",ranked,0,0,1;2;3,4;5;6\n'
+        reg = get_registry()
+        before = reg.counter("migrate.fallbacks_total").value
+        stats: dict = {}
+        st, _ = rate_backfill(_state(), data, CFG, stats_out=stats)
+        assert stats["streamed"] is False
+        assert reg.counter("migrate.fallbacks_total").value == before + 1
+        # Same result as the python-parsed non-streaming path.
+        import io as _io
+
+        from analyzer_tpu.io.csv_codec import load_stream_csv
+
+        ref, _ = rate_stream(
+            _state(), load_stream_csv(_io.StringIO(data.decode())), CFG
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.table), np.asarray(st.table)
+        )
+
+    def test_empty_stream(self):
+        st, outs = rate_backfill(
+            _state(), b"match_id,mode,winner,afk,team0,team1\n", CFG,
+            collect=True,
+        )
+        assert outs.updated.shape == (0,)
+        np.testing.assert_array_equal(
+            np.asarray(st.table), np.asarray(_state().table)
+        )
+
+
+# ---------------------------------------------------------------------------
+class TestStreamingOverlap:
+    """The perf core's structural claims: first dispatch after one decode
+    window (not whole-file), flat steady-state arena allocations."""
+
+    def test_first_dispatch_before_decode_completes(self, monkeypatch):
+        """Decode of window 2+ BLOCKS until the first chunk has
+        dispatched: an engine that needed the whole file before its
+        first dispatch would deadlock here (the gate times out and the
+        run fails) instead of passing."""
+        import analyzer_tpu.migrate.engine as engine_mod
+
+        gate = threading.Event()
+
+        class GatedDecoder(ColumnarDecoder):
+            def windows(self):
+                inner = super().windows()
+                first = True
+                while True:
+                    try:
+                        win = next(inner)
+                    except StopIteration:
+                        return
+                    if not first and not gate.wait(timeout=60):
+                        raise RuntimeError(
+                            "first dispatch never happened while decode "
+                            "was still pending — the streaming overlap "
+                            "is broken"
+                        )
+                    first = False
+                    yield win
+
+        monkeypatch.setattr(engine_mod, "ColumnarDecoder", GatedDecoder)
+        data, _ = _csv_bytes(1200, n_players=200, seed=31)
+
+        def on_chunk(_st, _next_step):
+            gate.set()
+
+        stats: dict = {}
+        # Auto batch size: the cost model sizes b to the ladder's width
+        # so batches FILL (a first-fit batch becomes emittable only by
+        # filling — the documented chain-bound caveat; an oversized
+        # forced b would legitimately serialize this stream).
+        st, _ = rate_backfill(
+            _state(200), data, CFG, window_rows=64,
+            steps_per_chunk=2, on_chunk=on_chunk, stats_out=stats,
+        )
+        assert gate.is_set()
+        assert stats["matches"] == 1200
+        assert stats["ttfd_s"] is not None
+
+    def test_arena_allocations_flat_at_ring_size(self):
+        """Decode slabs recycle through the arena: a 20+-window stream
+        allocates only the first few windows' slabs and reuses them for
+        the rest (the 'steady-state host allocations are flat'
+        acceptance pin)."""
+        data, _ = _csv_bytes(1500, n_players=150, seed=37)
+        arena = PinnedArena()
+        # The arena's alloc/reuse counters are process-wide (shared with
+        # every other arena this test session touched) — measure deltas.
+        reg = get_registry()
+        allocs0 = reg.counter("ingest.arena_allocs_total").value
+        reuses0 = reg.counter("ingest.arena_reuses_total").value
+        rate_backfill(
+            _state(150), data, CFG, window_rows=64, arena=arena,
+            steps_per_chunk=4,
+        )
+        allocs = reg.counter("ingest.arena_allocs_total").value - allocs0
+        reuses = reg.counter("ingest.arena_reuses_total").value - reuses0
+        # 4 slabs per decode window; the window in flight plus the one
+        # being appended bound the live set — generous ceiling of 3
+        # windows' worth against scheduling jitter.
+        assert allocs <= 12, (allocs, reuses)
+        assert reuses >= 4 * 15, (allocs, reuses)  # ~23 windows decoded
+        assert reuses / (allocs + reuses) > 0.8
+
+
+# ---------------------------------------------------------------------------
+class TestResume:
+    """Kill the backfill at a window boundary, resume from the
+    checkpoint, and the final table is bit-identical to an uninterrupted
+    run — both kernels, tiered and untiered, several kill points."""
+
+    @pytest.mark.parametrize("kernel,hot_rows", PARITY_CASES)
+    def test_resume_bit_identical(self, kernel, hot_rows, tmp_path):
+        data, _ = _csv_bytes(400, seed=41, afk_rate=0.1)
+        kw = dict(
+            kernel=kernel, hot_rows=hot_rows, fuse_window=4,
+            window_rows=128, steps_per_chunk=4,
+        )
+        full = run_migration(_state(), data, CFG, **kw)
+        assert full.finished
+        ref = np.asarray(full.state.table)
+        total = full.stats["n_steps"]
+        for stop in (4, 12, max(4, (total // 2) // 4 * 4)):
+            ck = str(tmp_path / f"mig-{kernel}-{hot_rows}-{stop}.npz")
+            bounded = run_migration(
+                _state(), data, CFG, checkpoint=ck, stop_after=stop, **kw
+            )
+            assert not bounded.finished
+            assert os.path.exists(ck)
+            resumed = run_migration(
+                None, data, CFG, checkpoint=ck, resume=True, **kw
+            )
+            assert resumed.finished
+            assert resumed.stats["streamed"]
+            np.testing.assert_array_equal(
+                ref, np.asarray(resumed.state.table),
+                err_msg=f"kernel={kernel} hot_rows={hot_rows} stop={stop}",
+            )
+
+    def test_periodic_checkpoints_resume(self, tmp_path):
+        data, _ = _csv_bytes(400, seed=43)
+        kw = dict(window_rows=128, steps_per_chunk=4)
+        full = run_migration(_state(), data, CFG, **kw)
+        ref = np.asarray(full.state.table)
+        ck = str(tmp_path / "periodic.npz")
+        run_migration(
+            _state(), data, CFG, checkpoint=ck, checkpoint_every=8,
+            stop_after=16, **kw
+        )
+        resumed = run_migration(None, data, CFG, checkpoint=ck, resume=True, **kw)
+        np.testing.assert_array_equal(ref, np.asarray(resumed.state.table))
+
+    def test_changed_bytes_rejected_on_resume(self, tmp_path):
+        data_a, _ = _csv_bytes(300, seed=47)
+        data_b, _ = _csv_bytes(300, seed=48)
+        ck = str(tmp_path / "fp.npz")
+        kw = dict(window_rows=128, steps_per_chunk=4)
+        run_migration(_state(), data_a, CFG, checkpoint=ck, stop_after=4, **kw)
+        with pytest.raises(ValueError, match="no longer matches"):
+            run_migration(None, data_b, CFG, checkpoint=ck, resume=True, **kw)
+
+    def test_fingerprint_is_content_addressed(self):
+        a = migration_fingerprint(b"x" * 100, 8, 4)
+        assert a == migration_fingerprint(b"x" * 100, 8, 4)
+        assert a != migration_fingerprint(b"y" * 100, 8, 4)
+        assert a != migration_fingerprint(b"x" * 100, 16, 4)
+        assert a != migration_fingerprint(b"x" * 100, 8, 8)
+
+
+# ---------------------------------------------------------------------------
+class TestLineageCutover:
+    """Atomic dual-lineage cutover: monotone versions, zero-copy table
+    adoption, retired staging, sharded mirror."""
+
+    def _rows(self, n, fill):
+        from analyzer_tpu.core.state import TABLE_WIDTH
+
+        return np.full((n, TABLE_WIDTH), fill, np.float32)
+
+    def test_cutover_monotone_and_adopts_table(self):
+        live = ViewPublisher()
+        live.publish_rows(["a", "b"], self._rows(2, 1.0))
+        live.publish_rows(["a"], self._rows(1, 2.0))
+        assert live.version == 2
+        lineage = LineageManager(live)
+        staging = lineage.begin()
+        state = PlayerState.create(4, cfg=CFG)
+        staging.publish_state(state, ids=["a", "b", "c", "d"])
+        assert staging.version == 1  # its own lineage's sequence
+        view = lineage.cutover()
+        assert view.version == 3  # live's sequence, monotone
+        assert live.current() is view
+        assert view.n_players == 4
+        assert view.resolve("c") == 2  # staging's id map adopted
+        # Zero-copy adoption: same device buffer, not a re-upload.
+        assert view.table is not None
+        assert lineage.cutover_pause_s is not None
+
+    def test_readers_never_see_torn_or_backward_versions(self):
+        live = ViewPublisher()
+        live.publish_rows(["p"], self._rows(1, 1.0))
+        stop = threading.Event()
+        seen: list[int] = []
+        bad: list[str] = []
+
+        def reader():
+            last = 0
+            while not stop.is_set():
+                v = live.current()
+                if v is None:
+                    bad.append("missing view")
+                    continue
+                if v.version < last:
+                    bad.append(f"version went backward: {v.version}<{last}")
+                last = v.version
+                seen.append(v.version)
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        for i in range(20):
+            lineage = LineageManager(live)
+            staging = lineage.begin()
+            staging.publish_state(PlayerState.create(2, cfg=CFG))
+            lineage.cutover()
+            live.publish_state(PlayerState.create(2, cfg=CFG))
+        stop.set()
+        t.join()
+        assert not bad, bad
+        assert seen and max(seen) <= live.version
+
+    def test_retired_staging_refuses_publish(self):
+        live = ViewPublisher()
+        lineage = LineageManager(live)
+        staging = lineage.begin()
+        staging.publish_state(PlayerState.create(2, cfg=CFG))
+        lineage.cutover()
+        with pytest.raises(RuntimeError, match="retired"):
+            staging.publish_state(PlayerState.create(2, cfg=CFG))
+
+    def test_cutover_without_staging_view_rejected(self):
+        live = ViewPublisher()
+        lineage = LineageManager(live)
+        lineage.begin()
+        with pytest.raises(ValueError, match="no published view"):
+            lineage.cutover()
+
+    def test_live_publishes_continue_after_cutover(self):
+        live = ViewPublisher()
+        live.publish_rows(["a"], self._rows(1, 1.0))
+        lineage = LineageManager(live)
+        staging = lineage.begin()
+        staging.publish_state(
+            PlayerState.create(2, cfg=CFG), ids=["a", "b"]
+        )
+        lineage.cutover()
+        # The worker's id-merge commits keep landing on the migrated
+        # lineage (the id map transferred with the cutover).
+        view = live.publish_rows(["b"], self._rows(1, 9.0))
+        assert view.resolve("b") == 1
+        assert float(view.host_table()[1, 0]) == 9.0
+
+    def test_sharded_cutover(self):
+        live = ShardedViewPublisher(2)
+        live.publish_state(PlayerState.create(6, cfg=CFG))
+        lineage = LineageManager(live)
+        staging = lineage.begin()
+        assert isinstance(staging, ShardedViewPublisher)
+        state = PlayerState.create(6, cfg=CFG)
+        staging.publish_state(state, ids=[f"p{i}" for i in range(6)])
+        view = lineage.cutover()
+        assert view.version == live.version
+        assert view.n_shards == 2
+        np.testing.assert_array_equal(
+            view.host_table(), np.asarray(state.table)[:6]
+        )
+        assert view.resolve("p3") == 3
+
+    def test_sharded_topology_mismatch_rejected(self):
+        live = ShardedViewPublisher(2)
+        other = ShardedViewPublisher(4)
+        other.publish_state(PlayerState.create(4, cfg=CFG))
+        with pytest.raises(ValueError, match="shard"):
+            live.cutover_from(other)
+
+    def test_abort_leaves_live_untouched(self):
+        live = ViewPublisher()
+        live.publish_rows(["a"], self._rows(1, 1.0))
+        before = live.current()
+        lineage = LineageManager(live)
+        staging = lineage.begin()
+        staging.publish_state(PlayerState.create(2, cfg=CFG))
+        lineage.abort()
+        assert live.current() is before
+        assert live.version == 1
+
+
+# ---------------------------------------------------------------------------
+class TestAmqpPartitionedBroker:
+    """The partition x lane -> physical queue mapping over a stub AMQP
+    server (an InMemoryBroker), mirroring the in-memory parity suite."""
+
+    def test_physical_queue_naming_contract(self):
+        assert physical_queue("analyze", 2, LANE_LIVE) == "analyze.p2.live"
+        assert (
+            physical_queue("analyze", 0, LANE_BACKFILL)
+            == "analyze.p0.backfill"
+        )
+
+    def test_declares_all_physical_queues(self):
+        base = InMemoryBroker()
+        broker = AmqpPartitionedBroker(base, partitions=3, lanes=True)
+        broker.declare_queue("analyze")
+        for p in range(3):
+            for lane in (LANE_LIVE, LANE_BACKFILL):
+                assert physical_queue("analyze", p, lane) in base.queues
+
+    def test_live_delivery_order_matches_single_queue(self):
+        """Seq-merged delivery: live-only traffic comes out in publish
+        order regardless of which partition each message landed in —
+        the InMemoryBroker parity contract."""
+        base = InMemoryBroker()
+        broker = AmqpPartitionedBroker(base, partitions=4)
+        single = InMemoryBroker()
+        bodies = [f"m{i:03d}".encode() for i in range(40)]
+        for body in bodies:
+            broker.publish("analyze", body)
+            single.publish("analyze", body)
+        got = [m.body for m in broker.get("analyze", 100)]
+        want = [m.body for m in single.get("analyze", 100)]
+        assert got == want == bodies
+
+    def test_partition_header_routing(self):
+        base = InMemoryBroker()
+        broker = AmqpPartitionedBroker(base, partitions=4)
+        broker.publish("analyze", b"x", headers={"x-partition": 2})
+        assert base.qsize(physical_queue("analyze", 2, LANE_LIVE)) == 1
+
+    def test_live_outranks_backfill(self):
+        base = InMemoryBroker()
+        broker = AmqpPartitionedBroker(base, partitions=2, lanes=True)
+        broker.publish("analyze", b"bf0", headers={"x-lane": "backfill"})
+        broker.publish("analyze", b"live0")
+        broker.publish("analyze", b"bf1", headers={"x-lane": "backfill"})
+        broker.publish("analyze", b"live1")
+        got = [m.body for m in broker.get("analyze", 10)]
+        assert got[:2] == [b"live0", b"live1"]
+        assert sorted(got[2:]) == [b"bf0", b"bf1"]
+
+    def test_backfill_starved_while_live_waits(self):
+        base = InMemoryBroker()
+        broker = AmqpPartitionedBroker(base, partitions=1, lanes=True)
+        for i in range(6):
+            broker.publish("analyze", f"live{i}".encode())
+        broker.publish("analyze", b"bf", headers={"x-lane": "backfill"})
+        # Room for 3: live still ready after the pop -> zero backfill.
+        got = [m.body for m in broker.get("analyze", 3)]
+        assert got == [b"live0", b"live1", b"live2"]
+        assert broker.lane_size("analyze", LANE_BACKFILL) == 1
+
+    def test_depths_and_partition_skew_surface(self):
+        base = InMemoryBroker()
+        broker = AmqpPartitionedBroker(base, partitions=2, lanes=True)
+        broker.publish("analyze", b"a", headers={"x-partition": 0})
+        broker.publish("analyze", b"b", headers={"x-partition": 1})
+        broker.publish(
+            "analyze", b"c",
+            headers={"x-partition": 1, "x-lane": "backfill"},
+        )
+        assert broker.qsize("analyze") == 3
+        depths = broker.partition_depths("analyze")
+        assert depths[1][LANE_LIVE] == 1
+        assert depths[1][LANE_BACKFILL] == 1
+        assert depths[0][LANE_BACKFILL] == 0
+
+    def test_nack_requeue_preserves_order(self):
+        base = InMemoryBroker()
+        broker = AmqpPartitionedBroker(base, partitions=2)
+        for i in range(4):
+            broker.publish("analyze", f"m{i}".encode())
+        first = broker.get("analyze", 2)
+        for m in first:
+            broker.nack(m.delivery_tag, requeue=True)
+        got = [m.body for m in broker.get("analyze", 10)]
+        assert got == [b"m0", b"m1", b"m2", b"m3"]
+
+    def test_worker_consumes_through_partitioned_amqp(self):
+        """End-to-end: the worker's poll loop over the mapped layout —
+        per-partition depth gauges included."""
+        from analyzer_tpu.config import ServiceConfig
+        from analyzer_tpu.service.store import InMemoryStore
+        from analyzer_tpu.service.worker import Worker
+        from tests.fakes import (
+            fake_match,
+            fake_participant,
+            fake_player,
+            fake_roster,
+        )
+
+        def mk_match(api_id, created_at):
+            players = [
+                fake_player(skill_tier=15, api_id=f"{api_id}-p{i}")
+                for i in range(6)
+            ]
+            m = fake_match(
+                "ranked",
+                [
+                    fake_roster(
+                        True,
+                        [fake_participant(player=p) for p in players[:3]],
+                    ),
+                    fake_roster(
+                        False,
+                        [fake_participant(player=p) for p in players[3:]],
+                    ),
+                ],
+                api_id=api_id,
+            )
+            m.created_at = created_at
+            return m
+
+        base = InMemoryBroker()
+        broker = AmqpPartitionedBroker(base, partitions=2, lanes=True)
+        store = InMemoryStore()
+        worker = Worker(
+            broker, store, ServiceConfig(batch_size=4, idle_timeout=0.0),
+            CFG, pipeline=False,
+        )
+        for i in range(4):
+            store.add_match(mk_match(f"m{i}", created_at=i))
+            broker.publish("analyze", f"m{i}".encode())
+        assert worker.poll()
+        assert worker.matches_rated == 4
+        assert broker.qsize("analyze") == 0
+
+
+# ---------------------------------------------------------------------------
+class TestSoakMigrate:
+    """cli soak --migrate: a full re-rate under live load holds the SLO
+    gates, cuts over atomically, and leaves the deterministic block
+    bit-identical to a migration-free soak."""
+
+    def _soak(self, migrate: bool):
+        from analyzer_tpu.loadgen import SoakConfig, SoakDriver
+
+        cfg = SoakConfig(
+            seed=6, duration_s=3.0, tick_s=1.0, qps=10.0, query_qps=6.0,
+            n_players=80, batch_size=32, polls_per_tick=4,
+            use_http=False, migrate=migrate, migrate_matches=150,
+        )
+        driver = SoakDriver(cfg)
+        try:
+            return driver.run()
+        finally:
+            driver.close()
+
+    def test_soak_migrate_green_and_deterministic_block_unchanged(self):
+        reset_migration_progress()
+        with_mig = self._soak(True)
+        assert with_mig["slo"]["pass"], with_mig["slo"]["violations"]
+        mig = with_mig["migration"]
+        assert mig["finished"] and mig["streamed"]
+        assert mig["bit_identical"] is True
+        assert mig["cutover_serves_migrated_table"] is True
+        assert mig["cutover_pause_ms"] is not None
+        versions = mig["lineage_versions"]
+        assert versions["post_cutover_live"] == versions["pre_cutover_live"] + 1
+        without = self._soak(False)
+        assert "migration" not in without
+        assert with_mig["deterministic"] == without["deterministic"]
+
+
+# ---------------------------------------------------------------------------
+class TestBenchdiffMigrateFamily:
+    """The MIGRATE_BENCH artifact family: config extraction, the delta
+    gate, and the vanished-block (silent offline fall-back) gate."""
+
+    def _artifact(self, value=1000.0, p99=2.0, pause=0.5, streamed=True):
+        return {
+            "metric": "migrate.matches_per_sec",
+            "value": value,
+            "latency_ms": {"p50": 1.0, "p90": 1.5, "p99": p99},
+            "migrate": {
+                "streamed": streamed,
+                "cutover_pause_ms": pause,
+                "stable": True,
+            },
+            "capture": {"degraded": False},
+        }
+
+    def test_bench_configs_extract_migrate_family(self):
+        from analyzer_tpu.obs.benchdiff import bench_configs, family_configs
+
+        configs = family_configs(
+            bench_configs(self._artifact()), "migrate"
+        )
+        names = {c.name: c for c in configs}
+        assert names["migrate.matches_per_sec"].higher_is_better
+        assert not names["migrate.live_p99_ms"].higher_is_better
+        assert not names["migrate.cutover_pause_ms"].higher_is_better
+
+    def _run_cli(self, a, b, tmp_path, *extra):
+        from analyzer_tpu.cli import main
+
+        pa = tmp_path / "MIGRATE_BENCH_r01.json"
+        pb = tmp_path / "MIGRATE_BENCH_r02.json"
+        pa.write_text(json.dumps(a))
+        pb.write_text(json.dumps(b))
+        return main(
+            ["benchdiff", str(pa), str(pb), "--family", "migrate", *extra]
+        )
+
+    def test_regression_gates(self, tmp_path, capsys):
+        assert self._run_cli(
+            self._artifact(), self._artifact(value=980.0), tmp_path
+        ) == 0
+        assert self._run_cli(
+            self._artifact(), self._artifact(value=500.0), tmp_path
+        ) == 1
+        capsys.readouterr()
+
+    def test_live_p99_regression_gates(self, tmp_path, capsys):
+        assert self._run_cli(
+            self._artifact(), self._artifact(p99=40.0), tmp_path
+        ) == 1
+        capsys.readouterr()
+
+    def test_vanished_streamed_block_gates(self, tmp_path, capsys):
+        rc = self._run_cli(
+            self._artifact(), self._artifact(streamed=False), tmp_path
+        )
+        out = capsys.readouterr()
+        assert rc == 1
+        assert "fall-back" in out.err
+
+    def test_family_scan_prefix(self, tmp_path):
+        from analyzer_tpu.obs.benchdiff import find_bench_artifacts
+
+        (tmp_path / "MIGRATE_BENCH_r01.json").write_text("{}")
+        (tmp_path / "BENCH_r01.json").write_text("{}")
+        got = find_bench_artifacts(str(tmp_path), family="migrate")
+        assert [os.path.basename(p) for p in got] == ["MIGRATE_BENCH_r01.json"]
+        bench = find_bench_artifacts(str(tmp_path), family="bench")
+        assert [os.path.basename(p) for p in bench] == ["BENCH_r01.json"]
+
+
+# ---------------------------------------------------------------------------
+class TestAdmissionThrottle:
+    """The engine's dispatch gate defers to live backlog and resumes
+    once it drains (the in-process backfill-lane arbitration)."""
+
+    def test_backfill_pauses_for_live_backlog_then_finishes(self):
+        data, _ = _csv_bytes(300, seed=53)
+        backlog = {"n": 5}
+        calls = {"n": 0}
+
+        def live_backlog():
+            calls["n"] += 1
+            if calls["n"] > 3:
+                backlog["n"] = 0  # live drains after a few polls
+            return backlog["n"]
+
+        reg = get_registry()
+        before = reg.counter("migrate.throttled_total").value
+        st, _ = rate_backfill(
+            _state(), data, CFG, window_rows=128, steps_per_chunk=4,
+            admission=AdmissionController(), live_backlog=live_backlog,
+            throttle_poll_s=0.001,
+        )
+        assert reg.counter("migrate.throttled_total").value > before
+        ref, _ = rate_stream(_state(), decode_stream_csv(data), CFG)
+        np.testing.assert_array_equal(
+            np.asarray(ref.table), np.asarray(st.table)
+        )

@@ -645,6 +645,7 @@ class TestStats:
         "measured_rtt_ms",
         "measured_host_ms",
         "serve",
+        "migration",
         "slo",
     }
 
@@ -656,6 +657,9 @@ class TestStats:
         assert set(worker.stats()) == self.STATS_SCHEMA
 
     def test_stats_after_work_and_failure(self, rig):
+        from analyzer_tpu.migrate.progress import reset_migration_progress
+
+        reset_migration_progress()  # another suite's migration must not leak
         broker, store, worker = rig
         for i in range(4):
             store.add_match(mk_match(f"s{i}", created_at=i))
@@ -675,6 +679,36 @@ class TestStats:
         assert s["resolved_pipeline_lag"] is None
         # No serving plane in this rig: the key is present, value None.
         assert s["serve"] is None
+        # No migration ran in this rig either: present, None.
+        assert s["migration"] is None
+
+    def test_stats_migration_block(self, rig):
+        """A live migration surfaces phase/watermark/progress/lineage
+        versions through stats() — the /statusz contract of ROADMAP
+        item 4 ('progress exposed on /statusz')."""
+        from analyzer_tpu.migrate.progress import reset_migration_progress
+
+        broker, store, worker = rig
+        prog = reset_migration_progress()
+        try:
+            prog.begin()
+            prog.note_decoded(100)
+            prog.note_assigned(80)
+            prog.note_dispatched(16, 0)
+            prog.set_total_steps(64)
+            prog.set_lineages(3, 1)
+            m = worker.stats()["migration"]
+            assert m["phase"] == "rating"
+            assert m["backfill_watermark_steps"] == 16
+            assert m["steps_total"] == 64
+            assert m["progress_pct"] == 25.0
+            assert m["matches_decoded"] == 100
+            assert m["lineage_live_version"] == 3
+            assert m["lineage_staging_version"] == 1
+            prog.finish()
+            assert worker.stats()["migration"]["phase"] == "done"
+        finally:
+            reset_migration_progress()
 
     def test_stats_serve_keys_when_serving(self):
         broker = InMemoryBroker()
