@@ -1043,6 +1043,20 @@ def cmd_benchdiff(args) -> int:
                 "overhead gate", file=sys.stderr,
             )
             rc = 1
+        # And for the fleet plane: the bench's federate_overhead block
+        # (a Collector scraping obsd under load vs unscraped on the
+        # same e2e line) must stay <= FEDERATE_OVERHEAD_MAX_PCT.
+        from analyzer_tpu.obs.benchdiff import federate_overhead_violations
+
+        fed_overhead = federate_overhead_violations(b_raw)
+        for v in fed_overhead:
+            print(f"FEDERATE OVERHEAD VIOLATION: {v}")
+        if fed_overhead:
+            print(
+                f"error: {os.path.basename(b_path)} fails the "
+                "federation overhead gate", file=sys.stderr,
+            )
+            rc = 1
     rows = diff_configs(a, b, args.regress_pct)
     sys.stdout.write(render_diff(a_path, b_path, rows))
     if any(r.regressed and r.gated for r in rows):
@@ -1140,13 +1154,18 @@ def cmd_trace(args) -> int:
     or a flight-recorder dump directory, with the stage decomposition
     (queue wait -> encode -> pack -> feed staging -> H2D -> dispatch ->
     fetch -> commit -> publish lag) and a critical-path report naming
-    the dominant stage. Needs a trace captured with causal tracing ON
-    (``cli soak --trace``, ``ANALYZER_TPU_TRACE=1``)."""
+    the dominant stage. MULTIPLE artifacts stitch into one cross-process
+    trace forest (clock-aligned via each export's trace_epoch metadata):
+    a match enqueued in one process and rated in another reconstructs
+    end to end, its handoff gap reported as the ``broker_transit`` stage
+    and each stage attributed to its host. Needs traces captured with
+    causal tracing ON (``cli soak --trace``, ``ANALYZER_TPU_TRACE=1``)."""
     from analyzer_tpu.obs.traceview import (
         batch_report,
         build_model,
         critical_path,
         load_events,
+        load_forest,
         match_report,
         render_batch,
         render_critical_path,
@@ -1155,7 +1174,10 @@ def cmd_trace(args) -> int:
     )
 
     try:
-        events = load_events(args.artifact)
+        if len(args.artifact) == 1:
+            events = load_events(args.artifact[0])
+        else:
+            events = load_forest(args.artifact)
     except (OSError, ValueError) as err:
         print(f"error: {err}", file=sys.stderr)
         return 2
@@ -1203,6 +1225,82 @@ def cmd_trace(args) -> int:
     else:
         sys.stdout.write(render_critical_path(cp))
     return 0
+
+
+def cmd_fleet(args) -> int:
+    """Fleet observability plane (obs/federate.py, docs/observability.md
+    "Fleet plane"): scrape N workers' obsd endpoints, merge their
+    registries under the reserved ``host=`` label, evaluate the
+    STANDARD objectives at fleet scope with per-host attribution, and
+    serve /fleetz, aggregated /metrics, a fleet /sloz and the fleet
+    history rings. ``--check`` is the CI one-shot: scrape once,
+    evaluate, exit 1 on any burn — the multi-process topology's
+    benchdiff."""
+    import time
+
+    from analyzer_tpu.obs.federate import Collector, FleetServer
+
+    targets = list(args.targets_pos)
+    if args.targets:
+        targets.extend(
+            t.strip() for t in args.targets.split(",") if t.strip()
+        )
+    if not targets:
+        print(
+            "error: no targets (positional host:port... or "
+            "--targets host:port,...)", file=sys.stderr,
+        )
+        return 2
+    collector = Collector(
+        targets,
+        flight_token=args.flight_token,
+        request_flight_dumps=not args.no_flight_requests,
+    )
+    if args.check:
+        burns = collector.check(time.monotonic())
+        down = [
+            t for t, row in collector.fleetz()["hosts"].items()
+            if not row["up"]
+        ]
+        for target in down:
+            print(f"DOWN: {target}")
+        for burn, hosts in burns:
+            where = ", ".join(hosts) if hosts else "fleet-wide"
+            print(f"FLEET BURN: {burn.objective} [{where}] — {burn.detail}")
+        if args.json:
+            json.dump(
+                collector.sloz(), sys.stdout, indent=1, sort_keys=True
+            )
+            sys.stdout.write("\n")
+        if burns or (down and args.require_all_up):
+            return 1
+        up = collector.fleetz()["up"]
+        print(f"fleet ok: {up}/{len(targets)} host(s) up, no burns")
+        return 0
+    server = FleetServer(collector, port=args.port)
+    print(f"fleetd serving /fleetz /metrics /sloz /historyz at {server.url}")
+    scrapes = 0
+    try:
+        while args.scrapes <= 0 or scrapes < args.scrapes:
+            collector.scrape(time.monotonic())
+            scrapes += 1
+            burning = collector.burning
+            if burning:
+                attribution = collector.attribution()
+                for name in burning:
+                    hosts = attribution.get(name)
+                    print(
+                        f"FLEET BURNING: {name} "
+                        f"[{', '.join(hosts) if hosts else 'fleet-wide'}]"
+                    )
+            if args.scrapes > 0 and scrapes >= args.scrapes:
+                break
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+    return 1 if collector.burning else 0
 
 
 def cmd_lint(args) -> int:
@@ -1392,9 +1490,13 @@ def cmd_soak(args) -> int:
               "reads the trace block's critical path)", file=sys.stderr)
         return 2
     _obs_begin(args)
-    server = _obs_serve(args)
+    # The soak's obsd rides the WORKER (SoakConfig.obs_port), not the
+    # generic CLI server: the endpoints then carry worker stats()/
+    # readiness and the /debug/flight trigger, so a fleet Collector
+    # (cli fleet) can scrape/judge the soak like any production worker.
     cfg = SoakConfig(
         seed=args.seed,
+        obs_port=args.obs_port,
         trace=bool(args.trace or args.trace_events),
         duration_s=args.duration,
         tick_s=args.tick,
@@ -1427,8 +1529,6 @@ def cmd_soak(args) -> int:
         artifact = driver.run()
     finally:
         driver.close()
-        if server is not None:
-            server.close()
     # _obs_write exports --trace-events (the ring still carries the
     # causal ids after close — only the enable flag is restored); the
     # export is the `cli trace` input.
@@ -1884,9 +1984,10 @@ def main(argv=None) -> int:
         "(docs/observability.md \"Causal tracing\")",
     )
     s.add_argument(
-        "artifact",
+        "artifact", nargs="+",
         help="a --trace-events JSONL export, or a flight-recorder dump "
-        "directory (its trace.jsonl is used)",
+        "directory (its trace.jsonl is used); several stitch into one "
+        "cross-process trace forest",
     )
     s.add_argument(
         "--match", metavar="ID",
@@ -1950,6 +2051,57 @@ def main(argv=None) -> int:
         help="dump the (filtered) payload as JSON instead of trends",
     )
     s.set_defaults(fn=cmd_history)
+
+    s = sub.add_parser(
+        "fleet",
+        help="fleet observability plane: scrape N workers' obsd "
+        "endpoints, merge registries under host=, evaluate fleet-scope "
+        "SLO burns with per-host attribution, serve /fleetz "
+        "(docs/observability.md \"Fleet plane\")",
+    )
+    s.add_argument(
+        "targets_pos", nargs="*", metavar="HOST:PORT",
+        help="worker obsd endpoints to scrape",
+    )
+    s.add_argument(
+        "--targets", metavar="HOST:PORT,...",
+        help="comma-separated target list (merged with positionals)",
+    )
+    s.add_argument(
+        "--port", type=int, default=0,
+        help="fleetd serving port (default: ephemeral, printed)",
+    )
+    s.add_argument(
+        "--interval", type=float, default=2.0, metavar="S",
+        help="scrape cadence in seconds (default: 2)",
+    )
+    s.add_argument(
+        "--scrapes", type=int, default=0, metavar="N",
+        help="stop after N scrape rounds (default: run until ^C); the "
+        "exit code reports whether anything was burning at the end",
+    )
+    s.add_argument(
+        "--check", action="store_true",
+        help="one-shot CI gate: scrape once, evaluate the objectives a "
+        "single sample can judge (absolute counter_zero + worst-host "
+        "gauge_max), exit 1 on any burn",
+    )
+    s.add_argument(
+        "--require-all-up", action="store_true",
+        help="--check also fails when any target is unreachable",
+    )
+    s.add_argument(
+        "--flight-token", metavar="TOKEN",
+        help="shared secret for the burning host's /debug/flight "
+        "trigger (workers read ANALYZER_TPU_FLIGHT_TOKEN)",
+    )
+    s.add_argument(
+        "--no-flight-requests", action="store_true",
+        help="never ask burning hosts for flight dumps",
+    )
+    s.add_argument("--json", action="store_true",
+                   help="--check prints the fleet /sloz payload as JSON")
+    s.set_defaults(fn=cmd_fleet)
 
     s = sub.add_parser(
         "soak",
@@ -2063,8 +2215,9 @@ def main(argv=None) -> int:
     )
     s.add_argument(
         "--obs-port", type=int, metavar="PORT",
-        help="serve the obsd introspection endpoints during the soak "
-        "(watch soak.* and broker.queue_depth live; 0 = ephemeral)",
+        help="serve the soak worker's obsd introspection endpoints "
+        "(watch soak.* and broker.queue_depth live, or point a "
+        "`cli fleet` Collector at it; 0 = ephemeral)",
     )
     s.add_argument(
         "--trace", action="store_true",

@@ -102,6 +102,15 @@ RULES: dict[str, str] = {
         "through the atomic cutover, or a torn migration serves silently "
         "wrong ratings"
     ),
+    "GL034": (
+        "fleet-plane hygiene: a counter()/gauge()/histogram() call "
+        "passing a reserved label key (host=/fleet= — "
+        "obs.registry.RESERVED_LABELS) outside obs/federate.py, which "
+        "would collide with the Collector's federated host= merge; or "
+        "a wall-clock read (time.*, datetime.now) inside "
+        "obs/federate.py — the Collector is clock-injected like the "
+        "history/SLO plane, scrape(now) takes the caller's timestamp"
+    ),
 }
 
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")

@@ -115,6 +115,17 @@ VirtualClock), so any wall-clock read inside them
 would silently decouple burn windows from the injected clock and break
 the soak's bit-identical-with-plane-on contract.
 
+GL034 guards the fleet observability plane (``obs/federate.py``,
+``docs/observability.md`` "Fleet plane"). Two halves: (1) the ``host``
+and ``fleet`` label keys are RESERVED for the Collector's federated
+merge (``obs.registry.RESERVED_LABELS``) — a ``counter()``/``gauge()``/
+``histogram()`` call passing either keyword anywhere outside
+``obs/federate.py`` would collide with (or spoof) the per-host series
+the fleet snapshot is keyed by, so it flags; (2) like GL032's
+history/SLO modules, ``obs/federate.py`` is CLOCK-INJECTED
+(``scrape(now)``/``check(now)`` take the caller's timestamp), so any
+wall-clock read inside it flags.
+
 GL030 is PATH-SCOPED to ``analyzer_tpu/service/``, ``sched/`` and
 ``serve/``: every STRING-LITERAL metric name handed to
 ``counter()``/``gauge()``/``histogram()`` and every literal span name
@@ -215,6 +226,20 @@ _GL032_FILES = (
     "analyzer_tpu/obs/slo.py",
 )
 
+#: The fleet plane's sanctioned home (GL034): the only module that may
+#: mint series under the reserved host=/fleet= label keys — and, being
+#: clock-injected like GL032's plane, the module where wall-clock
+#: reads are banned (scrape(now) takes the caller's timestamp).
+_GL034_FEDERATE_FILES = ("analyzer_tpu/obs/federate.py",)
+
+#: Label keys reserved for the fleet merge (mirrors
+#: obs.registry.RESERVED_LABELS; literal here so the linter stays
+#: importable without the obs package loaded).
+_GL034_RESERVED_LABELS = ("host", "fleet")
+
+#: Instrument-minting call names GL034 inspects for reserved keywords.
+_GL034_MINT_KINDS = ("counter", "gauge", "histogram")
+
 #: Directories where GL033 applies: the migration engine — the one
 #: package whose code runs a backfill NEXT TO a live serve plane
 #: (docs/migration.md "Lineage protocol").
@@ -303,6 +328,7 @@ class ShellRules:
         ingest_layer = self._in_ingest_layer()
         slo_plane_layer = self._in_slo_plane_layer()
         migrate_layer = self._in_migrate_layer()
+        federate_home = self._in_federate_home()
         tests = self._in_tests()
         pallas_home = self._in_pallas_home()
         table_home = self._in_table_home()
@@ -336,6 +362,10 @@ class ShellRules:
                     self._check_unpinned_staging(node)
                 if slo_plane_layer:
                     self._check_slo_plane_clock(node)
+                if federate_home:
+                    self._check_federate_clock(node)
+                elif not tests:
+                    self._check_reserved_labels(node)
                 if migrate_layer and not tests:
                     self._check_lineage_publish(node, cutover_ranges)
                 if not tests:
@@ -417,6 +447,10 @@ class ShellRules:
     def _in_migrate_layer(self) -> bool:
         path = self.path.replace("\\", "/")
         return any(frag in path for frag in _GL033_DIRS)
+
+    def _in_federate_home(self) -> bool:
+        path = self.path.replace("\\", "/")
+        return any(path.endswith(frag) for frag in _GL034_FEDERATE_FILES)
 
     def _cutover_entry_ranges(self) -> tuple:
         """(start, end) line spans of functions named ``cutover`` — the
@@ -730,6 +764,45 @@ class ShellRules:
                 "the caller (the worker's clock / the soak's "
                 "VirtualClock); this module must never own a clock",
             )
+
+    def _check_federate_clock(self, node: ast.Call) -> None:
+        """GL034 (clock half): a wall-clock read inside the fleet
+        Collector's module (obs/federate.py) — like the history/SLO
+        plane (GL032), the Collector is clock-injected: ``scrape(now)``
+        / ``check(now)`` take the caller's timestamp, so fleet burn
+        windows are exactly as deterministic as their driver."""
+        resolved = self.imports.resolve(node.func)
+        if resolved in _GL028_CLOCKS:
+            self._flag(
+                "GL034", node,
+                f"wall-clock read `{resolved}` in the clock-injected "
+                "fleet plane (obs/federate.py) — take `now` from the "
+                "caller (cli fleet's loop, a test's synthetic clock); "
+                "this module must never own a clock",
+            )
+
+    def _check_reserved_labels(self, node: ast.Call) -> None:
+        """GL034 (reserved-label half): a counter()/gauge()/histogram()
+        call passing a ``host=``/``fleet=`` label keyword outside
+        obs/federate.py. The Collector merges every scraped worker's
+        series into the fleet snapshot under ``host=<target>``
+        (obs.registry.RESERVED_LABELS) — a worker minting its own
+        host-labeled series would collide with, or spoof, the federated
+        view the fleet plane serves."""
+        f = node.func
+        if not isinstance(f, ast.Attribute) or f.attr not in _GL034_MINT_KINDS:
+            return
+        for kw in node.keywords:
+            if kw.arg in _GL034_RESERVED_LABELS:
+                self._flag(
+                    "GL034", node,
+                    f"`{kw.arg}=` label on a {f.attr}() mint outside "
+                    "obs/federate.py — host/fleet are RESERVED for the "
+                    "fleet Collector's federated merge "
+                    "(obs.registry.RESERVED_LABELS); pick another label "
+                    "key, or route the series through the fleet plane",
+                )
+                return
 
     def _check_objective_metric(self, node: ast.Call) -> None:
         """GL032 (schema half): an ``Objective(...)`` construction whose

@@ -164,6 +164,11 @@ class SoakConfig:
     # captured (pinned by tests/test_migrate.py).
     migrate: bool = False
     migrate_matches: int = 400
+    # obsd on the soak's worker (None = no listener): lets a fleet
+    # Collector (obs/federate.py) scrape the run — the deterministic
+    # block is BIT-IDENTICAL with a scraper attached or absent (the
+    # scrape path is read-only; pinned by tests/test_federate.py).
+    obs_port: int | None = None
 
     @property
     def n_ticks(self) -> int:
@@ -220,7 +225,7 @@ class SoakDriver:
         self.worker = Worker(
             self.broker, self.store, service_cfg, self.rating_config,
             clock=self.vclock.monotonic, pipeline=False, serve_port=0,
-            serve_shards=cfg.serve_shards,
+            serve_shards=cfg.serve_shards, obs_port=cfg.obs_port,
             slo_plane=cfg.slo_plane, audit=cfg.audit,
             audit_seed=cfg.seed, audit_sample_denom=cfg.audit_sample_denom,
         )

@@ -39,12 +39,18 @@ The LIVE half (this PR's obsd plane — everything above is post-hoc):
   * :mod:`~analyzer_tpu.obs.devicemem` — HBM-occupancy and live-buffer
     gauges sampled at batch boundaries (jax-aware, lazy import);
   * :mod:`~analyzer_tpu.obs.benchdiff` — the BENCH_*.json trajectory
-    diff behind ``cli benchdiff``.
+    diff behind ``cli benchdiff``;
+  * :mod:`~analyzer_tpu.obs.federate` — the FLEET plane: a Collector
+    scraping N workers' obsd endpoints into one federated registry
+    under the reserved ``host=`` label, fleet-scope SLO burns with
+    per-host attribution, and the ``/fleetz`` serving surface
+    (``cli fleet``; docs/observability.md "Fleet plane").
 
 Metric name catalog: docs/observability.md.
 """
 
 from analyzer_tpu.obs.audit import ShadowAuditor
+from analyzer_tpu.obs.federate import Collector, FleetServer
 from analyzer_tpu.obs.devicemem import (
     maybe_sample as maybe_sample_device_memory,
     sample_device_memory,
@@ -104,7 +110,9 @@ from analyzer_tpu.obs.tracer import (
 )
 
 __all__ = [
+    "Collector",
     "DeviceProfiler",
+    "FleetServer",
     "FlightRecorder",
     "HealthChecks",
     "HistorySampler",

@@ -437,6 +437,36 @@ def watchdog_overhead_violations(data: dict) -> list[str]:
     ]
 
 
+#: Federation must stay (nearly) free for the scraped worker: the
+#: bench's ``federate_overhead`` block measures the same end-to-end
+#: line with a fleet Collector scraping obsd under load vs unscraped,
+#: and the gate fails a candidate whose scrape tax exceeds this — the
+#: same contract as the tracing and SLO-plane gates above.
+FEDERATE_OVERHEAD_MAX_PCT = 2.0
+
+
+def federate_overhead_violations(data: dict) -> list[str]:
+    """The bench family's absolute federation-tax gate, derived from
+    the candidate alone: a ``federate_overhead`` block whose
+    ``overhead_pct`` exceeds :data:`FEDERATE_OVERHEAD_MAX_PCT` is a
+    violation. Degraded captures and unconverged pairs are excluded; no
+    block at all passes — the tax is only gateable where measured."""
+    block = data.get("federate_overhead")
+    if not isinstance(block, dict):
+        return []
+    if (data.get("capture") or {}).get("degraded"):
+        return []
+    if not block.get("stable", True):
+        return []
+    pct = block.get("overhead_pct")
+    if pct is None or float(pct) <= FEDERATE_OVERHEAD_MAX_PCT:
+        return []
+    return [
+        f"federate_overhead: scraped-under-load run is {float(pct):+.2f}% "
+        f"vs unscraped (gate: <= {FEDERATE_OVERHEAD_MAX_PCT:g}%)"
+    ]
+
+
 def find_bench_artifacts(directory: str, family: str = "bench") -> list[str]:
     """``<PREFIX>_*.json`` under ``directory``, name-sorted (the round
     numbering ``r01..rNN`` sorts chronologically by construction). The

@@ -75,9 +75,15 @@ class RoutedHTTPServer:
         host: str = DEFAULT_HOST,
         name: str = "analyzer-httpd",
         json_errors: bool = False,
+        local_only: set | None = None,
     ) -> None:
         self._routes = dict(routes)
         self._json_errors = json_errors
+        # Paths that ACT (trigger a dump) rather than read: they answer
+        # only to loopback peers even when an operator widened the bind
+        # to a real interface — a scraper on the network may look, not
+        # touch (obsd's /debug/flight; docs/observability.md).
+        self._local_only = set(local_only or ())
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -100,6 +106,13 @@ class RoutedHTTPServer:
                 fn = server._routes.get(path)
                 if fn is None:
                     self._send(*server._error(404, "not found"))
+                    return
+                if path in server._local_only and (
+                    self.client_address[0] not in ("127.0.0.1", "::1")
+                ):
+                    self._send(*server._error(
+                        403, "localhost-only endpoint"
+                    ))
                     return
                 params = {
                     k: v[-1]

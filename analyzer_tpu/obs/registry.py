@@ -287,6 +287,17 @@ STANDARD_COUNTERS = (
     # Dual-lineage cutovers performed by the serve plane (serve/view.py
     # cutover_from — the designated entry graftlint GL033 pins).
     "serve.view_cutovers_total",
+    # The fleet observability plane (obs/federate.py, docs/
+    # observability.md "Fleet plane"): Collector scrape rounds, per-host
+    # scrape failures, fleet-scope SLO burn onsets/recoveries over the
+    # merged rings, and flight dumps the Collector requested from a
+    # burning host via its /debug/flight trigger. Pre-declared so a
+    # collector that never saw a burn reads 0, not missing.
+    "fleet.scrapes_total",
+    "fleet.scrape_errors_total",
+    "fleet.burns_total",
+    "fleet.recoveries_total",
+    "fleet.flight_requests_total",
 )
 STANDARD_GAUGES = (
     "worker.pipeline_lag",
@@ -345,6 +356,15 @@ STANDARD_GAUGES = (
     "migrate.active",
     "migrate.watermark_steps",
     "migrate.total_steps",
+    # The fleet plane's topology gauges (obs/federate.py): scraped
+    # targets, targets refused past the host cap, objectives currently
+    # burning at FLEET scope, and the fleet history's tracked series.
+    # Per-host fleet.host_up{host=} series appear on first scrape.
+    "fleet.hosts",
+    "fleet.hosts_dropped",
+    "fleet.host_up",
+    "fleet.burning",
+    "fleet.series",
 )
 
 #: Histogram families the runtime emits (graftlint GL030 resolves
@@ -401,6 +421,146 @@ SPAN_CATALOG = (
 #: ids, per-request tokens) would otherwise grow the registry — and
 #: every snapshot, scrape and flight dump serializing it — forever.
 MAX_LABEL_VALUES = 256
+
+#: Label KEYS reserved for the fleet observability plane
+#: (obs/federate.py): the Collector merges every scraped worker's
+#: series into the fleet registry under ``host=<target>``, so a worker
+#: minting its own ``host=``/``fleet=`` label would collide with (or
+#: spoof) the federated view. graftlint GL034 flags any
+#: counter()/gauge()/histogram() call site outside obs/federate.py
+#: passing one of these keys.
+RESERVED_LABELS = ("host", "fleet")
+
+#: Operator-facing help text per schema family — the ``# HELP`` line of
+#: the Prometheus exposition (docs/observability.md carries the long
+#: form; these are the one-line scrape-page versions). Families not
+#: listed here (runtime-minted, tests) fall back to a generic line via
+#: :func:`schema_help`.
+SCHEMA_HELP = {
+    "worker.matches_rated_total": "matches rated and committed by the worker",
+    "worker.batches_ok_total": "batches that rated and committed cleanly",
+    "worker.batches_failed_total": "batches that hit the failure policy",
+    "worker.dead_letters_total": "messages dead-lettered to the failed queue",
+    "worker.acks_total": "messages acked after a committed batch",
+    "worker.pipeline_degradations_total":
+        "permanent fallbacks from the pipelined to the sequential loop",
+    "worker.pipeline_engine_failures_total":
+        "transient pipelined-engine construction failures (retried)",
+    "worker.pipeline_lag": "commit lag (batches) of the pipelined engine",
+    "worker.pipeline_degraded": "1 while the sequential fallback is active",
+    "worker.pipeline_inflight": "pipelined batches submitted, not harvested",
+    "worker.matches_per_sec": "worker throughput since start",
+    "sched.pad_steps_total": "schedule steps added as padding",
+    "sched.pad_slots_total": "schedule slots filled with the pad row",
+    "sched.steps_total": "supersteps dispatched by the scan runners",
+    "sched.occupancy": "fraction of schedule slots carrying real matches",
+    "feed.starved_total": "consumer waits on an empty prefetch ring",
+    "feed.backpressure_total": "producer waits on a full prefetch ring",
+    "feed.depth": "prefetch-ring occupancy after the last put/get",
+    "fused.windows_total": "fused working-set windows dispatched",
+    "fused.spills_total": "VMEM-budget window cuts (bulk spills)",
+    "fused.writebacks_avoided_total":
+        "per-step scatter rows the fused window kernel eliminated",
+    "fused.pad_steps_total": "inert padding steps in fused windows",
+    "fused.working_set_rows": "fused working-set high-water mark (rows)",
+    "tier.hits_total": "touched rows found in the HBM hot set",
+    "tier.misses_total": "touched rows promoted from the host cold tier",
+    "tier.promotions_total": "cold-to-hot row promotions",
+    "tier.demotions_total": "hot-set LRU demotions",
+    "tier.dirty_writebacks_total": "dirty rows written back to the cold tier",
+    "tier.spills_total": "window cuts forced by an over-budget working set",
+    "tier.hot_rows": "hot-set capacity in table rows",
+    "tier.host_bytes": "cold tier's committed host bytes",
+    "mesh.put_bytes_total": "bytes moved by mesh global puts",
+    "mesh.puts_total": "mesh global put calls",
+    "mesh.writebacks_avoidable_total":
+        "scatter rows a per-shard fused working set would have saved",
+    "jax.retraces_total": "XLA retraces observed by the jit listeners",
+    "jax.backend_compiles_total": "XLA backend compilations",
+    "obs.flight_dumps_total": "flight-recorder artifact dumps written",
+    "obs.dropped_series_total":
+        "series mints refused by the label-cardinality cap",
+    "serve.queries_total": "queries answered by the serving plane",
+    "serve.view_publishes_total": "ratings-view versions published",
+    "serve.leaderboard_cache_hits_total":
+        "leaderboard answers served from the version-keyed cache",
+    "serve.tier_cache_hits_total":
+        "tier-histogram answers served from the version-keyed cache",
+    "serve.view_publish_bytes_total": "H2D bytes moved by view publishes",
+    "serve.shard.queries_total": "queries routed to per-shard microbatches",
+    "serve.shard.merges_total": "cross-shard top-k host merges",
+    "serve.shard.merge_candidates_total": "candidates fed into shard merges",
+    "serve.view_cutovers_total": "atomic dual-lineage view cutovers",
+    "serve.view_version": "current served view version",
+    "serve.view_age_seconds": "seconds since the current view published",
+    "serve.shards": "shard count of the serving plane (0 = single)",
+    "soak.ticks_total": "soak virtual ticks executed",
+    "soak.matches_published_total": "matchmade matches pushed to the queue",
+    "soak.queries_sent_total": "serve queries issued by the soak workload",
+    "soak.slo_violations_total": "soak SLO gate failures",
+    "soak.qps_target": "configured soak match rate",
+    "soak.virtual_seconds": "virtual clock position of the running soak",
+    "broker.queue_depth": "ready messages on the consume queue",
+    "broker.partitions": "partition count of the partitioned broker",
+    "broker.backfill_admitted_total":
+        "backfill messages admitted behind live traffic",
+    "broker.backfill_throttled_total":
+        "backfill messages held back for host headroom",
+    "ingest.bytes_decoded_total": "bytes decoded by the columnar windows",
+    "ingest.rows_decoded_total": "rows decoded by the columnar windows",
+    "ingest.windows_total": "columnar decode windows completed",
+    "ingest.fallbacks_total": "streams refused by the native fast path",
+    "ingest.arena_allocs_total": "pinned-arena slab allocations",
+    "ingest.arena_reuses_total": "pinned-arena freelist reuses",
+    "ingest.h2d_commits_total": "H2D commits staged off the arena",
+    "ingest.arena_bytes": "pinned staging arena resident bytes",
+    "device.live_buffers": "live device buffers (leak canary)",
+    "history.samples_total": "history-ring sampling rounds",
+    "history.series": "series tracked by the history sampler",
+    "slo.burns_total": "SLO burn onsets seen by the watchdog",
+    "slo.recoveries_total": "SLO burn recoveries",
+    "slo.burning": "objectives currently burning (0 = healthy)",
+    "slo.state": "per-objective burn state (1 = burning)",
+    "audit.sampled_total": "served responses sampled by the shadow audit",
+    "audit.checked_total": "sampled responses replayed through the oracle",
+    "audit.mismatches_total":
+        "served responses that DIVERGED from the bit-exact oracle (SLO: 0)",
+    "audit.backlog": "sampled responses awaiting oracle replay",
+    "migrate.steps_total": "backfill supersteps dispatched",
+    "migrate.windows_total": "backfill decode windows consumed",
+    "migrate.matches_total": "matches re-rated by the backfill",
+    "migrate.throttled_total": "backfill dispatch pauses for live headroom",
+    "migrate.fallbacks_total": "backfills that fell back to the offline path",
+    "migrate.resumes_total": "backfills resumed from a checkpoint",
+    "migrate.cutovers_total": "migrations that completed their cutover",
+    "migrate.active": "1 while a backfill is running",
+    "migrate.watermark_steps": "backfill's dispatched-superstep watermark",
+    "migrate.total_steps": "backfill's total supersteps once known",
+    "fleet.scrapes_total": "Collector scrape rounds across the fleet",
+    "fleet.scrape_errors_total": "per-host scrape failures",
+    "fleet.burns_total": "fleet-scope SLO burn onsets",
+    "fleet.recoveries_total": "fleet-scope SLO burn recoveries",
+    "fleet.flight_requests_total":
+        "flight dumps requested from burning hosts via /debug/flight",
+    "fleet.hosts": "targets the Collector scrapes",
+    "fleet.hosts_dropped": "targets refused past the fleet host cap",
+    "fleet.host_up": "1 while the host's last scrape succeeded",
+    "fleet.burning": "objectives burning at fleet scope",
+    "fleet.series": "series tracked by the fleet history rings",
+    "phase_seconds": "wall seconds per instrumented phase",
+    "sched.pack_occupancy": "per-schedule slot occupancy distribution",
+    "serve.microbatch_occupancy": "per-tick serve microbatch fill",
+    "jax.backend_compile_seconds": "XLA backend compile durations",
+    "jax.trace_seconds": "XLA trace durations",
+}
+
+
+def schema_help(name: str) -> str:
+    """The ``# HELP`` line body for a series family; a generic pointer
+    at the catalog for names outside :data:`SCHEMA_HELP`."""
+    return SCHEMA_HELP.get(
+        name, f"analyzer_tpu series {name} (docs/observability.md catalog)"
+    )
 
 
 class MetricsRegistry:

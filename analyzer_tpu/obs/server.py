@@ -23,7 +23,15 @@ Everything the snapshot artifact exposes post-hoc (``--metrics-out``,
                            picks one downsampling tier);
   ``GET /sloz``            the SLO watchdog's objective table and
                            burn states (``obs/slo.py``);
-  ``GET /debug/snapshot``  the full JSON snapshot, spans included.
+  ``GET /debug/snapshot``  the full JSON snapshot, spans included;
+  ``GET /debug/flight``    TRIGGERS a flight-recorder dump
+                           (``?reason=...``) — the fleet Collector's
+                           evidence-capture hook (obs/federate.py):
+                           localhost-only regardless of the bind, and
+                           token-authenticated when a token is
+                           configured (``flight_token=`` /
+                           ``ANALYZER_TPU_FLIGHT_TOKEN``); throttling
+                           stays the recorder's (per reason).
 
 Served through the shared :mod:`analyzer_tpu.obs.httpd` plumbing (route
 table + daemon ``ThreadingHTTPServer``) — no framework, no dependency,
@@ -110,10 +118,25 @@ class ObsServer:
         status_provider=None,
         health: HealthChecks | None = None,
         max_statusz_spans: int = 200,
+        flight_dump=None,
+        flight_token: str | None = None,
     ) -> None:
+        import os
+
         self.health = health if health is not None else HealthChecks()
         self.status_provider = status_provider
         self._max_statusz_spans = max_statusz_spans
+        # /debug/flight: the dump hook (the worker passes its own so a
+        # remote-triggered artifact carries config + profiler info like
+        # a local one) and the shared-secret token. No token configured
+        # = localhost peers may trigger untokened (the endpoint is
+        # loopback-gated either way).
+        self._flight_dump = flight_dump
+        self.flight_token = (
+            flight_token
+            or os.environ.get("ANALYZER_TPU_FLIGHT_TOKEN")
+            or None
+        )
         self._httpd = RoutedHTTPServer(
             routes={
                 "/healthz": lambda params: text_body("ok\n"),
@@ -123,10 +146,12 @@ class ObsServer:
                 "/historyz": self._route_historyz,
                 "/sloz": self._route_sloz,
                 "/debug/snapshot": self._route_snapshot,
+                "/debug/flight": self._route_flight,
             },
             port=port,
             host=host,
             name="analyzer-obsd",
+            local_only={"/debug/flight"},
         )
         self.host = host
         logger.info("obsd listening on http://%s:%d", self.host, self.port)
@@ -167,6 +192,34 @@ class ObsServer:
 
         body = json.dumps(
             get_watchdog().status(), indent=1, sort_keys=True
+        )
+        return 200, body + "\n", "application/json"
+
+    def _route_flight(self, params) -> tuple[int, str, str]:
+        """The authenticated-localhost dump trigger: a fleet Collector
+        (or an operator's curl on the box) asks THIS process to freeze
+        its flight-recorder evidence — used at fleet-burn onset so the
+        burning host captures its own trajectory while it burns. The
+        recorder's per-reason throttle still applies (a storm of
+        requests produces one artifact); the reason is sanitized into
+        the artifact directory name by the recorder itself."""
+        if self.flight_token is not None and (
+            params.get("token") != self.flight_token
+        ):
+            return (
+                403,
+                json.dumps({"error": "bad or missing token"}) + "\n",
+                "application/json",
+            )
+        reason = params.get("reason") or "remote"
+        if self._flight_dump is not None:
+            path = self._flight_dump(reason)
+        else:
+            from analyzer_tpu.obs.flight import get_flight_recorder
+
+            path = get_flight_recorder().dump(reason)
+        body = json.dumps(
+            {"reason": reason, "dumped": path}, sort_keys=True
         )
         return 200, body + "\n", "application/json"
 

@@ -102,20 +102,33 @@ def _coerce(value) -> float | None:
 
 def prometheus_text(snap: dict | None = None) -> str:
     """Prometheus text-format exposition of a snapshot (or of the live
-    process when ``snap`` is None). Retrace counts surface as
-    ``jax_jit_cache_size{entrypoint="..."}``."""
+    process when ``snap`` is None). Every family leads with its
+    ``# HELP`` / ``# TYPE`` pair — HELP text from the STANDARD schema
+    catalog (``obs.registry.SCHEMA_HELP``), TYPE from the bucket the
+    series lives in (counters as ``counter``, gauges as ``gauge``,
+    histograms as ``summary``). Retrace counts surface as
+    ``jax_jit_cache_size{entrypoint="..."}``. :func:`parse_prometheus_text`
+    round-trips this output."""
+    from analyzer_tpu.obs.registry import schema_help
+
     snap = snap if snap is not None else snapshot(max_spans=0)
     lines: list[str] = []
     typed: set[str] = set()
+
+    def declare(name: str, family: str, mtype: str) -> None:
+        if name in typed:
+            return
+        typed.add(name)
+        text = schema_help(family).replace("\\", "\\\\").replace("\n", "\\n")
+        lines.append(f"# HELP {name} {text}")
+        lines.append(f"# TYPE {name} {mtype}")
 
     def emit(key: str, value, mtype: str, extra_labels: str = "") -> None:
         v = _coerce(value)
         if v is None:
             return
         name, labels = _split_series(key)
-        if name not in typed:
-            typed.add(name)
-            lines.append(f"# TYPE {name} {mtype}")
+        declare(name, key.split("{", 1)[0], mtype)
         body = ",".join(x for x in (labels, extra_labels) if x)
         series = f"{name}{{{body}}}" if body else name
         lines.append(f"{series} {v:g}")
@@ -126,9 +139,7 @@ def prometheus_text(snap: dict | None = None) -> str:
         emit(key, value, "gauge")
     for key, summ in snap.get("histograms", {}).items():
         name, labels = _split_series(key)
-        if name not in typed:
-            typed.add(name)
-            lines.append(f"# TYPE {name} summary")
+        declare(name, key.split("{", 1)[0], "summary")
         prefix = f"{{{labels}," if labels else "{"
         for q in ("p50", "p90", "p99"):
             if summ.get(q) is not None:
@@ -144,6 +155,113 @@ def prometheus_text(snap: dict | None = None) -> str:
             extra_labels=f'entrypoint="{escape_label_value(entry)}"',
         )
     return "\n".join(lines) + "\n"
+
+
+_LABEL_RE = re.compile(r'([a-zA-Z0-9_]+)="((?:\\.|[^"\\])*)"')
+_PROM_LINE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(\{(?P<labels>.*)\})?\s+(?P<value>\S+)$"
+)
+_QUANTILE_OF = {"0.5": "p50", "0.50": "p50", "0.9": "p90", "0.90": "p90",
+                "0.99": "p99"}
+
+
+def _unescape_label_value(value: str) -> str:
+    return (
+        value.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+    )
+
+
+def _unsanitize_map() -> dict[str, str]:
+    """sanitized exposition name -> the registry's dotted family name,
+    built from the STANDARD schema catalog (the exposition's name
+    sanitization is lossy — ``worker.acks_total`` and a hypothetical
+    ``worker_acks_total`` collide — so the catalog is the only way
+    back)."""
+    from analyzer_tpu.obs.registry import (
+        SCHEMA_HELP,
+        STANDARD_COUNTERS,
+        STANDARD_GAUGES,
+        STANDARD_HISTOGRAMS,
+    )
+
+    out: dict[str, str] = {}
+    for name in (
+        *STANDARD_COUNTERS, *STANDARD_GAUGES, *STANDARD_HISTOGRAMS,
+        *SCHEMA_HELP,
+    ):
+        out[_NAME_RE.sub("_", name)] = name
+    return out
+
+
+def parse_prometheus_text(text: str) -> dict:
+    """Parses a :func:`prometheus_text` exposition back into the
+    snapshot shape: ``counters``/``gauges`` as ``{series_key: value}``,
+    ``histograms`` as ``{series_key: {p50/p90/p99/sum/count}}``, plus
+    the scraped ``help`` and ``types`` per family. Series keys are the
+    registry's ``name{label=value,...}`` format with dotted names
+    recovered through the STANDARD schema catalog — the exposition/
+    parse pair round-trips every cataloged series (pinned by
+    tests/test_obs.py). Unknown families keep their sanitized names and
+    parse by their ``# TYPE`` line; lines with neither are skipped."""
+    unsanitize = _unsanitize_map()
+    out = {
+        "counters": {}, "gauges": {}, "histograms": {},
+        "help": {}, "types": {},
+    }
+
+    def family(name: str) -> str:
+        return unsanitize.get(name, name)
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            kind, rest = line[2:6], line[7:]
+            name, _, body = rest.partition(" ")
+            if kind == "HELP":
+                out["help"][family(name)] = (
+                    body.replace("\\n", "\n").replace("\\\\", "\\")
+                )
+            else:
+                out["types"][family(name)] = body.strip()
+            continue
+        if line.startswith("#"):
+            continue
+        m = _PROM_LINE_RE.match(line)
+        if m is None:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        name = m.group("name")
+        value = float(m.group("value"))
+        labels = {
+            k: _unescape_label_value(v)
+            for k, v in _LABEL_RE.findall(m.group("labels") or "")
+        }
+        quantile = labels.pop("quantile", None)
+        hist_field = None
+        base = name
+        if quantile is not None:
+            hist_field = _QUANTILE_OF.get(quantile)
+        elif name.endswith("_sum") and out["types"].get(
+            family(name[:-4])
+        ) == "summary":
+            base, hist_field = name[:-4], "sum"
+        elif name.endswith("_count") and out["types"].get(
+            family(name[:-6])
+        ) == "summary":
+            base, hist_field = name[:-6], "count"
+        fam = family(base)
+        inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+        key = f"{fam}{{{inner}}}" if inner else fam
+        if hist_field is not None:
+            entry = out["histograms"].setdefault(key, {})
+            entry[hist_field] = int(value) if hist_field == "count" else value
+            continue
+        mtype = out["types"].get(fam, "gauge")
+        bucket = "counters" if mtype == "counter" else "gauges"
+        out[bucket][key] = value
+    return out
 
 
 def render_summary(snap: dict) -> str:

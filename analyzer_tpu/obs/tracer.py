@@ -124,9 +124,18 @@ class Tracer:
 
     def export_chrome(self, path: str) -> int:
         """Writes the ring as Chrome trace-event JSONL; returns the event
-        count."""
+        count (the leading metadata line excluded). The first line is a
+        ``trace_epoch`` metadata event carrying this tracer's wall-clock
+        epoch — what lets the trace stitcher (obs/traceview.py
+        ``load_forest``) align exports from DIFFERENT processes onto one
+        timeline; Perfetto ignores unknown metadata."""
         events = self.events()
         with open(path, "w", encoding="utf-8") as f:
+            f.write(json.dumps({
+                "name": "trace_epoch", "cat": "__metadata", "ph": "M",
+                "ts": 0.0, "pid": os.getpid(), "tid": 0,
+                "args": {"epoch_wall": self.epoch_wall},
+            }) + "\n")
             for event in events:
                 f.write(json.dumps(event) + "\n")
         return len(events)

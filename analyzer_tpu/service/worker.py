@@ -213,6 +213,11 @@ class Worker:
                 port=obs_port,
                 host=obs_host or DEFAULT_HOST,
                 status_provider=self.stats,
+                # /debug/flight rides the worker's own dump path so a
+                # remotely-triggered artifact (a fleet Collector at
+                # burn onset, obs/federate.py) carries the config +
+                # device-profiler blocks a local trigger would.
+                flight_dump=self._flight_dump,
             )
             health = self.obs_server.health
             health.register("worker.pipeline", self._pipeline_health)
@@ -1126,13 +1131,15 @@ class Worker:
             return True, "pipelined"
         return True, "sequential by config"
 
-    def _flight_dump(self, reason: str, force: bool = False) -> None:
+    def _flight_dump(self, reason: str, force: bool = False) -> str | None:
         """One flight-recorder artifact for a failure path. Never raises
         (obs/flight.py owns the throttle + error swallowing); the config
         capture rides along so the artifact explains the worker's knobs,
         and the device profiler's capture info names the jax.profiler
-        artifact directory when one is armed."""
-        self.flight.dump(
+        artifact directory when one is armed. Returns the artifact path
+        (None when unarmed or throttled) — obsd's /debug/flight trigger
+        reports it to the requesting Collector."""
+        return self.flight.dump(
             reason, config=dataclasses.asdict(self.config), force=force,
             profile=self.profiler.capture_info(),
         )

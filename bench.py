@@ -72,7 +72,10 @@ line, plus an on-rig bit-identity check), BENCH_TRACE_OVERHEAD
 block that `cli benchdiff` gates at <= 2%), BENCH_WATCHDOG_OVERHEAD
 (default 1; 0 skips the SLO-plane-on vs off `watchdog_overhead` block —
 history sampler + burn-rate watchdog + shadow-audit drain riding every
-chunk boundary — gated the same <= 2%), BENCH_OBS_PORT
+chunk boundary — gated the same <= 2%), BENCH_FEDERATE_OVERHEAD
+(default 1; 0 skips the scraped-under-load vs unscraped
+`federate_overhead` block — a fleet Collector hitting obsd at 20 Hz
+while the e2e line runs — gated the same <= 2%), BENCH_OBS_PORT
 (serve obsd — /metrics, /statusz — on localhost while the capture runs;
 `cli bench --obs-port` sets the same thing).
 """
@@ -387,6 +390,55 @@ def _bench_main(metrics_out: str | None) -> None:
             "stable": wd_stable,
         }
 
+    # Fleet-federation tax: the SAME end-to-end rate_history line while
+    # a Collector (obs/federate.py) scrapes this process's obsd
+    # /debug/snapshot + /historyz at a dense cadence (20 Hz — well above
+    # production's per-interval scrape, deliberately worst-case). The
+    # scrape path serializes the full registry + span ring per round;
+    # benchdiff gates overhead_pct <= 2% so federation can never become
+    # a tax on the workers it observes (docs/observability.md "Fleet
+    # plane").
+    federate_overhead = None
+    if os.environ.get("BENCH_FEDERATE_OVERHEAD", "1") != "0":
+        import threading
+        import time as _time
+
+        from analyzer_tpu.obs.federate import Collector
+        from analyzer_tpu.obs.server import ObsServer
+
+        fed_obsd = ObsServer(port=0)
+        fed_col = Collector(
+            [f"127.0.0.1:{fed_obsd.port}"], request_flight_dumps=False
+        )
+        fed_stop = threading.Event()
+
+        def fed_scrape_loop():
+            while not fed_stop.is_set():
+                fed_col.scrape(_time.perf_counter())
+                fed_stop.wait(0.05)
+
+        fed_thread = threading.Thread(
+            target=fed_scrape_loop, name="bench-fed-scraper", daemon=True
+        )
+        fed_thread.start()
+        try:
+            _, t_fed, fed_times, fed_stable = time_runs(run_e2e, 2)
+        finally:
+            fed_stop.set()
+            fed_thread.join(timeout=10)
+            fed_obsd.close()
+        fed_pct = (t_fed - t_e2e) / t_e2e * 100.0
+        log(f"scraped-under-load rate_history: {t_fed:.2f}s "
+            f"({fed_pct:+.2f}% vs unscraped, {fed_col.scrapes} scrapes)")
+        federate_overhead = {
+            "off_s": round(t_e2e, 3),
+            "on_s": round(t_fed, 3),
+            "overhead_pct": round(fed_pct, 2),
+            "repeats_s": [round(t, 3) for t in fed_times],
+            "scrapes": fed_col.scrapes,
+            "stable": fed_stable,
+        }
+
     # Tiered table (BENCH_HOT_ROWS > 0): the SAME rate_history line with
     # only hot_rows of the table device-resident — min_over_resident is
     # the tiering tax benchdiff gates (sched/tier.py, docs/kernels.md).
@@ -431,6 +483,7 @@ def _bench_main(metrics_out: str | None) -> None:
         tiered=tiered_block,
         trace_overhead=trace_overhead,
         watchdog_overhead=watchdog_overhead,
+        federate_overhead=federate_overhead,
     )
 
 
@@ -1078,7 +1131,8 @@ def emit_metric(rate, capture: dict | None = None,
                 fused: dict | None = None,
                 tiered: dict | None = None,
                 trace_overhead: dict | None = None,
-                watchdog_overhead: dict | None = None):
+                watchdog_overhead: dict | None = None,
+                federate_overhead: dict | None = None):
     line = {
         "metric": "matches_per_sec_per_chip",
         "value": round(rate, 1),
@@ -1111,6 +1165,11 @@ def emit_metric(rate, capture: dict | None = None,
         # drain riding every chunk boundary vs plane-off on the same
         # line; `cli benchdiff` gates overhead_pct <= 2%).
         line["watchdog_overhead"] = watchdog_overhead
+    if federate_overhead is not None:
+        # The fleet-scrape tax (a Collector hitting obsd under load vs
+        # unscraped on the same line; `cli benchdiff` gates
+        # overhead_pct <= 2% — federation must never tax the workers).
+        line["federate_overhead"] = federate_overhead
     if telemetry is not None:
         line["telemetry"] = telemetry
     if metrics_out:

@@ -1,0 +1,99 @@
+"""Subprocess worker for the fleet-plane topology test
+(tests/test_federate.py).
+
+One invocation = one "host" of a two-worker fleet: it rebuilds the
+deterministic synthetic match population, seeds its store with the
+subset of matches the parent's partitioned fan-out assigned to it,
+publishes those ids into its LOCAL broker with the trace headers the
+parent minted (exactly what survives a cross-host AMQP handoff — the
+headers, nothing else), rates them through a real ``Worker`` with obsd
++ the serve plane on, exports its trace ring (the stitcher's input),
+and then keeps serving obsd until the parent signals exit — so the
+parent's Collector can scrape ``/debug/snapshot``/``/historyz`` and
+trigger ``/debug/flight`` on it.
+
+An "injected burn" is a file-gated dead-letter counter bump: the parent
+touches ``burn_file`` between two Collector scrapes, so the fleet-scope
+``zero-dead-letters`` window sees a delta on exactly this host.
+
+Spec (JSON, argv[1]): ``msgs`` ([{"id", "headers"}]), ``n_matches``,
+``id_prefix``, ``trace_out``, ``flight_dir``, ``ready_file``,
+``exit_file``, ``burn_file``, ``burn`` (count).
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    with open(sys.argv[1], encoding="utf-8") as f:
+        spec = json.load(f)
+    os.environ["ANALYZER_TPU_TRACE"] = "1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from analyzer_tpu.config import RatingConfig, ServiceConfig
+    from analyzer_tpu.fixtures import synthetic_batch
+    from analyzer_tpu.obs.registry import get_registry
+    from analyzer_tpu.obs.snapshot import write_chrome_trace
+    from analyzer_tpu.service.broker import InMemoryBroker
+    from analyzer_tpu.service.store import InMemoryStore
+    from analyzer_tpu.service.worker import Worker
+
+    msgs = spec["msgs"]
+    population = {
+        m.api_id: m
+        for m in synthetic_batch(
+            spec["n_matches"], id_prefix=spec["id_prefix"]
+        )
+    }
+    broker = InMemoryBroker()
+    store = InMemoryStore()
+    for m in msgs:
+        store.add_match(population[m["id"]])
+    worker = Worker(
+        broker,
+        store,
+        ServiceConfig(batch_size=max(1, len(msgs)), idle_timeout=0.0),
+        RatingConfig(),
+        pipeline=False,
+        obs_port=0,
+        flight_dir=spec["flight_dir"],
+        serve_port=0,
+    )
+    for m in msgs:
+        broker.publish("analyze", m["id"].encode(), headers=m["headers"])
+    worker.run(max_flushes=1, max_wall_s=300.0)
+    worker.drain()
+    write_chrome_trace(spec["trace_out"])
+    # Announce readiness atomically (tmp + rename): the parent polls for
+    # this file, then points its Collector at the obsd port inside.
+    tmp = spec["ready_file"] + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(
+            {"obs_port": worker.obs_server.port, "pid": os.getpid()}, f
+        )
+    os.replace(tmp, spec["ready_file"])
+    burned = False
+    deadline = time.time() + 300.0
+    while time.time() < deadline and not os.path.exists(spec["exit_file"]):
+        if (
+            not burned
+            and spec.get("burn")
+            and os.path.exists(spec["burn_file"])
+        ):
+            # The injected burn: dead letters appear on THIS host only,
+            # strictly between two of the parent's Collector scrapes.
+            get_registry().counter("worker.dead_letters_total").add(
+                spec["burn"]
+            )
+            burned = True
+        time.sleep(0.05)
+    worker.close()
+
+
+if __name__ == "__main__":
+    main()

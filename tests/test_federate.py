@@ -1,0 +1,860 @@
+"""The fleet observability plane (obs/federate.py, ISSUE 14).
+
+Acceptance contract: a Collector merges N workers' registries under the
+reserved ``host=`` label, maintains fleet-level history rings, detects
+an injected SLO burn at FLEET scope and attributes it to the offending
+host (requesting a flight dump from that host's ``/debug/flight``
+trigger), and serves ``/fleetz`` / aggregated ``/metrics`` / a fleet
+``/sloz``. The two-worker SUBPROCESS topology test at the bottom proves
+the whole chain against real processes, including cross-process trace
+stitching (enqueue in the parent, rating in a child, ``broker_transit``
+in the stitched report). Satellites pinned here: the registry's
+scrape-vs-write locking contract, Prometheus ``# HELP``/``# TYPE``
+round-trip, and the soak's deterministic block being bit-identical with
+a Collector scraping the run.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from analyzer_tpu.obs import reset_flight_recorder, reset_registry
+from analyzer_tpu.obs.federate import (
+    Collector,
+    FleetServer,
+    fleet_series_key,
+)
+from analyzer_tpu.obs.registry import RESERVED_LABELS, get_registry
+from analyzer_tpu.obs.snapshot import (
+    parse_prometheus_text,
+    prometheus_text,
+    snapshot,
+)
+from analyzer_tpu.obs.tracer import reset_tracer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    reset_registry()
+    reset_tracer()
+    reset_flight_recorder()
+    yield
+    reset_registry()
+    reset_tracer()
+    reset_flight_recorder()
+
+
+def http_get(url: str) -> tuple[int, str]:
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode("utf-8")
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode("utf-8")
+
+
+def _snap(counters=None, gauges=None, histograms=None) -> dict:
+    return {
+        "counters": dict(counters or {}),
+        "gauges": dict(gauges or {}),
+        "histograms": dict(histograms or {}),
+    }
+
+
+class FakeFleet:
+    """Canned per-target obsd payloads + a request log — the Collector's
+    injectable fetcher, so federation logic tests run without sockets."""
+
+    def __init__(self, snapshots: dict) -> None:
+        self.snapshots = snapshots  # target -> snapshot dict (mutable)
+        self.down: set = set()
+        self.requests: list = []
+        self.flight_requests: list = []
+
+    def fetch(self, url: str, timeout: float = 5.0) -> dict:
+        self.requests.append(url)
+        rest = url[len("http://"):]
+        target, _, pathq = rest.partition("/")
+        path, _, _query = ("/" + pathq).partition("?")
+        if target in self.down:
+            raise OSError(f"{target} down")
+        if path == "/debug/snapshot":
+            return self.snapshots[target]
+        if path == "/historyz":
+            return {"last_sample_t": 12.0, "samples": 5, "series": {}}
+        if path == "/debug/flight":
+            self.flight_requests.append(url)
+            return {"dumped": f"/tmp/flight-{target}", "reason": "x"}
+        raise AssertionError(f"unexpected path {path}")
+
+
+class TestFleetSeriesKey:
+    def test_bare_name_gains_host_label(self):
+        assert (
+            fleet_series_key("worker.acks_total", "10.0.0.1:9100")
+            == "worker.acks_total{host=10.0.0.1:9100}"
+        )
+
+    def test_existing_labels_merge_sorted(self):
+        key = fleet_series_key(
+            "broker.queue_depth{queue=analyze}", "a:1"
+        )
+        assert key == "broker.queue_depth{host=a:1,queue=analyze}"
+
+    def test_reserved_labels_constant(self):
+        assert "host" in RESERVED_LABELS and "fleet" in RESERVED_LABELS
+
+
+class TestFleetMerge:
+    def _collector(self, snapshots, **kw) -> tuple[Collector, FakeFleet]:
+        fleet = FakeFleet(snapshots)
+        col = Collector(
+            list(snapshots), fetch=fleet.fetch,
+            request_flight_dumps=kw.pop("request_flight_dumps", True),
+            **kw,
+        )
+        return col, fleet
+
+    def test_counters_sum_and_gain_host_series(self):
+        col, _ = self._collector({
+            "a:1": _snap(counters={"worker.matches_rated_total": 5}),
+            "b:2": _snap(counters={"worker.matches_rated_total": 7}),
+        })
+        col.scrape(1.0)
+        merged = col.fleet_snapshot()
+        assert merged["counters"]["worker.matches_rated_total"] == 12
+        assert merged["counters"][
+            "worker.matches_rated_total{host=a:1}"
+        ] == 5
+        assert merged["counters"][
+            "worker.matches_rated_total{host=b:2}"
+        ] == 7
+
+    def test_gauges_take_the_worst_host(self):
+        col, _ = self._collector({
+            "a:1": _snap(gauges={"serve.view_age_seconds": 2.0}),
+            "b:2": _snap(gauges={"serve.view_age_seconds": 44.0}),
+        })
+        col.scrape(1.0)
+        merged = col.fleet_snapshot()
+        assert merged["gauges"]["serve.view_age_seconds"] == 44.0
+        assert merged["gauges"][
+            "serve.view_age_seconds{host=a:1}"
+        ] == 2.0
+
+    def test_labeled_series_and_histograms_merge_under_host(self):
+        col, _ = self._collector({
+            "a:1": _snap(
+                counters={"worker.acks_total": 1},
+                gauges={"broker.queue_depth{queue=analyze}": 9},
+                histograms={
+                    "phase_seconds{phase=pack}": {
+                        "count": 3, "sum": 0.6, "p50": 0.2, "p99": 0.3,
+                    }
+                },
+            ),
+        })
+        col.scrape(1.0)
+        merged = col.fleet_snapshot()
+        assert merged["gauges"][
+            "broker.queue_depth{host=a:1,queue=analyze}"
+        ] == 9
+        hist = merged["histograms"][
+            "phase_seconds{host=a:1,phase=pack}"
+        ]
+        assert hist["count"] == 3 and hist["p99"] == 0.3
+
+    def test_down_host_leaves_merge_and_counts_errors(self):
+        col, fleet = self._collector({
+            "a:1": _snap(counters={"worker.acks_total": 5}),
+            "b:2": _snap(counters={"worker.acks_total": 3}),
+        })
+        col.scrape(1.0)
+        fleet.down.add("b:2")
+        col.scrape(2.0)
+        merged = col.fleet_snapshot()
+        assert merged["counters"]["worker.acks_total"] == 5
+        assert "worker.acks_total{host=b:2}" not in merged["counters"]
+        fz = col.fleetz()
+        assert fz["up"] == 1
+        assert fz["hosts"]["b:2"]["consecutive_failures"] == 1
+        assert fz["hosts"]["b:2"]["last_error"]
+        assert get_registry().counter("fleet.scrape_errors_total").value == 1
+
+    def test_host_cap_refuses_extra_targets(self):
+        snaps = {f"h{i}:1": _snap() for i in range(5)}
+        col, _ = self._collector(snaps, max_hosts=3)
+        assert len(col.targets) == 3
+        assert get_registry().gauge("fleet.hosts_dropped").value == 2
+
+    def test_fleet_self_telemetry_rides_the_merge(self):
+        col, _ = self._collector({"a:1": _snap()})
+        col.scrape(1.0)
+        merged = col.fleet_snapshot()
+        assert merged["counters"]["fleet.scrapes_total"] == 1
+        assert merged["gauges"]["fleet.hosts"] == 1
+
+    def test_per_host_history_staleness_lands_in_fleetz(self):
+        col, _ = self._collector({"a:1": _snap()})
+        col.scrape(1.0)
+        row = col.fleetz()["hosts"]["a:1"]
+        assert row["history_last_sample_t"] == 12.0
+        assert row["history_samples"] == 5
+
+
+class TestFleetBurns:
+    TARGETS = ("a:1", "b:2")
+
+    def _fleet(self):
+        snaps = {
+            t: _snap(counters={"worker.dead_letters_total": 0.0})
+            for t in self.TARGETS
+        }
+        fleet = FakeFleet(snaps)
+        col = Collector(
+            list(self.TARGETS), fetch=fleet.fetch, flight_token="tok",
+        )
+        return col, fleet
+
+    def test_burn_attributes_the_offending_host(self):
+        col, fleet = self._fleet()
+        col.scrape(0.0)
+        fleet.snapshots["b:2"]["counters"]["worker.dead_letters_total"] = 3.0
+        col.scrape(30.0)
+        col.scrape(61.0)
+        assert "zero-dead-letters" in col.burning
+        assert col.attribution()["zero-dead-letters"] == ["b:2"]
+        assert get_registry().counter("fleet.burns_total").value == 1
+
+    def test_burn_requests_flight_dump_from_burning_host_once(self):
+        col, fleet = self._fleet()
+        col.scrape(0.0)
+        fleet.snapshots["b:2"]["counters"]["worker.dead_letters_total"] = 3.0
+        col.scrape(30.0)
+        col.scrape(61.0)
+        col.scrape(75.0)  # still burning: no second request (onset-only)
+        assert len(fleet.flight_requests) == 1
+        url = fleet.flight_requests[0]
+        assert url.startswith("http://b:2/debug/flight")
+        assert "reason=fleet-slo-zero-dead-letters" in url
+        assert "token=tok" in url
+        assert (
+            get_registry().counter("fleet.flight_requests_total").value == 1
+        )
+
+    def test_recovery_counts_symmetrically(self):
+        col, fleet = self._fleet()
+        col.scrape(0.0)
+        fleet.snapshots["b:2"]["counters"]["worker.dead_letters_total"] = 3.0
+        col.scrape(30.0)
+        col.scrape(61.0)
+        assert col.burning
+        # Flat counters: once the window's oldest covered row already
+        # carries the post-burn value, the delta reads 0 and recovery
+        # is recorded.
+        for t in (90.0, 121.0, 150.0, 181.0, 211.0, 241.0, 271.0, 301.0,
+                  331.0, 361.0, 391.0):
+            col.scrape(t)
+        assert "zero-dead-letters" not in col.burning
+        assert get_registry().counter("fleet.recoveries_total").value == 1
+
+    def test_young_fleet_never_burns(self):
+        col, _ = self._fleet()
+        burns = col.scrape(0.0)
+        assert all(not b.burning for b in burns)
+
+    def test_sloz_payload_names_hosts(self):
+        col, fleet = self._fleet()
+        col.scrape(0.0)
+        fleet.snapshots["b:2"]["counters"]["worker.dead_letters_total"] = 1.0
+        col.scrape(30.0)
+        col.scrape(61.0)
+        sz = col.sloz()
+        assert sz["scope"] == "fleet"
+        row = next(
+            o for o in sz["objectives"] if o["name"] == "zero-dead-letters"
+        )
+        assert row["state"] == "burning" and row["hosts"] == ["b:2"]
+
+
+class TestCheckOnce:
+    def test_absolute_dead_letters_burn_with_attribution(self):
+        fleet = FakeFleet({
+            "a:1": _snap(counters={"worker.dead_letters_total": 0.0}),
+            "b:2": _snap(counters={"worker.dead_letters_total": 2.0}),
+        })
+        col = Collector(["a:1", "b:2"], fetch=fleet.fetch,
+                        request_flight_dumps=False)
+        burns = col.check(0.0)
+        names = {b.objective: hosts for b, hosts in burns}
+        assert names["zero-dead-letters"] == ["b:2"]
+
+    def test_worst_host_staleness_burns(self):
+        fleet = FakeFleet({
+            "a:1": _snap(gauges={"serve.view_age_seconds": 2.0}),
+            "b:2": _snap(gauges={"serve.view_age_seconds": 45.0}),
+        })
+        col = Collector(["a:1", "b:2"], fetch=fleet.fetch,
+                        request_flight_dumps=False)
+        burns = col.check(0.0)
+        names = {b.objective: hosts for b, hosts in burns}
+        assert names["bounded-view-staleness"] == ["b:2"]
+
+    def test_green_topology_returns_empty(self):
+        fleet = FakeFleet({
+            "a:1": _snap(counters={"worker.dead_letters_total": 0.0}),
+        })
+        col = Collector(["a:1"], fetch=fleet.fetch,
+                        request_flight_dumps=False)
+        assert col.check(0.0) == []
+
+
+class TestFleetServerEndpoints:
+    def test_federated_surface_over_a_live_obsd(self):
+        from analyzer_tpu.obs.server import ObsServer
+
+        obsd = ObsServer(port=0)
+        fs = None
+        try:
+            get_registry().counter("worker.matches_rated_total").add(10)
+            target = f"127.0.0.1:{obsd.port}"
+            col = Collector([target], request_flight_dumps=False)
+            col.scrape(0.0)
+            fs = FleetServer(col, port=0)
+            status, body = http_get(fs.url + "/fleetz")
+            assert status == 200
+            fz = json.loads(body)
+            assert fz["up"] == 1 and fz["hosts"][target]["up"]
+            status, body = http_get(fs.url + "/metrics")
+            assert status == 200
+            parsed = parse_prometheus_text(body)
+            key = f"worker.matches_rated_total{{host={target}}}"
+            assert parsed["counters"][key] == 10.0
+            assert parsed["counters"]["worker.matches_rated_total"] == 10.0
+            status, body = http_get(fs.url + "/sloz")
+            assert status == 200
+            assert json.loads(body)["scope"] == "fleet"
+            status, body = http_get(
+                fs.url + "/historyz?series=worker.matches"
+            )
+            assert status == 200
+            hz = json.loads(body)
+            assert "worker.matches_rated_total" in hz["series"]
+            assert key in hz["series"]
+        finally:
+            if fs is not None:
+                fs.close()
+            obsd.close()
+
+
+class TestDebugFlightTrigger:
+    def test_token_and_throttle(self, tmp_path):
+        from analyzer_tpu.obs.server import ObsServer
+
+        reset_flight_recorder(base_dir=str(tmp_path))
+        srv = ObsServer(port=0, flight_token="s3cret")
+        try:
+            status, _ = http_get(srv.url + "/debug/flight?reason=x")
+            assert status == 403  # missing token
+            status, body = http_get(
+                srv.url + "/debug/flight?reason=fleet-slo-x&token=s3cret"
+            )
+            assert status == 200
+            got = json.loads(body)
+            assert got["dumped"] and os.path.isdir(got["dumped"])
+            # The recorder's per-reason throttle still governs repeats.
+            status, body = http_get(
+                srv.url + "/debug/flight?reason=fleet-slo-x&token=s3cret"
+            )
+            assert json.loads(body)["dumped"] is None
+        finally:
+            srv.close()
+
+    def test_untokened_server_still_dumps_for_localhost(self, tmp_path):
+        from analyzer_tpu.obs.server import ObsServer
+
+        reset_flight_recorder(base_dir=str(tmp_path))
+        srv = ObsServer(port=0, flight_token="")
+        try:
+            assert srv.flight_token is None  # "" = unset, like the env
+            status, body = http_get(srv.url + "/debug/flight?reason=ok")
+            assert status == 200 and json.loads(body)["dumped"]
+        finally:
+            srv.close()
+
+    def test_route_is_registered_localhost_only(self):
+        from analyzer_tpu.obs.server import ObsServer
+
+        srv = ObsServer(port=0)
+        try:
+            assert "/debug/flight" in srv._httpd._local_only
+        finally:
+            srv.close()
+
+    def test_worker_wired_dump_carries_config(self, tmp_path):
+        from analyzer_tpu.config import RatingConfig, ServiceConfig
+        from analyzer_tpu.service import InMemoryBroker, InMemoryStore, Worker
+
+        reset_flight_recorder()
+        worker = Worker(
+            InMemoryBroker(), InMemoryStore(),
+            ServiceConfig(batch_size=2, idle_timeout=0.0), RatingConfig(),
+            obs_port=0, flight_dir=str(tmp_path),
+        )
+        try:
+            url = worker.obs_server.url + "/debug/flight?reason=fleet-slo-t"
+            status, body = http_get(url)
+            assert status == 200
+            path = json.loads(body)["dumped"]
+            assert path
+            with open(os.path.join(path, "context.json")) as f:
+                context = json.load(f)
+            # The worker's own dump hook ran: config rides the artifact
+            # exactly like a locally-triggered dump.
+            assert context["config"]["batch_size"] == 2
+            assert context["reason"] == "fleet-slo-t"
+        finally:
+            worker.close()
+
+
+class TestRegistryScrapeConcurrency:
+    """The locking contract the Collector relies on (satellite): a
+    reader thread snapshotting + rendering the registry while worker
+    threads mint and bump labeled series must never see a torn or
+    partially-labeled sample."""
+
+    N_WRITERS = 4
+    READS = 60
+
+    def test_reader_never_sees_torn_or_partially_labeled_series(self):
+        import re
+
+        reg = reset_registry()
+        stop = threading.Event()
+        failures: list = []
+
+        def writer(i: int) -> None:
+            n = 0
+            try:
+                while not stop.is_set():
+                    reg.counter(
+                        "worker.acks_total", queue=f"w{i}-{n % 40}"
+                    ).add(1)
+                    reg.gauge(
+                        "broker.queue_depth", queue=f"w{i}-{n % 40}"
+                    ).set(n)
+                    reg.histogram(
+                        "phase_seconds", phase=f"w{i}-{n % 10}"
+                    ).observe(n * 0.01)
+                    n += 1
+            except Exception as err:  # pragma: no cover - the assertion
+                failures.append(repr(err))
+
+        threads = [
+            threading.Thread(target=writer, args=(i,), daemon=True)
+            for i in range(self.N_WRITERS)
+        ]
+        for t in threads:
+            t.start()
+        key_re = re.compile(
+            r"^[a-zA-Z0-9_.]+(\{[a-zA-Z0-9_]+=[^,{}]*"
+            r"(,[a-zA-Z0-9_]+=[^,{}]*)*\})?$"
+        )
+        try:
+            for _ in range(self.READS):
+                snap = reg.snapshot()
+                for bucket in ("counters", "gauges", "histograms"):
+                    for key in snap[bucket]:
+                        assert key_re.match(key), f"torn series key {key!r}"
+                # The render + parse round trip must hold mid-write:
+                # every emitted line parses, labels complete.
+                parse_prometheus_text(prometheus_text(snap))
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+        assert failures == []
+        # Writers made real progress (the test raced something).
+        assert reg.snapshot()["counters"]["worker.acks_total"] == 0
+        total = sum(
+            v for k, v in reg.snapshot()["counters"].items()
+            if k.startswith("worker.acks_total{")
+        )
+        assert total > 0
+
+
+# ---------------------------------------------------------------------------
+SOAK_KW = dict(
+    seed=5, duration_s=3.0, qps=16.0, query_qps=4.0, n_players=120,
+    batch_size=32, use_http=False,
+)
+
+
+class TestSoakBitIdenticalUnderCollector:
+    def _run(self, obs_port=None, scraped=False):
+        from analyzer_tpu.loadgen import SoakConfig, SoakDriver
+
+        reset_registry()
+        reset_tracer()
+        driver = SoakDriver(SoakConfig(obs_port=obs_port, **SOAK_KW))
+        stop = threading.Event()
+        scraper = None
+        collector = None
+        try:
+            if scraped:
+                target = f"127.0.0.1:{driver.worker.obs_server.port}"
+                collector = Collector(
+                    [target], request_flight_dumps=False
+                )
+
+                def loop():
+                    while not stop.is_set():
+                        collector.scrape(time.monotonic())
+                        stop.wait(0.02)
+
+                scraper = threading.Thread(target=loop, daemon=True)
+                scraper.start()
+            artifact = driver.run()
+        finally:
+            stop.set()
+            if scraper is not None:
+                scraper.join(timeout=10)
+            driver.close()
+        return artifact, collector
+
+    def test_deterministic_block_bit_identical_with_a_scraper(self):
+        art_plain, _ = self._run()
+        art_scraped, collector = self._run(obs_port=0, scraped=True)
+        assert collector.scrapes > 0  # the scraper actually ran
+        a = json.dumps(art_plain["deterministic"], sort_keys=True)
+        b = json.dumps(art_scraped["deterministic"], sort_keys=True)
+        assert a == b
+        assert art_scraped["slo"]["pass"], art_scraped["slo"]["violations"]
+
+
+# ---------------------------------------------------------------------------
+class TestTwoWorkerTopology:
+    """The acceptance run: two REAL worker subprocesses, partitioned
+    fan-out from this (publisher) process, an injected burn on worker 1,
+    the Collector detecting + attributing it and pulling a flight dump
+    from the burning host, and a traced match's chain stitching
+    completely across the process boundary."""
+
+    N_MATCHES = 8
+    PREFIX = "fleet"
+    TOKEN = "fleet-test-token"
+
+    def _spawn(self, tmp_path, idx, msgs):
+        from tests.hostmesh import scrubbed_env
+
+        spec = {
+            "msgs": msgs,
+            "n_matches": self.N_MATCHES,
+            "id_prefix": self.PREFIX,
+            "trace_out": str(tmp_path / f"worker{idx}.jsonl"),
+            "flight_dir": str(tmp_path / f"flight{idx}"),
+            "ready_file": str(tmp_path / f"ready{idx}"),
+            "exit_file": str(tmp_path / f"exit{idx}"),
+            "burn_file": str(tmp_path / f"burn{idx}"),
+            "burn": 3 if idx == 1 else 0,
+        }
+        spec_path = tmp_path / f"spec{idx}.json"
+        spec_path.write_text(json.dumps(spec))
+        env = scrubbed_env(extra={
+            "JAX_PLATFORMS": "cpu",
+            "ANALYZER_TPU_TRACE": "1",
+            "ANALYZER_TPU_FLIGHT_TOKEN": self.TOKEN,
+        })
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", "fleet_worker.py"),
+             str(spec_path)],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        return proc, spec
+
+    @staticmethod
+    def _await_file(path, procs, timeout=280.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if os.path.exists(path):
+                return
+            for proc in procs:
+                if proc.poll() is not None and proc.returncode != 0:
+                    out, err = proc.communicate()
+                    raise AssertionError(
+                        f"fleet worker died rc={proc.returncode}\n"
+                        f"stdout:\n{out}\nstderr:\n{err}"
+                    )
+            time.sleep(0.1)
+        raise AssertionError(f"timed out waiting for {path}")
+
+    def test_fleet_burn_attribution_and_stitched_chain(self, tmp_path):
+        from analyzer_tpu.fixtures import synthetic_batch
+        from analyzer_tpu.obs import tracectx
+        from analyzer_tpu.obs.snapshot import write_chrome_trace
+        from analyzer_tpu.obs.traceview import (
+            build_model,
+            critical_path,
+            load_forest,
+            match_report,
+            verify_chain,
+        )
+        from analyzer_tpu.service.broker import partition_of
+
+        # -- publisher side: mint trace contexts, partition fan-out ----
+        tracectx.enable_tracing(True)
+        try:
+            assign = {0: [], 1: []}
+            for m in synthetic_batch(self.N_MATCHES, id_prefix=self.PREFIX):
+                ctx = tracectx.mint(m.api_id)
+                part = partition_of(m.api_id.encode(), None, 2)
+                assign[part].append(
+                    {"id": m.api_id, "headers": tracectx.headers(ctx)}
+                )
+        finally:
+            tracectx.enable_tracing(False)
+        assert assign[0] and assign[1], "degenerate partition fan-out"
+        pub_trace = tmp_path / "publisher.jsonl"
+        write_chrome_trace(str(pub_trace))
+
+        procs, specs = [], []
+        fs = None
+        try:
+            for idx in (0, 1):
+                proc, spec = self._spawn(tmp_path, idx, assign[idx])
+                procs.append(proc)
+                specs.append(spec)
+            ports = []
+            for spec in specs:
+                self._await_file(spec["ready_file"], procs)
+                with open(spec["ready_file"]) as f:
+                    ports.append(json.load(f)["obs_port"])
+            targets = [f"127.0.0.1:{p}" for p in ports]
+
+            collector = Collector(targets, flight_token=self.TOKEN)
+            collector.scrape(0.0)
+            fz = collector.fleetz()
+            assert fz["up"] == 2, fz
+            merged = collector.fleet_snapshot()
+            # Both workers' registries merged under host=; the fleet
+            # aggregate is the sum across the topology.
+            assert (
+                merged["counters"]["worker.matches_rated_total"]
+                == self.N_MATCHES
+            )
+            for target, part in zip(targets, (0, 1)):
+                key = f"worker.matches_rated_total{{host={target}}}"
+                assert merged["counters"][key] == len(assign[part])
+            assert not collector.burning
+
+            # -- inject the burn on worker 1, between scrapes ----------
+            with open(specs[1]["burn_file"], "w") as f:
+                f.write("burn\n")
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                _, body = http_get(
+                    f"http://{targets[1]}/debug/snapshot"
+                )
+                if json.loads(body)["counters"][
+                    "worker.dead_letters_total"
+                ] >= 3:
+                    break
+                time.sleep(0.1)
+            collector.scrape(30.0)
+            collector.scrape(61.0)
+            assert "zero-dead-letters" in collector.burning
+            assert (
+                collector.attribution()["zero-dead-letters"]
+                == [targets[1]]
+            )
+
+            # -- the burning host froze its own flight recorder --------
+            deadline = time.time() + 30
+            dumps = []
+            while time.time() < deadline and not dumps:
+                dumps = glob.glob(os.path.join(
+                    specs[1]["flight_dir"],
+                    "flight-*fleet-slo-zero-dead-letters*",
+                ))
+                time.sleep(0.1)
+            assert dumps, "no flight dump on the burning host"
+            assert os.path.exists(os.path.join(dumps[0], "history.json"))
+            assert not glob.glob(
+                os.path.join(specs[0]["flight_dir"], "flight-*")
+            ), "the healthy host must not dump"
+
+            # -- the federated surface serves the verdict --------------
+            fs = FleetServer(collector, port=0)
+            status, body = http_get(fs.url + "/fleetz")
+            fz = json.loads(body)
+            assert status == 200
+            assert fz["burning"] == ["zero-dead-letters"]
+            assert fz["attribution"]["zero-dead-letters"] == [targets[1]]
+            for target in targets:
+                assert fz["hosts"][target]["view_version"] >= 1
+            status, body = http_get(fs.url + "/metrics")
+            parsed = parse_prometheus_text(body)
+            assert parsed["counters"][
+                f"worker.dead_letters_total{{host={targets[1]}}}"
+            ] == 3.0
+
+            # -- cross-process trace stitching -------------------------
+            events = load_forest([
+                str(pub_trace),
+                specs[0]["trace_out"],
+                specs[1]["trace_out"],
+            ])
+            model = build_model(events)
+            assert model.hosts == {"publisher", "worker0", "worker1"}
+            rated = [m["id"] for part in (0, 1) for m in assign[part]]
+            assert sorted(model.match_batch) == sorted(rated)
+            for part in (0, 1):
+                for msg in assign[part]:
+                    problems = verify_chain(model, msg["id"])
+                    assert problems == [], (msg["id"], problems)
+                    rep = match_report(model, msg["id"])
+                    assert rep["enqueue_host"] == "publisher"
+                    assert rep["batch_host"] == f"worker{part}"
+                    transit = rep["stages_ms"]["broker_transit"]
+                    assert transit is not None and transit >= 0
+                    assert rep["stages_ms"]["queue_wait"] is None
+                    assert rep["publish_version"] is not None
+            cp = critical_path(model)
+            assert set(cp["hosts"]) == {"publisher", "worker0", "worker1"}
+            transit_hosts = cp["stage_hosts"]["broker_transit"]
+            assert set(transit_hosts) == {
+                "publisher->worker0", "publisher->worker1",
+            }
+            assert cp["dominant_stage"] in cp["stages_ms"]
+        finally:
+            if fs is not None:
+                fs.close()
+            for spec in specs:
+                with open(spec["exit_file"], "w") as f:
+                    f.write("done\n")
+            for proc in procs:
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+class TestFederateOverheadGate:
+    def _line(self, pct, stable=True, degraded=False):
+        return {
+            "metric": "matches_per_sec_per_chip", "value": 1000.0,
+            "capture": {"degraded": degraded},
+            "federate_overhead": {
+                "off_s": 1.0, "on_s": 1.0 + pct / 100.0,
+                "overhead_pct": pct, "scrapes": 40, "stable": stable,
+            },
+        }
+
+    def test_gate_semantics(self):
+        from analyzer_tpu.obs.benchdiff import federate_overhead_violations
+
+        assert federate_overhead_violations(self._line(1.5)) == []
+        v = federate_overhead_violations(self._line(3.5))
+        assert v and "federate_overhead" in v[0]
+        # excluded: degraded capture, unstable pair, absent block
+        assert federate_overhead_violations(
+            self._line(9.0, degraded=True)
+        ) == []
+        assert federate_overhead_violations(
+            self._line(9.0, stable=False)
+        ) == []
+        assert federate_overhead_violations({"metric": "x"}) == []
+
+    def test_cli_benchdiff_gates_federate_overhead(self, tmp_path, capsys):
+        from analyzer_tpu import cli
+
+        (tmp_path / "BENCH_r01.json").write_text(
+            json.dumps(self._line(0.5))
+        )
+        (tmp_path / "BENCH_r02.json").write_text(
+            json.dumps(self._line(4.0))
+        )
+        rc = cli.main([
+            "benchdiff", "--against-latest", "--dir", str(tmp_path),
+        ])
+        out = capsys.readouterr()
+        assert rc == 1
+        assert "FEDERATE OVERHEAD VIOLATION" in out.out
+
+
+class TestCliFleet:
+    def test_check_green_topology_exits_0(self, capsys):
+        from analyzer_tpu import cli
+        from analyzer_tpu.obs.server import ObsServer
+
+        srv = ObsServer(port=0)
+        try:
+            rc = cli.main([
+                "fleet", "--check", f"127.0.0.1:{srv.port}",
+            ])
+        finally:
+            srv.close()
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "fleet ok: 1/1" in out
+
+    def test_check_burning_topology_exits_1(self, capsys):
+        from analyzer_tpu import cli
+        from analyzer_tpu.obs.server import ObsServer
+
+        srv = ObsServer(port=0)
+        try:
+            get_registry().counter("worker.dead_letters_total").add(2)
+            rc = cli.main([
+                "fleet", "--check", "--json",
+                "--targets", f"127.0.0.1:{srv.port}",
+            ])
+        finally:
+            srv.close()
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "FLEET BURN: zero-dead-letters" in out
+
+    def test_check_down_target_with_require_all_up(self, capsys):
+        from analyzer_tpu import cli
+
+        # Port 1 on loopback: nothing listens; the scrape fails fast.
+        rc = cli.main([
+            "fleet", "--check", "--require-all-up", "127.0.0.1:1",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "DOWN: 127.0.0.1:1" in out
+
+    def test_no_targets_exits_2(self, capsys):
+        from analyzer_tpu import cli
+
+        assert cli.main(["fleet", "--check"]) == 2
+
+    def test_serve_mode_bounded_scrapes(self, capsys):
+        from analyzer_tpu import cli
+        from analyzer_tpu.obs.server import ObsServer
+
+        srv = ObsServer(port=0)
+        try:
+            rc = cli.main([
+                "fleet", f"127.0.0.1:{srv.port}",
+                "--scrapes", "2", "--interval", "0.05",
+            ])
+        finally:
+            srv.close()
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "fleetd serving" in out

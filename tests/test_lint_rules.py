@@ -1710,3 +1710,98 @@ class TestGL033MigrationLineage:
         from analyzer_tpu.lint.findings import RULES
 
         assert "GL033" in RULES
+
+
+class TestGL034FleetPlane:
+    """GL034 guards the fleet observability plane: the host=/fleet=
+    label keys are reserved for the Collector's federated merge
+    (obs/federate.py is the one sanctioned minter), and the Collector's
+    module is clock-injected — wall-clock reads inside it flag."""
+
+    RESERVED_LABEL_SRC = """
+    from analyzer_tpu.obs.registry import get_registry
+
+    def bad():
+        reg = get_registry()
+        reg.counter("worker.acks_total", host="10.0.0.1:9100").add(1)
+        reg.gauge("broker.queue_depth", fleet="prod").set(3)
+        reg.histogram("phase_seconds", host="a").observe(0.1)
+    """
+
+    WALL_CLOCK_SRC = """
+    import time
+
+    def scrape_all(collector):
+        collector.scrape(time.monotonic())
+    """
+
+    def test_reserved_labels_fire_outside_federate(self):
+        for path in (
+            "analyzer_tpu/service/worker.py",
+            "analyzer_tpu/obs/devicemem.py",
+            "experiments/serve_bench.py",
+        ):
+            assert rules_of(self.RESERVED_LABEL_SRC, path) == ["GL034"] * 3, path
+
+    def test_federate_home_may_mint_reserved_labels(self):
+        assert rules_of(
+            self.RESERVED_LABEL_SRC, "analyzer_tpu/obs/federate.py"
+        ) == []
+
+    def test_tests_exempt_from_label_half(self):
+        assert rules_of(
+            self.RESERVED_LABEL_SRC, "tests/test_federate.py"
+        ) == []
+
+    def test_unreserved_labels_stay_legal(self):
+        src = """
+        from analyzer_tpu.obs.registry import get_registry
+
+        def fine():
+            get_registry().gauge(
+                "broker.queue_depth", queue="analyze", partition="p0"
+            ).set(1)
+        """
+        assert rules_of(src, "analyzer_tpu/service/worker.py") == []
+
+    def test_wall_clock_fires_only_in_federate(self):
+        assert "GL034" in rules_of(
+            self.WALL_CLOCK_SRC, "analyzer_tpu/obs/federate.py"
+        )
+        for path in (
+            "analyzer_tpu/obs/flight.py",  # other obs modules own clocks
+            "analyzer_tpu/obs/server.py",
+        ):
+            assert "GL034" not in rules_of(self.WALL_CLOCK_SRC, path), path
+
+    def test_every_wall_clock_needle_fires_in_federate(self):
+        src = """
+        import time
+        import datetime
+
+        def bad():
+            time.time()
+            time.perf_counter()
+            time.sleep(1)
+            datetime.datetime.now()
+        """
+        assert rules_of(
+            src, "analyzer_tpu/obs/federate.py"
+        ) == ["GL034"] * 4
+
+    def test_shipping_federate_module_is_clean(self):
+        mod = "analyzer_tpu/obs/federate.py"
+        with open(os.path.join(_REPO, mod), encoding="utf-8") as f:
+            assert rules_of(f.read(), mod) == [], mod
+
+    def test_reserved_labels_match_registry_constant(self):
+        # The linter's literal needle must track the schema's constant.
+        from analyzer_tpu.lint.shellrules import _GL034_RESERVED_LABELS
+        from analyzer_tpu.obs.registry import RESERVED_LABELS
+
+        assert tuple(_GL034_RESERVED_LABELS) == tuple(RESERVED_LABELS)
+
+    def test_catalog_has_gl034(self):
+        from analyzer_tpu.lint.findings import RULES
+
+        assert "GL034" in RULES

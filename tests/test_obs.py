@@ -140,7 +140,13 @@ class TestTracer:
         path = tmp_path / "trace.jsonl"
         n = tr.export_chrome(str(path))
         lines = path.read_text().splitlines()
-        assert n == len(lines) == 2
+        # Line 0 is the trace_epoch metadata (the stitcher's clock
+        # anchor, obs/traceview.py load_forest); the count reports the
+        # ring's events alone.
+        assert n == 2 and len(lines) == 3
+        head = json.loads(lines[0])
+        assert head["name"] == "trace_epoch" and head["ph"] == "M"
+        assert head["args"]["epoch_wall"] == pytest.approx(tr.epoch_wall)
         for line in lines:
             e = json.loads(line)  # every line is one complete JSON event
             assert {"name", "ph", "ts", "pid", "tid"} <= set(e)
@@ -216,6 +222,63 @@ class TestExposition:
         reg.counter("worker.acks_total").add(2)
         out = render_summary(snapshot())
         assert "worker.acks_total" in out and "spans:" in out
+
+    def test_help_and_type_lines_from_the_schema_catalog(self):
+        from analyzer_tpu.obs.registry import SCHEMA_HELP
+
+        reg = get_registry()
+        reg.histogram("phase_seconds", phase="pack").observe(0.25)
+        txt = prometheus_text(snapshot(max_spans=0))
+        # Every family leads with # HELP (catalog text) then # TYPE;
+        # histograms expose as summaries.
+        assert (
+            f"# HELP worker_acks_total {SCHEMA_HELP['worker.acks_total']}"
+            in txt
+        )
+        assert "# TYPE worker_acks_total counter" in txt
+        assert (
+            f"# HELP serve_view_version {SCHEMA_HELP['serve.view_version']}"
+            in txt
+        )
+        assert "# TYPE serve_view_version gauge" in txt
+        assert (
+            f"# HELP phase_seconds {SCHEMA_HELP['phase_seconds']}" in txt
+        )
+        assert "# TYPE phase_seconds summary" in txt
+        for line in txt.splitlines():
+            if line.startswith("# HELP "):
+                name = line.split(" ", 3)[2]
+                assert f"# TYPE {name} " in txt, f"HELP without TYPE: {name}"
+
+    def test_exposition_round_trips_through_the_parser(self):
+        from analyzer_tpu.obs.snapshot import parse_prometheus_text
+
+        reg = get_registry()
+        reg.counter("worker.acks_total").add(5)
+        reg.counter("worker.acks_total", queue="analyze").add(2)
+        reg.gauge("worker.pipeline_degraded").set(True)
+        reg.gauge("serve.view_age_seconds").set(3.25)
+        h = reg.histogram("phase_seconds", phase="pack")
+        for i in range(20):
+            h.observe(i * 0.01)
+        snap = snapshot(max_spans=0)
+        parsed = parse_prometheus_text(prometheus_text(snap))
+        # Dotted names come back through the STANDARD catalog; every
+        # cataloged counter/gauge value survives the text round trip.
+        for key, value in snap["counters"].items():
+            assert parsed["counters"][key] == pytest.approx(value), key
+        assert parsed["gauges"]["worker.pipeline_degraded"] == 1.0
+        assert parsed["gauges"]["serve.view_age_seconds"] == 3.25
+        hist = parsed["histograms"]["phase_seconds{phase=pack}"]
+        summ = snap["histograms"]["phase_seconds{phase=pack}"]
+        assert hist["count"] == summ["count"]
+        assert hist["sum"] == pytest.approx(summ["sum"])
+        for q in ("p50", "p90", "p99"):
+            assert hist[q] == pytest.approx(summ[q])
+        assert parsed["types"]["worker.acks_total"] == "counter"
+        assert parsed["help"]["worker.acks_total"].startswith(
+            "messages acked"
+        )
 
 
 class TestLegacyViews:
