@@ -999,6 +999,23 @@ def cmd_benchdiff(args) -> int:
                 file=sys.stderr,
             )
             return 1
+        # And the assign-native contract (same pattern as the ingest
+        # family's python-codec gate): a baseline whose front half ran
+        # the GIL-released native windowed first-fit and a candidate
+        # whose assign block reports native: false means the assigner
+        # silently fell back to the python recurrence — a ~two-orders
+        # front-half slowdown a delta gate would merely call "slower".
+        a_native = bool((a_raw.get("assign") or {}).get("native"))
+        b_native = bool((b_raw.get("assign") or {}).get("native"))
+        if a_native and not b_native:
+            print(
+                f"error: {os.path.basename(b_path)} has no native "
+                f"windowed-assigner capture but {os.path.basename(a_path)} "
+                "does (silent fall-back to the python first-fit "
+                "recurrence?)",
+                file=sys.stderr,
+            )
+            return 1
     if args.family == "serve":
         # Same vanished-block contract for the shard plane: a baseline
         # with sharded.* configs and a candidate without them means the
@@ -1575,7 +1592,7 @@ def cmd_migrate(args) -> int:
               file=sys.stderr)
         return 2
     for flag in ("checkpoint_every", "stop_after_steps", "prefetch_depth",
-                 "window_rows", "batch_size"):
+                 "window_rows", "batch_size", "plan_windows"):
         val = getattr(args, flag)
         if val is not None and val <= 0:
             print(f"error: --{flag.replace('_', '-')} must be positive",
@@ -1634,6 +1651,8 @@ def cmd_migrate(args) -> int:
         engine_kw = {}
         if args.window_rows:
             engine_kw["window_rows"] = args.window_rows
+        if args.plan_windows:
+            engine_kw["plan_windows"] = args.plan_windows
         with timer.phase("migrate"):
             report = run_migration(
                 state, data, cfg,
@@ -1659,6 +1678,8 @@ def cmd_migrate(args) -> int:
             "batch_size": stats.get("batch_size"),
             "occupancy": round(stats.get("occupancy", 0.0), 3),
             "streamed": stats.get("streamed"),
+            "assign_native": stats.get("assign_native"),
+            "plan_windows": stats.get("plan_windows"),
             "stopped": stats.get("stopped", False),
             "ttfd_s": (
                 round(stats["ttfd_s"], 4)
@@ -2313,6 +2334,12 @@ def main(argv=None) -> int:
     s.add_argument(
         "--window-rows", type=int, metavar="N",
         help="decode window rows (default 4096; io/ingest.py)",
+    )
+    s.add_argument(
+        "--plan-windows", type=int, metavar="K",
+        help="decode windows in the batch-size planning prefix (default "
+        "4; deterministic — the policy folds into the resume "
+        "fingerprint, so resume with the value the run was started with)",
     )
     s.add_argument("--prefetch-depth", type=int, metavar="N")
     s.add_argument(

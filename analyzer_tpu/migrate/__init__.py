@@ -5,10 +5,12 @@ Three pieces compose a live rating migration:
 
   * :mod:`analyzer_tpu.migrate.engine` — the streaming front half:
     columnar CSV decode windows (``io/ingest.py``) feed an INCREMENTAL
-    first-fit assigner (:mod:`analyzer_tpu.migrate.assign`) on one
-    front-half thread while the device feed stages and the scan
-    dispatches — decode, assignment, H2D and compute all overlap, so
-    time-to-first-dispatch is O(one decode window) instead of O(file);
+    first-fit assigner (:mod:`analyzer_tpu.migrate.assign` — the
+    GIL-released native windowed loop by default, the python recurrence
+    as fallback/oracle) on one front-half thread while the device feed
+    stages and the scan dispatches — decode, assignment, H2D and
+    compute all overlap, so time-to-first-dispatch is O(the
+    planning prefix) instead of O(file);
   * :mod:`analyzer_tpu.migrate.lineage` — the dual-lineage serve
     protocol: the backfill publishes into a STAGING view lineage while
     the live lineage keeps serving, and :func:`~analyzer_tpu.migrate.
@@ -20,8 +22,14 @@ Three pieces compose a live rating migration:
     backfill rate (``Worker.stats()``'s ``migration`` block).
 """
 
-from analyzer_tpu.migrate.assign import IncrementalAssigner
+from analyzer_tpu.migrate.assign import (
+    IncrementalAssigner,
+    NativeIncrementalAssigner,
+    PyIncrementalAssigner,
+    assign_native_available,
+)
 from analyzer_tpu.migrate.engine import (
+    DEFAULT_PLAN_WINDOWS,
     MigrationReport,
     migration_fingerprint,
     rate_backfill,
@@ -35,10 +43,14 @@ from analyzer_tpu.migrate.progress import (
 )
 
 __all__ = [
+    "DEFAULT_PLAN_WINDOWS",
     "IncrementalAssigner",
     "LineageManager",
     "MigrationProgress",
     "MigrationReport",
+    "NativeIncrementalAssigner",
+    "PyIncrementalAssigner",
+    "assign_native_available",
     "cutover",
     "get_migration_progress",
     "migration_fingerprint",

@@ -1,28 +1,45 @@
 """Incremental capacity-aware first-fit assignment — the streaming
-front half's scheduler (docs/migration.md "Streaming front half").
+front half's scheduler (docs/migration.md "Streaming front half" and
+"Native front half").
 
-``sched.superstep.assign_batches`` consumes a COMPLETE stream: the
-native loop takes the whole arrays with the GIL released, and the python
-fallback iterates ``range(n)``. The migration engine's whole point is
-that no complete stream ever exists — matches become visible one decode
-window at a time — so this module carries the first-fit recurrence as
-RESTARTABLE state: :meth:`IncrementalAssigner.feed` consumes exactly the
-newly decoded slice ``[lo, hi)`` and leaves the per-player frontier, the
-batch fill counts, and the union-find next-free index ready for the next
-window. Feeding the windows in stream order produces assignments
-IDENTICAL to the one-shot python loop over the concatenated stream
-(pinned by tests/test_migrate.py) — the decomposition into windows is
-invisible to the result, so the emitted schedule is a pure function of
-(stream bytes, capacity) regardless of decode timing.
+``sched.superstep.assign_batches`` consumes a COMPLETE stream. The
+migration engine's whole point is that no complete stream ever exists —
+matches become visible one decode window at a time — so this module
+carries the first-fit recurrence as RESTARTABLE state:
+:meth:`IncrementalAssigner.feed` consumes exactly the newly decoded
+slice ``[lo, hi)`` and leaves the per-player frontier, the batch fill
+counts, and the union-find next-free index ready for the next window.
+Feeding the windows in stream order produces assignments IDENTICAL to a
+one-shot pass over the concatenated stream (pinned by
+tests/test_migrate.py) — the decomposition into windows is invisible to
+the result, so the emitted schedule is a pure function of (stream
+bytes, capacity) regardless of decode timing.
 
-One deliberate divergence from the offline packer: NON-RATABLE matches
-(unsupported mode, AFK) are assigned inline as capacity-consuming,
-dependency-free entries (first-fit from batch 0) instead of being held
-back and backfilled into other batches' padding slots. Holding them back
-requires knowing the whole stream's filler population up front — exactly
-what streaming forbids — and consuming them inline keeps occupancy high
-without it. They read and write no rating state, so the final table and
-every per-match output are bit-identical to any other placement
+:func:`IncrementalAssigner` is a thin ROUTER. The PRIMARY path is the
+native windowed loop (``sched/packer.cc assign_ff_create/feed/finish/
+destroy`` via :mod:`analyzer_tpu.sched._native`): the restartable state
+lives behind a heap handle, ``feed`` runs with the GIL RELEASED and
+publishes into the shared ``[2]`` int64 progress array at the pinned
+:data:`PROGRESS_EVERY` cadence with release stores — so the feed
+thread's sentinel-buffer visibility protocol and ``rate_stream``'s
+condition-variable handshake are unchanged, and the front-half thread
+stops serializing the decode behind a pure-python recurrence (ROADMAP
+item 4's "front half's floor"). The python recurrence
+(:class:`PyIncrementalAssigner`) remains as the always-available
+FALLBACK and as the differential ORACLE: native windowed output must be
+bit-identical to it — and, on filler-free streams, to the one-shot
+``assign_batches_first_fit`` — across arbitrary window cuts
+(tests/test_migrate.py, tests/test_native_props.py).
+
+One deliberate divergence from the offline packer, shared by BOTH
+implementations: NON-RATABLE matches (unsupported mode, AFK) are
+assigned inline as capacity-consuming, dependency-free entries
+(first-fit from batch 0) instead of being held back and backfilled into
+other batches' padding slots. Holding them back requires knowing the
+whole stream's filler population up front — exactly what streaming
+forbids — and consuming them inline keeps occupancy high without it.
+They read and write no rating state, so the final table and every
+per-match output are bit-identical to any other placement
 (``sched.runner.rate_stream``'s filler-placement argument); only the
 slot a filler's gate outputs are computed in moves.
 """
@@ -33,12 +50,39 @@ import numpy as np
 
 #: Periodic progress-publish interval (matches) inside one feed() slice —
 #: same cadence contract as the one-shot python loop's
-#: ``sched.superstep._PY_PROGRESS_EVERY``.
+#: ``sched.superstep._PY_PROGRESS_EVERY`` and pinned equal to the native
+#: loop's ``kFFProgressEvery`` (sched/packer.cc) so routing never
+#: changes the consumer-visible publish rhythm.
 PROGRESS_EVERY = 2048
 
 
-class IncrementalAssigner:
-    """Restartable first-fit over a growing stream.
+def _load_native():
+    """The ctypes loader, or None when the extension cannot build/load
+    (or predates the windowed entries — a stale ``.so`` rebuilt lazily
+    elsewhere must not crash the router)."""
+    try:
+        from analyzer_tpu.sched import _native
+
+        _native.assign_ff_create  # noqa: B018 — probe the windowed ABI
+    except (ImportError, AttributeError):
+        return None
+    return _native
+
+
+_native_mod = _load_native()
+
+
+def assign_native_available() -> bool:
+    """Whether the GIL-released windowed first-fit loaded (the router's
+    default path; surfaced as the ``migrate.assign_native`` gauge and
+    ``Worker.stats()['migration']['assign_native']``)."""
+    return _native_mod is not None
+
+
+class PyIncrementalAssigner:
+    """Restartable first-fit over a growing stream — the pure-python
+    recurrence, kept as the always-available fallback AND the
+    bit-exact differential oracle for the native windowed loop.
 
     ``out_batch`` / ``out_slot`` are the caller's preallocated int64
     buffers (sentinel-prefilled — the streamed feed's cross-thread
@@ -47,6 +91,8 @@ class IncrementalAssigner:
     final, ``progress[1]`` = batches used, written by :meth:`finish`).
     ``on_progress`` is the condition-variable wakeup hook.
     """
+
+    is_native = False
 
     def __init__(
         self,
@@ -178,3 +224,135 @@ class IncrementalAssigner:
             self.progress[1] = self.batches_used
         if self.on_progress is not None:
             self.on_progress()
+
+    def close(self) -> None:
+        """Interface parity with the native assigner's handle release —
+        a no-op here (the state is plain python objects)."""
+
+
+class NativeIncrementalAssigner:
+    """The GIL-released windowed first-fit: restartable state behind a
+    ``sched/packer.cc`` handle, same surface as
+    :class:`PyIncrementalAssigner` (feed/finish/n_assigned/batches_used)
+    and bit-identical output across any window decomposition.
+
+    Each :meth:`feed` call passes the window-local slice pointers down;
+    the C loop carries the frontier/fill/next-free state across calls
+    and publishes ``progress[0]`` with release stores at the pinned
+    :data:`PROGRESS_EVERY` cadence WHILE the GIL is released — a
+    consumer polling under ``cv.wait(poll_interval)`` sees fresh
+    entries mid-window exactly as it does under the python loop's
+    in-GIL publishes (the one behavioral difference: ``on_progress``
+    fires once per window, after the native call returns, because a
+    GIL-released loop cannot call back into python — the engine keeps
+    ``poll_interval`` around solely as the wait timeout covering that
+    gap, the same contract ``sched/superstep.py`` documents for the
+    one-shot loop). The handle is freed by :meth:`close` (idempotent,
+    also via ``__del__``); destroy without finish is legal and leaks
+    nothing (tests/sanitize_driver.py drives it under ASan).
+    """
+
+    is_native = True
+
+    def __init__(
+        self,
+        capacity: int,
+        out_batch: np.ndarray,
+        out_slot: np.ndarray,
+        progress: np.ndarray | None = None,
+        on_progress=None,
+        n_hint: int = 0,
+    ) -> None:
+        if _native_mod is None:
+            raise RuntimeError(
+                "native windowed assigner requested but the extension "
+                "did not load (assign_native_available() is False)"
+            )
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.out_batch = out_batch
+        self.out_slot = out_slot
+        self.progress = progress
+        self.on_progress = on_progress
+        self.n_assigned = 0
+        self._handle = _native_mod.assign_ff_create(self.capacity, n_hint)
+
+    def feed(
+        self,
+        player_idx: np.ndarray,
+        mode_id: np.ndarray,
+        afk: np.ndarray,
+        lo: int,
+        hi: int,
+    ) -> None:
+        """Same contract as :meth:`PyIncrementalAssigner.feed` — slices
+        ``[lo, hi)`` of the accumulated stream buffers, contiguous, in
+        stream order. The ratable gate vectorizes on the python side
+        (one uint8 window); everything per-match runs in C."""
+        if hi <= lo:
+            return
+        if lo != self.n_assigned:
+            raise ValueError(
+                f"feed slices must be contiguous: expected lo="
+                f"{self.n_assigned}, got {lo}"
+            )
+        if self._handle is None:
+            raise ValueError("assigner already closed")
+        n = hi - lo
+        idx = player_idx[lo:hi].reshape(n, -1)
+        ratable = np.asarray(
+            (mode_id[lo:hi] >= 0) & ~afk[lo:hi], dtype=np.uint8
+        )
+        _native_mod.assign_ff_feed(
+            self._handle, idx, ratable, lo, hi,
+            self.out_batch, self.out_slot, self.progress,
+        )
+        self.n_assigned = hi
+        if self.on_progress is not None:
+            self.on_progress()
+
+    @property
+    def batches_used(self) -> int:
+        """Batches holding at least one match so far (reads the native
+        high-water mark without publishing)."""
+        if self._handle is None:
+            raise ValueError("assigner already closed")
+        return _native_mod.assign_ff_finish(self._handle, None)
+
+    def finish(self) -> None:
+        """Publishes the final (n, batches-used) pair — the completion
+        record the feed's tail logic reads after the join."""
+        if self._handle is None:
+            raise ValueError("assigner already closed")
+        _native_mod.assign_ff_finish(self._handle, self.progress)
+        if self.on_progress is not None:
+            self.on_progress()
+
+    def close(self) -> None:
+        """Releases the native handle (idempotent; finish optional)."""
+        h, self._handle = self._handle, None
+        if h is not None:
+            _native_mod.assign_ff_destroy(h)
+
+    def __del__(self) -> None:  # pragma: no cover — GC timing
+        self.close()
+
+
+def IncrementalAssigner(
+    capacity: int,
+    out_batch: np.ndarray,
+    out_slot: np.ndarray,
+    progress: np.ndarray | None = None,
+    on_progress=None,
+    native: bool | None = None,
+):
+    """The router: native windowed first-fit when the extension loads,
+    the python recurrence otherwise. ``native=True`` demands the native
+    path (raises when unavailable — the differential tests' knob);
+    ``native=False`` forces the python oracle; ``None`` auto-selects.
+    Both returns expose the same surface (``feed``/``finish``/``close``/
+    ``n_assigned``/``batches_used``/``is_native``)."""
+    use = assign_native_available() if native is None else native
+    cls = NativeIncrementalAssigner if use else PyIncrementalAssigner
+    return cls(capacity, out_batch, out_slot, progress, on_progress)

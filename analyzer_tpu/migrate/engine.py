@@ -25,14 +25,20 @@ ROADMAP item 5's remainder:
     :class:`~analyzer_tpu.service.broker.AdmissionController`'s verdict
     so a live plane's commits keep their headroom.
 
-Time-to-first-dispatch is O(one decode window + spc batches of
-assignment) instead of O(file). Determinism: the emitted schedule is a
-pure function of (bytes, batch_size, steps_per_chunk) — window
-boundaries are fixed multiples of ``steps_per_chunk``, the assigner is
-sequential over stream order, and non-ratable matches are consumed
-inline (see ``migrate/assign.py`` on why, and why results are
-bit-identical to every other placement). The final table and collected
-outputs are bit-identical to ``rate_stream`` over the same decoded
+Time-to-first-dispatch is O(the planning prefix — ``plan_windows``
+decode windows — + spc batches of assignment) instead of O(file). The
+front half runs NATIVE by default: ``migrate/assign.py`` routes the
+incremental first-fit through the GIL-released windowed loop in
+``sched/packer.cc`` (the python recurrence stays as fallback and
+bit-exact oracle). Determinism: the emitted schedule is a pure function
+of (bytes, batch_size, steps_per_chunk) — window boundaries are fixed
+multiples of ``steps_per_chunk``, the assigner is sequential over
+stream order, and non-ratable matches are consumed inline (see
+``migrate/assign.py`` on why, and why results are bit-identical to
+every other placement); the auto-chosen batch size is itself a pure
+function of (the planning-prefix bytes, the knobs), and
+:func:`migration_fingerprint` folds that policy in. The final table and
+collected outputs are bit-identical to ``rate_stream`` over the same decoded
 stream (pinned by tests/test_migrate.py), and a resumed run
 (``start_step`` from a checkpoint) reproduces the uninterrupted run's
 table bit for bit — the front half re-derives the identical schedule
@@ -52,7 +58,10 @@ import numpy as np
 
 from analyzer_tpu.core.state import MAX_TEAM_SIZE
 from analyzer_tpu.io.ingest import ColumnarDecoder, DEFAULT_WINDOW_ROWS
-from analyzer_tpu.migrate.assign import IncrementalAssigner
+from analyzer_tpu.migrate.assign import (
+    IncrementalAssigner,
+    assign_native_available,
+)
 from analyzer_tpu.migrate.progress import get_migration_progress
 from analyzer_tpu.obs import (
     get_registry,
@@ -82,15 +91,43 @@ from analyzer_tpu.sched.tier import TierManager
 from analyzer_tpu.utils.host import fetch_tree
 
 
-def migration_fingerprint(data: bytes, batch_size: int, spc: int) -> str:
+#: Decode windows in the batch-size PLANNING PREFIX (``plan_windows``):
+#: one window can undershoot b on heavy-tailed ladders (a 4096-row head
+#: may miss the tail's width distribution); a few windows are still an
+#: O(prefix) launch cost. Small by design — raise it per run, not here.
+DEFAULT_PLAN_WINDOWS = 4
+
+
+def migration_fingerprint(
+    data: bytes,
+    batch_size: int,
+    spc: int,
+    plan_windows: int | None = None,
+    window_rows: int | None = None,
+) -> str:
     """Identity of one migration's emitted schedule: the schedule is a
     pure function of (bytes, batch size, window size), so this is what a
     mid-run checkpoint stores and a resume verifies — a changed input
-    file or chunking policy fails loudly instead of double-applying."""
+    file or chunking policy fails loudly instead of double-applying.
+
+    ``plan_windows``/``window_rows`` fold the batch-size PLANNING-PREFIX
+    policy in: the chosen b is a pure function of (the first
+    ``plan_windows * window_rows`` rows of the byte stream, the knobs),
+    so a resume under a different prefix policy — which could re-derive
+    a different b and with it a different schedule — fails as loudly as
+    changed bytes do. The engine always passes both; the bare 3-arg form
+    (policy-free hash) remains for content-only identities."""
     h = hashlib.sha1()
     h.update(b"migrate-v1")
     h.update(hashlib.sha256(data).digest())
     h.update(np.asarray((batch_size, spc), np.int64).tobytes())
+    if plan_windows is not None or window_rows is not None:
+        h.update(b"plan-v2")
+        h.update(
+            np.asarray(
+                (plan_windows or 0, window_rows or 0), np.int64
+            ).tobytes()
+        )
     return h.hexdigest()
 
 
@@ -139,9 +176,11 @@ def rate_backfill(
     steps_per_chunk: int | None = None,
     team_size: int | None = None,
     window_rows: int = DEFAULT_WINDOW_ROWS,
+    plan_windows: int | None = None,
     mode_names=None,
     arena=None,
     prefetch_depth: int | None = None,
+    assign_native: bool | None = None,
     kernel: str = "reference",
     fuse_window: int | None = None,
     fuse_max_rows: int | None = None,
@@ -187,6 +226,17 @@ def rate_backfill(
     is verified against the checkpoint's so a changed input fails loudly.
     ``stop_after`` ends the run at a window boundary at or after that
     step (the kill point of the resume tests).
+
+    ``plan_windows`` (default :data:`DEFAULT_PLAN_WINDOWS`) is the
+    batch-size PLANNING PREFIX: that many decode windows are consumed on
+    the caller's thread before ``b`` commits, so a heavy-tailed ladder
+    whose head undersells the width distribution no longer undershoots
+    the choice. The prefix is a pure function of (stream bytes, knobs) —
+    the policy folds into :func:`migration_fingerprint`, so resuming
+    under a changed policy fails loudly. ``assign_native`` forces the
+    assigner route (True = demand the GIL-released native windowed
+    first-fit, False = the python oracle; None auto-selects — see
+    ``migrate/assign.py``).
 
     ``kernel``/``fuse_*``/``hot_rows``/``prefetch_depth``/``collect``/
     ``on_chunk`` mirror :func:`analyzer_tpu.sched.runner.rate_stream`.
@@ -287,20 +337,40 @@ def rate_backfill(
         prog.note_decoded(hi)
         return lo, hi
 
-    # Window 0 decodes on THIS thread: the batch-size choice needs a
-    # prefix, and the choice is deterministic as a pure function of the
-    # first decode window (documented divergence from rate_stream's
-    # n/8 prefix — the whole stream length is unknown here).
+    # The PLANNING PREFIX decodes on THIS thread: the batch-size choice
+    # needs a prefix, and committing after ONE window can undershoot b
+    # on heavy-tailed ladders (a 4096-row head may miss the width
+    # distribution's tail). ``plan_windows`` decode windows are consumed
+    # up front instead — still O(prefix) launch latency, and the choice
+    # stays a deterministic pure function of (the prefix bytes, the
+    # knobs), which migration_fingerprint folds in (documented
+    # divergence from rate_stream's n/8 prefix — the whole stream
+    # length is unknown here).
+    k_plan = (
+        DEFAULT_PLAN_WINDOWS if plan_windows is None else int(plan_windows)
+    )
+    if k_plan < 1:
+        raise ValueError(f"plan_windows must be >= 1, got {plan_windows}")
     win_iter = decoder.windows()
-    first = next(win_iter, None)
-    if first is not None:
-        append(first)
+    prefix_windows = 0
+    for _ in range(k_plan):
+        win = next(win_iter, None)
+        if win is None:
+            break
+        append(win)
+        prefix_windows += 1
     n0 = n_decoded[0]
     if n0 == 0:
         if stats_out is not None:
             stats_out.update(
                 n_steps=0, batch_size=0, occupancy=0.0, matches=0,
                 streamed=True, ttfd_s=None,
+                plan_windows=k_plan, prefix_windows=prefix_windows,
+                prefix_rows=0,
+                assign_native=(
+                    assign_native if assign_native is not None
+                    else assign_native_available()
+                ),
             )
         if tier is not None:
             state = tier.finish(state.table)
@@ -321,7 +391,9 @@ def rate_backfill(
     else:
         b = batch_size
     spc = steps_per_chunk or min(8192, max(256, -(-n_bound // b) // 8 or 1))
-    fingerprint = migration_fingerprint(data, b, spc)
+    fingerprint = migration_fingerprint(
+        data, b, spc, plan_windows=k_plan, window_rows=window_rows
+    )
     if fingerprint_out is not None:
         fingerprint_out["fingerprint"] = fingerprint
     if expected_fingerprint is not None and fingerprint != expected_fingerprint:
@@ -354,23 +426,38 @@ def rate_backfill(
             cv.notify_all()
 
     assigner = IncrementalAssigner(
-        b, out_b, out_s, progress, on_progress=notify_progress
+        b, out_b, out_s, progress, on_progress=notify_progress,
+        native=assign_native,
     )
+    # The front-half's route is an operator signal (the benchdiff
+    # migrate family's assign-native gate catches a silent fall-back to
+    # the python recurrence): gauge for scrapes, progress block for
+    # /statusz, stats for the bench artifact.
+    reg.gauge("migrate.assign_native").set(assigner.is_native)
+    prog.note_assign_backend(assigner.is_native)
+
+    def assign_window(lo: int, hi: int) -> None:
+        with tracer.span("migrate.assign", cat="migrate", start=lo):
+            assigner.feed(pidx_buf, mode_buf, afk_buf, lo, hi)
+        reg.counter("migrate.assign_matches_total").add(hi - lo)
+        prog.note_assigned(assigner.n_assigned)
 
     def front():
         """The front-half thread: decode window -> append -> assign,
-        repeating until the stream is exhausted (or the run stopped)."""
+        repeating until the stream is exhausted (or the run stopped).
+        The native assigner releases the GIL for each feed window, so
+        this thread no longer serializes the decode behind a python
+        recurrence; the poll_interval timeout on the consumer's wait
+        covers the in-window gap where no python-side wakeup can fire."""
         try:
             if n_decoded[0]:
-                assigner.feed(pidx_buf, mode_buf, afk_buf, 0, n_decoded[0])
-                prog.note_assigned(assigner.n_assigned)
+                assign_window(0, n_decoded[0])
             for win in win_iter:
                 if stop_flag[0]:  # bounded run ended: stop decoding
                     win.release()
                     break
                 lo, hi = append(win)
-                assigner.feed(pidx_buf, mode_buf, afk_buf, lo, hi)
-                prog.note_assigned(assigner.n_assigned)
+                assign_window(lo, hi)
             assigner.finish()
         except BaseException as e:  # noqa: BLE001 — re-raised on the consumer
             worker_err.append(e)
@@ -587,6 +674,7 @@ def rate_backfill(
         with cv:
             cv.notify_all()
         front_thread.join()
+        assigner.close()  # releases the native handle (no-op for python)
     if pending is not None:
         with tracer.span("batch.fetch", cat="sched", start=emitted):
             outs.append(fetch_tree(pending))
@@ -616,6 +704,10 @@ def rate_backfill(
             ttfd_s=ttfd_s,
             fingerprint=fingerprint,
             window_rows=window_rows,
+            plan_windows=k_plan,
+            prefix_windows=prefix_windows,
+            prefix_rows=n0,
+            assign_native=assigner.is_native,
         )
     if stopped:
         # A bounded run's partial state: usable only through the

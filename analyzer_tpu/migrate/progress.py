@@ -43,6 +43,7 @@ class MigrationProgress:
         self.lineage_live_version: int | None = None
         self.lineage_staging_version: int | None = None
         self.cutover_pause_ms: float | None = None
+        self.assign_native: bool | None = None
         self.error: str | None = None
 
     # -- engine-side updates ----------------------------------------------
@@ -62,6 +63,12 @@ class MigrationProgress:
 
     def note_assigned(self, n_matches: int) -> None:
         self.matches_assigned = int(n_matches)
+
+    def note_assign_backend(self, native: bool) -> None:
+        """Which first-fit route the front half took (True = the
+        GIL-released native windowed loop, False = the python
+        recurrence) — the /statusz mirror of ``migrate.assign_native``."""
+        self.assign_native = bool(native)
 
     def note_dispatched(self, next_step: int, matches: int) -> None:
         self.phase = "rating"
@@ -120,6 +127,7 @@ class MigrationProgress:
             "phase": self.phase,
             "matches_decoded": self.matches_decoded,
             "matches_assigned": self.matches_assigned,
+            "assign_native": self.assign_native,
             "matches_rated": self.matches_rated,
             "backfill_watermark_steps": emitted,
             "steps_total": total,

@@ -179,6 +179,22 @@ def bench_configs(data: dict) -> list[BenchConfig]:
                     degraded=m_degraded,
                 )
             )
+        assign = data.get("assign") or {}
+        if assign.get("matches_per_sec") is not None:
+            # Front-half-only assignment throughput (higher): the
+            # GIL-released native windowed first-fit vs its python
+            # fallback is a ~two-orders gap, so a silent route change
+            # dwarfs any honest regression — the assign-native gate in
+            # ``cli benchdiff --family migrate`` fails the route flip
+            # outright, and this config catches the in-route slowdowns.
+            out.append(
+                BenchConfig(
+                    name="assign.matches_per_sec",
+                    value=float(assign["matches_per_sec"]),
+                    higher_is_better=True,
+                    degraded=m_degraded,
+                )
+            )
         return out
     if str(data["metric"]).startswith("serve."):
         latency = data.get("latency_ms") or {}
@@ -354,7 +370,12 @@ def family_configs(
     if family == "ingest":
         return [c for c in configs if c.name.startswith("ingest.")]
     if family == "migrate":
-        return [c for c in configs if c.name.startswith("migrate.")]
+        # assign.* rides the migrate family: the front-half-only
+        # throughput is captured by the same MIGRATE_BENCH artifact.
+        return [
+            c for c in configs
+            if c.name.startswith(("migrate.", "assign."))
+        ]
     return configs
 
 

@@ -280,6 +280,11 @@ STANDARD_COUNTERS = (
     "migrate.steps_total",
     "migrate.windows_total",
     "migrate.matches_total",
+    # Matches the streaming front half ASSIGNED (native or python —
+    # migrate.assign_native says which route; docs/migration.md "Native
+    # front half"). Leads matches_total during a run: assignment runs
+    # ahead of dispatch by the feed ring's depth.
+    "migrate.assign_matches_total",
     "migrate.throttled_total",
     "migrate.fallbacks_total",
     "migrate.resumes_total",
@@ -356,6 +361,11 @@ STANDARD_GAUGES = (
     "migrate.active",
     "migrate.watermark_steps",
     "migrate.total_steps",
+    # 1 while the backfill's first-fit runs on the GIL-released native
+    # windowed loop (sched/packer.cc assign_ff_*), 0 on the python
+    # fallback — the benchdiff migrate family's assign-native gate
+    # catches a capture that silently lost this.
+    "migrate.assign_native",
     # The fleet plane's topology gauges (obs/federate.py): scraped
     # targets, targets refused past the host cap, objectives currently
     # burning at FLEET scope, and the fleet history's tracked series.
@@ -414,6 +424,10 @@ SPAN_CATALOG = (
     # arena slab, and its H2D commit off that slab (docs/ingest.md)
     "ingest.decode",
     "ingest.commit",
+    # the migration engine's front-half thread: one decode window's
+    # incremental first-fit feed (native windowed loop or the python
+    # recurrence — docs/migration.md "Native front half")
+    "migrate.assign",
 )
 
 #: Distinct labeled series allowed per family (base metric name) before
@@ -529,6 +543,8 @@ SCHEMA_HELP = {
     "migrate.steps_total": "backfill supersteps dispatched",
     "migrate.windows_total": "backfill decode windows consumed",
     "migrate.matches_total": "matches re-rated by the backfill",
+    "migrate.assign_matches_total":
+        "matches assigned by the streaming front half's first-fit",
     "migrate.throttled_total": "backfill dispatch pauses for live headroom",
     "migrate.fallbacks_total": "backfills that fell back to the offline path",
     "migrate.resumes_total": "backfills resumed from a checkpoint",
@@ -536,6 +552,8 @@ SCHEMA_HELP = {
     "migrate.active": "1 while a backfill is running",
     "migrate.watermark_steps": "backfill's dispatched-superstep watermark",
     "migrate.total_steps": "backfill's total supersteps once known",
+    "migrate.assign_native":
+        "1 while the backfill's first-fit runs GIL-released in native code",
     "fleet.scrapes_total": "Collector scrape rounds across the fleet",
     "fleet.scrape_errors_total": "per-host scrape failures",
     "fleet.burns_total": "fleet-scope SLO burn onsets",

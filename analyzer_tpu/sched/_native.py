@@ -2,9 +2,12 @@
 
 Compiled/loaded via the shared helper (``analyzer_tpu.native_build``),
 exposing ``assign_supersteps``/``assign_batches_first_fit`` with the same
-contract as the numpy fallbacks in superstep.py. Import fails -> the
-caller falls back to pure Python; any numerical divergence is a bug
-(tested equal in tests/test_sched.py).
+contract as the numpy fallbacks in superstep.py, plus the windowed
+restartable first-fit handle API (``assign_ff_create``/``feed``/
+``finish``/``destroy``) that ``migrate/assign.py`` routes the streaming
+front half through. Import fails -> the caller falls back to pure
+Python; any numerical divergence is a bug (tested equal in
+tests/test_sched.py, tests/test_migrate.py and tests/test_native_props.py).
 """
 
 from __future__ import annotations
@@ -41,6 +44,31 @@ _lib.assign_batches_first_fit.argtypes = [
     ctypes.POINTER(ctypes.c_int64),
 ]
 _lib.assign_batches_first_fit.restype = None
+# Windowed, state-carrying first-fit (the migration engine's native
+# front half — see packer.cc's contract comment; the handle is opaque).
+_lib.assign_ff_create.argtypes = [ctypes.c_int64, ctypes.c_int64]
+_lib.assign_ff_create.restype = ctypes.c_void_p
+_lib.assign_ff_feed.argtypes = [
+    ctypes.c_void_p,
+    ctypes.POINTER(ctypes.c_int32),
+    ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_uint8),
+    ctypes.c_int64,
+    ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_int64),
+    ctypes.POINTER(ctypes.c_int64),
+    ctypes.POINTER(ctypes.c_int64),
+]
+_lib.assign_ff_feed.restype = ctypes.c_int64
+_lib.assign_ff_finish.argtypes = [
+    ctypes.c_void_p,
+    ctypes.POINTER(ctypes.c_int64),
+]
+_lib.assign_ff_finish.restype = ctypes.c_int64
+_lib.assign_ff_destroy.argtypes = [ctypes.c_void_p]
+_lib.assign_ff_destroy.restype = None
+
+_NULL_I64 = ctypes.POINTER(ctypes.c_int64)()
 
 
 def _prep(stream):
@@ -124,3 +152,115 @@ def assign_batches_first_fit(
         prog_ptr,
     )
     return out, out_slot
+
+
+# -- windowed restartable first-fit (migrate/assign.py's native path) ------
+def _check_i64_out(name: str, buf: np.ndarray, min_size: int) -> None:
+    # The C loop writes int64 entries at absolute positions through the
+    # raw pointer — an undersized/non-contiguous/wrong-dtype buffer
+    # would corrupt the heap, so validate loudly (same contract as the
+    # one-shot loop's buffer check above).
+    if (
+        buf.dtype != np.int64
+        or buf.size < min_size
+        or not buf.flags["C_CONTIGUOUS"]
+    ):
+        raise ValueError(
+            f"{name} must be a C-contiguous int64 array of size >= "
+            f"{min_size}, got dtype={buf.dtype} size={buf.size} "
+            f"contiguous={buf.flags['C_CONTIGUOUS']}"
+        )
+
+
+def assign_ff_create(capacity: int, n_hint: int = 0) -> int:
+    """Allocates a restartable first-fit state handle (packer.cc's
+    ``AssignFFState``). ``n_hint`` pre-sizes the player frontier (0 ->
+    1024; it grows geometrically either way). The handle MUST be
+    released with :func:`assign_ff_destroy`."""
+    if capacity < 1:
+        raise ValueError(f"capacity must be >= 1, got {capacity}")
+    handle = _lib.assign_ff_create(int(capacity), int(n_hint))
+    if not handle:
+        raise MemoryError("assign_ff_create returned NULL")
+    return handle
+
+
+def assign_ff_feed(
+    handle: int,
+    idx_window: np.ndarray,
+    ratable_window: np.ndarray,
+    lo: int,
+    hi: int,
+    out_batch: np.ndarray,
+    out_slot: np.ndarray,
+    progress: np.ndarray | None = None,
+) -> int:
+    """Consumes stream slice ``[lo, hi)``. ``idx_window`` is the
+    WINDOW-local ``[hi-lo, slots]`` int32 player-row block and
+    ``ratable_window`` the ``[hi-lo]`` uint8 gate; ``out_batch``/
+    ``out_slot``/``progress`` carry ABSOLUTE stream positions (the
+    caller passes its full-stream buffers every call). Runs with the
+    GIL released; ``progress[0]`` is published with release semantics
+    at the pinned cadence (packer.cc ``kFFProgressEvery`` ==
+    ``migrate.assign.PROGRESS_EVERY``). Returns ``hi - lo``; raises on
+    a contract violation instead of corrupting the native state."""
+    n = hi - lo
+    if n < 0:
+        raise ValueError(f"feed window [{lo}, {hi}) is negative")
+    idx = np.ascontiguousarray(idx_window, dtype=np.int32)
+    if idx.ndim != 2 or idx.shape[0] != n:
+        raise ValueError(
+            f"idx_window must be [{n}, slots], got shape {idx.shape}"
+        )
+    rat = np.ascontiguousarray(ratable_window, dtype=np.uint8)
+    if rat.shape != (n,):
+        raise ValueError(
+            f"ratable_window must be [{n}], got shape {rat.shape}"
+        )
+    _check_i64_out("out_batch", out_batch, hi)
+    _check_i64_out("out_slot", out_slot, hi)
+    if progress is not None:
+        _check_i64_out("progress", progress, 2)
+    if n == 0:
+        return 0
+    consumed = _lib.assign_ff_feed(
+        handle,
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        idx.shape[1],
+        rat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        lo,
+        hi,
+        out_batch.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        out_slot.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        progress.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        if progress is not None else _NULL_I64,
+    )
+    if consumed != n:
+        raise ValueError(
+            f"feed slices must be contiguous (native loop refused "
+            f"window [{lo}, {hi}))"
+        )
+    return consumed
+
+
+def assign_ff_finish(handle: int, progress: np.ndarray | None = None) -> int:
+    """Publishes the final (n, batches-used) pair into ``progress``
+    (when given) and returns batches used. Idempotent and state-free —
+    callable mid-stream to read the current high-water batch count."""
+    if progress is not None:
+        _check_i64_out("progress", progress, 2)
+    used = _lib.assign_ff_finish(
+        handle,
+        progress.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        if progress is not None else _NULL_I64,
+    )
+    if used < 0:
+        raise ValueError("assign_ff_finish on a null handle")
+    return used
+
+
+def assign_ff_destroy(handle: int) -> None:
+    """Frees the native state. Safe on a handle never finished; must be
+    called exactly once per :func:`assign_ff_create`."""
+    if handle:
+        _lib.assign_ff_destroy(handle)

@@ -681,6 +681,12 @@ def choose_batch_size_streamed(
     function of ``n``, so the chosen B (and with it the whole emitted
     schedule) remains reproducible; and the final state is B-independent
     anyway (per-player chronology fixes every match's priors).
+
+    The migration engine — which never knows ``n`` up front — passes an
+    explicit ``prefix`` instead: its deterministic ``plan_windows``
+    decode-window planning prefix (``migrate/engine.py``; the policy
+    folds into ``migration_fingerprint`` so a resume under a different
+    prefix fails loudly).
     """
     n = stream.n_matches
     p = prefix or min(n, max(1 << 18, n // 8))

@@ -499,9 +499,19 @@ def _bench_migrate_main(metrics_out: str | None) -> None:
     re-rate reports ``migrate.streamed: false`` — the gate fails that
     outright.
 
+    The ``assign`` block is the FRONT-HALF-ONLY microbench: the
+    windowed first-fit alone (no decode, no scan) over a
+    BENCH_ASSIGN_MATCHES stream (default 1M — big enough that the
+    python recurrence's GIL time dominates), native route vs the python
+    oracle, fed in BENCH_MIGRATE_WINDOW windows. ``assign.native:
+    false`` means the GIL-released loop never engaged — the family's
+    assign-native gate fails a candidate that lost it.
+
     Knobs: BENCH_MIGRATE_MATCHES (default 50k), BENCH_MIGRATE_PLAYERS
     (default matches//3), BENCH_MIGRATE_WINDOW (decode window rows,
-    default 4096), BENCH_REPEATS (default 3)."""
+    default 4096), BENCH_MIGRATE_PLAN_WINDOWS (batch-size planning
+    prefix, default engine), BENCH_ASSIGN_MATCHES (default 1M; 0 skips
+    the assign microbench), BENCH_REPEATS (default 3)."""
     import tempfile
     import threading
 
@@ -510,7 +520,12 @@ def _bench_migrate_main(metrics_out: str | None) -> None:
     from analyzer_tpu.io.csv_codec import save_stream_csv
     from analyzer_tpu.io.ingest import decode_stream_csv
     from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
-    from analyzer_tpu.migrate import LineageManager, rate_backfill
+    from analyzer_tpu.migrate import (
+        LineageManager,
+        assign_native_available,
+        rate_backfill,
+    )
+    from analyzer_tpu.migrate.assign import IncrementalAssigner
     from analyzer_tpu.obs import install_jax_hooks
     from analyzer_tpu.sched.feed import get_arena
     from analyzer_tpu.sched.runner import rate_stream
@@ -522,8 +537,65 @@ def _bench_migrate_main(metrics_out: str | None) -> None:
         os.environ.get("BENCH_MIGRATE_PLAYERS", max(n_matches // 3, 100))
     )
     window_rows = int(os.environ.get("BENCH_MIGRATE_WINDOW", 4096))
+    plan_windows = (
+        int(os.environ["BENCH_MIGRATE_PLAN_WINDOWS"])
+        if os.environ.get("BENCH_MIGRATE_PLAN_WINDOWS") else None
+    )
+    n_assign = int(os.environ.get("BENCH_ASSIGN_MATCHES", 1_000_000))
     repeats = int(os.environ.get("BENCH_REPEATS", 3))
     cfg = RatingConfig()
+
+    def assign_only(stream, native: bool, capacity: int) -> float:
+        """Seconds for one full windowed first-fit pass (front half
+        only — the floor ROADMAP item 4 named)."""
+        n = stream.n_matches
+        out_b = np.full(n, -1, np.int64)
+        out_s = np.full(n, -1, np.int64)
+        a = IncrementalAssigner(capacity, out_b, out_s, native=native)
+        t0 = time.perf_counter()
+        for lo in range(0, n, window_rows):
+            a.feed(
+                stream.player_idx, stream.mode_id, stream.afk,
+                lo, min(lo + window_rows, n),
+            )
+        a.finish()
+        dt = time.perf_counter() - t0
+        a.close()
+        return dt
+
+    assign_block = None
+    if n_assign > 0:
+        t0 = time.perf_counter()
+        a_players = synthetic_players(max(n_assign // 3, 100), seed=42)
+        a_stream = synthetic_stream(
+            n_assign, a_players, seed=42, max_activity_share=1e-4
+        )
+        log(f"assign microbench stream: {time.perf_counter() - t0:.2f}s "
+            f"for {n_assign} matches")
+        native_ok = assign_native_available()
+        t_native = (
+            min(assign_only(a_stream, True, 128) for _ in range(repeats))
+            if native_ok else None
+        )
+        # One python pass is the oracle datum (it is the slow side by
+        # two orders; repeating it buys nothing).
+        t_py = assign_only(a_stream, False, 128)
+        assign_block = {
+            "native": native_ok,
+            "matches": n_assign,
+            "window_rows": window_rows,
+            "matches_per_sec": round(
+                n_assign / (t_native if t_native is not None else t_py), 1
+            ),
+            "python_matches_per_sec": round(n_assign / t_py, 1),
+            "speedup_over_python": (
+                round(t_py / t_native, 2) if t_native is not None else None
+            ),
+        }
+        log(f"assign front half: native "
+            f"{assign_block['matches_per_sec']:,} matches/s, python "
+            f"{assign_block['python_matches_per_sec']:,} matches/s "
+            f"({assign_block['speedup_over_python']}x)")
 
     t0 = time.perf_counter()
     players = synthetic_players(n_players, seed=42)
@@ -567,7 +639,8 @@ def _bench_migrate_main(metrics_out: str | None) -> None:
     # Warmup migration (compiles the engine's scan ladder).
     warm_staging = ViewPublisher()
     rate_backfill(
-        state0, data, cfg, staging=warm_staging, window_rows=window_rows
+        state0, data, cfg, staging=warm_staging, window_rows=window_rows,
+        plan_windows=plan_windows,
     )
 
     times: list[float] = []
@@ -576,6 +649,7 @@ def _bench_migrate_main(metrics_out: str | None) -> None:
     ttfd: list[float] = []
     bit_identical = True
     streamed = False
+    last_stats: dict = {}
     for r in range(repeats):
         lineage = LineageManager(live)
         staging = lineage.begin()
@@ -587,7 +661,8 @@ def _bench_migrate_main(metrics_out: str | None) -> None:
             try:
                 final, _ = rate_backfill(
                     state0, data, cfg, staging=staging,
-                    window_rows=window_rows, stats_out=stats,
+                    window_rows=window_rows, plan_windows=plan_windows,
+                    stats_out=stats,
                 )
                 box["table"] = np.asarray(final.table)
             except BaseException as e:  # noqa: BLE001 — reported below
@@ -619,6 +694,7 @@ def _bench_migrate_main(metrics_out: str | None) -> None:
         log(f"repeat {r}: {wall:.3f}s ({n_matches / wall:.0f} matches/s), "
             f"cutover {cutover_ms[-1]:.3f} ms, live v{view.version}")
         streamed = bool(stats.get("streamed"))
+        last_stats = stats
 
     best = min(times)
     stable = _tail_stable(times, repeats)
@@ -645,10 +721,19 @@ def _bench_migrate_main(metrics_out: str | None) -> None:
             "cutover_pause_ms": round(min(cutover_ms), 3),
             "idle_p99_ms": round(idle_p99, 3),
             "queries_during_migration": len(lat_ms),
+            "assign_native": last_stats.get("assign_native"),
+            "plan_windows": last_stats.get("plan_windows"),
+            "prefix_windows": last_stats.get("prefix_windows"),
         },
         "arena": get_arena().stats(),
         "capture": {"degraded": not stable},
     }
+    if assign_block is not None:
+        # Prefix windows actually consumed by the e2e run's batch-size
+        # planner (the assign microbench itself sizes nothing).
+        assign_block["plan_windows"] = last_stats.get("plan_windows")
+        assign_block["prefix_windows"] = last_stats.get("prefix_windows")
+        line["assign"] = assign_block
     if metrics_out:
         from analyzer_tpu.obs import write_snapshot
 

@@ -68,6 +68,58 @@ def main() -> int:
     assert batch.tolist() == [0, 1, 0], batch.tolist()
     assert slot.tolist() == [0, 0, 1], slot.tolist()
 
+    # --- packer: windowed restartable first-fit (create/feed xN/finish/
+    # destroy) under the sanitizers — heap state carried across calls,
+    # player-frontier growth mid-stream, filler consumed inline, and the
+    # release-published progress array. Must reproduce the one-shot
+    # answers on this all-ratable stream.
+    flat = idx.reshape(3, 4)
+    rat = np.array([1, 1, 1], np.uint8)
+    out_b = np.full(3, -9, np.int64)
+    out_s = np.full(3, -9, np.int64)
+    prog = np.zeros(2, np.int64)
+    h = _native.assign_ff_create(2, 1)  # tiny hint: forces growth
+    assert _native.assign_ff_feed(h, flat[:1], rat[:1], 0, 1, out_b, out_s,
+                                  prog) == 1
+    assert _native.assign_ff_feed(h, flat[1:], rat[1:], 1, 3, out_b, out_s,
+                                  prog) == 2
+    used = _native.assign_ff_finish(h, prog)
+    _native.assign_ff_destroy(h)
+    assert out_b.tolist() == [0, 1, 0], out_b.tolist()
+    assert out_s.tolist() == [0, 0, 1], out_s.tolist()
+    assert used == 2 and prog.tolist() == [3, 2], (used, prog.tolist())
+    # Filler consumed inline (the windowed loop's divergence from the
+    # one-shot -1 convention): batch >= 0, frontier untouched.
+    h = _native.assign_ff_create(1, 0)
+    _native.assign_ff_feed(
+        h, flat, np.array([1, 0, 1], np.uint8), 0, 3, out_b, out_s, prog
+    )
+    assert _native.assign_ff_finish(h, prog) == 3
+    assert out_b.tolist() == [0, 1, 2], out_b.tolist()
+    _native.assign_ff_destroy(h)
+    # Destroy WITHOUT finish: the handle must free all carried state
+    # (frontier/fill/DSU vectors) from the destructor alone. Exit-time
+    # leak checking is off in this process (python's own noise would
+    # drown it), so ask the preloaded ASan runtime directly: its
+    # live-allocated-bytes counter (quarantine excluded) must come back
+    # flat across 64 cycles that each carry a ~16 MB frontier (n_hint
+    # 2M int64) — ~1 GB of growth if destroy dropped the state. A
+    # double free or use-after-destroy still aborts under ASan proper.
+    import ctypes
+
+    live_bytes = ctypes.CDLL(None).__sanitizer_get_current_allocated_bytes
+    live_bytes.restype = ctypes.c_size_t
+    live_bytes.argtypes = []
+    before = live_bytes()
+    for _ in range(64):
+        h = _native.assign_ff_create(4, 2_000_000)
+        _native.assign_ff_feed(h, flat, rat, 0, 3, out_b, out_s, prog)
+        _native.assign_ff_destroy(h)  # no finish — destructor frees all
+    grown = live_bytes() - before
+    assert grown < 64 * 1024 * 1024, (
+        f"destroy-without-finish leaked ~{grown} bytes over 64 cycles"
+    )
+
     # --- fastsql: scan (str/int/float incl. NULLs), cumcount, lookup.
     from analyzer_tpu.service import _native_sql
 

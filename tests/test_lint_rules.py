@@ -551,6 +551,27 @@ _lib.assign_batches_first_fit.argtypes = [
     ctypes.POINTER(ctypes.c_int64),
 ]
 _lib.assign_batches_first_fit.restype = None
+_lib.assign_ff_create.argtypes = [ctypes.c_int64, ctypes.c_int64]
+_lib.assign_ff_create.restype = ctypes.c_void_p
+_lib.assign_ff_feed.argtypes = [
+    ctypes.c_void_p,
+    ctypes.POINTER(ctypes.c_int32),
+    ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_uint8),
+    ctypes.c_int64,
+    ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_int64),
+    ctypes.POINTER(ctypes.c_int64),
+    ctypes.POINTER(ctypes.c_int64),
+]
+_lib.assign_ff_feed.restype = ctypes.c_int64
+_lib.assign_ff_finish.argtypes = [
+    ctypes.c_void_p,
+    ctypes.POINTER(ctypes.c_int64),
+]
+_lib.assign_ff_finish.restype = ctypes.c_int64
+_lib.assign_ff_destroy.argtypes = [ctypes.c_void_p]
+_lib.assign_ff_destroy.restype = None
 """
 
 

@@ -20,6 +20,9 @@ from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
 from analyzer_tpu.migrate import (
     IncrementalAssigner,
     LineageManager,
+    NativeIncrementalAssigner,
+    PyIncrementalAssigner,
+    assign_native_available,
     migration_fingerprint,
     rate_backfill,
     run_migration,
@@ -137,6 +140,106 @@ class TestIncrementalAssigner:
 
 
 # ---------------------------------------------------------------------------
+def _run_assigner(cls, capacity, stream, step, n_hint_progress=True):
+    """One windowed pass; returns (batch, slot, batches_used, progress)."""
+    n = stream.n_matches
+    out_b = np.full(n, -9, np.int64)
+    out_s = np.full(n, -9, np.int64)
+    progress = np.zeros(2, np.int64) if n_hint_progress else None
+    a = cls(capacity, out_b, out_s, progress)
+    for lo in range(0, n, step):
+        a.feed(stream.player_idx, stream.mode_id, stream.afk,
+               lo, min(lo + step, n))
+    used = a.batches_used
+    a.finish()
+    a.close()
+    return out_b, out_s, used, progress
+
+
+class TestNativeAssignerParity:
+    """The GIL-released native windowed first-fit against its python
+    oracle: bit-identical (batch, slot, batches-used) across window
+    sizes {1, 7, 300, 4096}, filler-heavy and heavy-tailed ladders,
+    and capacity edges — the tentpole's differential contract
+    (fuzz variant in tests/test_native_props.py)."""
+
+    STREAMS = {
+        "plain": dict(seed=5),
+        "filler_heavy": dict(seed=7, afk_rate=0.5),
+        "heavy_tailed": dict(seed=9, max_activity_share=0.5),
+    }
+
+    @pytest.fixture(autouse=True)
+    def _need_native(self):
+        if not assign_native_available():
+            pytest.skip("native windowed assigner not buildable here")
+
+    @pytest.mark.parametrize("shape", sorted(STREAMS))
+    @pytest.mark.parametrize("step", [1, 7, 300, 4096])
+    def test_native_matches_python_across_window_matrix(self, shape, step):
+        kw = dict(self.STREAMS[shape])
+        players = synthetic_players(40, seed=kw.pop("seed"))
+        s = synthetic_stream(600, players, seed=8, **kw)
+        for cap in (1, 8):
+            got = _run_assigner(NativeIncrementalAssigner, cap, s, step)
+            want = _run_assigner(PyIncrementalAssigner, cap, s, step)
+            np.testing.assert_array_equal(got[0], want[0])
+            np.testing.assert_array_equal(got[1], want[1])
+            assert got[2] == want[2]
+            # finish publishes the same completion record
+            np.testing.assert_array_equal(got[3], want[3])
+
+    def test_native_window_decomposition_is_invisible(self):
+        players = synthetic_players(40, seed=9)
+        s = synthetic_stream(300, players, seed=9, afk_rate=0.2)
+        ref = _run_assigner(NativeIncrementalAssigner, 4, s, 300)
+        for step in (1, 7, 64):
+            got = _run_assigner(NativeIncrementalAssigner, 4, s, step)
+            np.testing.assert_array_equal(got[0], ref[0])
+            np.testing.assert_array_equal(got[1], ref[1])
+            assert got[2] == ref[2]
+
+    def test_native_matches_one_shot_on_ratable_stream(self):
+        from analyzer_tpu.sched import _native
+
+        players = synthetic_players(50, seed=5)
+        raw = synthetic_stream(600, players, seed=5)
+        keep = raw.ratable
+        s = MatchStream(
+            raw.player_idx[keep], raw.winner[keep],
+            raw.mode_id[keep], raw.afk[keep],
+        )
+        got = _run_assigner(NativeIncrementalAssigner, 8, s, 97)
+        ref_b, ref_s = _native.assign_batches_first_fit(s, 8)
+        np.testing.assert_array_equal(got[0], ref_b)
+        np.testing.assert_array_equal(got[1], ref_s)
+
+    def test_native_contiguity_and_close_contracts(self):
+        s = synthetic_stream(50, synthetic_players(10, seed=1), seed=1)
+        out = np.full(50, -1, np.int64)
+        a = NativeIncrementalAssigner(4, out, out.copy())
+        a.feed(s.player_idx, s.mode_id, s.afk, 0, 10)
+        with pytest.raises(ValueError, match="contiguous"):
+            a.feed(s.player_idx, s.mode_id, s.afk, 20, 30)
+        a.close()
+        a.close()  # idempotent
+        with pytest.raises(ValueError, match="closed"):
+            a.feed(s.player_idx, s.mode_id, s.afk, 10, 20)
+
+    def test_router_selects_native_and_forces(self):
+        out = np.full(8, -1, np.int64)
+        auto = IncrementalAssigner(4, out, out.copy())
+        assert auto.is_native  # native available (fixture) -> default
+        auto.close()
+        py = IncrementalAssigner(4, out, out.copy(), native=False)
+        assert not py.is_native
+        py.close()
+        forced = IncrementalAssigner(4, out, out.copy(), native=True)
+        assert forced.is_native
+        forced.close()
+
+
+# ---------------------------------------------------------------------------
 PARITY_CASES = [
     ("reference", 0),
     ("fused", 0),
@@ -195,6 +298,87 @@ class TestBackfillParity:
         for key in ("n_steps", "batch_size", "occupancy", "fingerprint"):
             assert runs[0][1][key] == runs[1][1][key], key
 
+    def test_assigner_route_is_result_invisible(self):
+        """Native vs python front half: identical placement, so EVERY
+        collected field (filler slots included) and the table are
+        byte-identical — stricter than the rate_stream parity above."""
+        if not assign_native_available():
+            pytest.skip("native windowed assigner not buildable here")
+        data, _ = _csv_bytes(500, seed=29, afk_rate=0.15)
+        runs = {}
+        for native in (True, False):
+            stats: dict = {}
+            st, outs = rate_backfill(
+                _state(), data, CFG, collect=True, window_rows=64,
+                steps_per_chunk=4, assign_native=native, stats_out=stats,
+            )
+            assert stats["assign_native"] is native
+            assert stats["streamed"]
+            runs[native] = (np.asarray(st.table), outs)
+        np.testing.assert_array_equal(runs[True][0], runs[False][0])
+        for field in ("updated", "quality", "any_afk", "shared_mu",
+                      "delta"):
+            np.testing.assert_array_equal(
+                getattr(runs[True][1], field),
+                getattr(runs[False][1], field), err_msg=field,
+            )
+
+    def test_engine_sets_assign_native_gauge_and_counter(self):
+        data, _ = _csv_bytes(200, seed=31)
+        reg = get_registry()
+        before = reg.counter("migrate.assign_matches_total").value
+        stats: dict = {}
+        rate_backfill(_state(), data, CFG, stats_out=stats)
+        assert (
+            reg.gauge("migrate.assign_native").value
+            == stats["assign_native"]
+            == assign_native_available()
+        )
+        assert (
+            reg.counter("migrate.assign_matches_total").value - before
+            == stats["matches"]
+        )
+
+    def test_plan_prefix_covers_k_windows(self):
+        # 300 matches at window_rows=64: plan_windows=2 sizes b from
+        # exactly 128 rows; a large k clamps at the stream end.
+        data, _ = _csv_bytes(300, seed=37)
+        stats: dict = {}
+        rate_backfill(
+            _state(), data, CFG, window_rows=64, plan_windows=2,
+            stats_out=stats,
+        )
+        assert stats["plan_windows"] == 2
+        assert stats["prefix_windows"] == 2
+        assert stats["prefix_rows"] == 128
+        stats2: dict = {}
+        rate_backfill(
+            _state(), data, CFG, window_rows=64, plan_windows=50,
+            stats_out=stats2,
+        )
+        assert stats2["prefix_rows"] == 300
+        assert stats2["prefix_windows"] == 5  # ceil(300 / 64)
+        with pytest.raises(ValueError, match="plan_windows"):
+            rate_backfill(_state(), data, CFG, plan_windows=0)
+
+    def test_plan_prefix_policy_folds_into_fingerprint(self):
+        # Same bytes, different prefix policy -> different schedule
+        # identity (the choice of b is a function of the prefix, so a
+        # resume under a changed policy must fail loudly) — but the
+        # TABLE stays bit-identical (b-independence).
+        data, _ = _csv_bytes(300, seed=53)
+        tables, fps = [], []
+        for k in (1, 3):
+            stats: dict = {}
+            st, _ = rate_backfill(
+                _state(), data, CFG, window_rows=64, plan_windows=k,
+                stats_out=stats,
+            )
+            tables.append(np.asarray(st.table))
+            fps.append(stats["fingerprint"])
+        assert fps[0] != fps[1]
+        np.testing.assert_array_equal(tables[0], tables[1])
+
     def test_batch_size_independence(self):
         # The final table is b-independent (chronology fixes priors);
         # the streamed prefix choice therefore cannot change results.
@@ -245,30 +429,34 @@ class TestStreamingOverlap:
     window (not whole-file), flat steady-state arena allocations."""
 
     def test_first_dispatch_before_decode_completes(self, monkeypatch):
-        """Decode of window 2+ BLOCKS until the first chunk has
-        dispatched: an engine that needed the whole file before its
+        """Decode past the PLANNING PREFIX blocks until the first chunk
+        has dispatched: an engine that needed the whole file before its
         first dispatch would deadlock here (the gate times out and the
-        run fails) instead of passing."""
+        run fails) instead of passing. The prefix itself (plan_windows
+        decode windows, consumed for batch sizing before the front-half
+        thread starts) passes ungated — that launch cost is the
+        documented O(prefix) contract, not a loss of overlap."""
         import analyzer_tpu.migrate.engine as engine_mod
 
         gate = threading.Event()
+        plan = 2
 
         class GatedDecoder(ColumnarDecoder):
             def windows(self):
                 inner = super().windows()
-                first = True
+                served = 0
                 while True:
                     try:
                         win = next(inner)
                     except StopIteration:
                         return
-                    if not first and not gate.wait(timeout=60):
+                    if served >= plan and not gate.wait(timeout=60):
                         raise RuntimeError(
                             "first dispatch never happened while decode "
                             "was still pending — the streaming overlap "
                             "is broken"
                         )
-                    first = False
+                    served += 1
                     yield win
 
         monkeypatch.setattr(engine_mod, "ColumnarDecoder", GatedDecoder)
@@ -283,7 +471,7 @@ class TestStreamingOverlap:
         # filling — the documented chain-bound caveat; an oversized
         # forced b would legitimately serialize this stream).
         st, _ = rate_backfill(
-            _state(200), data, CFG, window_rows=64,
+            _state(200), data, CFG, window_rows=64, plan_windows=plan,
             steps_per_chunk=2, on_chunk=on_chunk, stats_out=stats,
         )
         assert gate.is_set()
@@ -372,12 +560,56 @@ class TestResume:
         with pytest.raises(ValueError, match="no longer matches"):
             run_migration(None, data_b, CFG, checkpoint=ck, resume=True, **kw)
 
+    def test_changed_plan_policy_rejected_on_resume(self, tmp_path):
+        # The batch-size planning prefix is a fingerprint input: a
+        # resume under a different policy could re-derive a different b
+        # (a different schedule) — it must fail as loudly as changed
+        # bytes do.
+        data, _ = _csv_bytes(300, seed=49)
+        ck = str(tmp_path / "plan.npz")
+        kw = dict(window_rows=64, steps_per_chunk=4)
+        run_migration(
+            _state(), data, CFG, checkpoint=ck, stop_after=4,
+            plan_windows=1, **kw
+        )
+        with pytest.raises(ValueError, match="no longer matches"):
+            run_migration(
+                None, data, CFG, checkpoint=ck, resume=True,
+                plan_windows=3, **kw
+            )
+
+    def test_resume_bit_identical_forced_native_both_sides(self, tmp_path):
+        # The parametrized matrix above already rides the default
+        # (native) route; this pins the acceptance wording explicitly —
+        # native windowed assigner on BOTH sides of the kill point.
+        if not assign_native_available():
+            pytest.skip("native windowed assigner not buildable here")
+        data, _ = _csv_bytes(400, seed=59, afk_rate=0.1)
+        kw = dict(window_rows=128, steps_per_chunk=4, assign_native=True)
+        full = run_migration(_state(), data, CFG, **kw)
+        ck = str(tmp_path / "native.npz")
+        run_migration(_state(), data, CFG, checkpoint=ck, stop_after=8, **kw)
+        resumed = run_migration(None, data, CFG, checkpoint=ck,
+                                resume=True, **kw)
+        assert resumed.stats["assign_native"] is True
+        np.testing.assert_array_equal(
+            np.asarray(full.state.table), np.asarray(resumed.state.table)
+        )
+
     def test_fingerprint_is_content_addressed(self):
         a = migration_fingerprint(b"x" * 100, 8, 4)
         assert a == migration_fingerprint(b"x" * 100, 8, 4)
         assert a != migration_fingerprint(b"y" * 100, 8, 4)
         assert a != migration_fingerprint(b"x" * 100, 16, 4)
         assert a != migration_fingerprint(b"x" * 100, 8, 8)
+        # The planning-prefix policy folds in (plan-v2 inputs); the
+        # bare 3-arg form stays the policy-free content hash.
+        b = migration_fingerprint(b"x" * 100, 8, 4, plan_windows=4,
+                                  window_rows=4096)
+        assert b != a
+        assert b == migration_fingerprint(b"x" * 100, 8, 4, 4, 4096)
+        assert b != migration_fingerprint(b"x" * 100, 8, 4, 2, 4096)
+        assert b != migration_fingerprint(b"x" * 100, 8, 4, 4, 128)
 
 
 # ---------------------------------------------------------------------------
@@ -687,7 +919,8 @@ class TestBenchdiffMigrateFamily:
     """The MIGRATE_BENCH artifact family: config extraction, the delta
     gate, and the vanished-block (silent offline fall-back) gate."""
 
-    def _artifact(self, value=1000.0, p99=2.0, pause=0.5, streamed=True):
+    def _artifact(self, value=1000.0, p99=2.0, pause=0.5, streamed=True,
+                  assign_native=True, assign_mps=2_000_000.0):
         return {
             "metric": "migrate.matches_per_sec",
             "value": value,
@@ -696,6 +929,11 @@ class TestBenchdiffMigrateFamily:
                 "streamed": streamed,
                 "cutover_pause_ms": pause,
                 "stable": True,
+            },
+            "assign": {
+                "native": assign_native,
+                "matches_per_sec": assign_mps,
+                "python_matches_per_sec": 150_000.0,
             },
             "capture": {"degraded": False},
         }
@@ -710,6 +948,9 @@ class TestBenchdiffMigrateFamily:
         assert names["migrate.matches_per_sec"].higher_is_better
         assert not names["migrate.live_p99_ms"].higher_is_better
         assert not names["migrate.cutover_pause_ms"].higher_is_better
+        # The front-half-only throughput rides the family's delta gate.
+        assert names["assign.matches_per_sec"].higher_is_better
+        assert names["assign.matches_per_sec"].value == 2_000_000.0
 
     def _run_cli(self, a, b, tmp_path, *extra):
         from analyzer_tpu.cli import main
@@ -744,6 +985,29 @@ class TestBenchdiffMigrateFamily:
         out = capsys.readouterr()
         assert rc == 1
         assert "fall-back" in out.err
+
+    def test_vanished_native_assigner_gates(self, tmp_path, capsys):
+        # Baseline ran the GIL-released native front half; the
+        # candidate's assign block reports native: false -> the route
+        # silently flipped to the python recurrence -> exit 1 (the
+        # ingest family's python-codec gate pattern).
+        rc = self._run_cli(
+            self._artifact(),
+            self._artifact(assign_native=False, assign_mps=150_000.0),
+            tmp_path,
+        )
+        out = capsys.readouterr()
+        assert rc == 1
+        assert "python first-fit" in out.err
+
+    def test_assign_regression_gates_within_route(self, tmp_path, capsys):
+        # Same route, slower front half: the delta gate catches it.
+        assert self._run_cli(
+            self._artifact(),
+            self._artifact(assign_mps=1_000_000.0),
+            tmp_path,
+        ) == 1
+        capsys.readouterr()
 
     def test_family_scan_prefix(self, tmp_path):
         from analyzer_tpu.obs.benchdiff import find_bench_artifacts

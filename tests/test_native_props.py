@@ -4,11 +4,17 @@ numpy/python reference implementations.
 The C sqlite scanner + joins (service/fastsql.cc) replaced measured-hot
 numpy paths; these drive them with adversarial inputs (duplicate keys,
 shared prefixes, width mismatches, NULLs, empty strings, unicode) that
-the fixture-based tests undersample. Examples are capped to keep the
-suite fast — the generators bias toward collisions on purpose.
+the fixture-based tests undersample. The windowed restartable first-fit
+(sched/packer.cc ``assign_ff_*`` — the migration engine's native front
+half) is fuzzed against BOTH its oracles: the python incremental
+recurrence under a *different* random window decomposition, and — on
+filler-free streams — the one-shot ``assign_batches_first_fit``.
+Examples are capped to keep the suite fast — the generators bias toward
+collisions on purpose.
 """
 
 import sqlite3
+from types import SimpleNamespace
 
 import numpy as np
 import pytest
@@ -17,6 +23,10 @@ from hypothesis import given, settings, strategies as st
 native = pytest.importorskip(
     "analyzer_tpu.service._native_sql",
     reason="native sqlite scanner not buildable here",
+)
+packer = pytest.importorskip(
+    "analyzer_tpu.sched._native",
+    reason="native packer not buildable here",
 )
 
 # Small alphabet + short lengths = many duplicates and shared prefixes.
@@ -96,6 +106,134 @@ class TestCumcountProperties:
             native.cumcount(np.array([0, 5], np.int64), 5)
         with pytest.raises(RuntimeError, match="outside"):
             native.cumcount(np.array([-1], np.int64), 5)
+
+
+def _ff_arrays(matches):
+    """(player_idx [n,2,2] int32, mode_id, afk) from a list of
+    (player-row list, ratable) tuples — the fuzz generator's stream."""
+    n = len(matches)
+    pidx = np.full((n, 2, 2), -1, np.int32)
+    mode = np.full(n, -1, np.int32)
+    afk = np.zeros(n, bool)
+    for i, (players, ratable) in enumerate(matches):
+        flat = pidx[i].reshape(-1)
+        flat[: len(players)] = players
+        mode[i] = 0 if ratable else -1
+    return pidx, mode, afk
+
+
+def _run_windowed(cls, capacity, pidx, mode, afk, widths):
+    """One windowed pass with the given assigner class, cutting the
+    stream by cycling ``widths``; returns (batch, slot, batches_used)."""
+    n = pidx.shape[0]
+    out_b = np.full(n, -9, np.int64)
+    out_s = np.full(n, -9, np.int64)
+    a = cls(capacity, out_b, out_s)
+    lo, w = 0, 0
+    while lo < n:
+        hi = min(lo + widths[w % len(widths)], n)
+        a.feed(pidx, mode, afk, lo, hi)
+        lo, w = hi, w + 1
+    used = a.batches_used
+    a.finish()
+    a.close()
+    return out_b, out_s, used
+
+
+# Small player alphabet = heavy frontier collisions (the chains that
+# actually exercise the DSU + floor recurrence); empty rosters allowed
+# (a ratable match with no players has floor 0, like the python loop).
+_ff_matches = st.lists(
+    st.tuples(
+        st.lists(st.integers(0, 15), max_size=4),
+        st.booleans(),
+    ),
+    max_size=100,
+)
+_ff_widths = st.lists(st.integers(1, 23), min_size=1, max_size=6)
+
+
+class TestAssignFFProperties:
+    """Native windowed ≡ python incremental ≡ (filler-free) one-shot —
+    the (batch, slot, batches-used) triple, under INDEPENDENT random
+    window decompositions on each side."""
+
+    @settings(max_examples=60, deadline=None)
+    @given(
+        matches=_ff_matches, capacity=st.integers(1, 5),
+        w_native=_ff_widths, w_py=_ff_widths,
+    )
+    def test_native_windowed_matches_python_incremental(
+        self, matches, capacity, w_native, w_py
+    ):
+        from analyzer_tpu.migrate.assign import (
+            NativeIncrementalAssigner,
+            PyIncrementalAssigner,
+        )
+
+        pidx, mode, afk = _ff_arrays(matches)
+        got = _run_windowed(
+            NativeIncrementalAssigner, capacity, pidx, mode, afk, w_native
+        )
+        want = _run_windowed(
+            PyIncrementalAssigner, capacity, pidx, mode, afk, w_py
+        )
+        assert np.array_equal(got[0], want[0])
+        assert np.array_equal(got[1], want[1])
+        assert got[2] == want[2]
+
+    @settings(max_examples=60, deadline=None)
+    @given(
+        matches=_ff_matches, capacity=st.integers(1, 5),
+        widths=_ff_widths,
+    )
+    def test_ratable_stream_matches_one_shot(
+        self, matches, capacity, widths
+    ):
+        # Filler-free: the windowed loop and the one-shot loop agree on
+        # every entry (with fillers the conventions diverge by design —
+        # inline capacity vs -1 + backfill; migrate/assign.py).
+        from analyzer_tpu.migrate.assign import NativeIncrementalAssigner
+
+        matches = [(p, True) for p, _ in matches]
+        pidx, mode, afk = _ff_arrays(matches)
+        n = pidx.shape[0]
+        got = _run_windowed(
+            NativeIncrementalAssigner, capacity, pidx, mode, afk, widths
+        )
+        stream = SimpleNamespace(
+            n_matches=n, player_idx=pidx, team_size=2,
+            ratable=np.ones(n, np.uint8),
+        )
+        ref_b, ref_s = packer.assign_batches_first_fit(stream, capacity)
+        assert np.array_equal(got[0], ref_b)
+        assert np.array_equal(got[1], ref_s)
+        assert got[2] == (int(ref_b.max()) + 1 if n else 0)
+
+    def test_capacity_one_and_all_filler_edges(self):
+        from analyzer_tpu.migrate.assign import (
+            NativeIncrementalAssigner,
+            PyIncrementalAssigner,
+        )
+
+        # capacity=1: every match (ratable or not) gets its own batch
+        # in stream order, slot 0.
+        matches = [([i % 3], i % 2 == 0) for i in range(17)]
+        pidx, mode, afk = _ff_arrays(matches)
+        for cls in (NativeIncrementalAssigner, PyIncrementalAssigner):
+            b, s, used = _run_windowed(cls, 1, pidx, mode, afk, [5])
+            assert b.tolist() == list(range(17))
+            assert s.tolist() == [0] * 17
+            assert used == 17
+        # all-filler: dependency-free first-fit from batch 0 — exact
+        # round-robin fill.
+        matches = [([j], False) for j in range(20)]
+        pidx, mode, afk = _ff_arrays(matches)
+        for cls in (NativeIncrementalAssigner, PyIncrementalAssigner):
+            b, s, used = _run_windowed(cls, 8, pidx, mode, afk, [3])
+            assert b.tolist() == [i // 8 for i in range(20)]
+            assert s.tolist() == [i % 8 for i in range(20)]
+            assert used == 3
 
 
 class TestScanProperties:

@@ -694,6 +694,7 @@ class TestStats:
             prog.begin()
             prog.note_decoded(100)
             prog.note_assigned(80)
+            prog.note_assign_backend(True)
             prog.note_dispatched(16, 0)
             prog.set_total_steps(64)
             prog.set_lineages(3, 1)
@@ -703,6 +704,9 @@ class TestStats:
             assert m["steps_total"] == 64
             assert m["progress_pct"] == 25.0
             assert m["matches_decoded"] == 100
+            # The front half's first-fit route (True = the GIL-released
+            # native windowed loop; None before an engine run reports).
+            assert m["assign_native"] is True
             assert m["lineage_live_version"] == 3
             assert m["lineage_staging_version"] == 1
             prog.finish()
