@@ -1333,6 +1333,12 @@ def cmd_lint(args) -> int:
         argv.append("--json")
     if args.rules:
         argv.append("--rules")
+    if not args.project:
+        argv.append("--no-project")
+    if args.baseline:
+        argv.extend(["--baseline", args.baseline])
+    if args.write_baseline:
+        argv.extend(["--write-baseline", args.write_baseline])
     return lint_main(argv)
 
 
@@ -1995,6 +2001,18 @@ def main(argv=None) -> int:
     s.add_argument("--json", action="store_true", help="JSON output")
     s.add_argument(
         "--rules", action="store_true", help="print the rule catalog"
+    )
+    s.add_argument(
+        "--project", action=argparse.BooleanOptionalAction, default=True,
+        help="cross-module thread rules GL040-GL045 (default on)",
+    )
+    s.add_argument(
+        "--baseline", metavar="FILE",
+        help="JSON suppression snapshot (stale entries fail loudly)",
+    )
+    s.add_argument(
+        "--write-baseline", metavar="FILE",
+        help="snapshot current findings as a baseline and exit 0",
     )
     s.set_defaults(fn=cmd_lint)
 

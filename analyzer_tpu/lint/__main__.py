@@ -2,7 +2,7 @@
 
 Exit codes (CI contract):
   0  clean
-  1  findings (or unparseable files)
+  1  findings (or unparseable files, or stale baseline entries)
   2  usage error
 
 The linter itself never imports jax, but a linted loader module is next
@@ -21,14 +21,70 @@ import argparse  # noqa: E402
 import json  # noqa: E402
 import sys  # noqa: E402
 
-from analyzer_tpu.lint.findings import RULES  # noqa: E402
+from analyzer_tpu.lint.findings import RULES, Finding  # noqa: E402
 from analyzer_tpu.lint.runner import lint_paths  # noqa: E402
+
+
+def _baseline_entry(f: Finding, line_text: str) -> dict:
+    return {
+        "rule": f.rule, "path": f.path, "line": f.line,
+        "text": line_text.strip(),
+    }
+
+
+def _flagged_line(f: Finding, cache: dict[str, list[str]]) -> str:
+    if f.path not in cache:
+        try:
+            with open(f.path, encoding="utf-8") as fh:
+                cache[f.path] = fh.read().splitlines()
+        except OSError:
+            cache[f.path] = []
+    lines = cache[f.path]
+    return lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: list[dict],
+) -> tuple[list[Finding], list[str]]:
+    """Splits findings into (kept, stale-entry errors).
+
+    A baseline entry matches a finding by (rule, path suffix, flagged
+    line text) — NOT by line number, so unrelated edits above the site
+    don't expire it. An entry that matches nothing is stale: the
+    flagged line was fixed or vanished, and carrying the suppression
+    forward would hide a future regression — it must be removed."""
+    cache: dict[str, list[str]] = {}
+    unmatched = list(baseline)
+    kept: list[Finding] = []
+    for f in findings:
+        text = _flagged_line(f, cache).strip()
+        hit = None
+        for entry in unmatched:
+            if (
+                entry.get("rule") == f.rule
+                and f.path.endswith(str(entry.get("path", "")))
+                and entry.get("text", "") == text
+            ):
+                hit = entry
+                break
+        if hit is not None:
+            unmatched.remove(hit)
+        else:
+            kept.append(f)
+    stale = [
+        f"stale baseline entry {e.get('rule')} {e.get('path')}:"
+        f"{e.get('line')} ({e.get('text', '')!r}): the flagged line no "
+        f"longer lints dirty — remove it from the baseline"
+        for e in unmatched
+    ]
+    return kept, stale
 
 
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m analyzer_tpu.lint",
-        description="graftlint: JAX-hazard + native-ABI static analysis",
+        description="graftlint: JAX-hazard + native-ABI + thread-ownership "
+                    "static analysis",
     )
     p.add_argument(
         "paths", nargs="*", default=["analyzer_tpu"],
@@ -36,10 +92,23 @@ def main(argv: list[str] | None = None) -> int:
     )
     p.add_argument(
         "--json", action="store_true",
-        help="machine-readable output (one JSON object)",
+        help="machine-readable output (one JSON object, incl. timings_s)",
     )
     p.add_argument(
         "--rules", action="store_true", help="print the rule catalog and exit"
+    )
+    p.add_argument(
+        "--project", action=argparse.BooleanOptionalAction, default=True,
+        help="run the cross-module thread rules GL040-GL045 (default on)",
+    )
+    p.add_argument(
+        "--baseline", metavar="FILE",
+        help="JSON suppression snapshot: findings matching an entry are "
+             "dropped; entries whose flagged line vanished fail loudly",
+    )
+    p.add_argument(
+        "--write-baseline", metavar="FILE",
+        help="write the current findings as a baseline snapshot and exit 0",
     )
     try:
         args = p.parse_args(argv)
@@ -53,13 +122,41 @@ def main(argv: list[str] | None = None) -> int:
     if missing:
         print(f"error: no such path: {', '.join(missing)}", file=sys.stderr)
         return 2
-    findings, errors = lint_paths(args.paths)
+    timings: dict[str, float] = {}
+    findings, errors = lint_paths(
+        args.paths, project=args.project, timings=timings
+    )
+    if args.write_baseline:
+        cache: dict[str, list[str]] = {}
+        entries = [
+            _baseline_entry(f, _flagged_line(f, cache)) for f in findings
+        ]
+        with open(args.write_baseline, "w", encoding="utf-8") as fh:
+            json.dump({"entries": entries}, fh, indent=2)
+            fh.write("\n")
+        print(
+            f"graftlint: wrote {len(entries)} baseline entrie(s) to "
+            f"{args.write_baseline}"
+        )
+        return 0
+    if args.baseline:
+        try:
+            with open(args.baseline, encoding="utf-8") as fh:
+                entries = json.load(fh).get("entries", [])
+        except (OSError, ValueError) as e:
+            print(f"error: unreadable baseline: {e}", file=sys.stderr)
+            return 2
+        findings, stale = apply_baseline(findings, entries)
+        errors = errors + stale
     if args.json:
         print(
             json.dumps(
                 {
                     "findings": [f.to_json() for f in findings],
                     "errors": errors,
+                    "timings_s": {
+                        k: round(v, 6) for k, v in sorted(timings.items())
+                    },
                 },
                 indent=2,
             )

@@ -111,6 +111,37 @@ RULES: dict[str, str] = {
         "obs/federate.py — the Collector is clock-injected like the "
         "history/SLO plane, scrape(now) takes the caller's timestamp"
     ),
+    # -- Thread-ownership rules (project mode; lint/threadrules.py) --
+    "GL040": (
+        "role-owned attribute (lint/ownership.py OWNED_ATTRS) written "
+        "from a function whose thread_role does not match the owning "
+        "thread (unannotated counts as mismatched; __init__ is exempt)"
+    ),
+    "GL041": (
+        "buffer lifetime hole across a GIL-released native call: a "
+        "self-attribute passed into a GIL-released ctypes entry while "
+        "some method rebinds that attribute outside __init__, or a "
+        ".ctypes.data/.data_as pointer used after its array was "
+        "rebound or deleted"
+    ),
+    "GL042": (
+        "lock-order cycle: two locks acquired in opposite nesting "
+        "orders somewhere across the project (direct `with` nesting "
+        "plus one level of same-class/imported calls)"
+    ),
+    "GL043": (
+        "user callback (on_*/..._hook/..._callback) invoked while "
+        "holding a lock — snapshot under the lock, call after release"
+    ),
+    "GL044": (
+        "Condition.wait() outside a predicate loop (or untimed inside "
+        "`while True:`) — spurious wakeups and stolen notifications "
+        "make a bare wait a missed-update bug"
+    ),
+    "GL045": (
+        "module-global mutable state written without a lock from a "
+        "module that declares thread roles — any thread may call in"
+    ),
 }
 
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")

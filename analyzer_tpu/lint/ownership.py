@@ -1,0 +1,84 @@
+"""Declarative thread-ownership surface for graftlint project mode.
+
+The threaded subsystems (feed ring, tier manager, migration engine,
+view publisher, pipelined writer) each split their state between a
+producer thread and a consumer thread; until now the split lived only
+in docstrings. This module makes the contract machine-readable:
+
+* ``thread_role("producer"|"consumer"|"any")`` stamps a function or
+  method with the thread it runs on. Zero runtime cost — the decorator
+  only sets ``__thread_role__`` on the function, it never wraps it.
+* ``OWNED_ATTRS`` names, per class, which ``self._x`` attributes each
+  role owns. GL040 checks every write site against this table.
+* ``GIL_RELEASED_ENTRIES`` names the ctypes entries that drop the GIL
+  while running; GL041 checks buffer lifetimes around calls to them.
+
+This module is imported BOTH by the linted runtime modules (for the
+decorator) and by the linter itself (for the tables) — it must stay
+stdlib-only so the lint pass never drags in jax/numpy.
+"""
+
+from __future__ import annotations
+
+ROLES = ("producer", "consumer", "any")
+
+
+def thread_role(role: str):
+    """Declares which thread a function runs on.
+
+    ``producer`` / ``consumer`` name the two sides of a documented
+    handoff; ``any`` marks entry points deliberately safe from either
+    side (e.g. methods that take the instance lock, or lock-free
+    readers). The linter (GL040) flags writes to role-owned attributes
+    from functions with the wrong — or no — role annotation.
+    """
+    if role not in ROLES:
+        raise ValueError(f"thread_role must be one of {ROLES}, got {role!r}")
+
+    def mark(fn):
+        fn.__thread_role__ = role
+        return fn
+
+    return mark
+
+
+#: Per-class attribute ownership: dotted class path -> role -> attrs
+#: that only that role's thread may write (``__init__`` excepted — the
+#: constructor runs before any thread is spawned). Keep entries here
+#: tied to a docstring in the owning class stating the same contract.
+OWNED_ATTRS: dict[str, dict[str, frozenset[str]]] = {
+    # sched/tier.py: "producer owns the page table, consumer owns
+    # cold-tier writes". The feed thread plans against the page table;
+    # the dispatch loop applies plans and writes the host cold tier.
+    "analyzer_tpu.sched.tier.TierManager": {
+        "producer": frozenset({
+            "_slot_lut", "_row_of", "_dirty", "_last_use", "_free",
+            "_host_version", "_seq",
+        }),
+        "consumer": frozenset({
+            "_applied", "_pending", "_c_slot_of", "_written_pub",
+            "_written_start", "_host_table",
+        }),
+    },
+    # service/pipeline.py: the writer thread creates its own store
+    # handle inside run() — no other thread may touch it (sqlite
+    # handles are thread-affine).
+    "analyzer_tpu.service.pipeline._Writer": {
+        "consumer": frozenset({"store"}),
+    },
+}
+
+
+#: ctypes entry points that release the GIL while running. A numpy
+#: buffer passed in by pointer must stay bound (same object) until the
+#: call returns — rebinding or deleting the owning name mid-call frees
+#: the buffer under the native loop. GL041 keys off this set.
+GIL_RELEASED_ENTRIES = frozenset({
+    "assign_supersteps",
+    "assign_batches_first_fit",
+    "assign_ff_feed",
+    "parse_stream_csv",
+    "scan_query",
+    "cumcount",
+    "lookup",
+})

@@ -1,0 +1,499 @@
+"""Whole-tree project model for graftlint project mode.
+
+One parse per file (shared with the per-file rule families via
+``runner.py``), one walk per module, producing the cross-module facts
+the thread rules (GL040-GL045) need:
+
+* module index keyed by dotted name (``analyzer_tpu.sched.tier``),
+* function/method index with ``@thread_role`` annotations resolved
+  through each module's import table,
+* attribute-write sites (``self._x = ...`` and subscript stores),
+* lock-acquisition sites and their syntactic nesting,
+* call sites of GIL-released native entries,
+* module-global write sites.
+
+Everything is stdlib ``ast`` — the model must build in milliseconds on
+machines with no accelerator stack and never import jax/numpy.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from analyzer_tpu.lint.findings import suppressed_rules
+from analyzer_tpu.lint.jaxrules import _Imports
+
+#: Terminal with-item names treated as locks even when their
+#: ``threading.Lock()`` assignment is out of view (e.g. injected).
+_LOCKY = ("lock", "mutex", "cond")
+
+_LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+}
+_CONDITION_FACTORIES = {"threading.Condition"}
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function or method: where it lives and what role it claims."""
+
+    module: str
+    qualname: str            # "ClassName.method" or "func" or "outer.inner"
+    cls: str | None          # enclosing class name, if a method
+    role: str | None         # thread_role(...) argument, if annotated
+    node: ast.AST
+    line: int
+    end_line: int
+
+
+@dataclasses.dataclass
+class AttrWrite:
+    """A ``self.X = ...`` / ``self.X[...] = ...`` / aug-assign site."""
+
+    attr: str
+    line: int
+    col: int
+    func: FuncInfo | None    # None for class-body / module-level writes
+    subscript: bool
+
+
+@dataclasses.dataclass
+class LockSite:
+    """One ``with <lock>:`` acquisition."""
+
+    ident: str               # project-global lock identity (see _lock_ident)
+    line: int
+    col: int
+    func: FuncInfo | None
+    held: tuple[str, ...]    # identities already held when this acquires
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    path: str
+    name: str                # dotted module name
+    tree: ast.Module
+    source: str
+    suppressions: dict[int, set[str]]
+    imports: _Imports
+    funcs: list[FuncInfo]
+    attr_writes: list[AttrWrite]
+    lock_sites: list[LockSite]
+    #: calls made while >= 1 lock held: (held identities, call node, func)
+    calls_under_lock: list[tuple[tuple[str, ...], ast.Call, FuncInfo | None]]
+    #: Condition.wait() call sites: (call node, enclosing func, loop info)
+    cond_waits: list[tuple[ast.Call, FuncInfo | None, "WaitContext"]]
+    #: GIL-released native entry calls: (entry name, call node, func)
+    native_calls: list[tuple[str, ast.Call, FuncInfo | None]]
+    #: module-global write sites inside functions: (name, node, func,
+    #: lock-held flag)
+    global_writes: list[tuple[str, ast.AST, FuncInfo | None, bool]]
+    #: names assigned a Condition() anywhere in the module (terminal
+    #: attr/name text, e.g. "_cond", "cv")
+    condition_names: set[str]
+    #: names assigned any threading lock factory
+    lock_names: set[str]
+    uses_thread_role: bool
+    #: method qualname -> lock identities that method acquires at its
+    #: top level (for the one-level call-graph edges in GL042)
+    acquires_by_func: dict[str, set[str]]
+
+
+@dataclasses.dataclass
+class WaitContext:
+    """How a Condition.wait() call sits relative to enclosing loops."""
+
+    in_loop: bool            # any enclosing While/For
+    loop_is_while_true: bool  # nearest enclosing loop is ``while True``
+    has_timeout: bool        # wait(...) was given a timeout argument
+
+
+def module_name_for(path: str) -> str:
+    """Dotted module name from a file path, rooted at the last
+    ``analyzer_tpu`` path component (so absolute and relative paths
+    agree); bare basename for files outside the package."""
+    parts = path.replace("\\", "/").split("/")
+    base = parts[-1][:-3] if parts[-1].endswith(".py") else parts[-1]
+    if "analyzer_tpu" in parts[:-1]:
+        i = len(parts) - 2 - parts[:-1][::-1].index("analyzer_tpu")
+        pkg = parts[i:-1]
+        return ".".join([*pkg, base])
+    return base
+
+
+def _role_of(node: ast.AST, imports: _Imports) -> str | None:
+    """thread_role("...") argument from a def's decorator list, resolved
+    through the import table (any alias of lint.ownership.thread_role,
+    or a bare ``thread_role`` name)."""
+    for deco in getattr(node, "decorator_list", ()):
+        if not (isinstance(deco, ast.Call) and deco.args):
+            continue
+        resolved = imports.resolve(deco.func)
+        if resolved is None or not resolved.endswith("thread_role"):
+            continue
+        arg = deco.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+    return None
+
+
+class _ModuleWalker(ast.NodeVisitor):
+    """Single recursive pass collecting every fact ModuleInfo holds."""
+
+    def __init__(self, info: ModuleInfo, native_entries: frozenset[str]):
+        self.info = info
+        self.native_entries = native_entries
+        self._class_stack: list[str] = []
+        self._func_stack: list[FuncInfo] = []
+        self._held: list[str] = []      # lock identities currently held
+        self._loop_stack: list[ast.AST] = []
+
+    # -- identity helpers ------------------------------------------------
+
+    def _terminal(self, node: ast.AST) -> str | None:
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        if isinstance(node, ast.Name):
+            return node.id
+        return None
+
+    def _lock_ident(self, node: ast.AST) -> str | None:
+        """Project-global identity for a lock expression, or None if the
+        expression is not lock-shaped.
+
+        ``self._lock`` in class C of module M -> ``M.C._lock`` so every
+        method of one class agrees; a parameter annotated with a class
+        name (``staging: "ViewPublisher"``) resolves to that class's
+        identity, which is how cross-instance handoffs like
+        ``cutover_from`` get a comparable name. Module-level names ->
+        ``M.name``. Call expressions (``with tracer.span(...)``) are
+        never locks.
+        """
+        if isinstance(node, ast.Call):
+            return None
+        term = self._terminal(node)
+        if term is None:
+            return None
+        known = (
+            term in self.info.lock_names
+            or term in self.info.condition_names
+            or any(t in term.lower() for t in _LOCKY[:2])
+            or term.lower().endswith("cond")
+            or term == "cv"
+        )
+        if not known:
+            return None
+        mod = self.info.name
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and self._class_stack:
+                    return f"{mod}.{self._class_stack[-1]}.{term}"
+                cls = self._param_class(base.id)
+                if cls is not None:
+                    return f"{mod}.{cls}.{term}"
+            # Unresolvable receiver: scope by the enclosing class so
+            # same-class chains still collide, different ones don't.
+            scope = self._class_stack[-1] if self._class_stack else "<module>"
+            return f"{mod}.{scope}.<expr>.{term}"
+        return f"{mod}.{term}"
+
+    def _param_class(self, name: str) -> str | None:
+        """Class a parameter is annotated with, when the annotation is a
+        plain or string-literal class name (``staging: "ViewPublisher"``)."""
+        for fi in reversed(self._func_stack):
+            args = getattr(fi.node, "args", None)
+            if args is None:
+                continue
+            for a in [*args.posonlyargs, *args.args, *args.kwonlyargs]:
+                if a.arg != name or a.annotation is None:
+                    continue
+                ann = a.annotation
+                if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                    return ann.value.split(".")[-1].strip("'\" ")
+                if isinstance(ann, ast.Name):
+                    return ann.id
+        return None
+
+    # -- structure -------------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_func(self, node) -> None:
+        cls = self._class_stack[-1] if self._class_stack else None
+        outer = ".".join(f.qualname.split(".")[-1] for f in self._func_stack)
+        parts = [p for p in (cls, outer, node.name) if p]
+        fi = FuncInfo(
+            module=self.info.name,
+            qualname=".".join(parts),
+            cls=cls,
+            role=_role_of(node, self.info.imports),
+            node=node,
+            line=node.lineno,
+            end_line=getattr(node, "end_lineno", node.lineno) or node.lineno,
+        )
+        self.info.funcs.append(fi)
+        self._func_stack.append(fi)
+        # Lock state does not leak across a def boundary: the nested
+        # function runs later, on whatever thread calls it.
+        saved_held, self._held = self._held, []
+        saved_loops, self._loop_stack = self._loop_stack, []
+        self.generic_visit(node)
+        self._held = saved_held
+        self._loop_stack = saved_loops
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # -- locks -----------------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired: list[str] = []
+        func = self._func_stack[-1] if self._func_stack else None
+        for item in node.items:
+            ident = self._lock_ident(item.context_expr)
+            if ident is None:
+                continue
+            self.info.lock_sites.append(LockSite(
+                ident=ident,
+                line=item.context_expr.lineno,
+                col=item.context_expr.col_offset,
+                func=func,
+                held=tuple(self._held + acquired),
+            ))
+            if func is not None:
+                self.info.acquires_by_func.setdefault(
+                    func.qualname, set()
+                ).add(ident)
+            acquired.append(ident)
+        self._held.extend(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        del self._held[len(self._held) - len(acquired):]
+
+    # -- loops (for GL044 wait-in-predicate-loop) ------------------------
+
+    def _visit_loop(self, node) -> None:
+        self._loop_stack.append(node)
+        self.generic_visit(node)
+        self._loop_stack.pop()
+
+    visit_While = _visit_loop
+    visit_For = _visit_loop
+
+    # -- writes ----------------------------------------------------------
+
+    def _record_target(self, tgt: ast.AST, subscript: bool) -> None:
+        func = self._func_stack[-1] if self._func_stack else None
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._record_target(el, subscript)
+            return
+        if isinstance(tgt, ast.Subscript):
+            self._record_target(tgt.value, True)
+            return
+        if isinstance(tgt, ast.Attribute):
+            if isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+                self.info.attr_writes.append(AttrWrite(
+                    attr=tgt.attr, line=tgt.lineno, col=tgt.col_offset,
+                    func=func, subscript=subscript,
+                ))
+            return
+        if isinstance(tgt, ast.Name) and func is not None:
+            # A store to a module-level name from inside a function is a
+            # global write only when the name IS module-global here:
+            # either declared ``global`` in this function, or (for
+            # subscript stores, which don't rebind) defined at module
+            # top level and not shadowed by a local/param.
+            name = tgt.id
+            if name in self._declared_global():
+                self.info.global_writes.append(
+                    (name, tgt, func, bool(self._held))
+                )
+            elif subscript and self._is_module_level(name, func):
+                self.info.global_writes.append(
+                    (name, tgt, func, bool(self._held))
+                )
+
+    def _declared_global(self) -> set[str]:
+        out: set[str] = set()
+        for fi in self._func_stack:
+            for stmt in ast.walk(fi.node):
+                if isinstance(stmt, ast.Global):
+                    out.update(stmt.names)
+        return out
+
+    def _is_module_level(self, name: str, func: FuncInfo) -> bool:
+        for fi in self._func_stack:
+            args = getattr(fi.node, "args", None)
+            if args is None:
+                continue
+            params = {
+                a.arg for a in
+                [*args.posonlyargs, *args.args, *args.kwonlyargs]
+            }
+            if args.vararg:
+                params.add(args.vararg.arg)
+            if args.kwarg:
+                params.add(args.kwarg.arg)
+            if name in params:
+                return False
+            for stmt in ast.walk(fi.node):
+                if isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name) and t.id == name:
+                            return False
+                elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                    t = stmt.target
+                    if isinstance(t, ast.Name) and t.id == name:
+                        return False
+                elif isinstance(stmt, (ast.For, ast.comprehension)):
+                    t = stmt.target
+                    if isinstance(t, ast.Name) and t.id == name:
+                        return False
+        return name in _module_level_names(self.info.tree)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            self._record_target(tgt, False)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_target(node.target, False)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_target(node.target, False)
+        self.generic_visit(node)
+
+    # -- calls -----------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = self._func_stack[-1] if self._func_stack else None
+        term = self._terminal(node.func)
+        if self._held:
+            self.info.calls_under_lock.append(
+                (tuple(self._held), node, func)
+            )
+        if term == "wait" and isinstance(node.func, ast.Attribute):
+            recv = self._terminal(node.func.value)
+            if recv is not None and (
+                recv in self.info.condition_names
+                or recv.lower().endswith("cond")
+                or recv == "cv"
+            ):
+                nearest = self._loop_stack[-1] if self._loop_stack else None
+                is_while_true = (
+                    isinstance(nearest, ast.While)
+                    and isinstance(nearest.test, ast.Constant)
+                    and bool(nearest.test.value)
+                )
+                self.info.cond_waits.append((node, func, WaitContext(
+                    in_loop=nearest is not None,
+                    loop_is_while_true=is_while_true,
+                    has_timeout=bool(node.args or node.keywords),
+                )))
+        if term in self.native_entries:
+            self.info.native_calls.append((term, node, func))
+        self.generic_visit(node)
+
+
+def _module_level_names(tree: ast.Module) -> set[str]:
+    out: set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            if isinstance(stmt.target, ast.Name):
+                out.add(stmt.target.id)
+    return out
+
+
+def _collect_factory_names(tree: ast.Module, imports: _Imports,
+                           factories: set[str]) -> set[str]:
+    """Terminal names (attr or plain) assigned ``threading.X()`` for X in
+    ``factories``, anywhere in the module."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        if imports.resolve(value.func) not in factories:
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for t in targets:
+            if isinstance(t, ast.Attribute):
+                out.add(t.attr)
+            elif isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
+
+
+def build_module(path: str, source: str, tree: ast.Module,
+                 native_entries: frozenset[str]) -> ModuleInfo:
+    imports = _Imports(tree)
+    info = ModuleInfo(
+        path=path,
+        name=module_name_for(path),
+        tree=tree,
+        source=source,
+        suppressions=suppressed_rules(source),
+        imports=imports,
+        funcs=[],
+        attr_writes=[],
+        lock_sites=[],
+        calls_under_lock=[],
+        cond_waits=[],
+        native_calls=[],
+        global_writes=[],
+        condition_names=_collect_factory_names(
+            tree, imports, _CONDITION_FACTORIES
+        ),
+        lock_names=_collect_factory_names(tree, imports, _LOCK_FACTORIES),
+        uses_thread_role=False,
+        acquires_by_func={},
+    )
+    _ModuleWalker(info, native_entries).visit(tree)
+    info.uses_thread_role = any(f.role is not None for f in info.funcs)
+    return info
+
+
+class ProjectModel:
+    """The cross-module fact base GL040-GL045 run against."""
+
+    def __init__(self, native_entries: frozenset[str] | None = None):
+        if native_entries is None:
+            from analyzer_tpu.lint.ownership import GIL_RELEASED_ENTRIES
+            native_entries = GIL_RELEASED_ENTRIES
+        self.native_entries = native_entries
+        self.modules: dict[str, ModuleInfo] = {}
+
+    def add(self, path: str, source: str, tree: ast.Module) -> ModuleInfo:
+        info = build_module(path, source, tree, self.native_entries)
+        self.modules[info.name] = info
+        return info
+
+    @classmethod
+    def from_sources(
+        cls, sources: dict[str, str],
+        native_entries: frozenset[str] | None = None,
+    ) -> "ProjectModel":
+        """Builds a model from {path: source}; raises SyntaxError on bad
+        input like ``lint_source`` does."""
+        model = cls(native_entries)
+        for path, source in sources.items():
+            model.add(path, source, ast.parse(source, filename=path))
+        return model

@@ -1,18 +1,24 @@
-"""File walking and per-file orchestration for graftlint."""
+"""File walking and orchestration for graftlint.
+
+Every file is parsed exactly ONCE: the parse feeds the ProjectModel,
+and the per-file rule families (jax hazards, shell rules, ABI
+cross-check) run from the model's stored trees. Project mode then runs
+the cross-module thread rules (GL040-GL045) over the same model — no
+second pass over the sources.
+"""
 
 from __future__ import annotations
 
 import ast
 import os
+import time
 
 from analyzer_tpu.lint.abi import cross_check
-from analyzer_tpu.lint.findings import (
-    Finding,
-    apply_suppressions,
-    suppressed_rules,
-)
+from analyzer_tpu.lint.findings import Finding
 from analyzer_tpu.lint.jaxrules import JaxHazards
+from analyzer_tpu.lint.project import ModuleInfo, ProjectModel
 from analyzer_tpu.lint.shellrules import ShellRules
+from analyzer_tpu.lint.threadrules import check_project
 
 _SKIP_DIRS = {"__pycache__", ".git", ".hypothesis", ".pytest_cache"}
 
@@ -32,23 +38,75 @@ def iter_py_files(paths: list[str]) -> list[str]:
     return out
 
 
-def lint_source(source: str, path: str = "<string>") -> list[Finding]:
-    """Lints one python source string. Raises SyntaxError on bad input —
-    callers decide whether that is a finding (CLI) or a crash (tests)."""
-    tree = ast.parse(source, filename=path)
-    findings = JaxHazards(path, tree).run()
-    findings += ShellRules(path, tree).run()
-    findings += cross_check(path, tree)
-    findings = apply_suppressions(findings, suppressed_rules(source))
+def _per_file_findings(
+    info: ModuleInfo, timings: dict[str, float] | None = None,
+) -> list[Finding]:
+    t0 = time.perf_counter()
+    findings = JaxHazards(info.path, info.tree).run()
+    t1 = time.perf_counter()
+    findings += ShellRules(info.path, info.tree).run()
+    t2 = time.perf_counter()
+    findings += cross_check(info.path, info.tree)
+    t3 = time.perf_counter()
+    if timings is not None:
+        timings["jax"] = timings.get("jax", 0.0) + (t1 - t0)
+        timings["shell"] = timings.get("shell", 0.0) + (t2 - t1)
+        timings["abi"] = timings.get("abi", 0.0) + (t3 - t2)
+    return findings
+
+
+def _finish(
+    model: ProjectModel,
+    per_file: list[Finding],
+    project: bool,
+    timings: dict[str, float] | None,
+) -> list[Finding]:
+    findings = per_file
+    if project:
+        findings = findings + check_project(model, timings)
+    by_path = {info.path: info.suppressions for info in model.modules.values()}
+    findings = [
+        f for f in findings
+        if f.rule not in by_path.get(f.path, {}).get(f.line, ())
+    ]
     return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
 
 
-def lint_paths(paths: list[str]) -> tuple[list[Finding], list[str]]:
+def lint_source(source: str, path: str = "<string>") -> list[Finding]:
+    """Lints one python source string (per-file families AND the thread
+    rules over a single-module model — a partial model can only miss
+    cross-module facts, never invent them). Raises SyntaxError on bad
+    input — callers decide whether that is a finding (CLI) or a crash
+    (tests)."""
+    model = ProjectModel()
+    info = model.add(path, source, ast.parse(source, filename=path))
+    return _finish(model, _per_file_findings(info), True, None)
+
+
+def lint_project_sources(sources: dict[str, str]) -> list[Finding]:
+    """Cross-module entry for tests: lints {path: source} as one
+    project (thread rules see every module at once)."""
+    model = ProjectModel.from_sources(sources)
+    per_file: list[Finding] = []
+    for info in model.modules.values():
+        per_file += _per_file_findings(info)
+    return _finish(model, per_file, True, None)
+
+
+def lint_paths(
+    paths: list[str],
+    project: bool = True,
+    timings: dict[str, float] | None = None,
+) -> tuple[list[Finding], list[str]]:
     """Lints every ``.py`` under ``paths``. Returns (findings, errors) —
     errors are unreadable/unparseable files, reported separately so a
-    syntax error can't masquerade as a clean run."""
-    findings: list[Finding] = []
+    syntax error can't masquerade as a clean run. ``project=False``
+    skips the cross-module thread rules (GL040-GL045); ``timings``
+    (if given) collects per-stage wall seconds."""
+    model = ProjectModel()
+    per_file: list[Finding] = []
     errors: list[str] = []
+    t_parse = 0.0
     for path in iter_py_files(paths):
         try:
             with open(path, encoding="utf-8") as f:
@@ -57,7 +115,14 @@ def lint_paths(paths: list[str]) -> tuple[list[Finding], list[str]]:
             errors.append(f"{path}: unreadable: {e}")
             continue
         try:
-            findings.extend(lint_source(source, path))
+            t0 = time.perf_counter()
+            tree = ast.parse(source, filename=path)
+            info = model.add(path, source, tree)
+            t_parse += time.perf_counter() - t0
         except SyntaxError as e:
             errors.append(f"{path}: syntax error: {e}")
-    return findings, errors
+            continue
+        per_file.extend(_per_file_findings(info, timings))
+    if timings is not None:
+        timings["parse"] = t_parse
+    return _finish(model, per_file, project, timings), errors

@@ -1,0 +1,366 @@
+"""Thread-ownership rules GL040-GL045, run against the ProjectModel.
+
+These are the cross-module rules per-file AST cannot express: attribute
+ownership (who writes what from which thread), buffer lifetime across
+GIL-released native calls, lock-order cycles over the whole tree,
+callbacks fired under locks, Condition.wait predicate loops, and
+module-global writes from threaded modules.
+
+Conservatism contract matches the per-file families: false negatives
+are acceptable, false positives break the clean-tree test and must be
+fixed in the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import time
+
+from analyzer_tpu.lint.findings import Finding
+from analyzer_tpu.lint.ownership import OWNED_ATTRS
+from analyzer_tpu.lint.project import FuncInfo, ModuleInfo, ProjectModel
+
+#: Callback-shaped terminal callee names for GL043. ``notify_progress``
+#: and Condition.notify* are excluded on purpose: notifying under the
+#: lock is the documented Condition idiom.
+_HOOK_SUFFIXES = ("_hook", "_callback")
+
+
+def _callbacky(name: str) -> bool:
+    if name.startswith("on_") and len(name) > 3:
+        return True
+    if name.endswith(_HOOK_SUFFIXES):
+        return True
+    return name == "callback"
+
+
+def _owner_of(cls_path: str, attr: str) -> str | None:
+    roles = OWNED_ATTRS.get(cls_path, {})
+    for role, attrs in roles.items():
+        if attr in attrs:
+            return role
+    return None
+
+
+# ---------------------------------------------------------------- GL040
+
+
+def _check_gl040(model: ProjectModel) -> list[Finding]:
+    out: list[Finding] = []
+    for mod in model.modules.values():
+        for w in mod.attr_writes:
+            if w.func is None or w.func.cls is None:
+                continue
+            cls_path = f"{mod.name}.{w.func.cls}"
+            owner = _owner_of(cls_path, w.attr)
+            if owner is None:
+                continue
+            method = w.func.qualname.split(".")[-1]
+            if method == "__init__":
+                continue  # constructor runs before any thread is spawned
+            if w.func.role == owner:
+                continue
+            claimed = (
+                f"role {w.func.role!r}" if w.func.role else "no thread_role"
+            )
+            out.append(Finding(
+                "GL040", mod.path, w.line, w.col,
+                f"self.{w.attr} is owned by the {owner} thread "
+                f"(OWNED_ATTRS[{cls_path!r}]) but {w.func.qualname} "
+                f"claims {claimed}; annotate the method with "
+                f"@thread_role({owner!r}) or move the write",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------- GL041
+
+
+def _check_gl041(model: ProjectModel) -> list[Finding]:
+    out: list[Finding] = []
+    for mod in model.modules.values():
+        reassigned = _self_attrs_reassigned_outside_init(mod)
+        for entry, call, func in mod.native_calls:
+            for arg in call.args:
+                # (a) self.X passed by pointer where some OTHER method
+                # of the class plainly rebinds self.X — the binding can
+                # change (freeing the old buffer) while the GIL-released
+                # loop still writes through the stale pointer.
+                if (
+                    isinstance(arg, ast.Attribute)
+                    and isinstance(arg.value, ast.Name)
+                    and arg.value.id == "self"
+                    and func is not None and func.cls is not None
+                    and arg.attr in reassigned.get(func.cls, set())
+                ):
+                    out.append(Finding(
+                        "GL041", mod.path, call.lineno, call.col_offset,
+                        f"self.{arg.attr} is passed into GIL-released "
+                        f"native entry {entry}() but is reassigned "
+                        f"outside __init__ elsewhere in {func.cls}; the "
+                        f"old buffer can be freed while the native loop "
+                        f"still writes through it — make the binding "
+                        f"immutable after __init__ or copy before the "
+                        f"call",
+                    ))
+        out.extend(_gl041_stale_pointers(mod))
+    return out
+
+
+def _self_attrs_reassigned_outside_init(
+    mod: ModuleInfo,
+) -> dict[str, set[str]]:
+    """class name -> self attrs rebound (plain Assign, not subscript)
+    outside __init__."""
+    out: dict[str, set[str]] = {}
+    for w in mod.attr_writes:
+        if w.subscript or w.func is None or w.func.cls is None:
+            continue
+        if w.func.qualname.split(".")[-1] == "__init__":
+            continue
+        out.setdefault(w.func.cls, set()).add(w.attr)
+    return out
+
+
+def _gl041_stale_pointers(mod: ModuleInfo) -> list[Finding]:
+    """Local flavor: ``p = x.ctypes.data_as(...)`` (or ``.ctypes.data``)
+    followed by a rebind or ``del`` of ``x`` before a later call using
+    ``p`` — the pointer outlives the array that backs it. Linear
+    statement-order scan per function body."""
+    out: list[Finding] = []
+    for fi in mod.funcs:
+        body = getattr(fi.node, "body", None)
+        if not body:
+            continue
+        ptr_of: dict[str, str] = {}      # pointer var -> source array var
+        dead: set[str] = set()           # array vars rebound/deleted
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    for arg in node.args:
+                        if (
+                            isinstance(arg, ast.Name)
+                            and arg.id in ptr_of
+                            and ptr_of[arg.id] in dead
+                        ):
+                            out.append(Finding(
+                                "GL041", mod.path, node.lineno,
+                                node.col_offset,
+                                f"pointer {arg.id} was taken from "
+                                f"{ptr_of[arg.id]}.ctypes but "
+                                f"{ptr_of[arg.id]} was rebound or "
+                                f"deleted before this call; the buffer "
+                                f"behind the pointer may already be "
+                                f"freed",
+                            ))
+            if isinstance(stmt, ast.Assign):
+                src = _ctypes_pointer_source(stmt.value)
+                for t in stmt.targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    if src is not None:
+                        ptr_of[t.id] = src
+                        continue
+                    if t.id in ptr_of:
+                        del ptr_of[t.id]
+                    if t.id in {a for a in ptr_of.values()}:
+                        dead.add(t.id)
+            elif isinstance(stmt, ast.Delete):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        dead.add(t.id)
+    return out
+
+
+def _ctypes_pointer_source(value: ast.AST) -> str | None:
+    """Array name behind ``x.ctypes.data_as(...)`` / ``x.ctypes.data``."""
+    node = value
+    if isinstance(node, ast.Call):
+        node = node.func
+    # walk: data_as -> ctypes -> x  /  data -> ctypes -> x
+    if isinstance(node, ast.Attribute) and node.attr in ("data_as", "data"):
+        inner = node.value
+        if isinstance(inner, ast.Attribute) and inner.attr == "ctypes":
+            if isinstance(inner.value, ast.Name):
+                return inner.value.id
+    return None
+
+
+# ---------------------------------------------------------------- GL042
+
+
+def _check_gl042(model: ProjectModel) -> list[Finding]:
+    # Edge set: (from lock, to lock) -> first (path, line, col) seen.
+    edges: dict[tuple[str, str], tuple[str, int, int]] = {}
+    for mod in model.modules.values():
+        for site in mod.lock_sites:
+            for held in site.held:
+                if held != site.ident:
+                    edges.setdefault(
+                        (held, site.ident), (mod.path, site.line, site.col)
+                    )
+        # One-level call graph: while holding L, calling a same-class
+        # method (self.m()) or an imports-resolved module function that
+        # acquires M at its top level adds L -> M.
+        for held_stack, call, func in mod.calls_under_lock:
+            for target in _resolved_acquisitions(model, mod, call, func):
+                for held in held_stack:
+                    if held != target:
+                        edges.setdefault(
+                            (held, target),
+                            (mod.path, call.lineno, call.col_offset),
+                        )
+    # Cycle detection over the edge graph; report each edge that sits on
+    # a cycle, at the site that created it.
+    adj: dict[str, set[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+    out: list[Finding] = []
+    seen: set[tuple[str, int]] = set()
+    for (a, b), (path, line, col) in sorted(edges.items()):
+        if _reaches(adj, b, a):
+            if (path, line) in seen:
+                continue
+            seen.add((path, line))
+            out.append(Finding(
+                "GL042", path, line, col,
+                f"lock-order cycle: {a} is held while acquiring {b}, "
+                f"but elsewhere {b} is held while (transitively) "
+                f"acquiring {a}; two threads taking the locks in "
+                f"opposite orders deadlock — pick one global order",
+            ))
+    return out
+
+
+def _resolved_acquisitions(
+    model: ProjectModel, mod: ModuleInfo, call: ast.Call,
+    func: FuncInfo | None,
+) -> set[str]:
+    callee = call.func
+    # self.method() -> same class, same module.
+    if (
+        isinstance(callee, ast.Attribute)
+        and isinstance(callee.value, ast.Name)
+        and callee.value.id == "self"
+        and func is not None and func.cls is not None
+    ):
+        return set(
+            mod.acquires_by_func.get(f"{func.cls}.{callee.attr}", ())
+        )
+    # module.func() via the import table -> that module's top level.
+    resolved = mod.imports.resolve(callee)
+    if resolved and "." in resolved:
+        target_mod, target_fn = resolved.rsplit(".", 1)
+        other = model.modules.get(target_mod)
+        if other is not None:
+            return set(other.acquires_by_func.get(target_fn, ()))
+    return set()
+
+
+def _reaches(adj: dict[str, set[str]], src: str, dst: str) -> bool:
+    stack, seen = [src], {src}
+    while stack:
+        cur = stack.pop()
+        if cur == dst:
+            return True
+        for nxt in adj.get(cur, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append(nxt)
+    return False
+
+
+# ---------------------------------------------------------------- GL043
+
+
+def _check_gl043(model: ProjectModel) -> list[Finding]:
+    out: list[Finding] = []
+    for mod in model.modules.values():
+        for held, call, _func in mod.calls_under_lock:
+            callee = call.func
+            name = (
+                callee.attr if isinstance(callee, ast.Attribute)
+                else callee.id if isinstance(callee, ast.Name) else None
+            )
+            if name is None or not _callbacky(name):
+                continue
+            out.append(Finding(
+                "GL043", mod.path, call.lineno, call.col_offset,
+                f"user callback {name}() invoked while holding "
+                f"{', '.join(held)}; a callback that blocks or "
+                f"re-enters the lock deadlocks the owner — snapshot "
+                f"under the lock, invoke after releasing it",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------- GL044
+
+
+def _check_gl044(model: ProjectModel) -> list[Finding]:
+    out: list[Finding] = []
+    for mod in model.modules.values():
+        for call, _func, ctx in mod.cond_waits:
+            if ctx.in_loop and not ctx.loop_is_while_true:
+                continue  # predicate loop: while <pred>: cv.wait(...)
+            if ctx.in_loop and ctx.loop_is_while_true and ctx.has_timeout:
+                continue  # timed poll inside an explicit forever-loop
+            shape = (
+                "inside `while True:` without a timeout"
+                if ctx.in_loop else "outside any loop"
+            )
+            out.append(Finding(
+                "GL044", mod.path, call.lineno, call.col_offset,
+                f"Condition.wait() {shape}; spurious wakeups and "
+                f"stolen notifications are legal, so wait must sit in "
+                f"`while <predicate>: cond.wait()` (or carry a timeout "
+                f"inside an explicit poll loop)",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------- GL045
+
+
+def _check_gl045(model: ProjectModel) -> list[Finding]:
+    out: list[Finding] = []
+    for mod in model.modules.values():
+        if not mod.uses_thread_role:
+            continue
+        for name, node, func, lock_held in mod.global_writes:
+            if lock_held:
+                continue
+            out.append(Finding(
+                "GL045", mod.path, node.lineno, node.col_offset,
+                f"module-global {name!r} written from "
+                f"{func.qualname if func else '<module>'} without a "
+                f"lock, in a thread-role-annotated module; any thread "
+                f"may call in — guard the write with a module lock "
+                f"(see sched.feed.get_arena) or move the state onto an "
+                f"instance",
+            ))
+    return out
+
+
+_CHECKS = [
+    ("GL040", _check_gl040),
+    ("GL041", _check_gl041),
+    ("GL042", _check_gl042),
+    ("GL043", _check_gl043),
+    ("GL044", _check_gl044),
+    ("GL045", _check_gl045),
+]
+
+
+def check_project(
+    model: ProjectModel, timings: dict[str, float] | None = None,
+) -> list[Finding]:
+    """Runs every thread rule over the model. ``timings`` (if given)
+    collects per-rule wall seconds for the CLI's --json output."""
+    out: list[Finding] = []
+    for rule_id, check in _CHECKS:
+        t0 = time.perf_counter()
+        out.extend(check(model))
+        if timings is not None:
+            timings[rule_id] = time.perf_counter() - t0
+    return out

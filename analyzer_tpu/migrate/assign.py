@@ -304,6 +304,10 @@ class NativeIncrementalAssigner:
         ratable = np.asarray(
             (mode_id[lo:hi] >= 0) & ~afk[lo:hi], dtype=np.uint8
         )
+        # ``close()`` does rebind self._handle, but never concurrently
+        # with a feed: the engine joins the front thread before its
+        # finally-block close, and __del__ implies no live references.
+        # graftlint: disable=GL041 — close() is ordered after the join
         _native_mod.assign_ff_feed(
             self._handle, idx, ratable, lo, hi,
             self.out_batch, self.out_slot, self.progress,

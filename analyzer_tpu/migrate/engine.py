@@ -58,6 +58,7 @@ import numpy as np
 
 from analyzer_tpu.core.state import MAX_TEAM_SIZE
 from analyzer_tpu.io.ingest import ColumnarDecoder, DEFAULT_WINDOW_ROWS
+from analyzer_tpu.lint.ownership import thread_role
 from analyzer_tpu.migrate.assign import (
     IncrementalAssigner,
     assign_native_available,
@@ -442,6 +443,7 @@ def rate_backfill(
         reg.counter("migrate.assign_matches_total").add(hi - lo)
         prog.note_assigned(assigner.n_assigned)
 
+    @thread_role("producer")
     def front():
         """The front-half thread: decode window -> append -> assign,
         repeating until the stream is exhausted (or the run stopped).
@@ -563,6 +565,7 @@ def rate_backfill(
             advanced = True
         return advanced
 
+    @thread_role("consumer")
     def produce(put) -> None:
         nonlocal emitted
         while True:

@@ -32,14 +32,30 @@ def sanitize_spec(env=None) -> tuple[str, list[str]]:
     libasan.so)``); without it the CDLL load fails and callers fall back
     to pure python like any other bad build. tests/test_native_sanitize.py
     runs the whole dance in a subprocess.
+
+    ``ANALYZER_TPU_SANITIZE=thread`` builds under TSan for the concurrent
+    hammer (``tests/sanitize_driver.py``). Thread may NOT be combined
+    with address/leak: both runtimes interpose malloc with incompatible
+    shadow-memory layouts, so a mixed build fails at load time with an
+    opaque linker error — rejecting it here surfaces as the same
+    ImportError the pure-python fallback contract expects, with a
+    message that says why.
     """
     env = os.environ if env is None else env
-    san = ",".join(
+    parts = [
         s.strip() for s in env.get("ANALYZER_TPU_SANITIZE", "").split(",")
         if s.strip()
-    )
+    ]
+    san = ",".join(parts)
     if not san:
         return "", []
+    if "thread" in parts and ({"address", "leak"} & set(parts)):
+        raise ImportError(
+            "ANALYZER_TPU_SANITIZE cannot combine 'thread' with "
+            "'address'/'leak': the TSan and ASan runtimes both interpose "
+            "malloc with incompatible shadow memory and the mixed .so "
+            "will not load — run the two drives as separate processes"
+        )
     return (
         "san-" + san.replace(",", "-"),
         [f"-fsanitize={san}", "-g", "-fno-omit-frame-pointer"],

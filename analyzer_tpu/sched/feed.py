@@ -48,6 +48,7 @@ from collections import deque
 
 import numpy as np
 
+from analyzer_tpu.lint.ownership import thread_role
 from analyzer_tpu.obs import get_registry, get_tracer
 from analyzer_tpu.obs.tracer import bind_trace, current_trace
 
@@ -132,6 +133,7 @@ class PinnedArena:
         with self._lock:
             return self._new((tuple(shape), np.dtype(dtype).str))
 
+    @thread_role("any")
     def take(self, shape, dtype) -> np.ndarray:
         """Leases a slab (freelist hit, or a counted fresh allocation).
         Contents are UNDEFINED — the decoder overwrites every used slot
@@ -146,6 +148,7 @@ class PinnedArena:
                 return buf
             return self._new(key)
 
+    @thread_role("any")
     def give(self, buf: np.ndarray) -> None:
         """Returns a leased slab to the freelist for reuse."""
         with self._lock:
@@ -155,6 +158,7 @@ class PinnedArena:
             key, _base = entry
             self._free.setdefault(key, []).append(buf)
 
+    @thread_role("any")
     def give_when_done(self, buf: np.ndarray, device_array) -> None:
         """Like :meth:`give`, but defers the freelist return until
         ``device_array``'s transfer reports ready — the safe release
@@ -211,6 +215,7 @@ class PinnedArena:
             return False
         return self._transfer[1]
 
+    @thread_role("producer")
     def commit(self, buf):
         """Issues the (async where the backend allows) H2D transfer of
         ``buf`` and returns the device array. The caller keeps ownership
@@ -220,6 +225,7 @@ class PinnedArena:
         self._commits.add(1)
         return self._transfer[0](buf)
 
+    @thread_role("any")
     def stats(self) -> dict:
         """JSON-ready arena counters (the bench artifact's ``arena``
         block): allocations, reuses, hit rate, resident bytes."""
@@ -306,6 +312,7 @@ class DeviceFeed:
         self._starved = reg.counter("feed.starved_total")
         self._backpressure = reg.counter("feed.backpressure_total")
 
+    @thread_role("producer")
     def put(self, item) -> None:
         """Commits one slab; blocks while the ring is at depth."""
         with self._cond:
@@ -319,6 +326,7 @@ class DeviceFeed:
             self._depth_gauge.set(len(self._items))
             self._cond.notify_all()
 
+    @thread_role("consumer")
     def get(self):
         """Next committed slab; ``None`` once closed and drained."""
         with self._cond:
@@ -335,6 +343,7 @@ class DeviceFeed:
                 raise self._error
             return None
 
+    @thread_role("any")
     def close(self, error: BaseException | None = None) -> None:
         """Ends the stream (idempotent). The first recorded ``error``
         wins and is raised by the consumer's ``get`` after the drain."""
@@ -374,6 +383,7 @@ class Prefetcher:
         )
         self._thread.start()
 
+    @thread_role("producer")
     def _run(self, producer) -> None:
         try:
             with bind_trace(self._trace):

@@ -60,6 +60,7 @@ on the staging and writeback paths.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import weakref
 from functools import partial
 
@@ -68,6 +69,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from analyzer_tpu.lint.ownership import thread_role
 from analyzer_tpu.obs import get_registry, get_tracer, track_jit
 from analyzer_tpu.obs.devicemem import set_host_tier_sampler
 
@@ -109,6 +111,7 @@ track_jit("tier._gather_hot", _gather_hot)
 #: samples the cold tier next to the HBM gauges).
 _MANAGERS: "weakref.WeakSet[TierManager]" = weakref.WeakSet()
 _SAMPLER_INSTALLED = False
+_SAMPLER_LOCK = threading.Lock()
 
 
 def _host_tier_bytes() -> int:
@@ -209,9 +212,13 @@ class TierManager:
         reg.gauge("tier.host_bytes").set(self.host_nbytes)
         self._tracer = get_tracer()
         _MANAGERS.add(self)
-        if not _SAMPLER_INSTALLED:
-            set_host_tier_sampler(_host_tier_bytes)
-            _SAMPLER_INSTALLED = True
+        # Managers may be constructed from any thread (tests spin them
+        # up concurrently); the install-once flag needs the lock even
+        # though a duplicate install would be harmless.
+        with _SAMPLER_LOCK:
+            if not _SAMPLER_INSTALLED:
+                set_host_tier_sampler(_host_tier_bytes)
+                _SAMPLER_INSTALLED = True
 
     # -- sizing ----------------------------------------------------------
     @property
@@ -252,6 +259,7 @@ class TierManager:
         )
 
     # -- producer half (feed thread) -------------------------------------
+    @thread_role("producer")
     def split_spans(self, player_idx: np.ndarray) -> list[tuple[int, int]]:
         """Cuts a chunk at step boundaries so each sub-window's distinct
         touched rows fit the hot capacity — the forced-miss/thrash path:
@@ -286,6 +294,7 @@ class TierManager:
             self._spills.add(len(spans) - 1)
         return spans
 
+    @thread_role("producer")
     def plan_rows(self, touched: np.ndarray, written: np.ndarray) -> TierPlan:
         """The page-table transaction for one dispatch window: ``touched``
         (unique, pad-free) must all be resident when the window runs,
@@ -390,6 +399,7 @@ class TierManager:
             written_rows=written,
         )
 
+    @thread_role("producer")
     def plan_window(self, player_idx: np.ndarray, valid: np.ndarray):
         """Reference-kernel staging of one (already budget-split)
         sub-window: plans residency for its touched rows and remaps the
@@ -406,6 +416,7 @@ class TierManager:
         hot_pidx = self._slot_lut[player_idx]
         return plan, hot_pidx
 
+    @thread_role("producer")
     def plan_fused(self, slot_rows: np.ndarray, n_live: int,
                    player_idx: np.ndarray, valid: np.ndarray):
         """Fused-kernel staging of one residency window: the fused plan
@@ -419,6 +430,7 @@ class TierManager:
         plan = self.plan_rows(touched, written)
         return plan, self._slot_lut[slot_rows]
 
+    @thread_role("producer")
     def stage_windows(self, player_idx, winner, mode_id, afk) -> TieredChunk:
         """Producer-side staging of one reference-kernel chunk: budget
         splits, per-sub-window residency plans, index remap, and the
@@ -438,6 +450,7 @@ class TierManager:
         return TieredChunk(parts)
 
     # -- consumer half (dispatch loop) ------------------------------------
+    @thread_role("consumer")
     def _drain(self) -> None:
         """Materializes every queued writeback into the cold tier. The
         queued gathers have had at least one window of device time to
@@ -448,6 +461,7 @@ class TierManager:
             host = np.asarray(dev)
             self._host_table[rows] = host[:n]
 
+    @thread_role("consumer")
     def apply(self, table, plan: TierPlan):
         """Executes one plan against the hot table, in the only order
         that is correct: drain earlier writebacks (the cold tier becomes
@@ -500,6 +514,7 @@ class TierManager:
             self._written_start[plan.written_rows] = True
         return table
 
+    @thread_role("consumer")
     def dispatch_chunk(self, state, staged: TieredChunk, cfg, collect):
         """Consumer-side dispatch of one reference-kernel tiered chunk:
         apply each sub-window's plan, scan it, concatenate the collected
@@ -519,6 +534,7 @@ class TierManager:
             ys_parts[0] if len(ys_parts) == 1 else jnp.concatenate(ys_parts)
         )
 
+    @thread_role("consumer")
     def _fetch_resident(self, table, rows: np.ndarray) -> np.ndarray:
         """Current values of resident ``rows`` off the hot table (one
         bucketed gather + D2H)."""
@@ -528,6 +544,7 @@ class TierManager:
         # graftlint: disable=GL025 — snapshot/publish boundary sync
         return np.asarray(_gather_hot(table, jnp.asarray(idx)))[: rows.size]
 
+    @thread_role("consumer")
     def full_table(self, table) -> np.ndarray:
         """The logical full ``[P+1, 16]`` table as of the last dispatched
         window: the cold tier (drained) plus the current values of every
@@ -541,6 +558,7 @@ class TierManager:
             full[resident] = self._fetch_resident(table, resident)
         return full
 
+    @thread_role("consumer")
     def full_state(self, table):
         """A PlayerState view of :meth:`full_table` (checkpoint hooks —
         same one-sync-per-snapshot cost profile as the untiered hook)."""
@@ -548,12 +566,14 @@ class TierManager:
             self._template, table=jnp.asarray(self.full_table(table))
         )
 
+    @thread_role("consumer")
     def finish(self, table):
         """Final state of a tiered run: drain, reconstruct, and return a
         PlayerState bit-identical to the untiered runner's."""
         return self.full_state(table)
 
     # -- serve-view publish ------------------------------------------------
+    @thread_role("consumer")
     def publish_view(self, publisher, table, force: bool = True):
         """Publishes the logical table through ``publisher`` from the hot
         set: rows written since the last publish come from the hot table
@@ -576,6 +596,7 @@ class TierManager:
         self._written_pub[:] = False
         return view
 
+    @thread_role("consumer")
     def maybe_publish_view(self, publisher, table):
         """Throttled :meth:`publish_view` — the chunk-boundary hook."""
         return self.publish_view(publisher, table, force=False)

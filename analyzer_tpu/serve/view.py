@@ -51,6 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from analyzer_tpu.core.state import TABLE_WIDTH
+from analyzer_tpu.lint.ownership import thread_role
 from analyzer_tpu.logging_utils import get_logger
 from analyzer_tpu.obs import get_registry
 from analyzer_tpu.obs.retrace import track_jit
@@ -201,6 +202,7 @@ class ViewPublisher:
         self._retired = False
 
     # -- read side --------------------------------------------------------
+    @thread_role("any")
     def current(self) -> RatingsView | None:
         """The latest published view (None before the first publish).
         One atomic reference read — never blocks, never tears."""
@@ -215,6 +217,7 @@ class ViewPublisher:
         return None if view is None else view.age_s
 
     # -- write side -------------------------------------------------------
+    @thread_role("any")
     def publish_rows(self, ids, rows) -> RatingsView:
         """Merges ``rows`` (``[n, 16]`` float32, packed layout) for the
         players named by ``ids`` and publishes a new version. New ids
@@ -266,6 +269,7 @@ class ViewPublisher:
                 table = jnp.array(self._staging[: alloc + 1])
             return self._swap(table, p)
 
+    @thread_role("any")
     def publish_state(self, state, ids=None) -> RatingsView:
         """Publishes a whole rating table: ``state`` is a ``PlayerState``
         (or a raw ``[P+1, 16]`` array — the last row being the padding
@@ -294,6 +298,7 @@ class ViewPublisher:
             _count_publish_bytes(self._staging.nbytes)
             return self._swap(jnp.array(self._staging), p)
 
+    @thread_role("any")
     def publish_state_patch(
         self, rows_idx, rows, n_players: int, full_table
     ) -> RatingsView:
@@ -360,6 +365,7 @@ class ViewPublisher:
             >= self.min_publish_interval_s
         )
 
+    @thread_role("any")
     def maybe_publish_state(self, state, ids=None) -> RatingsView | None:
         """Throttled :meth:`publish_state` — the sched runners call this
         at chunk boundaries, where an unthrottled publish would pay a
@@ -368,6 +374,7 @@ class ViewPublisher:
             return None
         return self.publish_state(state, ids=ids)
 
+    @thread_role("any")
     def warm_patch_buckets(self, cap_ids: int) -> int:
         """Pre-compiles the patch-scatter ladder for every id-count
         bucket up to ``cap_ids`` by re-publishing EXISTING rows
@@ -399,6 +406,7 @@ class ViewPublisher:
             self.publish_rows(page, rows)
         return len(pages)
 
+    @thread_role("any")
     def cutover_from(self, staging: "ViewPublisher") -> RatingsView:
         """THE dual-lineage cutover entry (docs/migration.md): adopts the
         ``staging`` publisher's latest view as this (live) lineage's next
@@ -586,6 +594,7 @@ class ShardedViewPublisher:
         self._retired = False  # see ViewPublisher: consumed by a cutover
 
     # -- read side --------------------------------------------------------
+    @thread_role("any")
     def current(self) -> ShardedRatingsView | None:
         """The latest published sharded view (None before the first
         publish). One atomic reference read — never blocks, never tears
@@ -609,6 +618,7 @@ class ShardedViewPublisher:
         )
 
     # -- write side -------------------------------------------------------
+    @thread_role("any")
     def publish_rows(self, ids, rows) -> ShardedRatingsView:
         """Id-merge publish (the service worker's commit boundary):
         routes each id's row to its owner shard and patches only the
@@ -664,6 +674,7 @@ class ShardedViewPublisher:
                     tables.append(self._rebuild_shard(d))
             return self._swap(tables, p)
 
+    @thread_role("any")
     def publish_state(self, state, ids=None) -> ShardedRatingsView:
         """Whole-table publish, split by interleaved ownership — the
         topology-blind bootstrap (``cli serve --shards``, checkpoint
@@ -693,12 +704,14 @@ class ShardedViewPublisher:
                 tables.append(self._rebuild_shard(d))
             return self._swap(tables, p)
 
+    @thread_role("any")
     def maybe_publish_state(self, state, ids=None) -> ShardedRatingsView | None:
         """Throttled :meth:`publish_state` (the sched-runner surface)."""
         if not self.due():
             return None
         return self.publish_state(state, ids=ids)
 
+    @thread_role("any")
     def publish_shard_patches(
         self, patches, n_players: int, full_slices
     ) -> ShardedRatingsView:
@@ -758,6 +771,7 @@ class ShardedViewPublisher:
                     tables.append(prev.shards[d].table)
             return self._swap(tables, n_players)
 
+    @thread_role("any")
     def warm_patch_buckets(self, cap_ids: int) -> int:
         """The sharded mirror of
         :meth:`ViewPublisher.warm_patch_buckets`: one publish per ladder
@@ -799,6 +813,7 @@ class ShardedViewPublisher:
             self.publish_rows(page, rows)
         return len(pages)
 
+    @thread_role("any")
     def cutover_from(self, staging: "ShardedViewPublisher") -> ShardedRatingsView:
         """The sharded mirror of :meth:`ViewPublisher.cutover_from`: all
         ``S`` per-shard tables of the staging lineage's latest view are

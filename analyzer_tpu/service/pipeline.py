@@ -81,6 +81,7 @@ import jax
 import numpy as np
 
 from analyzer_tpu.core.state import MU_LO, SIGMA_HI
+from analyzer_tpu.lint.ownership import thread_role
 from analyzer_tpu.logging_utils import get_logger
 from analyzer_tpu.obs import get_flight_recorder, get_registry, get_tracer
 from analyzer_tpu.obs.tracer import bind_trace, current_trace
@@ -276,16 +277,19 @@ class _Writer(threading.Thread):
         self._active = False
         self._stop_requested = False
 
+    @thread_role("any")
     def submit(self, job: _Job) -> None:
         with self.cv:
             self.jobs.append(job)
             self.cv.notify_all()
 
+    @thread_role("any")
     def stop(self) -> None:
         with self.cv:
             self._stop_requested = True
             self.cv.notify_all()
 
+    @thread_role("any")
     def wait_left(self, seq: int) -> bool:
         """Blocks until every job with ``seq' <= seq`` has left the
         writer (ok OR aborted). Returns False when the stream is
@@ -301,6 +305,7 @@ class _Writer(threading.Thread):
                 self.cv.wait(0.1)
             return not self.poisoned
 
+    @thread_role("any")
     def wait_idle(self) -> None:
         """Blocks until the queue is empty and nothing is mid-flight.
         Used by harvest after a failure: every queued job drains to
@@ -318,6 +323,7 @@ class _Writer(threading.Thread):
                     break
                 self.cv.wait(0.1)
 
+    @thread_role("consumer")
     def run(self) -> None:
         try:
             self.store = self._store_factory()

@@ -41,6 +41,7 @@ from analyzer_tpu.obs import (
 )
 from analyzer_tpu.obs import tracectx
 from analyzer_tpu.obs.tracer import bind_trace
+from analyzer_tpu.lint.ownership import thread_role
 from analyzer_tpu.sched import pack_schedule, rate_history
 from analyzer_tpu.service.broker import Broker, Message
 from analyzer_tpu.service.encode import EncodedBatch
@@ -454,6 +455,7 @@ class Worker:
         self.profiler.request("slo_burn")
         self._flight_dump(f"slo-{objective.name}")
 
+    @thread_role("any")
     def request_stop(self) -> None:
         """Asks the consume loop to exit after the current batch. Safe
         from a signal handler (single flag write). The reference has no
@@ -462,6 +464,7 @@ class Worker:
         batch always finishes its commit + acks first."""
         self._stop_requested = True
 
+    @thread_role("consumer")
     def run(
         self,
         max_flushes: int | None = None,
@@ -766,6 +769,7 @@ class Worker:
         self.profiler.request("dead_letter")
         self._flight_dump("dead_letter")
 
+    @thread_role("consumer")
     def try_process(self) -> None:
         """Routes the flushed batch: the sequential reference-shaped path
         (default), or the pipelined engine (``service/pipeline.py``) that
@@ -893,6 +897,7 @@ class Worker:
             self.obs_server.close()
             self.obs_server = None
 
+    @thread_role("consumer")
     def _try_process_pipelined(self, batch) -> None:
         from analyzer_tpu.service.pipeline import PipelineFallback
 
@@ -927,6 +932,7 @@ class Worker:
             engine.drain()
             self._process_batch_sequential(batch)
 
+    @thread_role("consumer")
     def _process_batch_sequential(self, batch) -> None:
         """The reference's ``try_process`` (``worker.py:103-166``), with
         POISON-PILL ISOLATION on top: a failure that names its offending
@@ -971,6 +977,7 @@ class Worker:
 
         self._ack_batch(batch)
 
+    @thread_role("consumer")
     def _ack_batch(self, batch) -> None:
         """Per-message ack + notify/crunch/sew/telesuck fan-out
         (``worker.py:122-166``). Always on the consumer thread — the
@@ -1074,6 +1081,7 @@ class Worker:
         ]
 
     # -- serving plane ----------------------------------------------------
+    @thread_role("consumer")
     def _publish_view(self, enc, table) -> None:
         """Publishes one committed batch's posterior rows into the
         serving plane's view (serve/view.py). ``enc`` supplies the
@@ -1186,6 +1194,7 @@ class Worker:
         dt = self.clock() - self._started_at
         return self.matches_rated / dt if dt > 0 else 0.0
 
+    @thread_role("any")
     def stats(self) -> dict:
         """One operator-facing snapshot of the counters the reference
         never had (SURVEY.md section 5.5: its only observability was

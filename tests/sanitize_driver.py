@@ -22,8 +22,144 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import numpy as np  # noqa: E402
 
 
+def _hammer_ff(_native, inject: str | None) -> None:
+    """Two threads drive ``assign_ff_feed`` concurrently, each on its OWN
+    handle over the same deterministic stream; both GIL-released native
+    loops overlap (a Barrier lines them up, one big feed call each).
+
+    Clean mode: every thread owns its out/progress buffers — no shared
+    mutable state, TSan must stay silent, and both results must equal a
+    single-threaded reference. ``inject="shared-out"``: the threads share
+    ONE out_batch/out_slot pair. Both write identical values (same
+    stream, same deterministic algorithm) so the answers stay right —
+    but the plain int64 stores from two concurrent GIL-released loops
+    are a genuine write-write data race TSan must report. That is the
+    fixture proving the drive can actually catch what it claims to.
+    """
+    import threading
+
+    n, slots = 200_000, 4
+    # Deterministic player stream: multiplicative hash over a 5000-row
+    # frontier (no RNG — the reference and both threads must agree).
+    flat = ((np.arange(n * slots, dtype=np.int64) * 2654435761) % 5000)
+    flat = flat.astype(np.int32).reshape(n, slots)
+    rat = np.ones(n, np.uint8)
+
+    def run_stream(out_b, out_s, prog, barrier=None):
+        h = _native.assign_ff_create(64, 0)
+        try:
+            if barrier is not None:
+                barrier.wait()
+            _native.assign_ff_feed(h, flat, rat, 0, n, out_b, out_s, prog)
+            _native.assign_ff_finish(h, prog)
+        finally:
+            _native.assign_ff_destroy(h)
+
+    ref_b = np.full(n, -9, np.int64)
+    ref_s = np.full(n, -9, np.int64)
+    run_stream(ref_b, ref_s, np.zeros(2, np.int64))
+
+    barrier = threading.Barrier(2)
+    if inject == "shared-out":
+        shared_b = np.full(n, -9, np.int64)
+        shared_s = np.full(n, -9, np.int64)
+        bufs = [(shared_b, shared_s), (shared_b, shared_s)]
+    else:
+        bufs = [
+            (np.full(n, -9, np.int64), np.full(n, -9, np.int64))
+            for _ in range(2)
+        ]
+    progs = [np.zeros(2, np.int64) for _ in range(2)]
+    threads = [
+        threading.Thread(
+            target=run_stream, args=(b, s, p, barrier),
+            name=f"hammer-ff-{i}",
+        )
+        for i, ((b, s), p) in enumerate(zip(bufs, progs))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for b, s in bufs:
+        assert (b == ref_b).all(), "hammer diverged from reference (batch)"
+        assert (s == ref_s).all(), "hammer diverged from reference (slot)"
+    for p in progs:
+        assert p[0] == n, p.tolist()
+
+
+def _hammer_arena() -> None:
+    """Arena take/give storm from two threads against a stats() reader —
+    the freelist lock plus the registry counters under contention.
+    ``commit`` is never called, so no jax import sneaks into the
+    sanitized process."""
+    import threading
+
+    from analyzer_tpu.sched.feed import PinnedArena
+
+    arena = PinnedArena("hammer")
+    shapes = [((256, 4), np.int32), ((64, 16), np.float32), ((1024,), np.uint8)]
+    stop = threading.Event()
+    errs: list[BaseException] = []
+
+    def storm():
+        try:
+            for i in range(400):
+                shape, dtype = shapes[i % len(shapes)]
+                buf = arena.take(shape, dtype)
+                buf.fill(1)
+                arena.give(buf)
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            errs.append(e)
+        finally:
+            stop.set()
+
+    def reader():
+        try:
+            while not stop.is_set():
+                arena.stats()
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=storm, name="hammer-arena-0"),
+        threading.Thread(target=storm, name="hammer-arena-1"),
+        threading.Thread(target=reader, name="hammer-arena-reader"),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errs:
+        raise errs[0]
+    assert arena.stats()["reuses"] > 0
+
+
+def thread_main() -> int:
+    """TSan drive: the concurrent hammer only. The fixture suite stays
+    in the ASan path — under TSan the interesting property is overlap,
+    not answers, and keeping the import graph lean (packer + feed, no
+    jax) keeps the TSan report surface to our own code."""
+    from analyzer_tpu.sched import _native
+
+    assert _native._lib._name.endswith(".san-thread.so"), (
+        f"loaded unsanitized library: {_native._lib._name}"
+    )
+    inject = os.environ.get("ANALYZER_TPU_HAMMER_INJECT") or None
+    _hammer_ff(_native, inject)
+    _hammer_arena()
+    print("SANITIZE_OK")
+    return 0
+
+
 def main() -> int:
     assert os.environ.get("ANALYZER_TPU_SANITIZE"), "driver needs the env set"
+    modes = {
+        s.strip()
+        for s in os.environ["ANALYZER_TPU_SANITIZE"].split(",") if s.strip()
+    }
+    if "thread" in modes:
+        return thread_main()
 
     # --- fastcsv: writer-format roundtrip through the sanitized parser.
     from analyzer_tpu.core import constants
@@ -105,20 +241,22 @@ def main() -> int:
     # flat across 64 cycles that each carry a ~16 MB frontier (n_hint
     # 2M int64) — ~1 GB of growth if destroy dropped the state. A
     # double free or use-after-destroy still aborts under ASan proper.
-    import ctypes
+    # ASan-only: other sanitizer runtimes don't export the counter.
+    if "address" in modes:
+        import ctypes
 
-    live_bytes = ctypes.CDLL(None).__sanitizer_get_current_allocated_bytes
-    live_bytes.restype = ctypes.c_size_t
-    live_bytes.argtypes = []
-    before = live_bytes()
-    for _ in range(64):
-        h = _native.assign_ff_create(4, 2_000_000)
-        _native.assign_ff_feed(h, flat, rat, 0, 3, out_b, out_s, prog)
-        _native.assign_ff_destroy(h)  # no finish — destructor frees all
-    grown = live_bytes() - before
-    assert grown < 64 * 1024 * 1024, (
-        f"destroy-without-finish leaked ~{grown} bytes over 64 cycles"
-    )
+        live_bytes = ctypes.CDLL(None).__sanitizer_get_current_allocated_bytes
+        live_bytes.restype = ctypes.c_size_t
+        live_bytes.argtypes = []
+        before = live_bytes()
+        for _ in range(64):
+            h = _native.assign_ff_create(4, 2_000_000)
+            _native.assign_ff_feed(h, flat, rat, 0, 3, out_b, out_s, prog)
+            _native.assign_ff_destroy(h)  # no finish — destructor frees all
+        grown = live_bytes() - before
+        assert grown < 64 * 1024 * 1024, (
+            f"destroy-without-finish leaked ~{grown} bytes over 64 cycles"
+        )
 
     # --- fastsql: scan (str/int/float incl. NULLs), cumcount, lookup.
     from analyzer_tpu.service import _native_sql

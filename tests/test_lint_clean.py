@@ -19,6 +19,28 @@ def test_package_tree_is_lint_clean():
     assert findings == [], "\n" + "\n".join(f.render() for f in findings)
 
 
+def test_package_tree_is_clean_in_project_mode_within_budget():
+    """Project mode (GL040-GL045 over the whole-tree model) gates tier-1
+    too, and the single-parse refactor keeps the full run cheap: the
+    wall budget fails if a rule regresses to quadratic work or a family
+    starts re-parsing the tree."""
+    import time
+
+    timings: dict[str, float] = {}
+    t0 = time.perf_counter()
+    findings, errors = lint_paths(
+        [os.path.join(_REPO, "analyzer_tpu")], project=True, timings=timings
+    )
+    wall = time.perf_counter() - t0
+    assert errors == []
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+    # Generous on purpose (CI machines vary) — the seed runs in ~5s;
+    # 30s means something is structurally wrong, not just a slow box.
+    assert wall < 30.0, f"whole-tree project lint took {wall:.1f}s"
+    for rule in ("GL040", "GL041", "GL042", "GL043", "GL044", "GL045"):
+        assert rule in timings
+
+
 def test_linter_does_not_import_jax():
     """The lint pass must stay runnable in milliseconds on machines with
     no accelerator stack: importing it (and linting a file) may not drag

@@ -507,6 +507,235 @@ CASES = [
         """,
         ["GL022"],
     ),
+    # ---------------- GL041: stale pointer across a native call --------
+    (
+        "gl041_pointer_outlives_array",
+        """
+        import numpy as np
+
+        def f(lib):
+            x = np.zeros(4, np.int64)
+            p = x.ctypes.data_as(None)
+            x = np.ones(4, np.int64)
+            lib.go(p)
+        """,
+        ["GL041"],
+    ),
+    (
+        "gl041_pointer_deleted_array",
+        """
+        import numpy as np
+
+        def f(lib):
+            x = np.zeros(4, np.int64)
+            p = x.ctypes.data
+            del x
+            lib.go(p)
+        """,
+        ["GL041"],
+    ),
+    (
+        "gl041_pointer_used_before_rebind_ok",
+        """
+        import numpy as np
+
+        def f(lib):
+            x = np.zeros(4, np.int64)
+            p = x.ctypes.data_as(None)
+            lib.go(p)
+            x = np.ones(4, np.int64)
+            return x
+        """,
+        [],
+    ),
+    # ---------------- GL042: lock-order cycle (single module) ----------
+    (
+        "gl042_opposite_nesting_orders",
+        """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def one():
+            with _a:
+                with _b:
+                    pass
+
+        def two():
+            with _b:
+                with _a:
+                    pass
+        """,
+        ["GL042", "GL042"],
+    ),
+    (
+        "gl042_consistent_order_ok",
+        """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def one():
+            with _a:
+                with _b:
+                    pass
+
+        def two():
+            with _a:
+                with _b:
+                    pass
+        """,
+        [],
+    ),
+    # ---------------- GL043: callback invoked under a lock -------------
+    (
+        "gl043_hook_under_lock",
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.on_progress = None
+
+            def go(self):
+                with self._lock:
+                    self.on_progress()
+        """,
+        ["GL043"],
+    ),
+    (
+        "gl043_hook_after_release_ok",
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.on_progress = None
+
+            def go(self):
+                with self._lock:
+                    snapshot = 1
+                self.on_progress(snapshot)
+        """,
+        [],
+    ),
+    # ---------------- GL044: Condition.wait predicate loops ------------
+    (
+        "gl044_wait_outside_loop",
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self.ready = False
+
+            def get(self):
+                with self._cond:
+                    self._cond.wait()
+                    return self.ready
+        """,
+        ["GL044"],
+    ),
+    (
+        "gl044_untimed_wait_in_while_true",
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self.ready = False
+
+            def get(self):
+                with self._cond:
+                    while True:
+                        self._cond.wait()
+        """,
+        ["GL044"],
+    ),
+    (
+        "gl044_predicate_loop_ok",
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self.ready = False
+
+            def get(self):
+                with self._cond:
+                    while not self.ready:
+                        self._cond.wait()
+                    return self.ready
+        """,
+        [],
+    ),
+    (
+        "gl044_timed_poll_in_while_true_ok",
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self.done = False
+
+            def get(self):
+                with self._cond:
+                    while True:
+                        if self.done:
+                            return
+                        self._cond.wait(0.1)
+        """,
+        [],
+    ),
+    # ---------------- GL045: unlocked module globals in role modules ---
+    (
+        "gl045_global_write_in_role_module",
+        """
+        from analyzer_tpu.lint.ownership import thread_role
+
+        _cache = {}
+
+        @thread_role("producer")
+        def produce():
+            _cache["k"] = 1
+        """,
+        ["GL045"],
+    ),
+    (
+        "gl045_locked_global_write_ok",
+        """
+        import threading
+
+        from analyzer_tpu.lint.ownership import thread_role
+
+        _lock = threading.Lock()
+        _cache = {}
+
+        @thread_role("producer")
+        def produce():
+            with _lock:
+                _cache["k"] = 1
+        """,
+        [],
+    ),
+    (
+        "gl045_no_roles_declared_ok",
+        """
+        _cache = {}
+
+        def produce():
+            _cache["k"] = 1
+        """,
+        [],
+    ),
 ]
 
 
@@ -1826,3 +2055,263 @@ class TestGL034FleetPlane:
         from analyzer_tpu.lint.findings import RULES
 
         assert "GL034" in RULES
+
+
+class TestGL040Ownership:
+    """GL040 keys off lint/ownership.py OWNED_ATTRS, which is scoped by
+    dotted class path — the same snippet fires under the real tier.py
+    path and stays silent everywhere else."""
+
+    SRC = """
+    class TierManager:
+        def __init__(self):
+            self._applied = -1
+
+        def plan_rows(self):
+            self._applied = 3
+    """
+
+    def test_unannotated_write_fires_in_owned_class(self):
+        assert rules_of(self.SRC, "analyzer_tpu/sched/tier.py") == ["GL040"]
+
+    def test_wrong_role_fires(self):
+        src = """
+        from analyzer_tpu.lint.ownership import thread_role
+
+        class TierManager:
+            def __init__(self):
+                self._applied = -1
+
+            @thread_role("producer")
+            def plan_rows(self):
+                self._applied = 3
+        """
+        assert rules_of(src, "analyzer_tpu/sched/tier.py") == ["GL040"]
+
+    def test_owning_role_ok(self):
+        src = """
+        from analyzer_tpu.lint.ownership import thread_role
+
+        class TierManager:
+            def __init__(self):
+                self._applied = -1
+
+            @thread_role("consumer")
+            def apply(self):
+                self._applied = 3
+        """
+        assert rules_of(src, "analyzer_tpu/sched/tier.py") == []
+
+    def test_init_exempt_and_other_paths_silent(self):
+        assert rules_of(self.SRC, "snippet.py") == []
+
+    def test_decorator_rejects_unknown_role(self):
+        from analyzer_tpu.lint.ownership import thread_role
+
+        with pytest.raises(ValueError):
+            thread_role("driver")
+
+    def test_decorator_is_zero_cost(self):
+        from analyzer_tpu.lint.ownership import thread_role
+
+        @thread_role("producer")
+        def f():
+            return 41
+
+        assert f() == 41 and f.__thread_role__ == "producer"
+
+
+class TestGL041BufferLifetime:
+    def test_self_attr_rebound_outside_init_fires(self):
+        src = """
+        class C:
+            def __init__(self, lib, buf):
+                self.lib = lib
+                self.buf = buf
+
+            def feed(self):
+                self.lib.assign_ff_feed(self.buf)
+
+            def close(self):
+                self.buf = None
+        """
+        assert rules_of(src) == ["GL041"]
+
+    def test_immutable_binding_ok(self):
+        src = """
+        class C:
+            def __init__(self, lib, buf):
+                self.lib = lib
+                self.buf = buf
+
+            def feed(self):
+                self.lib.assign_ff_feed(self.buf)
+        """
+        assert rules_of(src) == []
+
+    def test_non_native_entry_ok(self):
+        src = """
+        class C:
+            def __init__(self, lib, buf):
+                self.lib = lib
+                self.buf = buf
+
+            def feed(self):
+                self.lib.ordinary_call(self.buf)
+
+            def close(self):
+                self.buf = None
+        """
+        assert rules_of(src) == []
+
+
+class TestProjectCrossModule:
+    """The rules only project mode can express: facts spanning modules
+    (lint_project_sources feeds multiple files into ONE model)."""
+
+    def _rules(self, sources):
+        from analyzer_tpu.lint.runner import lint_project_sources
+
+        return [
+            (f.rule, f.path)
+            for f in lint_project_sources(
+                {k: textwrap.dedent(v) for k, v in sources.items()}
+            )
+        ]
+
+    def test_two_module_lock_cycle(self):
+        got = self._rules({
+            "mod_a.py": """
+                import threading
+
+                from mod_b import grab_b
+
+                A = threading.Lock()
+
+                def with_a_then_b():
+                    with A:
+                        grab_b()
+
+                def grab_a():
+                    with A:
+                        pass
+            """,
+            "mod_b.py": """
+                import threading
+
+                from mod_a import grab_a
+
+                B = threading.Lock()
+
+                def grab_b():
+                    with B:
+                        pass
+
+                def with_b_then_a():
+                    with B:
+                        grab_a()
+            """,
+        })
+        assert ("GL042", "mod_a.py") in got
+        assert ("GL042", "mod_b.py") in got
+
+    def test_call_through_without_cycle_ok(self):
+        got = self._rules({
+            "mod_a.py": """
+                import threading
+
+                from mod_b import grab_b
+
+                A = threading.Lock()
+
+                def with_a_then_b():
+                    with A:
+                        grab_b()
+            """,
+            "mod_b.py": """
+                import threading
+
+                B = threading.Lock()
+
+                def grab_b():
+                    with B:
+                        pass
+            """,
+        })
+        assert got == []
+
+    def test_reassigned_buffer_during_native_call(self):
+        got = self._rules({
+            "owner.py": """
+                import numpy as np
+
+                class Assigner:
+                    def __init__(self, lib):
+                        self.lib = lib
+                        self.out = np.zeros(8, np.int64)
+
+                    def feed(self, idx):
+                        self.lib.assign_ff_feed(idx, self.out)
+
+                    def reset(self):
+                        self.out = np.zeros(8, np.int64)
+            """,
+        })
+        assert got == [("GL041", "owner.py")]
+
+
+class TestLintCliProjectMode:
+    def _lint(self, *argv, cwd=_REPO):
+        return subprocess.run(
+            [sys.executable, "-m", "analyzer_tpu.lint", *argv],
+            capture_output=True, text=True, timeout=120, cwd=cwd,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+
+    _DIRTY = (
+        "import threading\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n\n"
+        "    def get(self):\n"
+        "        with self._cond:\n"
+        "            self._cond.wait()\n"
+    )
+
+    def test_no_project_skips_thread_rules(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(self._DIRTY)
+        assert self._lint(str(bad)).returncode == 1
+        proc = self._lint("--no-project", str(bad))
+        assert proc.returncode == 0, proc.stdout
+        assert "clean" in proc.stdout
+
+    def test_json_reports_per_rule_timings(self, tmp_path):
+        good = tmp_path / "good.py"
+        good.write_text("def f(x):\n    return x\n")
+        proc = self._lint("--json", str(good))
+        out = json.loads(proc.stdout)
+        for key in ("parse", "jax", "shell", "abi", "GL040", "GL045"):
+            assert key in out["timings_s"], out["timings_s"]
+
+    def test_baseline_roundtrip_and_stale_expiry(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(self._DIRTY)
+        baseline = tmp_path / "baseline.json"
+        proc = self._lint("--write-baseline", str(baseline), str(bad))
+        assert proc.returncode == 0, proc.stdout
+        entries = json.loads(baseline.read_text())["entries"]
+        assert [e["rule"] for e in entries] == ["GL044"]
+        # With the snapshot, the same dirty tree lints clean.
+        proc = self._lint("--baseline", str(baseline), str(bad))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "clean" in proc.stdout
+        # Fix the flagged line: the baseline entry must expire LOUDLY.
+        bad.write_text(self._DIRTY.replace(
+            "            self._cond.wait()\n",
+            "            while not getattr(self, 'ready', False):\n"
+            "                self._cond.wait()\n",
+        ))
+        proc = self._lint("--baseline", str(baseline), str(bad))
+        assert proc.returncode == 1
+        assert "stale baseline entry" in proc.stderr

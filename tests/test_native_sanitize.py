@@ -66,6 +66,74 @@ def test_all_native_extensions_pass_under_asan_ubsan():
         assert marker not in proc.stderr, f"sanitizer report{report}"
 
 
+def _tsan_env(**extra) -> dict | None:
+    """Env for a TSan subprocess drive, or None when the toolchain or
+    runtime is missing / the interpreter won't start under the preload
+    (the skip contract the ASan path pins)."""
+    if shutil.which("g++") is None:
+        return None
+    tsan = _runtime("libtsan.so") or _runtime("libtsan.so.2")
+    if tsan is None:
+        return None
+    env = dict(
+        os.environ,
+        ANALYZER_TPU_SANITIZE="thread",
+        LD_PRELOAD=tsan,
+        # Python's interned/startup machinery predates any of our
+        # threads; only races our hammer creates should be fatal —
+        # halt_on_error keeps a report from scrolling past as a warning.
+        TSAN_OPTIONS="halt_on_error=1:exitcode=66",
+        JAX_PLATFORMS="cpu",
+    )
+    env.update(extra)
+    probe = subprocess.run(
+        [sys.executable, "-c", "print('ok')"],
+        capture_output=True, text=True, timeout=60,
+        env=dict(env, ANALYZER_TPU_SANITIZE=""),
+    )
+    if probe.returncode != 0 or "ok" not in probe.stdout:
+        return None  # interpreter itself won't run under this runtime
+    return env
+
+
+def test_concurrent_hammer_clean_under_tsan():
+    """Two threads in ``assign_ff_feed`` on separate handles + the arena
+    storm: with per-thread buffers the drive must come out TSan-silent —
+    the dynamic proof of the same contracts GL040-GL045 check statically."""
+    env = _tsan_env()
+    if env is None:
+        pytest.skip("no g++ / TSan runtime on this machine")
+    proc = subprocess.run(
+        [sys.executable, _DRIVER],
+        capture_output=True, text=True, timeout=300, env=env, cwd=_REPO,
+    )
+    report = f"\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    assert proc.returncode == 0, f"TSan driver failed{report}"
+    assert "SANITIZE_OK" in proc.stdout, f"driver exited early{report}"
+    assert "WARNING: ThreadSanitizer" not in proc.stderr, (
+        f"TSan report{report}"
+    )
+
+
+def test_tsan_catches_injected_unsynchronized_write():
+    """The negative control: sharing ONE out-buffer pair between the two
+    GIL-released feed loops is a genuine write-write race (identical
+    values, so the answers stay right — only a race detector can see
+    it). If TSan misses this, the clean run above proves nothing."""
+    env = _tsan_env(ANALYZER_TPU_HAMMER_INJECT="shared-out")
+    if env is None:
+        pytest.skip("no g++ / TSan runtime on this machine")
+    proc = subprocess.run(
+        [sys.executable, _DRIVER],
+        capture_output=True, text=True, timeout=300, env=env, cwd=_REPO,
+    )
+    report = f"\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    assert "WARNING: ThreadSanitizer" in proc.stderr, (
+        f"TSan did not catch the injected race{report}"
+    )
+    assert proc.returncode != 0, f"race reported but exit was clean{report}"
+
+
 def test_sanitized_build_uses_distinct_so(tmp_path):
     """The tag-suffixed path keeps sanitized and normal artifacts from
     clobbering each other — checked without a compile by inspecting the
@@ -80,3 +148,11 @@ def test_sanitized_build_uses_distinct_so(tmp_path):
     # Whitespace/empty segments normalize instead of poisoning the flag.
     tag, flags = sanitize_spec({"ANALYZER_TPU_SANITIZE": " address , "})
     assert tag == "san-address" and flags[0] == "-fsanitize=address"
+    # TSan gets its own tag; mixing it with ASan/leak is rejected up
+    # front (both runtimes interpose malloc with incompatible shadow
+    # memory — the combined .so would fail at load with a linker error).
+    tag, flags = sanitize_spec({"ANALYZER_TPU_SANITIZE": "thread"})
+    assert tag == "san-thread" and flags[0] == "-fsanitize=thread"
+    for combo in ("thread,address", "address,thread", "thread,leak"):
+        with pytest.raises(ImportError):
+            sanitize_spec({"ANALYZER_TPU_SANITIZE": combo})
