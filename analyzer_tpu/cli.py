@@ -877,6 +877,10 @@ def cmd_bench(args) -> int:
         os.environ["BENCH_INGEST"] = "1"
     if getattr(args, "migrate", False):
         os.environ["BENCH_MIGRATE"] = "1"
+    if getattr(args, "profile", False):
+        os.environ["BENCH_PROFILE"] = "1"
+    if getattr(args, "profile_dir", None):
+        os.environ["BENCH_PROFILE_DIR"] = args.profile_dir
     bench.main(
         metrics_out=getattr(args, "metrics_out", None),
         obs_port=getattr(args, "obs_port", None),
@@ -1030,6 +1034,21 @@ def cmd_benchdiff(args) -> int:
                 file=sys.stderr,
             )
             return 1
+    # The vanished-block contract for profile intelligence (any family —
+    # bench --profile stamps the block wherever a capture was armed): a
+    # baseline whose device profile parsed and a candidate whose profile
+    # block is missing or reports parsed:false means the candidate
+    # silently stopped attributing its captures — its roofline rides
+    # wall time again, and a delta gate would never notice.
+    a_parsed = bool((a_raw.get("profile") or {}).get("parsed"))
+    b_parsed = bool((b_raw.get("profile") or {}).get("parsed"))
+    if a_parsed and not b_parsed:
+        print(
+            f"error: {os.path.basename(b_path)} has no parsed device "
+            f"profile but {os.path.basename(a_path)} does (capture "
+            "attribution silently broke?)", file=sys.stderr,
+        )
+        return 1
     if args.family in ("bench", "tiered"):
         # Absolute tracing-tax gate on the candidate alone: the bench's
         # trace_overhead block (tracing-on vs tracing-off on the same
@@ -1236,11 +1255,106 @@ def cmd_trace(args) -> int:
             sys.stdout.write(render_batch(report))
         return 0
     cp = critical_path(model, window=args.window or None)
+    decomp = None
+    if getattr(args, "profile", None):
+        # Join a capture dir's device trace against this host-side
+        # forest: the critical path's `dispatch` stage decomposes into
+        # device-execute / device-idle / host-overhead (obs/profview).
+        from analyzer_tpu.obs.profview import (
+            analyze_capture,
+            decompose_dispatch,
+            render_decomposition,
+        )
+
+        att = analyze_capture(args.profile, update_metrics=False)
+        decomp = decompose_dispatch(model, att)
+        if decomp is None:
+            print(
+                f"note: profile {args.profile} did not join this trace "
+                f"(parsed={str(bool(att.get('parsed'))).lower()})",
+                file=sys.stderr,
+            )
     if args.json:
+        if decomp is not None:
+            cp = dict(cp, dispatch_decomposition=decomp)
         json.dump(cp, sys.stdout, indent=1, sort_keys=True)
         sys.stdout.write("\n")
     else:
         sys.stdout.write(render_critical_path(cp))
+        if decomp is not None:
+            sys.stdout.write(render_decomposition(decomp))
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """Profile attribution (obs/profview.py): read a device-profiler
+    capture dir (obs/prof.py's ``profile-<ts>-<reason>-<pid>/``), bin
+    its Chrome-format device trace into a per-kernel device-time table,
+    and report the busy/idle and compile/execute splits. A torn or
+    missing trace reports ``parsed: false`` (exit 1) rather than
+    crashing. With ``--trace-events``, additionally joins the capture
+    against the host-side causal-trace forest and decomposes the
+    ``dispatch`` stage into device-execute / device-idle /
+    host-overhead."""
+    from analyzer_tpu.obs.profview import (
+        analyze_capture,
+        decompose_dispatch,
+        render_attribution,
+        render_decomposition,
+    )
+
+    att = analyze_capture(args.capture_dir, update_metrics=False)
+    decomp = None
+    if args.trace_events:
+        from analyzer_tpu.obs.traceview import build_model, load_forest
+
+        try:
+            model = build_model(load_forest(args.trace_events))
+        except (OSError, ValueError) as err:
+            print(f"error: {err}", file=sys.stderr)
+            return 2
+        decomp = decompose_dispatch(model, att)
+    if args.json:
+        out = dict(att)
+        if decomp is not None:
+            out["dispatch_decomposition"] = decomp
+        json.dump(out, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(render_attribution(att))
+        if decomp is not None:
+            sys.stdout.write(render_decomposition(decomp))
+    return 0 if att["parsed"] else 1
+
+
+def cmd_tune(args) -> int:
+    """Tuning advisor (obs/advisor.py): a deterministic rule table over
+    the artifacts the repo already emits (BENCH/SOAK/INGEST/MIGRATE
+    JSON, history rings, profile attribution) that names the bottleneck
+    and recommends concrete knob changes, each citing its evidence.
+    Same inputs produce a byte-identical report — pipe it into a file
+    and diff across runs. Exit 0 with findings or without; exit 2 only
+    when no artifact loads at all."""
+    from analyzer_tpu.obs.advisor import advise, gather_inputs, render_report
+
+    inputs = gather_inputs(
+        paths=args.artifacts,
+        scan_dir=args.dir if not args.artifacts else None,
+        profile_dir=args.profile,
+    )
+    if not inputs["artifacts"] and not inputs["history"] \
+            and inputs["profile"] is None:
+        print(
+            f"error: no artifacts loaded (looked at "
+            f"{args.artifacts or args.dir})", file=sys.stderr,
+        )
+        return 2
+    report = advise(inputs)
+    if args.json:
+        json.dump(report, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(render_report(report))
     return 0
 
 
@@ -1938,6 +2052,18 @@ def main(argv=None) -> int:
         "the MIGRATE_BENCH_*.json artifact `cli benchdiff --family "
         "migrate` gates (docs/migration.md)",
     )
+    s.add_argument(
+        "--profile", action="store_true",
+        help="auto-arm a one-window device-profiler capture per config "
+        "(BENCH_PROFILE env): the artifact's `roofline` block is then "
+        "computed from MEASURED device-busy time instead of wall time, "
+        "and gains the device_idle_frac `cli benchdiff` gates",
+    )
+    s.add_argument(
+        "--profile-dir", metavar="DIR",
+        help="where --profile writes its capture dirs "
+        "(BENCH_PROFILE_DIR env; default: a temp directory)",
+    )
     s.set_defaults(fn=cmd_bench)
 
     s = sub.add_parser(
@@ -2043,8 +2169,60 @@ def main(argv=None) -> int:
         help="restrict the critical-path report to the last N batches "
         "(default: all)",
     )
+    s.add_argument(
+        "--profile", metavar="DIR",
+        help="a device-profiler capture dir (obs/prof.py): joins its "
+        "attribution against this host trace and decomposes the "
+        "`dispatch` stage into device-execute / device-idle / "
+        "host-overhead",
+    )
     s.add_argument("--json", action="store_true", help="JSON output")
     s.set_defaults(fn=cmd_trace)
+
+    s = sub.add_parser(
+        "profile",
+        help="attribute a device-profiler capture dir: per-kernel "
+        "device time, busy/idle and compile/execute splits "
+        "(docs/observability.md \"Profile intelligence\")",
+    )
+    s.add_argument(
+        "capture_dir",
+        help="a profile-<ts>-<reason>-<pid>/ capture directory "
+        "(--profile-dir / ANALYZER_TPU_PROFILE_DIR arms them)",
+    )
+    s.add_argument(
+        "--trace-events", nargs="+", metavar="ARTIFACT", default=[],
+        help="host-side trace artifacts (JSONL exports or flight-dump "
+        "dirs): join the capture against the causal-trace forest and "
+        "decompose the dispatch stage",
+    )
+    s.add_argument("--json", action="store_true", help="JSON output")
+    s.set_defaults(fn=cmd_profile)
+
+    s = sub.add_parser(
+        "tune",
+        help="telemetry-driven tuning advisor: name the bottleneck and "
+        "the knob from bench/soak/migrate artifacts, history rings and "
+        "profile captures (deterministic; docs/observability.md "
+        "\"Profile intelligence\")",
+    )
+    s.add_argument(
+        "artifacts", nargs="*",
+        help="artifact paths (BENCH/SOAK/INGEST/MIGRATE_BENCH JSON, a "
+        "history.json or flight-dump dir); none = scan --dir",
+    )
+    s.add_argument(
+        "--dir", default=".",
+        help="directory scanned for artifacts when none are named "
+        "(default: .)",
+    )
+    s.add_argument(
+        "--profile", metavar="DIR",
+        help="also attribute a device-profiler capture dir and feed its "
+        "busy/idle split to the rules",
+    )
+    s.add_argument("--json", action="store_true", help="JSON output")
+    s.set_defaults(fn=cmd_tune)
 
     s = sub.add_parser(
         "metrics",

@@ -142,6 +142,14 @@ RULES: dict[str, str] = {
         "module-global mutable state written without a lock from a "
         "module that declares thread roles — any thread may call in"
     ),
+    "GL046": (
+        "profile-intelligence purity: a wall-clock read in "
+        "obs/profview.py or obs/advisor.py (clock-injected like "
+        "GL032/GL034 — attribution and the advisor's byte-identical "
+        "report must be deterministic), or a peak-magnitude numeric "
+        "literal (>= 1e10) outside obs/hw.py, the roofline ledger's "
+        "one sanctioned peak table"
+    ),
 }
 
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")

@@ -265,6 +265,25 @@ _GL033_INTERNALS = ("_view", "_staging")
 #: legal only inside a function of this name (migrate/lineage.py).
 _GL033_CUTOVER_FN = "cutover"
 
+#: Files where GL046's wall-clock ban applies: the profile-intelligence
+#: plane's pure modules — profview only reads timestamps the profiler
+#: recorded, and the advisor's byte-identical-report contract forbids
+#: any clock at all (same clock-injected contract as GL032/GL034).
+_GL046_FILES = (
+    "analyzer_tpu/obs/profview.py",
+    "analyzer_tpu/obs/advisor.py",
+)
+
+#: The roofline ledger's sanctioned home (GL046, peak-literal half):
+#: the only module that may carry peak-magnitude numeric literals.
+_GL046_PEAK_HOME = ("analyzer_tpu/obs/hw.py",)
+
+#: Numeric literals at or above this magnitude read as hardware peaks
+#: (bytes/s, flop/s) — 1e10 sits above every time-unit conversion
+#: factor (1e9 ns/s) and below the smallest peak in the table, so the
+#: ban needs no allowlist of innocents.
+_GL046_PEAK_MIN = 1e10  # graftlint: disable=GL046 — the rule's own threshold
+
 #: Wall-clock reads GL028 bans in loadgen decision paths. Pacing and
 #: measured-latency reads carry line-scoped disables with reasons.
 #: (GL032 reuses the same needle set for the SLO plane's modules.)
@@ -329,6 +348,8 @@ class ShellRules:
         slo_plane_layer = self._in_slo_plane_layer()
         migrate_layer = self._in_migrate_layer()
         federate_home = self._in_federate_home()
+        profile_plane = self._in_profile_plane_layer()
+        peak_home = self._in_peak_home()
         tests = self._in_tests()
         pallas_home = self._in_pallas_home()
         table_home = self._in_table_home()
@@ -362,6 +383,8 @@ class ShellRules:
                     self._check_unpinned_staging(node)
                 if slo_plane_layer:
                     self._check_slo_plane_clock(node)
+                if profile_plane:
+                    self._check_profile_plane_clock(node)
                 if federate_home:
                     self._check_federate_clock(node)
                 elif not tests:
@@ -391,17 +414,30 @@ class ShellRules:
                     self._check_server_import(node)
                 if not (tests or pallas_home):
                     self._check_pallas_import(node)
-            elif (
+            elif isinstance(node, ast.Constant):
                 # graftlint: disable=GL024 — the rule's own needle
-                isinstance(node, ast.Constant) and node.value == "0.0.0.0"
-            ):
-                self._flag(
-                    "GL024", node,
-                    'bare "0.0.0.0" bind — the introspection plane must '
-                    "default to localhost; widening to all interfaces is "
-                    "an operator's explicit runtime choice, not a code "
-                    "default",
-                )
+                if node.value == "0.0.0.0":
+                    self._flag(
+                        "GL024", node,
+                        'bare "0.0.0.0" bind — the introspection plane '
+                        "must default to localhost; widening to all "
+                        "interfaces is an operator's explicit runtime "
+                        "choice, not a code default",
+                    )
+                elif (
+                    not (tests or peak_home)
+                    and isinstance(node.value, (int, float))
+                    and not isinstance(node.value, bool)
+                    and abs(node.value) >= _GL046_PEAK_MIN
+                ):
+                    self._flag(
+                        "GL046", node,
+                        f"peak-magnitude numeric literal {node.value!r} "
+                        "outside obs/hw.py — a pasted bandwidth/flops "
+                        "number silently forks the roof every roofline "
+                        "verdict is judged against; import it from "
+                        "analyzer_tpu.obs.hw (PEAKS / peaks_for) instead",
+                    )
         return self.findings
 
     def _in_timed_layer(self) -> bool:
@@ -451,6 +487,14 @@ class ShellRules:
     def _in_federate_home(self) -> bool:
         path = self.path.replace("\\", "/")
         return any(path.endswith(frag) for frag in _GL034_FEDERATE_FILES)
+
+    def _in_profile_plane_layer(self) -> bool:
+        path = self.path.replace("\\", "/")
+        return any(path.endswith(frag) for frag in _GL046_FILES)
+
+    def _in_peak_home(self) -> bool:
+        path = self.path.replace("\\", "/")
+        return any(path.endswith(frag) for frag in _GL046_PEAK_HOME)
 
     def _cutover_entry_ranges(self) -> tuple:
         """(start, end) line spans of functions named ``cutover`` — the
@@ -763,6 +807,25 @@ class ShellRules:
                 "plane (obs/history.py, obs/slo.py) — take `now` from "
                 "the caller (the worker's clock / the soak's "
                 "VirtualClock); this module must never own a clock",
+            )
+
+    def _check_profile_plane_clock(self, node: ast.Call) -> None:
+        """GL046 (clock half): a wall-clock read inside the
+        profile-intelligence plane's pure modules (obs/profview.py,
+        obs/advisor.py). Attribution only divides timestamps the
+        profiler recorded, and the advisor's contract is a
+        byte-identical report for identical inputs — a stray
+        ``time.time()`` would break determinism silently (the report
+        still looks plausible, it just stops being diffable)."""
+        resolved = self.imports.resolve(node.func)
+        if resolved in _GL028_CLOCKS:
+            self._flag(
+                "GL046", node,
+                f"wall-clock read `{resolved}` in the pure profile-"
+                "intelligence plane (obs/profview.py, obs/advisor.py) — "
+                "these modules analyze recorded artifacts and must be "
+                "deterministic; timestamps come from the capture, never "
+                "from a clock",
             )
 
     def _check_federate_clock(self, node: ast.Call) -> None:

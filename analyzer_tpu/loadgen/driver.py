@@ -858,6 +858,33 @@ class SoakDriver:
                     "(artifact `trace` block has the full decomposition)",
                     trace_block["dominant_stage"],
                 )
+            # When a device profile was captured during the soak (dead
+            # letter / degradation / SIGUSR2), attribute it right here:
+            # the violation log then names the dominant device kernel
+            # and the busy/idle split next to the dominant host stage.
+            from analyzer_tpu.obs.prof import get_device_profiler
+
+            last_capture = get_device_profiler().last_capture
+            if last_capture is not None:
+                from analyzer_tpu.obs.profview import analyze_capture
+
+                att = analyze_capture(last_capture)
+                if att["parsed"]:
+                    dev_split = att["device"]
+                    logger.warning(
+                        "device profile %s: dominant kernel %s, busy "
+                        "%.3f ms / idle %.3f ms (idle %.1f%% of the "
+                        "capture window)",
+                        last_capture, att["dominant_kernel"],
+                        dev_split["busy_us"] / 1e3,
+                        dev_split["idle_us"] / 1e3,
+                        100 * dev_split["idle_frac"],
+                    )
+                else:
+                    logger.warning(
+                        "device profile %s did not parse: %s",
+                        last_capture, att.get("error"),
+                    )
         logger.info(
             "soak done: %d matches over %d ticks (%.1f wall s), slo=%s",
             rated, cfg.n_ticks, wall_s,

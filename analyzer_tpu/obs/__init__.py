@@ -44,7 +44,17 @@ The LIVE half (this PR's obsd plane — everything above is post-hoc):
     scraping N workers' obsd endpoints into one federated registry
     under the reserved ``host=`` label, fleet-scope SLO burns with
     per-host attribution, and the ``/fleetz`` serving surface
-    (``cli fleet``; docs/observability.md "Fleet plane").
+    (``cli fleet``; docs/observability.md "Fleet plane");
+  * :mod:`~analyzer_tpu.obs.profview` — profile attribution: reads the
+    capture dirs :mod:`~analyzer_tpu.obs.prof` writes into a per-kernel
+    device-time table + busy/idle split, and joins the capture against
+    the host trace forest (``cli profile``);
+  * :mod:`~analyzer_tpu.obs.hw` — the roofline ledger's peak table and
+    per-dispatch bytes/flops cost model (the one sanctioned home of
+    peak-magnitude literals, graftlint GL046);
+  * :mod:`~analyzer_tpu.obs.advisor` — the telemetry-driven tuning
+    advisor: a deterministic rule table over the repo's artifacts that
+    names the bottleneck and the knob (``cli tune``).
 
 Metric name catalog: docs/observability.md.
 """

@@ -68,6 +68,24 @@ def load_bench(path: str) -> dict:
     return data
 
 
+def _roofline_config(data: dict, degraded: bool) -> BenchConfig | None:
+    """The roofline ledger's gated config: device-idle fraction inside
+    the measured capture window (lower is better — rising idle means
+    dispatches shrank relative to launch overhead). Only artifacts whose
+    capture actually measured device time carry it; a candidate that
+    silently stopped parsing its profile is caught by the ``profile.
+    parsed`` vanished-block gate in ``cli benchdiff``, not here."""
+    roof = data.get("roofline") or {}
+    if roof.get("device_idle_frac") is None:
+        return None
+    return BenchConfig(
+        name="roofline.device_idle_frac",
+        value=float(roof["device_idle_frac"]),
+        higher_is_better=False,
+        degraded=degraded,
+    )
+
+
 def bench_configs(data: dict) -> list[BenchConfig]:
     """The comparable configs inside one artifact.
 
@@ -145,6 +163,9 @@ def bench_configs(data: dict) -> list[BenchConfig]:
                     degraded=i_degraded,
                 )
             )
+        roof = _roofline_config(data, i_degraded)
+        if roof is not None:
+            out.append(roof)
         return out
     if str(data["metric"]).startswith("migrate."):
         # Migrate family (``MIGRATE_BENCH_*``, metric
@@ -195,6 +216,9 @@ def bench_configs(data: dict) -> list[BenchConfig]:
                     degraded=m_degraded,
                 )
             )
+        roof = _roofline_config(data, m_degraded)
+        if roof is not None:
+            out.append(roof)
         return out
     if str(data["metric"]).startswith("serve."):
         latency = data.get("latency_ms") or {}
@@ -306,6 +330,9 @@ def bench_configs(data: dict) -> list[BenchConfig]:
                 degraded=degraded or not streamed.get("stable", True),
             )
         )
+    roof = _roofline_config(data, degraded)
+    if roof is not None:
+        out.append(roof)
     return out
 
 

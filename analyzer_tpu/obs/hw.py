@@ -1,0 +1,202 @@
+"""Hardware peak table + the per-dispatch bytes/flops cost model.
+
+The roofline ledger's two inputs live here and ONLY here:
+
+  * **Peak table** — nominal per-chip HBM bandwidth and matrix-unit
+    flops for the platforms the paper targets (v5e is the north-star
+    rig, v5p the scale-up check, CPU the dev fallback), overridable per
+    run via ``ANALYZER_TPU_PEAK_BYTES_PER_S`` /
+    ``ANALYZER_TPU_PEAK_FLOPS_PER_S`` (a rig whose measured STREAM
+    number disagrees with the datasheet should pin its own roof).
+  * **Cost model** — bytes moved and flops retired per dispatched
+    match slot, derived from the known kernel shapes: each slot gathers
+    two teams of up to :data:`SLOT_TEAM_SIZE` player rows out of the
+    ``[P+1, 16]`` float32 table, runs the closed-form TrueSkill update,
+    and scatters the touched rows back (core/state.py documents the row
+    layout; sched/superstep.py the ``[W, B, 2, T]`` gather tensors).
+
+graftlint **GL046** makes this module the one sanctioned home of
+peak-magnitude numeric literals (>= 1e10): a bandwidth number pasted
+into an analysis module would silently fork the roof the verdicts are
+judged against. Everything here is stdlib-only and clock-free — the
+roofline never measures, it only divides numbers the caller measured.
+
+``bound_by`` verdict semantics (:func:`roofline`): whichever roof the
+dispatch sits closer to names the bound; when BOTH achieved fractions
+sit under :data:`OVERHEAD_BOUND_FRAC` the dispatch is not near either
+roof and the verdict is ``overhead`` — per-dispatch fixed cost (launch
+latency, the dev tunnel) dominates, and the tuning answer is batching /
+fusion, not bandwidth.
+"""
+
+from __future__ import annotations
+
+import os
+
+ENV_PEAK_BYTES = "ANALYZER_TPU_PEAK_BYTES_PER_S"
+ENV_PEAK_FLOPS = "ANALYZER_TPU_PEAK_FLOPS_PER_S"
+
+#: Nominal per-chip roofs. Bandwidth is HBM (CPU: a typical desktop
+#: DDR figure); flops are the chip's headline dense bf16 number —
+#: deliberately the CEILING: the scan kernel is elementwise f32 VPU
+#: work, so its achieved fraction reads honestly low.
+PEAKS: dict[str, dict] = {
+    "v5e": {
+        "bytes_per_s": 819.0e9,
+        "flops_per_s": 197.0e12,
+        "label": "TPU v5e (819 GB/s HBM, 197 bf16 TFLOP/s)",
+    },
+    "v5p": {
+        "bytes_per_s": 2765.0e9,
+        "flops_per_s": 459.0e12,
+        "label": "TPU v5p (2765 GB/s HBM, 459 bf16 TFLOP/s)",
+    },
+    "cpu": {
+        "bytes_per_s": 50.0e9,
+        "flops_per_s": 200.0e9,
+        "label": "CPU (nominal 50 GB/s DDR, 200 GFLOP/s)",
+    },
+}
+
+#: Below this achieved fraction of BOTH roofs, the dispatch is bound by
+#: neither memory nor compute: fixed per-dispatch overhead dominates.
+OVERHEAD_BOUND_FRAC = 0.05
+
+# -- Kernel-shape constants (the cost model's inputs) -------------------
+# Mirrors core/state.py TABLE_WIDTH (16 f32 columns per player row) and
+# core/state.py MAX_TEAM_SIZE (two teams of up to 5 players per match
+# slot); tests pin the mirror so drift fails loudly.
+TABLE_ROW_BYTES = 16 * 4
+SLOT_TEAM_SIZE = 5
+#: int32 player index + mask per gathered slot position.
+SLOT_INDEX_BYTES = 2 * 4
+#: Closed-form TrueSkill update per match slot: per-player seed checks,
+#: the team mu/sigma reductions, v/w via the Normal pdf/cdf rationals,
+#: and the per-player mean/variance writeback — an order-of-magnitude
+#: MODEL constant (like sched/superstep.py's cost model), not a
+#: measurement.
+FLOPS_PER_MATCH_SLOT = 640.0
+
+
+def classify(platform: str | None = None,
+             device_kind: str | None = None) -> str:
+    """Peak-table key for a jax device's (platform, device_kind). An
+    unrecognized TPU generation maps to v5e (the paper's target rig);
+    everything else falls back to the CPU row."""
+    kind = (device_kind or "").lower().replace(" ", "")
+    if "v5e" in kind or "v5lite" in kind:
+        return "v5e"
+    if "v5p" in kind:
+        return "v5p"
+    if (platform or "").lower() == "tpu":
+        return "v5e"
+    return "cpu"
+
+
+def peaks_for(platform: str | None = None, device_kind: str | None = None,
+              env=os.environ) -> dict:
+    """The roof pair for a device, env overrides applied. ``source``
+    says whether the numbers came from the table or the operator."""
+    key = classify(platform, device_kind)
+    base = PEAKS[key]
+    out = {
+        "platform": key,
+        "label": base["label"],
+        "bytes_per_s": float(base["bytes_per_s"]),
+        "flops_per_s": float(base["flops_per_s"]),
+        "source": "table",
+    }
+    if env.get(ENV_PEAK_BYTES):
+        out["bytes_per_s"] = float(env[ENV_PEAK_BYTES])
+        out["source"] = "env"
+    if env.get(ENV_PEAK_FLOPS):
+        out["flops_per_s"] = float(env[ENV_PEAK_FLOPS])
+        out["source"] = "env"
+    return out
+
+
+def slot_cost(n_slots: int, team_size: int = SLOT_TEAM_SIZE) -> dict:
+    """Bytes/flops for ``n_slots`` dispatched match slots: per slot,
+    ``2 * team_size`` player rows gathered (read) and scattered back
+    (write) plus the int32 index/mask tensors, and one closed-form
+    update's flops."""
+    players = n_slots * 2 * team_size
+    return {
+        "slots": int(n_slots),
+        "bytes": int(players * (2 * TABLE_ROW_BYTES + SLOT_INDEX_BYTES)),
+        "flops": int(n_slots * FLOPS_PER_MATCH_SLOT),
+    }
+
+
+def dispatch_cost(n_steps: int, batch_size: int,
+                  team_size: int = SLOT_TEAM_SIZE) -> dict:
+    """Cost of a packed schedule: ``n_steps x batch_size`` slots
+    (padding included — pad slots move bytes too)."""
+    return slot_cost(int(n_steps) * int(batch_size), team_size=team_size)
+
+
+def stream_cost(n_matches: int, team_size: int = SLOT_TEAM_SIZE) -> dict:
+    """Cost keyed by match count (no schedule in hand — the migrate
+    backfill's shape): a lower bound, padding excluded."""
+    return slot_cost(int(n_matches), team_size=team_size)
+
+
+def roofline(bytes_: float, flops: float, device_s: float,
+             platform: str | None = None, device_kind: str | None = None,
+             device_idle_frac: float | None = None, source: str = "wall",
+             env=os.environ) -> dict:
+    """The artifact ``roofline`` block: achieved bytes/s and flop/s over
+    ``device_s``, fraction of each roof, and the bound-by verdict.
+    ``source`` records where the device time came from (``profile`` =
+    measured device-busy time from a capture; ``wall`` = the repeat
+    minimum, an upper bound on device time)."""
+    peak = peaks_for(platform, device_kind, env=env)
+    if device_s and device_s > 0:
+        abps = float(bytes_) / device_s
+        afps = float(flops) / device_s
+    else:
+        abps = afps = 0.0
+    frac_bw = abps / peak["bytes_per_s"] if peak["bytes_per_s"] > 0 else 0.0
+    frac_fl = afps / peak["flops_per_s"] if peak["flops_per_s"] > 0 else 0.0
+    if max(frac_bw, frac_fl) < OVERHEAD_BOUND_FRAC:
+        bound = "overhead"
+    elif frac_bw >= frac_fl:
+        bound = "memory"
+    else:
+        bound = "compute"
+    out = {
+        "device_s": round(float(device_s), 6),
+        "device_time_source": source,
+        "bytes": int(bytes_),
+        "flops": int(flops),
+        "achieved_bytes_per_s": round(abps, 1),
+        "achieved_flops_per_s": round(afps, 1),
+        "frac_of_peak_bw": round(frac_bw, 6),
+        "frac_of_peak_flops": round(frac_fl, 6),
+        "bound_by": bound,
+        "peak": peak,
+    }
+    if device_idle_frac is not None:
+        out["device_idle_frac"] = round(float(device_idle_frac), 4)
+    return out
+
+
+def render_roofline(roof: dict) -> str:
+    """One-paragraph human render of a ``roofline`` block."""
+    peak = roof.get("peak") or {}
+    lines = [
+        f"roofline ({peak.get('label', '?')}; peaks from "
+        f"{peak.get('source', '?')}, device time from "
+        f"{roof.get('device_time_source', '?')}):",
+        f"  achieved {roof['achieved_bytes_per_s'] / 1e9:.3f} GB/s "
+        f"({100 * roof['frac_of_peak_bw']:.2f}% of peak bw), "
+        f"{roof['achieved_flops_per_s'] / 1e9:.3f} GFLOP/s "
+        f"({100 * roof['frac_of_peak_flops']:.2f}% of peak flops)",
+        f"  bound by: {roof['bound_by']}",
+    ]
+    if roof.get("device_idle_frac") is not None:
+        lines.append(
+            f"  device idle inside the capture window: "
+            f"{100 * roof['device_idle_frac']:.1f}%"
+        )
+    return "\n".join(lines) + "\n"

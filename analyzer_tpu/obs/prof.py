@@ -30,6 +30,7 @@ start/stop never raise into the dispatch path.
 from __future__ import annotations
 
 import contextlib
+import json
 import os
 import threading
 import time
@@ -39,6 +40,25 @@ from analyzer_tpu.logging_utils import get_logger
 logger = get_logger(__name__)
 
 ENV_DIR = "ANALYZER_TPU_PROFILE_DIR"
+
+MANIFEST_NAME = "manifest.json"
+
+
+def _device_identity() -> dict:
+    """Best-effort (platform, device_kind) of device 0 — the capture
+    must not fail because jax is absent or unhappy."""
+    try:
+        import jax
+    except ImportError:
+        return {"platform": None, "device_kind": None}
+    try:
+        dev = jax.devices()[0]
+        return {
+            "platform": str(dev.platform),
+            "device_kind": str(getattr(dev, "device_kind", "") or ""),
+        }
+    except Exception:  # noqa: BLE001 — identity is advisory
+        return {"platform": None, "device_kind": None}
 
 
 def _start_trace(path: str) -> None:
@@ -74,6 +94,7 @@ class DeviceProfiler:
         self._last_at: dict[str, float] = {}
         self.captures = 0
         self.last_capture: str | None = None
+        self.last_manifest: dict | None = None
 
     def configure(
         self,
@@ -109,11 +130,16 @@ class DeviceProfiler:
         return True
 
     @contextlib.contextmanager
-    def maybe_capture(self):
+    def maybe_capture(self, context: dict | None = None):
         """Wraps one dispatch window: a no-op unless a request is
         pending, else the block runs under ``jax.profiler`` into a
-        fresh ``profile-<ts>-<reason>-<pid>`` directory. Profiler
-        errors never propagate into the dispatch path."""
+        fresh ``profile-<ts>-<reason>-<pid>`` directory with a
+        ``manifest.json`` naming the reason, wall window, dispatch
+        window ordinal, the trace/batch ids in flight (the thread-bound
+        trace id plus whatever the dispatch site passes in ``context``),
+        and the device platform — so obs/profview joins capture to
+        host trace without filename archaeology. Profiler errors never
+        propagate into the dispatch path."""
         if self._pending is None:  # the per-batch fast path: one read
             yield
             return
@@ -128,10 +154,12 @@ class DeviceProfiler:
             self.profile_dir, f"profile-{stamp}-{safe}-{os.getpid()}"
         )
         started = False
+        manifest: dict | None = None
         try:
             os.makedirs(path, exist_ok=True)
             _start_trace(path)
             started = True
+            manifest = self._manifest_start(reason, path, context)
         except Exception:  # noqa: BLE001 — attribution must not kill the batch
             logger.exception("device profiler start failed (%s)", reason)
         try:
@@ -142,6 +170,8 @@ class DeviceProfiler:
                     _stop_trace()
                     self.captures += 1
                     self.last_capture = path
+                    if manifest is not None:
+                        self._write_manifest(path, manifest)
                     logger.info(
                         "device profiler capture (%s) written to %s",
                         reason, path,
@@ -151,16 +181,61 @@ class DeviceProfiler:
                         "device profiler stop failed (%s)", reason
                     )
 
+    def _manifest_start(
+        self, reason: str, path: str, context: dict | None
+    ) -> dict:
+        """The manifest fields knowable at capture start. The bound
+        trace id doubles as the batch id at both dispatch sites ("b<N>"
+        per worker numbering), so it lands in both lists."""
+        from analyzer_tpu.obs.tracer import current_trace
+
+        trace = current_trace()
+        manifest = {
+            "version": 1,
+            "reason": reason,
+            "dir": os.path.basename(path),
+            # 1-based ordinal of this capture = the dispatch window it
+            # wrapped, in profiler order.
+            "capture_index": self.captures + 1,
+            "wall_start": time.time(),
+            "traces": [trace] if trace else [],
+            "batches": [trace] if trace else [],
+            "device": _device_identity(),
+        }
+        for key in ("traces", "batches"):
+            extra = (context or {}).get(key) or []
+            for item in extra:
+                if item and item not in manifest[key]:
+                    manifest[key].append(str(item))
+        for key, value in sorted((context or {}).items()):
+            if key not in ("traces", "batches") and key not in manifest:
+                manifest[key] = value
+        return manifest
+
+    def _write_manifest(self, path: str, manifest: dict) -> None:
+        manifest["wall_end"] = time.time()
+        try:
+            with open(
+                os.path.join(path, MANIFEST_NAME), "w", encoding="utf-8"
+            ) as f:
+                json.dump(manifest, f, sort_keys=True, indent=2)
+                f.write("\n")
+            self.last_manifest = manifest
+        except OSError:
+            logger.exception("device profiler manifest write failed")
+
     def capture_info(self) -> dict | None:
         """The flight-dump context block: None when unarmed, else the
-        directory, capture count, and the latest capture path (None
-        until the first window actually ran)."""
+        directory, capture count, the latest capture path (None until
+        the first window actually ran), and that capture's manifest
+        (reason / wall window / dispatch window / ids in flight)."""
         if not self.armed:
             return None
         return {
             "dir": self.profile_dir,
             "captures": self.captures,
             "last_capture": self.last_capture,
+            "last_manifest": self.last_manifest,
         }
 
 

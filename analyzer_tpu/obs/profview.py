@@ -1,0 +1,351 @@
+"""Profile attribution: reads the capture dirs obs/prof.py writes.
+
+``DeviceProfiler.maybe_capture`` wraps one dispatch window in a
+``jax.profiler`` trace; until this module, the result was an opaque
+TensorBoard directory no repo tool ever read. :func:`analyze_capture`
+turns one capture into numbers the rest of the plane can join against:
+
+  * finds the Chrome-format device trace(s) (``*trace.json.gz`` — the
+    artifact jax.profiler writes under ``plugins/profile/<run>/``), and
+    TOLERATES a missing or torn file: the attribution reports
+    ``parsed: false`` with the error instead of crashing the CLI or the
+    SLO-violation log path that consumes it;
+  * bins device ops into a per-kernel device-time table (sorted by
+    total time — ``dominant_kernel`` is the first answer to "is the rig
+    run decode-, H2D-, or scan-bound");
+  * splits compile-vs-execute (host-side ``*compile*`` events vs device
+    busy time) and device-busy-vs-idle over the capture window (merged
+    interval union across all device lanes — ``idle_frac`` is the
+    roofline ledger's ``device_idle_frac``);
+  * joins the capture against the host-side causal-trace forest
+    (:func:`decompose_dispatch`): the capture's ``manifest.json`` names
+    the batch/trace ids that were in flight, so the host trace's
+    ``dispatch`` stage decomposes into device-execute / device-idle /
+    host-overhead without filename or clock archaeology.
+
+Clock-injected contract (graftlint **GL046**, same as the history/SLO
+plane's GL032): this module never reads a wall clock — every timestamp
+it handles was recorded by someone else. Peak-magnitude literals are
+banned here too; roofs come from :mod:`analyzer_tpu.obs.hw`.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+
+from analyzer_tpu.obs.registry import get_registry
+
+#: A file is a device trace when its name ends with one of these (jax
+#: writes ``<host>.trace.json.gz``; tests may commit a bare
+#: ``trace.json``).
+_TRACE_SUFFIXES = ("trace.json.gz", "trace.json")
+
+#: Process-name prefixes that classify a trace pid as a DEVICE lane
+#: (besides the explicit ``/device:`` marker XLA uses).
+_DEVICE_PREFIXES = ("tpu", "gpu")
+
+
+def find_trace_files(capture_dir: str) -> list[str]:
+    """Every Chrome-trace file under a capture dir (sorted relative
+    paths, deterministic across runs)."""
+    out = []
+    for root, _dirs, files in os.walk(capture_dir):
+        for fn in files:
+            if fn.endswith(_TRACE_SUFFIXES):
+                out.append(
+                    os.path.relpath(os.path.join(root, fn), capture_dir)
+                )
+    return sorted(out)
+
+
+def load_manifest(capture_dir: str) -> dict | None:
+    """The capture's ``manifest.json`` (obs/prof.py), or None — older
+    captures predate the manifest and still attribute, just without the
+    host-trace join keys."""
+    try:
+        with open(
+            os.path.join(capture_dir, "manifest.json"), encoding="utf-8"
+        ) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return data if isinstance(data, dict) else None
+
+
+def _read_trace(path: str) -> list[dict]:
+    """One Chrome trace file -> its event dicts. Raises on a torn or
+    non-trace file; :func:`analyze_capture` catches and reports."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt", encoding="utf-8", errors="replace") as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents") if isinstance(doc, dict) else doc
+    if not isinstance(events, list):
+        raise ValueError("no traceEvents array")
+    return [e for e in events if isinstance(e, dict)]
+
+
+def _device_pids(events: list[dict]) -> tuple[set, dict]:
+    """(device pids, pid -> process name) from the trace's metadata
+    events. A trace with NO process metadata treats every pid as a
+    device lane (best-effort: synthetic traces)."""
+    names: dict = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            nm = str((e.get("args") or {}).get("name", ""))
+            names[e.get("pid")] = nm
+    dev = {
+        pid for pid, nm in names.items()
+        if "/device:" in nm or nm.lower().startswith(_DEVICE_PREFIXES)
+    }
+    return dev, names
+
+
+def _merged_busy_us(intervals: list[tuple]) -> float:
+    """Total covered time of an interval set (union across lanes: "any
+    device lane busy"), so overlapping streams don't double-count."""
+    total = 0.0
+    end = None
+    for start, stop in sorted(intervals):
+        if end is None or start > end:
+            total += stop - start
+            end = stop
+        elif stop > end:
+            total += stop - end
+            end = stop
+    return total
+
+
+def analyze_capture(capture_dir: str, update_metrics: bool = True) -> dict:
+    """One capture dir -> the attribution dict (see module docstring).
+    Never raises on bad input: ``parsed: false`` + ``error`` instead.
+    On success, bumps ``profile.captures_parsed_total`` and sets
+    ``profile.device_idle_frac`` in the process registry (pass
+    ``update_metrics=False`` from pure consumers like the advisor's
+    determinism tests)."""
+    out = {
+        "dir": capture_dir,
+        "parsed": False,
+        "error": None,
+        "trace_files": [],
+        "manifest": None,
+        "kernels": [],
+        "dominant_kernel": None,
+        "device": None,
+        "compile": None,
+    }
+    if not os.path.isdir(capture_dir):
+        out["error"] = "no such capture directory"
+        return out
+    out["manifest"] = load_manifest(capture_dir)
+    rels = find_trace_files(capture_dir)
+    out["trace_files"] = rels
+    if not rels:
+        out["error"] = "no trace.json(.gz) under the capture directory"
+        return out
+    events: list[dict] = []
+    errors = []
+    for rel in rels:
+        try:
+            events.extend(_read_trace(os.path.join(capture_dir, rel)))
+        except (OSError, EOFError, ValueError) as err:
+            errors.append(f"{rel}: {err}")
+    if errors:
+        out["error"] = "; ".join(errors)
+    if not events:
+        return out  # every trace file was torn/empty: parsed stays False
+
+    dev_pids, pnames = _device_pids(events)
+    treat_all_as_device = not pnames
+    kernels: dict[str, list] = {}
+    busy_iv: list[tuple] = []
+    lanes = set()
+    t_min = t_max = None
+    compile_us = 0.0
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        try:
+            ts = float(e["ts"])
+            dur = float(e.get("dur", 0.0))
+        except (KeyError, TypeError, ValueError):
+            continue
+        name = str(e.get("name", "?"))
+        is_device = treat_all_as_device or e.get("pid") in dev_pids
+        if not is_device:
+            # Host side: only the compile split cares (XlaCompile &co).
+            if "compile" in name.lower():
+                compile_us += dur
+            continue
+        k = kernels.setdefault(name, [0, 0.0])
+        k[0] += 1
+        k[1] += dur
+        busy_iv.append((ts, ts + dur))
+        lanes.add((e.get("pid"), e.get("tid")))
+        t_min = ts if t_min is None else min(t_min, ts)
+        t_max = ts + dur if t_max is None else max(t_max, ts + dur)
+
+    busy_us = _merged_busy_us(busy_iv)
+    window_us = (t_max - t_min) if busy_iv else 0.0
+    idle_us = max(0.0, window_us - busy_us)
+    idle_frac = idle_us / window_us if window_us > 0 else 0.0
+    kern_total = sum(v[1] for v in kernels.values())
+    table = [
+        {
+            "name": name,
+            "count": count,
+            "total_us": round(total, 3),
+            "share": round(total / kern_total, 4) if kern_total > 0 else None,
+        }
+        for name, (count, total) in sorted(
+            kernels.items(), key=lambda kv: (-kv[1][1], kv[0])
+        )
+    ]
+    out["kernels"] = table
+    out["dominant_kernel"] = table[0]["name"] if table else None
+    out["device"] = {
+        "busy_us": round(busy_us, 3),
+        "idle_us": round(idle_us, 3),
+        "window_us": round(window_us, 3),
+        "idle_frac": round(idle_frac, 4),
+        "lanes": len(lanes),
+    }
+    exec_us = busy_us
+    out["compile"] = {
+        "compile_us": round(compile_us, 3),
+        "execute_us": round(exec_us, 3),
+        "compile_frac": (
+            round(compile_us / (compile_us + exec_us), 4)
+            if (compile_us + exec_us) > 0 else None
+        ),
+    }
+    out["parsed"] = True
+    if update_metrics:
+        reg = get_registry()
+        reg.counter("profile.captures_parsed_total").add(1)
+        reg.gauge("profile.device_idle_frac").set(round(idle_frac, 4))
+    return out
+
+
+def decompose_dispatch(model, attribution: dict) -> dict | None:
+    """The payoff join: the host trace's ``dispatch`` stage split into
+    device-execute / device-idle / host-overhead using a capture's
+    attribution. Batches are selected by the manifest's in-flight
+    batch/trace ids (``scope: manifest``); a manifest-less capture
+    falls back to every batch in the model (``scope: all_batches`` —
+    honest but coarser). None when the attribution didn't parse or the
+    model has no batches to join."""
+    if not attribution.get("parsed"):
+        return None
+    device = attribution.get("device") or {}
+    man = attribution.get("manifest") or {}
+    ids = set(man.get("batches") or man.get("traces") or [])
+    # Stitched forests namespace process-local batch ids by host
+    # ("worker:b1"); the manifest records the raw id the capturing
+    # process knew, so match either form.
+    batches = [
+        bt for key, bt in sorted(model.batches.items())
+        if key in ids or key.split(":", 1)[-1] in ids
+    ]
+    scope = "manifest"
+    if not batches:
+        batches = list(model.batches.values())
+        scope = "all_batches"
+    if not batches:
+        return None
+    from analyzer_tpu.obs.traceview import batch_report
+
+    dispatch_ms = 0.0
+    for bt in batches:
+        v = batch_report(bt)["stages_ms"].get("dispatch")
+        if v is not None:
+            dispatch_ms += v
+    # The capture covers the selected dispatch window(s): clip the
+    # device split to the host-observed dispatch total, and call the
+    # remainder host overhead (enqueue cost, the dev tunnel's latency).
+    exec_ms = min(device.get("busy_us", 0.0) / 1e3, dispatch_ms)
+    idle_ms = min(device.get("idle_us", 0.0) / 1e3,
+                  max(0.0, dispatch_ms - exec_ms))
+    host_ms = max(0.0, dispatch_ms - exec_ms - idle_ms)
+    out = {
+        "scope": scope,
+        "batches": sorted(bt.batch_id for bt in batches),
+        "dispatch_ms": round(dispatch_ms, 3),
+        "device_execute_ms": round(exec_ms, 3),
+        "device_idle_ms": round(idle_ms, 3),
+        "host_overhead_ms": round(host_ms, 3),
+    }
+    if dispatch_ms > 0:
+        out["shares"] = {
+            "device_execute": round(exec_ms / dispatch_ms, 4),
+            "device_idle": round(idle_ms / dispatch_ms, 4),
+            "host_overhead": round(host_ms / dispatch_ms, 4),
+        }
+    return out
+
+
+def render_attribution(att: dict) -> str:
+    """Human render of :func:`analyze_capture`'s dict (``cli profile``)."""
+    out = [f"profile capture: {att['dir']}"]
+    man = att.get("manifest") or {}
+    if man:
+        wall = ""
+        if man.get("wall_start") is not None and man.get("wall_end") is not None:
+            wall = f", wall window {man['wall_end'] - man['wall_start']:.3f}s"
+        out.append(
+            f"  manifest: reason={man.get('reason', '?')}"
+            f", platform={(man.get('device') or {}).get('platform') or '?'}"
+            f", batches in flight: "
+            f"{', '.join(man.get('batches') or []) or '(none)'}{wall}"
+        )
+    if not att["parsed"]:
+        out.append(f"  parsed: false — {att.get('error') or 'no device events'}")
+        return "\n".join(out) + "\n"
+    dev = att["device"]
+    comp = att["compile"]
+    out.append(
+        f"  device: busy {dev['busy_us'] / 1e3:.3f} ms / idle "
+        f"{dev['idle_us'] / 1e3:.3f} ms over a "
+        f"{dev['window_us'] / 1e3:.3f} ms window "
+        f"(idle {100 * dev['idle_frac']:.1f}%, {dev['lanes']} lane(s))"
+    )
+    if comp["compile_frac"] is not None:
+        out.append(
+            f"  compile vs execute: {comp['compile_us'] / 1e3:.3f} ms vs "
+            f"{comp['execute_us'] / 1e3:.3f} ms "
+            f"({100 * comp['compile_frac']:.1f}% compile)"
+        )
+    if att["kernels"]:
+        out.append("  per-kernel device time:")
+        width = max(len(k["name"]) for k in att["kernels"][:12])
+        for k in att["kernels"][:12]:
+            share = f"{100 * k['share']:5.1f}%" if k["share"] is not None else ""
+            out.append(
+                f"    {k['name']:<{width}}  {k['total_us'] / 1e3:9.3f} ms  "
+                f"x{k['count']:<5d}{share}"
+            )
+        out.append(f"  dominant kernel: {att['dominant_kernel']}")
+    return "\n".join(out) + "\n"
+
+
+def render_decomposition(decomp: dict) -> str:
+    """Human render of :func:`decompose_dispatch`'s dict (the extra
+    section under ``cli trace`` / ``cli profile --trace`` reports)."""
+    shares = decomp.get("shares") or {}
+
+    def pct(key):
+        v = shares.get(key)
+        return "" if v is None else f"  {100 * v:5.1f}%"
+
+    return (
+        f"dispatch decomposition ({decomp['scope']}; batches "
+        f"{', '.join(decomp['batches'])}):\n"
+        f"  dispatch total : {decomp['dispatch_ms']:9.3f} ms\n"
+        f"  device execute : {decomp['device_execute_ms']:9.3f} ms"
+        f"{pct('device_execute')}\n"
+        f"  device idle    : {decomp['device_idle_ms']:9.3f} ms"
+        f"{pct('device_idle')}\n"
+        f"  host overhead  : {decomp['host_overhead_ms']:9.3f} ms"
+        f"{pct('host_overhead')}\n"
+    )

@@ -303,6 +303,11 @@ STANDARD_COUNTERS = (
     "fleet.burns_total",
     "fleet.recoveries_total",
     "fleet.flight_requests_total",
+    # Profile intelligence (obs/profview.py): capture dirs whose device
+    # trace parsed end-to-end. Pre-declared so a host that never
+    # attributed a capture reads 0, and a candidate whose parser broke
+    # reads a vanished delta in benchdiff, not a missing series.
+    "profile.captures_parsed_total",
 )
 STANDARD_GAUGES = (
     "worker.pipeline_lag",
@@ -375,6 +380,11 @@ STANDARD_GAUGES = (
     "fleet.host_up",
     "fleet.burning",
     "fleet.series",
+    # Device-idle fraction of the most recently attributed capture
+    # window (obs/profview.py): the roofline ledger's batching signal —
+    # high idle inside the window = dispatches too small to amortize
+    # launch latency.
+    "profile.device_idle_frac",
 )
 
 #: Histogram families the runtime emits (graftlint GL030 resolves
@@ -565,6 +575,10 @@ SCHEMA_HELP = {
     "fleet.host_up": "1 while the host's last scrape succeeded",
     "fleet.burning": "objectives burning at fleet scope",
     "fleet.series": "series tracked by the fleet history rings",
+    "profile.captures_parsed_total":
+        "device-profile capture dirs attributed end-to-end",
+    "profile.device_idle_frac":
+        "device-idle fraction of the last attributed capture window",
     "phase_seconds": "wall seconds per instrumented phase",
     "sched.pack_occupancy": "per-schedule slot occupancy distribution",
     "serve.microbatch_occupancy": "per-tick serve microbatch fill",

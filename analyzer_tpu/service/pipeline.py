@@ -508,7 +508,9 @@ class PipelineEngine:
         )
         chunk = w._step_chunk
         ys_chunks = []
-        with dispatch_span, w.profiler.maybe_capture():
+        with dispatch_span, w.profiler.maybe_capture(
+            context={"matches": n, "steps": sched.n_steps, "seq": self.seq}
+        ):
             for s0 in range(0, sched.n_steps, chunk):
                 arrays = sched.device_arrays(s0, s0 + chunk)
                 state, ys = _scan_chunk(state, arrays, w.rating_config, True,

@@ -1055,7 +1055,9 @@ class Worker:
             sched = self._bucketed_schedule(enc.stream, enc.state.pad_row)
         with tracer.span(
             "batch.compute", cat="worker", matches=n, steps=sched.n_steps
-        ), self.profiler.maybe_capture():
+        ), self.profiler.maybe_capture(
+            context={"matches": n, "steps": sched.n_steps}
+        ):
             final_state, outs = rate_history(
                 enc.state, sched, self.rating_config, collect=True,
                 steps_per_chunk=self._step_chunk,

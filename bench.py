@@ -75,7 +75,13 @@ history sampler + burn-rate watchdog + shadow-audit drain riding every
 chunk boundary — gated the same <= 2%), BENCH_FEDERATE_OVERHEAD
 (default 1; 0 skips the scraped-under-load vs unscraped
 `federate_overhead` block — a fleet Collector hitting obsd at 20 Hz
-while the e2e line runs — gated the same <= 2%), BENCH_OBS_PORT
+while the e2e line runs — gated the same <= 2%), BENCH_PROFILE
+(default 0; 1 arms a one-window device-profiler capture around one
+reference run — `cli bench --profile` — so the `roofline` block divides
+by MEASURED device-busy time and gains device_idle_frac, and the
+artifact embeds a `profile` attribution block), BENCH_PROFILE_DIR
+(where --profile writes capture dirs; default a temp dir),
+BENCH_OBS_PORT
 (serve obsd — /metrics, /statusz — on localhost while the capture runs;
 `cli bench --obs-port` sets the same thing).
 """
@@ -244,6 +250,10 @@ def _bench_main(metrics_out: str | None) -> None:
         f"cost model predicts {predicted:.3f}s quiet device time")
     state, best, times, stable = time_runs(run, repeats, max_extra=2 * repeats)
     log(f"reference kernel device-only best: {best:.3f}s")
+    # --profile: one extra run under the device profiler while the
+    # staged chunks are still alive; the roofline below then divides by
+    # measured device-busy time instead of the wall minimum.
+    profile_block = bench_profile_window(run, "bench")
     del chunks  # free before staging the fused windows / e2e lines
 
     # Fused window kernel (core/fused.py): SAME repeat protocol on the
@@ -471,6 +481,26 @@ def _bench_main(metrics_out: str | None) -> None:
         phases["fused_best_s"] = head_best
     if tiered_block is not None:
         phases["tiered_best_s"] = tiered_block["min_s"]
+
+    # The roofline ledger (obs/hw.py): the reference dispatch's modeled
+    # bytes/flops over device time — measured busy time when --profile
+    # captured a window (source: profile), else the device-only wall
+    # minimum (source: wall, an upper bound on device time).
+    from analyzer_tpu.obs import hw
+
+    cost = hw.dispatch_cost(sched.n_steps, sched.batch_size)
+    device_s, source, idle_frac = best, "wall", None
+    if profile_block and profile_block.get("parsed") \
+            and profile_block.get("device_busy_s", 0) > 0:
+        device_s = profile_block["device_busy_s"]
+        source = "profile"
+        idle_frac = profile_block.get("device_idle_frac")
+    roofline_block = hw.roofline(
+        cost["bytes"], cost["flops"], device_s,
+        platform=dev.platform, device_kind=dev.device_kind,
+        device_idle_frac=idle_frac, source=source,
+    )
+    log(hw.render_roofline(roofline_block).rstrip("\n"))
     emit_metric(
         rate,
         capture_stats(
@@ -484,6 +514,8 @@ def _bench_main(metrics_out: str | None) -> None:
         trace_overhead=trace_overhead,
         watchdog_overhead=watchdog_overhead,
         federate_overhead=federate_overhead,
+        roofline=roofline_block,
+        profile=profile_block,
     )
 
 
@@ -728,6 +760,19 @@ def _bench_migrate_main(metrics_out: str | None) -> None:
         "arena": get_arena().stats(),
         "capture": {"degraded": not stable},
     }
+    # Roofline (obs/hw.py): the backfill's per-match cost model over the
+    # end-to-end wall best — a LOWER bound on achieved rates (decode and
+    # assignment share the wall here), honest for the bound-by verdict.
+    import jax
+
+    from analyzer_tpu.obs import hw
+
+    _dev = jax.devices()[0]
+    _cost = hw.stream_cost(n_matches)
+    line["roofline"] = hw.roofline(
+        _cost["bytes"], _cost["flops"], best,
+        platform=_dev.platform, device_kind=_dev.device_kind,
+    )
     if assign_block is not None:
         # Prefix windows actually consumed by the e2e run's batch-size
         # planner (the assign microbench itself sizes nothing).
@@ -875,6 +920,18 @@ def _bench_ingest_main(metrics_out: str | None) -> None:
         "arena": arena.stats(),
         "capture": {"degraded": not stable},
     }
+    # Roofline (obs/hw.py): decode bytes over the wall best — the
+    # ingest line moves bytes, not flops, so the verdict reads memory
+    # (wire-speed) or overhead (windowing dominated).
+    import jax
+
+    from analyzer_tpu.obs import hw
+
+    _dev = jax.devices()[0]
+    line["roofline"] = hw.roofline(
+        len(data), 0.0, best,
+        platform=_dev.platform, device_kind=_dev.device_kind,
+    )
     if metrics_out:
         from analyzer_tpu.obs import write_snapshot
 
@@ -1209,6 +1266,58 @@ def obs_breakdown(phases: dict) -> dict:
     }
 
 
+def bench_profile_window(run, reason: str) -> dict | None:
+    """One-window device-profiler capture around a single run() (`cli
+    bench --profile` / BENCH_PROFILE=1): arms obs/prof.py into
+    BENCH_PROFILE_DIR (default: a temp dir), re-runs the workload once
+    under jax.profiler, and attributes the capture with obs/profview —
+    so the artifact's roofline block divides by MEASURED device-busy
+    time instead of wall time. None when not requested; a block with
+    ``parsed: false`` when the capture failed (the bench itself never
+    fails on profiling)."""
+    if os.environ.get("BENCH_PROFILE", "0") == "0":
+        return None
+    import tempfile
+
+    from analyzer_tpu.obs.prof import reset_device_profiler
+    from analyzer_tpu.obs.profview import analyze_capture
+
+    profile_dir = os.environ.get("BENCH_PROFILE_DIR") or tempfile.mkdtemp(
+        prefix="analyzer-bench-profile-"
+    )
+    prof = reset_device_profiler(profile_dir=profile_dir, min_interval_s=0.0)
+    prof.request(reason, force=True)
+    try:
+        with prof.maybe_capture(context={"bench": reason}):
+            run()
+    except Exception as err:  # noqa: BLE001 — profiling must not fail the bench
+        log(f"profiled run failed: {err!r}")
+    if prof.last_capture is None:
+        log(f"profile capture did not start under {profile_dir}")
+        return {
+            "parsed": False, "dir": profile_dir,
+            "error": "capture did not start",
+        }
+    att = analyze_capture(prof.last_capture, update_metrics=False)
+    block = {
+        "parsed": bool(att["parsed"]),
+        "dir": prof.last_capture,
+        "dominant_kernel": att.get("dominant_kernel"),
+    }
+    if att.get("error"):
+        block["error"] = att["error"]
+    if att["parsed"]:
+        dev = att["device"]
+        block["device_busy_s"] = round(dev["busy_us"] / 1e6, 6)
+        block["device_idle_frac"] = dev["idle_frac"]
+        log(f"profile: device busy {block['device_busy_s']:.3f}s, idle "
+            f"{100 * dev['idle_frac']:.1f}% of the capture window, "
+            f"dominant kernel {att['dominant_kernel']}")
+    else:
+        log(f"profile capture did not parse: {att.get('error')}")
+    return block
+
+
 def emit_metric(rate, capture: dict | None = None,
                 streamed: dict | None = None,
                 telemetry: dict | None = None,
@@ -1217,7 +1326,9 @@ def emit_metric(rate, capture: dict | None = None,
                 tiered: dict | None = None,
                 trace_overhead: dict | None = None,
                 watchdog_overhead: dict | None = None,
-                federate_overhead: dict | None = None):
+                federate_overhead: dict | None = None,
+                roofline: dict | None = None,
+                profile: dict | None = None):
     line = {
         "metric": "matches_per_sec_per_chip",
         "value": round(rate, 1),
@@ -1255,6 +1366,17 @@ def emit_metric(rate, capture: dict | None = None,
         # unscraped on the same line; `cli benchdiff` gates
         # overhead_pct <= 2% — federation must never tax the workers).
         line["federate_overhead"] = federate_overhead
+    if roofline is not None:
+        # The roofline ledger (obs/hw.py): achieved bytes/s and flop/s
+        # against the device's peak table, with the bound-by verdict;
+        # `cli benchdiff` gates device_idle_frac when a profile measured
+        # it, and `cli tune` reads the verdict.
+        line["roofline"] = roofline
+    if profile is not None:
+        # The --profile capture's attribution summary (obs/profview.py);
+        # benchdiff's vanished-block gate fails a candidate whose
+        # profile silently stopped parsing.
+        line["profile"] = profile
     if telemetry is not None:
         line["telemetry"] = telemetry
     if metrics_out:

@@ -2315,3 +2315,95 @@ class TestLintCliProjectMode:
         proc = self._lint("--baseline", str(baseline), str(bad))
         assert proc.returncode == 1
         assert "stale baseline entry" in proc.stderr
+
+
+class TestGL046ProfilePlane:
+    """GL046 guards profile intelligence: the pure analysis modules
+    (obs/profview.py, obs/advisor.py) must never read a wall clock —
+    the advisor's contract is a byte-identical report for identical
+    inputs — and peak-magnitude numeric literals (>= 1e10) belong in
+    obs/hw.py, the roofline ledger's one sanctioned peak table."""
+
+    WALL_CLOCK_SRC = """
+    import time
+
+    def report(artifacts):
+        return {"generated_at": time.time(), "artifacts": artifacts}
+    """
+
+    PEAK_LITERAL_SRC = """
+    PEAK_BW = 819.0e9 * 100  # still a literal >= 1e10 in the AST? no —
+    V5E_BYTES_PER_S = 8.19e11
+    """
+
+    def test_wall_clock_fires_only_in_plane_modules(self):
+        for path in (
+            "analyzer_tpu/obs/profview.py",
+            "analyzer_tpu/obs/advisor.py",
+        ):
+            assert "GL046" in rules_of(self.WALL_CLOCK_SRC, path), path
+        for path in (
+            "analyzer_tpu/obs/prof.py",    # the CAPTURE side owns clocks
+            "analyzer_tpu/obs/flight.py",
+        ):
+            assert "GL046" not in rules_of(self.WALL_CLOCK_SRC, path), path
+
+    def test_every_wall_clock_needle_fires(self):
+        src = """
+        import time
+        import datetime
+
+        def bad():
+            time.time()
+            time.perf_counter()
+            time.sleep(1)
+            datetime.datetime.now()
+        """
+        assert rules_of(src, "analyzer_tpu/obs/advisor.py") == ["GL046"] * 4
+
+    def test_peak_literal_fires_outside_hw(self):
+        assert "GL046" in rules_of(
+            self.PEAK_LITERAL_SRC, "analyzer_tpu/obs/benchdiff.py"
+        )
+        assert "GL046" in rules_of(self.PEAK_LITERAL_SRC, "bench_like.py")
+
+    def test_peak_literal_sanctioned_in_hw_and_tests(self):
+        assert rules_of(
+            self.PEAK_LITERAL_SRC, "analyzer_tpu/obs/hw.py"
+        ) == []
+        assert rules_of(
+            self.PEAK_LITERAL_SRC, "tests/test_profile_intel.py"
+        ) == []
+
+    def test_time_unit_conversions_stay_clean(self):
+        # 1e9 (ns/s) and friends sit BELOW the threshold by design: the
+        # rule must not force disables onto innocent unit conversions.
+        src = """
+        NS_PER_S = 1e9
+        US_PER_S = 1_000_000
+        GB = 1 << 30
+
+        def to_seconds(ns):
+            return ns / 1e9
+        """
+        assert rules_of(src, "analyzer_tpu/obs/profview.py") == []
+
+    def test_line_scoped_disable_works(self):
+        src = """
+        MEASURED_PEAK = 8.1e11  # graftlint: disable=GL046 — rig-measured
+        """
+        assert rules_of(src, "analyzer_tpu/obs/benchdiff.py") == []
+
+    def test_shipping_plane_modules_are_clean(self):
+        for mod in (
+            "analyzer_tpu/obs/profview.py",
+            "analyzer_tpu/obs/advisor.py",
+            "analyzer_tpu/obs/hw.py",
+        ):
+            with open(os.path.join(_REPO, mod), encoding="utf-8") as f:
+                assert rules_of(f.read(), mod) == [], mod
+
+    def test_catalog_has_gl046(self):
+        from analyzer_tpu.lint.findings import RULES
+
+        assert "GL046" in RULES

@@ -1,0 +1,736 @@
+"""Profile intelligence: attribution, roofline ledger, tuning advisor.
+
+Pins the three layers end to end against the committed capture
+fixtures (tests/fixtures/profile_ok + profile_torn):
+
+  * obs/hw.py          — peak table + env override, the per-dispatch
+                         bytes/flops cost model, the bound-by verdicts;
+  * obs/profview.py    — Chrome-trace parsing (tolerant of torn files),
+                         the per-kernel table, busy/idle and
+                         compile/execute splits, the host-trace join
+                         (dispatch -> device-execute/idle/host);
+  * obs/prof.py        — the capture manifest.json that carries the
+                         join keys;
+  * obs/advisor.py     — every rule's fire/hold edge and the
+                         byte-identical report contract;
+  * cli profile / cli tune / cli benchdiff — the operator surfaces,
+                         including the roofline regression gate and the
+                         profile.parsed vanished-block gate.
+"""
+
+import gzip
+import json
+import os
+
+import pytest
+
+from analyzer_tpu.obs import hw
+from analyzer_tpu.obs.profview import (
+    analyze_capture,
+    decompose_dispatch,
+    find_trace_files,
+    load_manifest,
+    render_attribution,
+    render_decomposition,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+OK_DIR = os.path.join(FIXTURES, "profile_ok")
+TORN_DIR = os.path.join(FIXTURES, "profile_torn")
+
+
+# -- obs/hw.py: peaks, cost model, verdicts -----------------------------
+
+
+class TestHwPeaks:
+    def test_classify_maps_known_devices(self):
+        assert hw.classify("tpu", "TPU v5e") == "v5e"
+        assert hw.classify("tpu", "TPU v5 lite") == "v5e"
+        assert hw.classify("tpu", "TPU v5p") == "v5p"
+        # Unknown TPU generation: the paper's target rig.
+        assert hw.classify("tpu", "TPU v9x") == "v5e"
+        assert hw.classify("cpu", "") == "cpu"
+        assert hw.classify(None, None) == "cpu"
+
+    def test_peaks_from_table(self):
+        p = hw.peaks_for("tpu", "TPU v5e", env={})
+        assert p["source"] == "table"
+        assert p["platform"] == "v5e"
+        assert p["bytes_per_s"] == hw.PEAKS["v5e"]["bytes_per_s"]
+        assert p["flops_per_s"] == hw.PEAKS["v5e"]["flops_per_s"]
+
+    def test_env_override_pins_the_roof(self):
+        env = {hw.ENV_PEAK_BYTES: "123.0", hw.ENV_PEAK_FLOPS: "456.0"}
+        p = hw.peaks_for("tpu", "TPU v5e", env=env)
+        assert p["source"] == "env"
+        assert p["bytes_per_s"] == 123.0
+        assert p["flops_per_s"] == 456.0
+        # One override alone still flips the source.
+        p = hw.peaks_for("cpu", None, env={hw.ENV_PEAK_BYTES: "99.0"})
+        assert p["source"] == "env"
+        assert p["bytes_per_s"] == 99.0
+        assert p["flops_per_s"] == hw.PEAKS["cpu"]["flops_per_s"]
+
+    def test_cost_model_mirrors_the_table_layout(self):
+        from analyzer_tpu.core.state import MAX_TEAM_SIZE, TABLE_WIDTH
+
+        # The mirror contract: a core/state.py layout change must land
+        # here too, or the roofline silently miscounts bytes.
+        assert hw.TABLE_ROW_BYTES == TABLE_WIDTH * 4
+        assert hw.SLOT_TEAM_SIZE == MAX_TEAM_SIZE
+
+    def test_slot_cost_math(self):
+        c = hw.slot_cost(1)
+        players = 2 * hw.SLOT_TEAM_SIZE
+        assert c["slots"] == 1
+        assert c["bytes"] == players * (
+            2 * hw.TABLE_ROW_BYTES + hw.SLOT_INDEX_BYTES
+        )
+        assert c["flops"] == int(hw.FLOPS_PER_MATCH_SLOT)
+
+    def test_dispatch_and_stream_cost_scale_linearly(self):
+        one = hw.slot_cost(1)
+        d = hw.dispatch_cost(4, 8)  # padding included: 32 slots
+        assert d["slots"] == 32
+        assert d["bytes"] == 32 * one["bytes"]
+        assert d["flops"] == 32 * one["flops"]
+        s = hw.stream_cost(7)
+        assert s["slots"] == 7
+        assert s["bytes"] == 7 * one["bytes"]
+
+    def test_roofline_verdicts(self):
+        env = {hw.ENV_PEAK_BYTES: "100.0", hw.ENV_PEAK_FLOPS: "100.0"}
+        mem = hw.roofline(50.0, 1.0, 1.0, env=env)
+        assert mem["bound_by"] == "memory"
+        assert mem["frac_of_peak_bw"] == pytest.approx(0.5)
+        comp = hw.roofline(1.0, 50.0, 1.0, env=env)
+        assert comp["bound_by"] == "compute"
+        over = hw.roofline(1.0, 1.0, 1.0, env=env)
+        assert over["bound_by"] == "overhead"
+        assert over["frac_of_peak_bw"] < hw.OVERHEAD_BOUND_FRAC
+
+    def test_roofline_records_source_and_idle(self):
+        r = hw.roofline(
+            10.0, 10.0, 0.5, platform="cpu", device_idle_frac=0.25,
+            source="profile", env={},
+        )
+        assert r["device_time_source"] == "profile"
+        assert r["device_idle_frac"] == 0.25
+        assert r["achieved_bytes_per_s"] == pytest.approx(20.0)
+        # Zero device time: rates zero, never a division error.
+        z = hw.roofline(10.0, 10.0, 0.0, env={})
+        assert z["achieved_bytes_per_s"] == 0.0
+        assert z["bound_by"] == "overhead"
+
+    def test_render_roofline_names_the_bound(self):
+        env = {hw.ENV_PEAK_BYTES: "100.0", hw.ENV_PEAK_FLOPS: "100.0"}
+        text = hw.render_roofline(
+            hw.roofline(50.0, 1.0, 1.0, device_idle_frac=0.3, env=env)
+        )
+        assert "bound by: memory" in text
+        assert "device idle inside the capture window: 30.0%" in text
+
+
+# -- obs/profview.py: the committed fixtures ----------------------------
+
+
+class TestAttributionFixture:
+    def test_fixture_attributes_end_to_end(self):
+        att = analyze_capture(OK_DIR, update_metrics=False)
+        assert att["parsed"] is True
+        assert att["error"] is None
+        assert att["trace_files"] == [
+            os.path.join("plugins", "profile", "run1", "host.trace.json.gz")
+        ]
+        dev = att["device"]
+        # Two fusion spans [100,300)+[400,500) and one gather [550,600):
+        # 350us busy over a [100,600) = 500us window.
+        assert dev["busy_us"] == pytest.approx(350.0)
+        assert dev["idle_us"] == pytest.approx(150.0)
+        assert dev["window_us"] == pytest.approx(500.0)
+        assert dev["idle_frac"] == pytest.approx(0.3)
+        assert dev["lanes"] == 1
+
+    def test_fixture_kernel_table_sorted_by_total(self):
+        att = analyze_capture(OK_DIR, update_metrics=False)
+        assert att["dominant_kernel"] == "fusion.update"
+        k0, k1 = att["kernels"]
+        assert k0["name"] == "fusion.update"
+        assert k0["count"] == 2
+        assert k0["total_us"] == pytest.approx(300.0)
+        assert k0["share"] == pytest.approx(0.8571)
+        assert k1["name"] == "gather.rows"
+        assert k1["share"] == pytest.approx(0.1429)
+
+    def test_fixture_compile_split_is_host_side_only(self):
+        att = analyze_capture(OK_DIR, update_metrics=False)
+        comp = att["compile"]
+        # The XlaCompile span sits on the host pid: never device busy.
+        assert comp["compile_us"] == pytest.approx(400.0)
+        assert comp["execute_us"] == pytest.approx(350.0)
+        assert comp["compile_frac"] == pytest.approx(400.0 / 750.0, abs=1e-4)
+
+    def test_fixture_manifest_join_keys(self):
+        man = load_manifest(OK_DIR)
+        assert man["reason"] == "slo_burn"
+        assert man["batches"] == ["b1"]
+        assert man["device"]["platform"] == "tpu"
+        att = analyze_capture(OK_DIR, update_metrics=False)
+        assert att["manifest"] == man
+
+    def test_torn_fixture_reports_not_crashes(self):
+        att = analyze_capture(TORN_DIR, update_metrics=False)
+        assert att["parsed"] is False
+        assert att["trace_files"]  # the file exists; its tail is gone
+        assert "end-of-stream" in att["error"] or "Error" in att["error"]
+
+    def test_missing_and_empty_dirs(self, tmp_path):
+        att = analyze_capture(str(tmp_path / "nope"), update_metrics=False)
+        assert att["parsed"] is False
+        assert "no such capture directory" in att["error"]
+        att = analyze_capture(str(tmp_path), update_metrics=False)
+        assert att["parsed"] is False
+        assert "no trace.json" in att["error"]
+
+    def test_metrics_update_on_success_only(self):
+        from analyzer_tpu.obs import reset_registry
+
+        reg = reset_registry()
+        analyze_capture(TORN_DIR)  # torn: no counter bump
+        assert reg.counter("profile.captures_parsed_total").value == 0
+        analyze_capture(OK_DIR)
+        assert reg.counter("profile.captures_parsed_total").value == 1
+        assert reg.gauge("profile.device_idle_frac").value == pytest.approx(
+            0.3
+        )
+        reset_registry()
+
+    def test_render_attribution(self):
+        att = analyze_capture(OK_DIR, update_metrics=False)
+        text = render_attribution(att)
+        assert "dominant kernel: fusion.update" in text
+        assert "idle 30.0%" in text
+        assert "reason=slo_burn" in text
+        torn = render_attribution(analyze_capture(TORN_DIR,
+                                                  update_metrics=False))
+        assert "parsed: false" in torn
+
+    def test_trace_file_discovery_is_sorted_and_suffixed(self, tmp_path):
+        (tmp_path / "b").mkdir()
+        (tmp_path / "b" / "z.trace.json").write_text("[]")
+        (tmp_path / "a.trace.json.gz").write_bytes(
+            gzip.compress(b"[]")
+        )
+        (tmp_path / "notes.txt").write_text("x")
+        rels = find_trace_files(str(tmp_path))
+        assert rels == ["a.trace.json.gz", os.path.join("b", "z.trace.json")]
+
+
+# -- the host-trace join ------------------------------------------------
+
+
+def _host_events(batches=("b1",)):
+    """A minimal single-host causal trace: one full chain per batch,
+    each with a 2000us (b1) / 1000us (b2) compute span — the same shape
+    test_trace.py pins for traceview itself."""
+    pid, tid = 1, 1
+    out = []
+
+    def span(name, ts, dur, trace):
+        return {"name": name, "cat": "x", "ph": "X", "ts": ts, "dur": dur,
+                "pid": pid, "tid": tid, "args": {"trace": trace}}
+
+    def instant(name, ts, **args):
+        return {"name": name, "cat": "trace", "ph": "i", "s": "t", "ts": ts,
+                "pid": pid, "tid": tid, "args": args}
+
+    for i, batch in enumerate(batches):
+        base = 10000.0 * i
+        match = f"m{i + 1}"
+        compute = 2000.0 if i == 0 else 1000.0
+        out.extend([
+            instant("trace.enqueue", base + 100.0, trace=match, span=1),
+            instant("batch.assemble", base + 1000.0, batch=batch,
+                    members=[match], enqueues=[base + 100.0]),
+            span("batch.encode", base + 1000.0, 400.0, batch),
+            span("batch.pack", base + 1400.0, 100.0, batch),
+            span("feed.materialize", base + 1500.0, 50.0, batch),
+            span("feed.transfer", base + 1550.0, 250.0, batch),
+            span("batch.compute", base + 1800.0, compute, batch),
+            span("batch.fetch", base + 1800.0 + compute, 300.0, batch),
+            span("batch.commit", base + 2100.0 + compute, 500.0, batch),
+            instant("view.publish", base + 2800.0 + compute, version=7,
+                    trace=batch),
+        ])
+    return out
+
+
+class TestDecomposeDispatch:
+    def _model(self, batches=("b1", "b2")):
+        from analyzer_tpu.obs.traceview import build_model
+
+        return build_model(_host_events(batches))
+
+    def test_manifest_scope_selects_in_flight_batches(self):
+        att = analyze_capture(OK_DIR, update_metrics=False)
+        d = decompose_dispatch(self._model(), att)
+        # The manifest names b1 only; b2's 1.0ms dispatch is excluded.
+        assert d["scope"] == "manifest"
+        assert d["batches"] == ["b1"]
+        assert d["dispatch_ms"] == pytest.approx(2.0)
+        assert d["device_execute_ms"] == pytest.approx(0.35)
+        assert d["device_idle_ms"] == pytest.approx(0.15)
+        assert d["host_overhead_ms"] == pytest.approx(1.5)
+        assert d["shares"]["host_overhead"] == pytest.approx(0.75)
+
+    def test_manifestless_capture_falls_back_to_all_batches(self):
+        att = dict(analyze_capture(OK_DIR, update_metrics=False))
+        att["manifest"] = None
+        d = decompose_dispatch(self._model(), att)
+        assert d["scope"] == "all_batches"
+        assert d["batches"] == ["b1", "b2"]
+        assert d["dispatch_ms"] == pytest.approx(3.0)
+
+    def test_device_split_clips_to_host_dispatch(self):
+        att = dict(analyze_capture(OK_DIR, update_metrics=False))
+        # Doctor a device window far wider than the host dispatch: the
+        # split must clip, never go negative.
+        att["device"] = {"busy_us": 5_000_000.0, "idle_us": 5_000_000.0}
+        d = decompose_dispatch(self._model(("b1",)), att)
+        assert d["device_execute_ms"] == pytest.approx(2.0)
+        assert d["device_idle_ms"] == 0.0
+        assert d["host_overhead_ms"] == 0.0
+
+    def test_unparsed_or_batchless_joins_return_none(self):
+        att = analyze_capture(TORN_DIR, update_metrics=False)
+        assert decompose_dispatch(self._model(), att) is None
+        ok = analyze_capture(OK_DIR, update_metrics=False)
+        from analyzer_tpu.obs.traceview import build_model
+
+        assert decompose_dispatch(build_model([]), ok) is None
+
+    def test_render_decomposition(self):
+        att = analyze_capture(OK_DIR, update_metrics=False)
+        text = render_decomposition(decompose_dispatch(self._model(), att))
+        assert "dispatch decomposition (manifest; batches b1)" in text
+        assert "host overhead" in text
+
+
+# -- obs/prof.py: the capture manifest ----------------------------------
+
+
+class TestCaptureManifest:
+    def _profiler(self, monkeypatch, tmp_path):
+        from analyzer_tpu.obs import prof
+
+        calls = []
+        monkeypatch.setattr(
+            prof, "_start_trace", lambda p: calls.append(("start", p))
+        )
+        monkeypatch.setattr(
+            prof, "_stop_trace", lambda: calls.append(("stop",))
+        )
+        p = prof.DeviceProfiler(
+            profile_dir=str(tmp_path), min_interval_s=0.0
+        )
+        return p, calls
+
+    def test_capture_writes_manifest_with_join_keys(self, monkeypatch,
+                                                    tmp_path):
+        p, _calls = self._profiler(monkeypatch, tmp_path)
+        assert p.request("slo_burn", force=True)
+        with p.maybe_capture(
+            context={"matches": 64, "steps": 4, "batches": ["b9"]}
+        ):
+            pass
+        assert p.last_capture is not None
+        path = os.path.join(p.last_capture, "manifest.json")
+        with open(path, encoding="utf-8") as f:
+            man = json.load(f)
+        assert man["version"] == 1
+        assert man["reason"] == "slo_burn"
+        assert man["capture_index"] == 1
+        assert man["dir"] == os.path.basename(p.last_capture)
+        assert "b9" in man["batches"]
+        assert man["matches"] == 64
+        assert man["steps"] == 4
+        assert man["wall_end"] >= man["wall_start"]
+        assert set(man["device"]) == {"platform", "device_kind"}
+
+    def test_manifest_lands_in_capture_info(self, monkeypatch, tmp_path):
+        p, _ = self._profiler(monkeypatch, tmp_path)
+        info = p.capture_info()
+        assert info["last_manifest"] is None
+        p.request("dead_letter", force=True)
+        with p.maybe_capture():
+            pass
+        info = p.capture_info()
+        assert info["last_manifest"]["reason"] == "dead_letter"
+        assert info["last_capture"] == p.last_capture
+        # profview reads it straight back.
+        assert load_manifest(p.last_capture)["reason"] == "dead_letter"
+
+    def test_no_pending_request_means_no_capture(self, monkeypatch,
+                                                 tmp_path):
+        p, calls = self._profiler(monkeypatch, tmp_path)
+        with p.maybe_capture(context={"matches": 1}):
+            pass
+        assert calls == []
+        assert p.last_capture is None
+        assert p.capture_info()["last_manifest"] is None
+
+
+# -- obs/advisor.py: the rule table -------------------------------------
+
+
+def _bench_data(**over):
+    data = {
+        "metric": "matches_per_sec_per_chip",
+        "value": 500000.0,
+        "capture": {"degraded": False},
+    }
+    data.update(over)
+    return data
+
+
+def _inputs(arts=(), history=None, profile=None):
+    return {
+        "artifacts": [
+            {"path": p, "family": fam, "metric": str(d.get("metric", "")),
+             "data": d}
+            for p, fam, d in arts
+        ],
+        "history": history,
+        "profile": profile,
+    }
+
+
+class TestAdvisorRules:
+    def _rules_fired(self, inputs):
+        from analyzer_tpu.obs.advisor import advise
+
+        return [f["rule"] for f in advise(inputs)["findings"]]
+
+    def test_no_evidence_no_findings(self):
+        from analyzer_tpu.obs.advisor import advise
+
+        report = advise(_inputs())
+        assert report["findings"] == []
+        assert report["bottleneck"] is None
+        assert report["snippet"] == ""
+
+    def test_healthy_bench_fires_nothing(self):
+        data = _bench_data(
+            roofline={"bound_by": "memory", "frac_of_peak_bw": 0.3,
+                      "device_idle_frac": 0.1},
+            fused={"min_over_reference": 0.6, "window": 16},
+            tiered={"hit_rate": 0.99, "min_over_resident": 1.05},
+            telemetry={"feed": {"starved_total": 0,
+                                "backpressure_total": 5}},
+        )
+        assert self._rules_fired(_inputs([("a", "bench", data)])) == []
+
+    def test_device_idle_rule_doubles_the_window(self):
+        from analyzer_tpu.obs.advisor import advise
+
+        data = _bench_data(
+            roofline={"device_idle_frac": 0.55},
+            fused={"window": 16},
+        )
+        report = advise(_inputs([("a", "bench", data)]))
+        [f] = report["findings"]
+        assert f["rule"] == "device-idle"
+        assert f["env"] == {"BENCH_FUSE_WINDOW": "32"}
+        assert "roofline.device_idle_frac=0.55" in f["evidence"][0]
+        # Below the threshold: holds.
+        calm = _bench_data(roofline={"device_idle_frac": 0.2})
+        assert self._rules_fired(_inputs([("a", "bench", calm)])) == []
+
+    def test_device_idle_rule_reads_the_profile_too(self):
+        prof = {"parsed": True, "dir": "cap", "dominant_kernel": "k",
+                "device": {"idle_frac": 0.6}}
+        fired = self._rules_fired(_inputs(profile=prof))
+        assert fired == ["device-idle"]
+
+    def test_dispatch_overhead_rule(self):
+        data = _bench_data(
+            roofline={"bound_by": "overhead", "frac_of_peak_bw": 0.01,
+                      "frac_of_peak_flops": 0.001},
+        )
+        assert self._rules_fired(
+            _inputs([("a", "bench", data)])
+        ) == ["dispatch-overhead"]
+
+    def test_fused_not_paying_rule(self):
+        from analyzer_tpu.obs.advisor import advise
+
+        data = _bench_data(fused={"min_over_reference": 0.99, "window": 8})
+        [f] = advise(_inputs([("a", "bench", data)]))["findings"]
+        assert f["rule"] == "fused-not-paying"
+        assert f["env"] == {"BENCH_FUSE_WINDOW": "16"}
+        paying = _bench_data(fused={"min_over_reference": 0.7, "window": 8})
+        assert self._rules_fired(_inputs([("a", "bench", paying)])) == []
+
+    def test_tier_thrash_rule(self):
+        from analyzer_tpu.obs.advisor import advise
+
+        data = _bench_data(
+            tiered={"hit_rate": 0.91, "min_over_resident": 1.4,
+                    "hot_rows": 4096},
+        )
+        [f] = advise(_inputs([("a", "bench", data)]))["findings"]
+        assert f["rule"] == "tier-thrash"
+        assert f["env"] == {"BENCH_HOT_ROWS": "8192"}
+        assert len(f["evidence"]) == 2
+
+    def test_feed_starved_rule(self):
+        data = _bench_data(
+            telemetry={"feed": {"starved_total": 12,
+                                "backpressure_total": 3}},
+        )
+        assert self._rules_fired(
+            _inputs([("a", "bench", data)])
+        ) == ["feed-starved"]
+        # Backpressure-dominated: the host is ahead, rule holds.
+        data = _bench_data(
+            telemetry={"feed": {"starved_total": 2,
+                                "backpressure_total": 9}},
+        )
+        assert self._rules_fired(_inputs([("a", "bench", data)])) == []
+
+    def test_native_fallback_rules_lead_the_table(self):
+        ingest = {"metric": "ingest.rows_per_sec", "value": 1.0,
+                  "ingest": {"native": False}}
+        migrate = {"metric": "migrate.matches_per_sec", "value": 1.0,
+                   "migrate": {"assign_native": False}}
+        bench = _bench_data(roofline={"device_idle_frac": 0.9})
+        fired = self._rules_fired(_inputs([
+            ("a", "bench", bench), ("b", "ingest", ingest),
+            ("c", "migrate", migrate),
+        ]))
+        # Severity order: rebuild the native codecs before tuning knobs.
+        assert fired == [
+            "ingest-native-fallback", "migrate-assign-fallback",
+            "device-idle",
+        ]
+
+    def test_queue_wait_and_growth_rules(self):
+        soak = {"metric": "soak.matches_per_sec", "value": 1.0,
+                "slo": {"dominant_stage": "queue_wait"}}
+        hist = {"series": {"broker.queue_depth": {
+            "rings": {"raw": [[0.0, 3.0], [1.0, 9.0]]}}}}
+        fired = self._rules_fired(
+            _inputs([("a", "soak", soak)], history=hist)
+        )
+        assert fired == ["queue-wait-dominant", "queue-depth-growing"]
+        flat = {"series": {"broker.queue_depth": {
+            "rings": {"raw": [[0.0, 3.0], [1.0, 4.0]]}}}}
+        assert self._rules_fired(_inputs(history=flat)) == []
+
+    def test_plan_prefix_rule(self):
+        from analyzer_tpu.obs.advisor import advise
+
+        mig = {"metric": "migrate.matches_per_sec", "value": 1.0,
+               "migrate": {"plan_windows": 8, "prefix_windows": 8}}
+        [f] = advise(_inputs([("a", "migrate", mig)]))["findings"]
+        assert f["rule"] == "plan-prefix-exhausted"
+        assert f["env"] == {"BENCH_MIGRATE_PLAN_WINDOWS": "16"}
+        mig = {"metric": "migrate.matches_per_sec", "value": 1.0,
+               "migrate": {"plan_windows": 8, "prefix_windows": 3}}
+        assert self._rules_fired(_inputs([("a", "migrate", mig)])) == []
+
+    def test_bandwidth_roof_rule_is_informational(self):
+        from analyzer_tpu.obs.advisor import advise
+
+        data = _bench_data(
+            roofline={"bound_by": "memory", "frac_of_peak_bw": 0.62},
+        )
+        [f] = advise(_inputs([("a", "bench", data)]))["findings"]
+        assert f["rule"] == "bandwidth-roof"
+        assert f["env"] == {} and f["flags"] == []
+
+    def test_snippet_merges_env_without_duplicates(self):
+        from analyzer_tpu.obs.advisor import advise
+
+        data = _bench_data(
+            roofline={"device_idle_frac": 0.55, "bound_by": "overhead",
+                      "frac_of_peak_bw": 0.01, "frac_of_peak_flops": 0.01},
+            fused={"window": 16},
+        )
+        report = advise(_inputs([("a", "bench", data)]))
+        # device-idle and dispatch-overhead both want the fuse window;
+        # the snippet carries the key once (first writer wins).
+        assert report["snippet"].count("BENCH_FUSE_WINDOW") == 1
+
+
+class TestAdvisorDeterminism:
+    def _seed_dir(self, tmp_path):
+        art = _bench_data(
+            roofline={"bound_by": "overhead", "frac_of_peak_bw": 0.01,
+                      "frac_of_peak_flops": 0.001,
+                      "device_idle_frac": 0.55},
+            fused={"min_over_reference": 0.99, "window": 16},
+            tiered={"hit_rate": 0.91, "min_over_resident": 1.4,
+                    "hot_rows": 4096},
+            telemetry={"feed": {"starved_total": 12,
+                                "backpressure_total": 3}},
+        )
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(art))
+        return tmp_path
+
+    def test_byte_identical_report(self, tmp_path):
+        from analyzer_tpu.obs.advisor import (
+            advise,
+            gather_inputs,
+            render_report,
+        )
+
+        d = str(self._seed_dir(tmp_path))
+        one = advise(gather_inputs(scan_dir=d))
+        two = advise(gather_inputs(scan_dir=d))
+        assert json.dumps(one, sort_keys=True) == json.dumps(
+            two, sort_keys=True
+        )
+        assert render_report(one) == render_report(two)
+        assert render_report(one).endswith("\n")
+
+    def test_gather_scans_known_families_only(self, tmp_path):
+        from analyzer_tpu.obs.advisor import gather_inputs
+
+        self._seed_dir(tmp_path)
+        (tmp_path / "NOTES.json").write_text(json.dumps({"metric": "x"}))
+        (tmp_path / "BENCH_bad.json").write_text("{torn")
+        inputs = gather_inputs(scan_dir=str(tmp_path))
+        assert [os.path.basename(a["path"]) for a in inputs["artifacts"]] \
+            == ["BENCH_r01.json"]
+
+    def test_gather_joins_profile_and_history(self, tmp_path):
+        from analyzer_tpu.obs.advisor import advise, gather_inputs
+
+        self._seed_dir(tmp_path)
+        (tmp_path / "history.json").write_text(json.dumps({"series": {}}))
+        inputs = gather_inputs(
+            paths=[str(tmp_path / "BENCH_r01.json"),
+                   str(tmp_path / "history.json")],
+            profile_dir=OK_DIR,
+        )
+        assert inputs["history"] == {"series": {}}
+        assert inputs["profile"]["parsed"] is True
+        report = advise(inputs)
+        assert report["profile"]["dominant_kernel"] == "fusion.update"
+        assert report["profile"]["device_idle_frac"] == pytest.approx(0.3)
+
+
+# -- the operator surfaces: cli profile / tune / benchdiff --------------
+
+
+class TestCliSurfaces:
+    def test_cli_profile_names_the_dominant_kernel(self, capsys):
+        from analyzer_tpu.cli import main
+
+        assert main(["profile", OK_DIR]) == 0
+        out = capsys.readouterr().out
+        assert "dominant kernel: fusion.update" in out
+        assert "idle 30.0%" in out
+
+    def test_cli_profile_torn_exits_nonzero(self, capsys):
+        from analyzer_tpu.cli import main
+
+        assert main(["profile", TORN_DIR]) == 1
+        assert "parsed: false" in capsys.readouterr().out
+
+    def test_cli_profile_json_with_host_trace_join(self, capsys, tmp_path):
+        from analyzer_tpu.cli import main
+
+        host = tmp_path / "host.jsonl"
+        host.write_text(
+            "".join(json.dumps(e) + "\n" for e in _host_events())
+        )
+        rc = main(["profile", OK_DIR, "--trace-events", str(host),
+                   "--json"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["parsed"] is True
+        d = doc["dispatch_decomposition"]
+        assert d["scope"] == "manifest"
+        assert d["dispatch_ms"] == pytest.approx(2.0)
+        assert d["device_execute_ms"] == pytest.approx(0.35)
+
+    def test_cli_tune_is_byte_identical_across_runs(self, capsys,
+                                                    tmp_path):
+        from analyzer_tpu.cli import main
+
+        art = _bench_data(roofline={"bound_by": "overhead",
+                                    "frac_of_peak_bw": 0.01,
+                                    "frac_of_peak_flops": 0.001})
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(art))
+        assert main(["tune", "--dir", str(tmp_path)]) == 0
+        one = capsys.readouterr().out
+        assert main(["tune", "--dir", str(tmp_path)]) == 0
+        two = capsys.readouterr().out
+        assert one == two
+        assert "bottleneck: per-dispatch fixed cost" in one
+        assert "export BENCH_FUSE_WINDOW=32" in one
+
+    def test_cli_tune_empty_dir_exits_2(self, tmp_path, capsys):
+        from analyzer_tpu.cli import main
+
+        assert main(["tune", "--dir", str(tmp_path)]) == 2
+        capsys.readouterr()
+
+    def test_benchdiff_gates_device_idle_regression(self, tmp_path,
+                                                    capsys):
+        from analyzer_tpu.cli import main
+
+        a = tmp_path / "BENCH_r01.json"
+        b = tmp_path / "BENCH_r02.json"
+        a.write_text(json.dumps(
+            _bench_data(roofline={"device_idle_frac": 0.1})
+        ))
+        b.write_text(json.dumps(
+            _bench_data(roofline={"device_idle_frac": 0.5})
+        ))
+        assert main(["benchdiff", str(a), str(b)]) == 1
+        b.write_text(json.dumps(
+            _bench_data(roofline={"device_idle_frac": 0.1})
+        ))
+        assert main(["benchdiff", str(a), str(b)]) == 0
+        capsys.readouterr()
+
+    def test_benchdiff_gates_vanished_profile_block(self, tmp_path,
+                                                    capsys):
+        from analyzer_tpu.cli import main
+
+        a = tmp_path / "BENCH_r01.json"
+        b = tmp_path / "BENCH_r02.json"
+        a.write_text(json.dumps(
+            _bench_data(profile={"parsed": True, "dir": "cap"})
+        ))
+        b.write_text(json.dumps(_bench_data()))
+        assert main(["benchdiff", str(a), str(b)]) == 1
+        assert "capture attribution silently broke" in \
+            capsys.readouterr().err
+        # Candidate still parsing: clean.
+        b.write_text(json.dumps(
+            _bench_data(profile={"parsed": True, "dir": "cap2"})
+        ))
+        assert main(["benchdiff", str(a), str(b)]) == 0
+        # Baseline never profiled: a candidate without one cannot gate.
+        a.write_text(json.dumps(_bench_data()))
+        b.write_text(json.dumps(_bench_data()))
+        assert main(["benchdiff", str(a), str(b)]) == 0
+        capsys.readouterr()
+
+
+class TestRegistrySchema:
+    def test_profile_series_predeclared(self):
+        from analyzer_tpu.obs.registry import (
+            SCHEMA_HELP,
+            STANDARD_COUNTERS,
+            STANDARD_GAUGES,
+        )
+
+        assert "profile.captures_parsed_total" in STANDARD_COUNTERS
+        assert "profile.device_idle_frac" in STANDARD_GAUGES
+        assert "profile.captures_parsed_total" in SCHEMA_HELP
+        assert "profile.device_idle_frac" in SCHEMA_HELP
