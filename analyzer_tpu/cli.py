@@ -960,6 +960,22 @@ def cmd_benchdiff(args) -> int:
                 f"{len(violations)} soak SLO(s)", file=sys.stderr,
             )
             rc = 1
+        # The vanished-block contract for the rating-quality plane: a
+        # baseline whose artifact carried a calibration `quality` block
+        # and a candidate without one means the ledger silently
+        # disengaged (quality=False leaked into CI, or the scoring
+        # site was dropped) — a delta gate would just diff fewer
+        # configs and report "no regressions".
+        a_quality = isinstance(a_raw.get("quality"), dict)
+        b_quality = isinstance(b_raw.get("quality"), dict)
+        if a_quality and not b_quality:
+            print(
+                f"error: {os.path.basename(b_path)} has no rating-quality "
+                f"block but {os.path.basename(a_path)} does (calibration "
+                "ledger silently disengaged?)",
+                file=sys.stderr,
+            )
+            return 1
     if args.family == "tiered" and a and not b:
         # The baseline captured a tiered block but the candidate has
         # none: the run silently fell back to untiered — exactly the
@@ -1181,6 +1197,104 @@ def cmd_history(args) -> int:
         f"samples, last_t={last_t}"
     )
     sys.stdout.write(render_history(payload, tier=args.tier))
+    return 0
+
+
+def cmd_quality(args) -> int:
+    """Rating-quality report (docs/observability.md "Rating quality"):
+    the calibration ledger's reliability table, streaming Brier /
+    log-loss / ECE, and the population-drift verdict — from a live
+    worker's ``/qualityz`` (``--url``), from a saved soak artifact's
+    ``quality`` block (``--artifact``), or from this process's own
+    ledger (mostly empty outside a run). ``--fit-temperature`` fits a
+    post-hoc temperature over the live ledger's retained (logit,
+    outcome) prefix (models/calibration.py) — a fitted T far from 1.0
+    quantifies HOW miscalibrated the predictor is, not merely that the
+    ECE floor tripped."""
+    summary = None
+    if args.url:
+        import urllib.request
+
+        url = args.url.rstrip("/") + "/qualityz"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                summary = json.load(resp)
+        except OSError as err:
+            print(f"error: cannot fetch {url}: {err}", file=sys.stderr)
+            return 2
+        if not summary.get("enabled", True):
+            print(
+                "error: worker runs with the quality ledger disabled",
+                file=sys.stderr,
+            )
+            return 2
+    elif args.artifact:
+        try:
+            with open(args.artifact, encoding="utf-8") as f:
+                artifact = json.load(f)
+        except (OSError, ValueError) as err:
+            print(f"error: cannot read artifact: {err}", file=sys.stderr)
+            return 2
+        summary = artifact.get("quality")
+        if not isinstance(summary, dict):
+            print(
+                "error: artifact has no quality block (soak ran with "
+                "--no-quality?)", file=sys.stderr,
+            )
+            return 2
+    ledger = None
+    if summary is None:
+        from analyzer_tpu.obs.quality import get_quality_ledger
+
+        ledger = get_quality_ledger()
+        if ledger is None:
+            from analyzer_tpu.config import RatingConfig
+            from analyzer_tpu.obs.quality import CalibrationLedger
+
+            ledger = CalibrationLedger(RatingConfig(), mirror=False)
+        summary = ledger.summary()
+    if args.fit_temperature:
+        if ledger is None:
+            print(
+                "error: --fit-temperature needs the live ledger's "
+                "retained (logit, outcome) pairs — /qualityz and the "
+                "artifact carry only their count", file=sys.stderr,
+            )
+            return 2
+        import numpy as np
+
+        from analyzer_tpu.models.calibration import fit_temperature
+
+        z, y = ledger.retained()
+        if not z.size:
+            print(
+                "error: no retained (logit, outcome) pairs to fit",
+                file=sys.stderr,
+            )
+            return 2
+
+        def _nll(t: float) -> float:
+            zz = np.clip(z / t, -30.0, 30.0)
+            p = 1.0 / (1.0 + np.exp(-zz))
+            eps = 1e-12
+            return float(-np.mean(
+                y * np.log(p + eps) + (1.0 - y) * np.log(1.0 - p + eps)
+            ))
+
+        t = fit_temperature(z, y)
+        summary["temperature"] = {
+            "t": round(float(t), 4),
+            "nll_before": round(_nll(1.0), 6),
+            "nll_after": round(_nll(float(t)), 6),
+            "n": int(z.size),
+        }
+    if args.json:
+        json.dump(summary, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+        return 0
+    from analyzer_tpu.obs.quality import render_quality
+
+    sys.stdout.write(render_quality(summary))
     return 0
 
 
@@ -1660,6 +1774,7 @@ def cmd_soak(args) -> int:
         audit_sample_denom=args.audit_sample_denom,
         migrate=args.migrate,
         migrate_matches=args.migrate_matches,
+        quality=not args.no_quality,
     )
     driver = SoakDriver(cfg)
     try:
@@ -1689,6 +1804,34 @@ def cmd_soak(args) -> int:
             print(f"SLO VIOLATION: {v}", file=sys.stderr)
         return 1
     return 0
+
+
+def _migrate_quality(data: bytes, report, pre_live_view, cfg):
+    """The staging-vs-live replay judge (obs/quality.py
+    :func:`score_table`): scores the migrated table AND the
+    pre-migration live table over the SAME replay window with the
+    identical serve-plane Phi link — did the re-rate produce a
+    better-fitting table than the lineage it replaced? Advisory (the
+    migrated table saw these matches, the live one may not have — a
+    fit gap is expected; the alarm is a MIGRATED table that fits
+    worse)."""
+    import io as _io
+
+    import numpy as np
+
+    from analyzer_tpu.io.csv_codec import load_stream_csv
+    from analyzer_tpu.obs.quality import score_table
+
+    stream = load_stream_csv(_io.StringIO(data.decode("utf-8")))
+    keys = ("matches_scored", "brier", "logloss", "ece")
+    migrated = score_table(np.asarray(report.state.table), stream, cfg)
+    out = {"migrated": {k: migrated[k] for k in keys}}
+    if pre_live_view is not None:
+        live_q = score_table(
+            np.asarray(pre_live_view.host_table()), stream, cfg
+        )
+        out["live_pre_cutover"] = {k: live_q[k] for k in keys}
+    return out
 
 
 def cmd_migrate(args) -> int:
@@ -1773,6 +1916,10 @@ def cmd_migrate(args) -> int:
             engine_kw["window_rows"] = args.window_rows
         if args.plan_windows:
             engine_kw["plan_windows"] = args.plan_windows
+        # Snapshot the pre-migration live view NOW — the cutover inside
+        # run_migration repoints `live` at the migrated table, and the
+        # replay judge needs the table being REPLACED.
+        pre_live_view = live.current()
         with timer.phase("migrate"):
             report = run_migration(
                 state, data, cfg,
@@ -1791,6 +1938,15 @@ def cmd_migrate(args) -> int:
             )
         if report.finished:
             _obs_write(args)
+        quality = None
+        if report.finished and not args.no_quality:
+            with timer.phase("quality"):
+                try:
+                    quality = _migrate_quality(
+                        data, report, pre_live_view, cfg
+                    )
+                except Exception as e:  # noqa: BLE001 — advisory evidence
+                    quality = {"error": repr(e)}
         stats = report.stats
         print(json.dumps({
             "matches": stats.get("matches"),
@@ -1807,6 +1963,7 @@ def cmd_migrate(args) -> int:
             ),
             "cutover_pause_ms": report.cutover_pause_ms,
             "lineage_live_version": live.version,
+            "quality": quality,
             "phases": {k: round(v, 3) for k, v in timer.report().items()},
         }))
         return 0
@@ -2270,6 +2427,34 @@ def main(argv=None) -> int:
     s.set_defaults(fn=cmd_history)
 
     s = sub.add_parser(
+        "quality",
+        help="rating-quality report: calibration reliability table, "
+        "Brier/log-loss/ECE, population drift (live /qualityz, a saved "
+        "soak artifact, or this process's ledger) "
+        "(docs/observability.md \"Rating quality\")",
+    )
+    s.add_argument(
+        "--url", metavar="URL",
+        help="fetch from a live worker's obsd endpoint "
+        "(e.g. http://127.0.0.1:9100 — /qualityz is appended)",
+    )
+    s.add_argument(
+        "--artifact", metavar="PATH",
+        help="read the quality block of a saved SOAK_*.json artifact",
+    )
+    s.add_argument(
+        "--fit-temperature", action="store_true",
+        help="fit a post-hoc temperature over the live ledger's "
+        "retained (logit, outcome) prefix (models/calibration.py) and "
+        "report NLL before/after — quantifies over/under-confidence",
+    )
+    s.add_argument(
+        "--json", action="store_true",
+        help="dump the summary as JSON instead of the rendered report",
+    )
+    s.set_defaults(fn=cmd_quality)
+
+    s = sub.add_parser(
         "fleet",
         help="fleet observability plane: scrape N workers' obsd "
         "endpoints, merge registries under host=, evaluate fleet-scope "
@@ -2467,6 +2652,12 @@ def main(argv=None) -> int:
         "either way)",
     )
     s.add_argument(
+        "--no-quality", action="store_true",
+        help="disable the calibration ledger (the rating-quality "
+        "bit-identity AB knob; the artifact loses its `quality` block "
+        "and the deterministic block is identical either way)",
+    )
+    s.add_argument(
         "--migrate", action="store_true",
         help="run a full zero-downtime re-rate UNDER the live soak "
         "load: the streamed backfill engine rates a seeded synthetic "
@@ -2549,6 +2740,12 @@ def main(argv=None) -> int:
     s.add_argument("--obs-port", type=int, metavar="PORT")
     s.add_argument("--metrics-out", metavar="PATH")
     s.add_argument("--trace-events", metavar="PATH")
+    s.add_argument(
+        "--no-quality", action="store_true",
+        help="skip the staging-vs-live calibration replay judge "
+        "(obs/quality.py score_table; it re-reads the stream once per "
+        "lineage, so very large histories may want this)",
+    )
     s.set_defaults(fn=cmd_migrate)
 
     s = sub.add_parser("worker", help="broker-consuming service loop")

@@ -150,6 +150,14 @@ RULES: dict[str, str] = {
         "literal (>= 1e10) outside obs/hw.py, the roofline ledger's "
         "one sanctioned peak table"
     ),
+    "GL047": (
+        "rating-quality purity: a wall-clock read in obs/quality.py "
+        "(the calibration ledger is clock-injected — the soak's "
+        "quality block is byte-identical per (seed, config)), or a "
+        "float threshold literal outside the module's one declared "
+        "QUALITY_TABLE (bin edges and alert floors have ONE home; "
+        "0.0/0.5/1.0/2.0 arithmetic identities are exempt)"
+    ),
 }
 
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")

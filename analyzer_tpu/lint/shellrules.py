@@ -284,6 +284,26 @@ _GL046_PEAK_HOME = ("analyzer_tpu/obs/hw.py",)
 #: ban needs no allowlist of innocents.
 _GL046_PEAK_MIN = 1e10  # graftlint: disable=GL046 — the rule's own threshold
 
+#: The rating-quality plane's home (GL047): the calibration ledger
+#: (``analyzer_tpu/obs/quality.py``) is CLOCK-INJECTED like the
+#: history/SLO plane — the soak's ``quality`` block must be
+#: byte-identical per (seed, config), so the module may never own a
+#: clock (clock half), and every tunable float threshold — bin edges,
+#: PSI/ECE alert floors, epsilons — must live inside the module's ONE
+#: declared table (literal half): a pasted magic number elsewhere
+#: silently forks the calibration verdict the live objective, the soak
+#: artifact check, and benchdiff are all judged against.
+_GL047_FILES = ("analyzer_tpu/obs/quality.py",)
+
+#: The one sanctioned home for the quality plane's threshold literals:
+#: float constants outside this module-level assignment's span flag.
+_GL047_TABLE = "QUALITY_TABLE"
+
+#: Float literals that are arithmetic identity/structure, not tunable
+#: thresholds: 0.0 accumulator seeds, 0.5 (the Phi link's midpoint),
+#: 1.0 complements, 2.0 (the erfc normalizer).
+_GL047_FLOAT_OK = (0.0, 0.5, 1.0, 2.0)
+
 #: Wall-clock reads GL028 bans in loadgen decision paths. Pacing and
 #: measured-latency reads carry line-scoped disables with reasons.
 #: (GL032 reuses the same needle set for the SLO plane's modules.)
@@ -350,6 +370,10 @@ class ShellRules:
         federate_home = self._in_federate_home()
         profile_plane = self._in_profile_plane_layer()
         peak_home = self._in_peak_home()
+        quality_home = self._in_quality_home()
+        quality_table_span = (
+            self._quality_table_span() if quality_home else None
+        )
         tests = self._in_tests()
         pallas_home = self._in_pallas_home()
         table_home = self._in_table_home()
@@ -385,6 +409,8 @@ class ShellRules:
                     self._check_slo_plane_clock(node)
                 if profile_plane:
                     self._check_profile_plane_clock(node)
+                if quality_home:
+                    self._check_quality_plane_clock(node)
                 if federate_home:
                     self._check_federate_clock(node)
                 elif not tests:
@@ -438,6 +464,26 @@ class ShellRules:
                         "verdict is judged against; import it from "
                         "analyzer_tpu.obs.hw (PEAKS / peaks_for) instead",
                     )
+                elif (
+                    quality_home
+                    and isinstance(node.value, float)
+                    and node.value not in _GL047_FLOAT_OK
+                    and not (
+                        quality_table_span is not None
+                        and quality_table_span[0]
+                        <= node.lineno
+                        <= quality_table_span[1]
+                    )
+                ):
+                    self._flag(
+                        "GL047", node,
+                        f"float threshold literal {node.value!r} outside "
+                        f"{_GL047_TABLE} — the quality plane's bin edges "
+                        "and alert floors have ONE home; a magic number "
+                        "here silently forks the calibration verdict the "
+                        "live objective, the soak artifact check, and "
+                        "benchdiff are all judged against",
+                    )
         return self.findings
 
     def _in_timed_layer(self) -> bool:
@@ -487,6 +533,27 @@ class ShellRules:
     def _in_federate_home(self) -> bool:
         path = self.path.replace("\\", "/")
         return any(path.endswith(frag) for frag in _GL034_FEDERATE_FILES)
+
+    def _in_quality_home(self) -> bool:
+        path = self.path.replace("\\", "/")
+        return any(path.endswith(frag) for frag in _GL047_FILES)
+
+    def _quality_table_span(self) -> tuple[int, int] | None:
+        """The module-level ``QUALITY_TABLE = {...}`` assignment's line
+        span — the one sanctioned home for the quality plane's float
+        threshold literals. ``None`` (table missing or renamed) makes
+        EVERY non-exempt float flag: deleting the table must not
+        silently disarm the rule."""
+        for stmt in self.tree.body:
+            targets: tuple = ()
+            if isinstance(stmt, ast.Assign):
+                targets = tuple(stmt.targets)
+            elif isinstance(stmt, ast.AnnAssign):
+                targets = (stmt.target,)
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == _GL047_TABLE:
+                    return (stmt.lineno, stmt.end_lineno or stmt.lineno)
+        return None
 
     def _in_profile_plane_layer(self) -> bool:
         path = self.path.replace("\\", "/")
@@ -826,6 +893,24 @@ class ShellRules:
                 "these modules analyze recorded artifacts and must be "
                 "deterministic; timestamps come from the capture, never "
                 "from a clock",
+            )
+
+    def _check_quality_plane_clock(self, node: ast.Call) -> None:
+        """GL047 (clock half): a wall-clock read inside the rating-
+        quality plane (obs/quality.py). The calibration ledger is
+        clock-injected like the history/SLO plane — ``observe_population
+        (now=...)`` takes the caller's timestamp (the worker's clock,
+        under the soak the VirtualClock) — so the soak's ``quality``
+        block stays byte-identical per (seed, config); one stray
+        ``time.monotonic()`` would silently break that contract."""
+        resolved = self.imports.resolve(node.func)
+        if resolved in _GL028_CLOCKS:
+            self._flag(
+                "GL047", node,
+                f"wall-clock read `{resolved}` in the clock-injected "
+                "rating-quality plane (obs/quality.py) — take `now` "
+                "from the caller (the worker's clock / the soak's "
+                "VirtualClock); this module must never own a clock",
             )
 
     def _check_federate_clock(self, node: ast.Call) -> None:

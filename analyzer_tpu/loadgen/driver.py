@@ -169,6 +169,14 @@ class SoakConfig:
     # block is BIT-IDENTICAL with a scraper attached or absent (the
     # scrape path is read-only; pinned by tests/test_federate.py).
     obs_port: int | None = None
+    # Rating-quality plane (obs/quality.py): the calibration ledger
+    # scores every committed batch's PRE-update win probability against
+    # the realized outcome; the artifact gains a `quality` block and
+    # the calibration artifact check (obs/slo.py) gates the verdict
+    # once the volume floor is met. Observer-only: the deterministic
+    # block is BIT-IDENTICAL with the plane on or off (the AB knob,
+    # `cli soak --no-quality`; pinned by tests/test_quality.py).
+    quality: bool = True
 
     @property
     def n_ticks(self) -> int:
@@ -228,6 +236,7 @@ class SoakDriver:
             serve_shards=cfg.serve_shards, obs_port=cfg.obs_port,
             slo_plane=cfg.slo_plane, audit=cfg.audit,
             audit_seed=cfg.seed, audit_sample_denom=cfg.audit_sample_denom,
+            quality=cfg.quality,
         )
         self.players = synthetic_players(cfg.n_players, seed=cfg.seed)
         self.outcomes = OutcomeModel(
@@ -545,6 +554,7 @@ class SoakDriver:
             return block
         stats = res["stats"]
         pre_version = self.worker.view_publisher.version
+        pre_cutover_view = self.worker.view_publisher.current()
         bit_identical = bool(
             np.array_equal(res["table"], self._mig_reference, equal_nan=True)
         )
@@ -581,7 +591,43 @@ class SoakDriver:
                 "post_cutover_live": view.version,
             },
         )
+        if self.cfg.quality:
+            try:
+                block["quality"] = self._migration_quality(
+                    res["table"], pre_cutover_view
+                )
+            except Exception as e:  # noqa: BLE001 — advisory evidence only
+                block["quality"] = {"error": repr(e)}
         return block
+
+    def _migration_quality(self, migrated_table, live_view) -> dict | None:
+        """The staging-vs-live replay judge (obs/quality.py
+        :func:`score_table`): both lineages score the IDENTICAL
+        migration window with the identical serve-plane link — did the
+        backfill produce a better-fitting table than the live lineage
+        it replaces? Advisory evidence (never gates the verdict: the
+        live lineage never saw this window, so a fit gap is expected —
+        the signal is a *migrated* table that fits WORSE)."""
+        import io as _io
+
+        import numpy as np
+
+        from analyzer_tpu.io.csv_codec import load_stream_csv
+        from analyzer_tpu.obs.quality import score_table
+
+        if live_view is None:
+            return None
+        stream = load_stream_csv(_io.StringIO(self._mig_data.decode()))
+        keys = ("matches_scored", "brier", "logloss", "ece")
+        migrated = score_table(migrated_table, stream, self.rating_config)
+        live = score_table(
+            np.asarray(live_view.host_table()), stream, self.rating_config
+        )
+        return {
+            "replay_matches": self.cfg.migrate_matches,
+            "migrated": {k: migrated[k] for k in keys},
+            "live_pre_cutover": {k: live[k] for k in keys},
+        }
 
     # -- query workload ----------------------------------------------------
     def _issue_queries(self, n: int, latencies_ms: list,
@@ -816,6 +862,14 @@ class SoakDriver:
                     {k: m[k] for k in ("kind", "key", "version")}
                     for m in self.worker.auditor.mismatches[:8]
                 ]
+        if self.worker.quality is not None:
+            # The calibration ledger's evidence (obs/quality.py):
+            # OUTSIDE the deterministic block — but itself
+            # deterministic per (seed, config), byte-identical across
+            # reruns (pinned by tests/test_quality.py). Attached
+            # BEFORE soak_violations so the calibration artifact
+            # check (obs/slo.py) judges this run's own reliability.
+            artifact["quality"] = self.worker.quality.summary()
         if cfg.migrate:
             # Deterministic block is captured above; the cutover (and
             # its version bump) happens only now. The migration's own

@@ -128,6 +128,32 @@ def bench_configs(data: dict) -> list[BenchConfig]:
                     degraded=degraded,
                 )
             )
+        # Rating-quality scores (the artifact's `quality` block,
+        # obs/quality.py): Brier and ECE diff lower-is-better, so a
+        # candidate that degrades calibration FAILS the soak family
+        # even when its throughput improved. A candidate that LOSES
+        # the block entirely is gated separately (`cli benchdiff
+        # --family soak` fails a vanished quality block outright
+        # rather than silently diffing fewer configs).
+        quality = data.get("quality") or {}
+        if quality.get("brier") is not None:
+            out.append(
+                BenchConfig(
+                    name="quality.brier",
+                    value=float(quality["brier"]),
+                    higher_is_better=False,
+                    degraded=degraded,
+                )
+            )
+        if quality.get("ece") is not None:
+            out.append(
+                BenchConfig(
+                    name="quality.ece",
+                    value=float(quality["ece"]),
+                    higher_is_better=False,
+                    degraded=degraded,
+                )
+            )
         return out
     if str(data["metric"]).startswith("ingest."):
         # Ingest family (``INGEST_BENCH_*``, metric
@@ -388,12 +414,13 @@ def family_configs(
     must fail on its own ratio even when headline throughput holds, and
     a capture that silently fell back to untiered (no tiered block at
     all) shows up as "no comparable configs" instead of a clean pass.
-    The ``soak`` family likewise keeps only ``soak.*`` configs (its
-    absolute SLO gate is :func:`soak_slo_violations`, not a delta)."""
+    The ``soak`` family likewise keeps only ``soak.*`` plus the
+    rating-quality ``quality.*`` configs (its absolute SLO gate is
+    :func:`soak_slo_violations`, not a delta)."""
     if family == "tiered":
         return [c for c in configs if c.name.startswith("tiered.")]
     if family == "soak":
-        return [c for c in configs if c.name.startswith("soak.")]
+        return [c for c in configs if c.name.startswith(("soak.", "quality."))]
     if family == "ingest":
         return [c for c in configs if c.name.startswith("ingest.")]
     if family == "migrate":

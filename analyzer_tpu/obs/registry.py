@@ -308,6 +308,21 @@ STANDARD_COUNTERS = (
     # attributed a capture reads 0, and a candidate whose parser broke
     # reads a vanished delta in benchdiff, not a missing series.
     "profile.captures_parsed_total",
+    # The rating-quality plane (obs/quality.py, docs/observability.md
+    # "Rating quality"): matches scored against their pre-update
+    # predicted win probability, plus the streaming Brier/log-loss sums
+    # and the per-bin reliability counts. COUNTERS by design: they sum,
+    # so the fleet merge stays exact and the live calibration-floor
+    # objective computes an exact windowed ECE from history-ring deltas
+    # (quality.bin_count{bin=} / bin_p_sum{bin=} / bin_y_sum{bin=}
+    # labeled series appear on first score). Pre-declared so "nothing
+    # scored" reads 0, not missing.
+    "quality.matches_scored_total",
+    "quality.brier_sum",
+    "quality.logloss_sum",
+    "quality.bin_count",
+    "quality.bin_p_sum",
+    "quality.bin_y_sum",
 )
 STANDARD_GAUGES = (
     "worker.pipeline_lag",
@@ -385,6 +400,12 @@ STANDARD_GAUGES = (
     # high idle inside the window = dispatches too small to amortize
     # launch latency.
     "profile.device_idle_frac",
+    # The rating-quality plane's derived running means (scrape-page
+    # conveniences — the counters above are the source of truth) and
+    # the population-drift PSI against the pinned reference window.
+    "quality.brier",
+    "quality.ece",
+    "quality.psi_mu",
 )
 
 #: Histogram families the runtime emits (graftlint GL030 resolves
@@ -579,6 +600,17 @@ SCHEMA_HELP = {
         "device-profile capture dirs attributed end-to-end",
     "profile.device_idle_frac":
         "device-idle fraction of the last attributed capture window",
+    "quality.matches_scored_total":
+        "rated matches scored against their pre-update win probability",
+    "quality.brier_sum": "running Brier-score sum over scored matches",
+    "quality.logloss_sum": "running log-loss sum over scored matches",
+    "quality.bin_count": "scored matches per reliability bin",
+    "quality.bin_p_sum": "predicted-probability sum per reliability bin",
+    "quality.bin_y_sum": "realized-outcome sum per reliability bin",
+    "quality.brier": "running mean Brier score (lower = better)",
+    "quality.ece": "running expected calibration error (lower = better)",
+    "quality.psi_mu":
+        "population-stability index of mu vs the pinned reference window",
     "phase_seconds": "wall seconds per instrumented phase",
     "sched.pack_occupancy": "per-schedule slot occupancy distribution",
     "serve.microbatch_occupancy": "per-tick serve microbatch fill",

@@ -23,6 +23,10 @@ Everything the snapshot artifact exposes post-hoc (``--metrics-out``,
                            picks one downsampling tier);
   ``GET /sloz``            the SLO watchdog's objective table and
                            burn states (``obs/slo.py``);
+  ``GET /qualityz``        the rating-quality ledger's reliability
+                           table, streaming brier/log-loss/ECE and
+                           population-drift snapshot
+                           (``obs/quality.py``);
   ``GET /debug/snapshot``  the full JSON snapshot, spans included;
   ``GET /debug/flight``    TRIGGERS a flight-recorder dump
                            (``?reason=...``) — the fleet Collector's
@@ -145,6 +149,7 @@ class ObsServer:
                 "/statusz": lambda params: text_body(self._statusz()),
                 "/historyz": self._route_historyz,
                 "/sloz": self._route_sloz,
+                "/qualityz": self._route_qualityz,
                 "/debug/snapshot": self._route_snapshot,
                 "/debug/flight": self._route_flight,
             },
@@ -193,6 +198,22 @@ class ObsServer:
         body = json.dumps(
             get_watchdog().status(), indent=1, sort_keys=True
         )
+        return 200, body + "\n", "application/json"
+
+    def _route_qualityz(self, params) -> tuple[int, str, str]:
+        """The rating-quality plane (obs/quality.py): the live ledger's
+        full reliability table + drift snapshot, or an explicit
+        ``enabled: false`` when this process runs no ledger — a scraper
+        can tell "plane off" from "broken" (the same presence contract
+        as stats()['quality'])."""
+        from analyzer_tpu.obs.quality import get_quality_ledger
+
+        ledger = get_quality_ledger()
+        payload = (
+            {"enabled": False} if ledger is None
+            else dict(ledger.summary(), enabled=True)
+        )
+        body = json.dumps(payload, indent=1, sort_keys=True)
         return 200, body + "\n", "application/json"
 
     def _route_flight(self, params) -> tuple[int, str, str]:
@@ -246,6 +267,7 @@ class ObsServer:
         "tier.host_bytes",
         "device.live_buffers",
         "audit.mismatches_total",
+        "quality.matches_scored_total",
     )
 
     def _statusz(self) -> str:

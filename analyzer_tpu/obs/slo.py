@@ -43,6 +43,7 @@ import dataclasses
 import threading
 
 from analyzer_tpu.obs.registry import get_registry
+from analyzer_tpu.obs.quality import QUALITY_TABLE as _QUALITY_TABLE
 
 #: Live evaluation kinds (docs/observability.md "SLO engine"):
 #:   counter_zero  any increment over the short window burns
@@ -54,9 +55,16 @@ from analyzer_tpu.obs.registry import get_registry
 #:   ratio_min     metric/(metric+metric_b) delta-ratio over the longest
 #:                 window below threshold burns (tier hit-rate floor);
 #:                 skipped below ``min_volume`` events
+#:   calibration   windowed expected calibration error, computed EXACTLY
+#:                 from the labeled ``quality.bin_p_sum{bin=}`` /
+#:                 ``bin_y_sum{bin=}`` ring deltas normalized by the
+#:                 ``metric`` (scored-matches) delta, above threshold
+#:                 over the longest window burns; skipped below
+#:                 ``min_volume`` scored matches (obs/quality.py)
 #:   artifact      no live half — artifact-mode check only
 LIVE_KINDS = (
     "counter_zero", "counter_rate", "gauge_max", "gauge_growth", "ratio_min",
+    "calibration",
 )
 
 
@@ -164,6 +172,19 @@ STANDARD_OBJECTIVES = (
         ),
     ),
     Objective(
+        "calibration-floor", "calibration", "quality.matches_scored_total",
+        threshold=_QUALITY_TABLE["ece_alert"],
+        min_volume=float(_QUALITY_TABLE["min_matches"]),
+        artifact_check="calibration",
+        description=(
+            "windowed expected calibration error of served win "
+            "probabilities vs realized outcomes — the first MODEL-"
+            "QUALITY objective (obs/quality.py); evaluated only past "
+            "min_volume scored matches, thresholds shared with the "
+            "quality plane's one declared table"
+        ),
+    ),
+    Objective(
         "bounded-memory-growth", "gauge_growth", "device.live_buffers",
         threshold=200.0,
         description=(
@@ -261,6 +282,36 @@ def evaluate_live(obj: Objective, history, now: float) -> Burn:
             obj.name, ratio < obj.threshold, ratio,
             f"{obj.metric}/({obj.metric}+{obj.metric_b}) = {ratio:.3f} "
             f"over {w:g}s (SLO: >= {obj.threshold:g})",
+        )
+    if obj.kind == "calibration":
+        # Exact windowed ECE from ring deltas: counters sum, so
+        # sum_b |Δbin_p_sum_b - Δbin_y_sum_b| / Δscored IS the ECE of
+        # exactly the matches scored inside the window (obs/quality.py
+        # ece_from_bins documents the identity). The labeled series
+        # appear on first score; a bin with no history contributes no
+        # gap, which under-counts only if the ring never sampled it —
+        # and the volume guard (from the same deltas) covers that.
+        w = obj.windows[-1]
+        got = history.window_delta(obj.metric, w, now)
+        if got is None:
+            return Burn(obj.name, False, None, "no history yet")
+        total, span = got
+        if total < obj.min_volume:
+            return Burn(
+                obj.name, False, None,
+                f"below min volume ({total:g} < {obj.min_volume:g})",
+            )
+        gap = 0.0
+        for k in range(int(_QUALITY_TABLE["bins"])):
+            p = history.window_delta(f"quality.bin_p_sum{{bin={k}}}", w, now)
+            y = history.window_delta(f"quality.bin_y_sum{{bin={k}}}", w, now)
+            if p is not None and y is not None:
+                gap += abs(p[0] - y[0])
+        ece = gap / total
+        return Burn(
+            obj.name, ece > obj.threshold, ece,
+            f"windowed ece {ece:.3f} over {total:g} matches / {w:g}s "
+            f"(SLO: <= {obj.threshold:g})",
         )
     return Burn(obj.name, False, None, f"artifact-only ({obj.kind})")
 
@@ -512,6 +563,31 @@ def _check_audit_mismatches(data, det, thr, obj):
     return None
 
 
+def _check_calibration(data, det, thr, obj):
+    # The rating-quality gate (obs/quality.py): the quality block rides
+    # OUTSIDE the deterministic block, like audit — the plane is an
+    # observer and the deterministic block stays bit-identical with the
+    # plane on or off. Absent block = plane off = nothing to gate (the
+    # vanished-block regression is benchdiff's job, mirroring the
+    # ingest/migrate vanished-native gates); below the volume floor the
+    # verdict is withheld, like the live min_volume guard.
+    quality = data.get("quality")
+    if not isinstance(quality, dict):
+        return None
+    n = quality.get("matches_scored") or 0
+    if n < thr.get("min_quality_matches", obj.min_volume):
+        return None
+    ece = quality.get("ece")
+    cap = thr.get("max_ece", obj.threshold)
+    if ece is not None and ece > cap:
+        return (
+            f"quality ece {ece:g} above {cap:g} over {n} scored matches "
+            "(served win probabilities are mis-calibrated; "
+            "docs/OPERATIONS.md \"Triaging a calibration burn\")"
+        )
+    return None
+
+
 _ARTIFACT_CHECKS = {
     "dead_letters": _check_dead_letters,
     "retraces_steady": _check_retraces,
@@ -522,6 +598,7 @@ _ARTIFACT_CHECKS = {
     "latency_cap": _check_latency_cap,
     "dominant_stage": _check_dominant_stage,
     "audit_mismatches": _check_audit_mismatches,
+    "calibration": _check_calibration,
 }
 
 

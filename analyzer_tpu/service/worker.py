@@ -115,6 +115,7 @@ class Worker:
         audit: bool | None = None,
         audit_sample_denom: int | None = None,
         audit_seed: int = 0,
+        quality: bool = True,
         history_interval_s: float = 1.0,
     ) -> None:
         self.broker = broker
@@ -326,6 +327,25 @@ class Worker:
                     ),
                 )
                 self.query_engine.auditor = self.auditor
+        # The rating-quality plane (obs/quality.py, docs/observability.md
+        # "Rating quality"): at every sequential commit the ledger
+        # scores the batch's PRE-update predicted win probabilities
+        # (the serve plane's exact Phi link over the prior ratings)
+        # against the realized outcomes, mirrored into quality.*
+        # counters; drift snapshots ride the throttled _slo_tick. An
+        # observer by construction — nothing here feeds back into the
+        # rating path, so the soak's deterministic block is
+        # bit-identical with the plane on or off (quality=False is the
+        # AB knob, like slo_plane).
+        self.quality = None
+        if quality:
+            from analyzer_tpu.obs.quality import (
+                CalibrationLedger,
+                set_quality_ledger,
+            )
+
+            self.quality = CalibrationLedger(self.rating_config)
+            set_quality_ledger(self.quality)
 
     # -- micro-batcher ----------------------------------------------------
     def poll(self) -> bool:
@@ -434,6 +454,17 @@ class Worker:
                     reg.gauge("serve.view_age_seconds").set(round(age, 3))
             if self.auditor is not None:
                 self.auditor.drain(limit=64)
+            if self.quality is not None and self.view_publisher is not None:
+                # Population-drift snapshot over the COMMITTED table
+                # (the served view — the same surface readers see):
+                # PSI vs the pinned reference window + sigma
+                # convergence by games-played cohort, throttled to the
+                # history interval like everything else on this tick.
+                view = self.view_publisher.current()
+                if view is not None:
+                    self.quality.observe_population(
+                        view.host_table(), now=now
+                    )
             self.history.sample(now)
             if self.watchdog is not None:
                 self.watchdog.check(now)
@@ -449,6 +480,22 @@ class Worker:
         logger.warning(
             "SLO burn: %s — %s", objective.name, burn.detail
         )
+        if (
+            getattr(objective, "kind", None) == "calibration"
+            and self.quality is not None
+        ):
+            # Name the worst reliability bin while the evidence is
+            # fresh — the triage runbook's first question is WHERE the
+            # predictions are off, not just that they are.
+            wb = self.quality.worst_bin()
+            if wb is not None:
+                logger.warning(
+                    "calibration burn: worst reliability bin "
+                    "[%s, %s): mean_p=%s mean_y=%s over %s matches",
+                    wb["lo"], wb["hi"], wb["mean_p"], wb["mean_y"],
+                    wb["count"],
+                )
+                self.flight.note("quality.worst_bin", **wb)
         self.flight.note(
             "slo.burn", objective=objective.name, detail=burn.detail
         )
@@ -887,6 +934,16 @@ class Worker:
             # The watchdog is process-wide; a closed worker must not
             # keep receiving burn callbacks through it.
             self.watchdog.on_burn = None
+        if self.quality is not None:
+            from analyzer_tpu.obs.quality import (
+                get_quality_ledger,
+                set_quality_ledger,
+            )
+
+            # The /qualityz registration is process-wide; release it
+            # only if it is still ours (a newer worker may own it).
+            if get_quality_ledger() is self.quality:
+                set_quality_ledger(None)
         if self.serve_server is not None:
             self.serve_server.close()
             self.serve_server = None
@@ -1051,6 +1108,13 @@ class Worker:
         )
         if not n:
             return []
+        # Pre-update prior snapshot for the calibration ledger: ONE
+        # compact row gather (never the whole table), taken before
+        # rate_history may donate the state buffer; scored after the
+        # commit below (obs/quality.py).
+        q_prior = (
+            self._quality_prior(enc) if self.quality is not None else None
+        )
         with tracer.span("batch.pack", cat="worker", matches=n):
             sched = self._bucketed_schedule(enc.stream, enc.state.pad_row)
         with tracer.span(
@@ -1072,6 +1136,8 @@ class Worker:
         # the serving plane see this batch's posteriors only once the
         # store does (no-op without serve_port).
         self._publish_view(enc, final_state.table)
+        if q_prior is not None:
+            self._score_quality(q_prior)
         self.matches_rated += n
         self.batches_ok += 1
         logger.info(
@@ -1081,6 +1147,37 @@ class Worker:
         return [
             m if isinstance(m, str) else m.api_id for m in enc.matches
         ]
+
+    def _quality_prior(self, enc) -> tuple | None:
+        """The calibration ledger's input: a host snapshot of the
+        PRE-update table plus host views of the batch's stream. One
+        whole-table device_get per batch — shape-stable, so it never
+        touches the compile cache (a compact device GATHER of just the
+        batch's rows would retrace on every distinct row count and
+        trip the soak's flat-retrace SLO). Never raises — the quality
+        plane is an observer and must not take down the consume loop."""
+        import numpy as np
+
+        try:
+            return (
+                np.asarray(enc.state.table),
+                np.asarray(enc.stream.player_idx),
+                np.asarray(enc.stream.winner),
+                np.asarray(enc.stream.mode_id),
+                np.asarray(enc.stream.afk),
+                int(enc.state.pad_row),
+            )
+        except Exception:  # noqa: BLE001 — observer plane
+            logger.exception("quality prior snapshot failed")
+            return None
+
+    def _score_quality(self, prior: tuple) -> None:
+        """Scores one committed batch against its pre-update priors."""
+        try:
+            table, idx, winner, mode_id, afk, pad = prior
+            self.quality.score_batch(table, idx, winner, mode_id, afk, pad)
+        except Exception:  # noqa: BLE001 — observer plane
+            logger.exception("quality scoring failed")
 
     # -- serving plane ----------------------------------------------------
     @thread_role("consumer")
@@ -1265,6 +1362,13 @@ class Worker:
                     ),
                 }
                 if self.watchdog is not None else None
+            ),
+            # The rating-quality plane's digest (None when the ledger
+            # is off): matches scored against their pre-update win
+            # probability, running brier/ece, drift PSI — /qualityz
+            # carries the full reliability table (obs/quality.py).
+            "quality": (
+                self.quality.stats() if self.quality is not None else None
             ),
         }
 

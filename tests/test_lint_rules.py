@@ -2407,3 +2407,99 @@ class TestGL046ProfilePlane:
         from analyzer_tpu.lint.findings import RULES
 
         assert "GL046" in RULES
+
+
+class TestGL047QualityPlane:
+    """GL047 guards the rating-quality plane (obs/quality.py): the
+    calibration ledger is clock-injected — the soak's `quality` block
+    is byte-identical per (seed, config), so the module never owns a
+    clock — and every float threshold literal lives inside the one
+    declared QUALITY_TABLE (a pasted magic number elsewhere silently
+    forks the calibration verdict)."""
+
+    WALL_CLOCK_SRC = """
+    import time
+
+    def snapshot():
+        return {"t": time.monotonic()}
+    """
+
+    def test_wall_clock_fires_only_in_quality_module(self):
+        assert "GL047" in rules_of(
+            self.WALL_CLOCK_SRC, "analyzer_tpu/obs/quality.py"
+        )
+        for path in (
+            "analyzer_tpu/obs/prof.py",  # the capture side owns clocks
+            "analyzer_tpu/service/worker.py",
+        ):
+            assert "GL047" not in rules_of(self.WALL_CLOCK_SRC, path), path
+
+    def test_every_wall_clock_needle_fires(self):
+        src = """
+        import time
+        import datetime
+
+        def bad():
+            time.time()
+            time.perf_counter()
+            time.sleep(1)
+            datetime.datetime.now()
+        """
+        assert rules_of(src, "analyzer_tpu/obs/quality.py") == ["GL047"] * 4
+
+    def test_float_literal_outside_table_fires(self):
+        src = """
+        QUALITY_TABLE = {
+            "ece_alert": 0.25,
+            "prob_eps": 1e-6,
+        }
+
+        def check(ece):
+            return ece > 0.3
+        """
+        assert rules_of(src, "analyzer_tpu/obs/quality.py") == ["GL047"]
+
+    def test_table_span_and_exempt_floats_stay_clean(self):
+        src = """
+        QUALITY_TABLE = {
+            "ece_alert": 0.25,
+            "psi_eps": 1e-4,
+        }
+
+        def complement(p):
+            return 1.0 - max(p, 0.0) + 0.5 * 2.0
+        """
+        assert rules_of(src, "analyzer_tpu/obs/quality.py") == []
+
+    def test_missing_table_flags_every_float(self):
+        # Renaming/deleting the table must not silently disarm the rule.
+        src = """
+        THRESHOLDS = {"ece_alert": 0.25}
+        """
+        assert rules_of(src, "analyzer_tpu/obs/quality.py") == ["GL047"]
+
+    def test_int_literals_are_out_of_scope(self):
+        src = """
+        QUALITY_TABLE = {"bins": 10}
+
+        def pick(k):
+            return min(k, 10 - 1)
+        """
+        assert rules_of(src, "analyzer_tpu/obs/quality.py") == []
+
+    def test_line_scoped_disable_works(self):
+        src = """
+        QUALITY_TABLE = {"ece_alert": 0.25}
+        LEGACY = 0.2  # graftlint: disable=GL047 — migration shim
+        """
+        assert rules_of(src, "analyzer_tpu/obs/quality.py") == []
+
+    def test_shipping_quality_module_is_clean(self):
+        mod = "analyzer_tpu/obs/quality.py"
+        with open(os.path.join(_REPO, mod), encoding="utf-8") as f:
+            assert rules_of(f.read(), mod) == []
+
+    def test_catalog_has_gl047(self):
+        from analyzer_tpu.lint.findings import RULES
+
+        assert "GL047" in RULES

@@ -1,0 +1,758 @@
+"""Rating-quality observability tests (ISSUE 18): the online
+calibration ledger (obs/quality.py), population-drift telemetry, and
+the first model-quality SLO.
+
+The load-bearing pins:
+
+  * the ledger's scores are EXACTLY the serve-plane Phi link
+    (serve/oracle.py win_probability) recomputed over the pre-update
+    priors — scoring at the worker's commit site reproduces an
+    independent oracle replay bit-for-bit;
+  * the soak's deterministic block is BIT-IDENTICAL with the quality
+    plane on vs off per (seed, config), and the `quality` block itself
+    is byte-identical across reruns;
+  * summed per-bin counters from independent ledgers reproduce the
+    union ledger's ECE exactly (the fleet-federation identity);
+  * a doctored outcome stream trips the calibration-floor objective in
+    all three consumers: the SoakDriver verdict, `cli benchdiff
+    --family soak`, and the live watchdog (ring-fed on an injected
+    clock);
+  * `cli benchdiff --family soak` fails outright when the candidate
+    LOSES the quality block the baseline had;
+  * temperature fitting (models/calibration.py) is deterministic,
+    handles empty/degenerate inputs, and never worsens NLL.
+"""
+
+import json
+import math
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analyzer_tpu.config import RatingConfig, ServiceConfig
+from analyzer_tpu.core.state import MU_LO, SIGMA_LO, PlayerState
+from analyzer_tpu.io.synthetic import synthetic_players, synthetic_stream
+from analyzer_tpu.obs import (
+    get_registry,
+    reset_flight_recorder,
+    reset_history,
+    reset_registry,
+    reset_watchdog,
+)
+from analyzer_tpu.obs.history import HistorySampler
+from analyzer_tpu.obs.quality import (
+    QUALITY_TABLE,
+    CalibrationLedger,
+    ece_from_bins,
+    get_quality_ledger,
+    render_quality,
+    reset_quality_ledger,
+    score_table,
+    set_quality_ledger,
+)
+from analyzer_tpu.obs.slo import Watchdog, soak_violations
+from analyzer_tpu.obs.tracer import reset_tracer
+from analyzer_tpu.serve.oracle import win_probability
+from analyzer_tpu.service import InMemoryBroker, InMemoryStore, Worker
+from tests.fakes import fake_match, fake_participant, fake_player, fake_roster
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    reset_registry()
+    reset_tracer()
+    reset_flight_recorder()
+    reset_history()
+    reset_watchdog()
+    reset_quality_ledger()
+    yield
+    reset_registry()
+    reset_tracer()
+    reset_flight_recorder()
+    reset_history()
+    reset_watchdog()
+    reset_quality_ledger()
+
+
+def http_get(url: str) -> tuple[int, str]:
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode("utf-8")
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode("utf-8")
+
+
+def _rated_table(n_players: int, seed: int = 0) -> np.ndarray:
+    """A host [n+1, 16] table with rated (non-NaN) shared mu/sigma."""
+    state = PlayerState.create(n_players, cfg=RatingConfig())
+    table = np.asarray(state.table).copy()
+    rng = np.random.default_rng(seed)
+    table[:n_players, MU_LO] = rng.normal(1500.0, 300.0, n_players)
+    table[:n_players, SIGMA_LO] = rng.uniform(50.0, 400.0, n_players)
+    return table
+
+
+def _stream(n_matches: int, n_players: int, seed: int = 0):
+    players = synthetic_players(n_players, seed=seed)
+    return synthetic_stream(n_matches, players, seed=seed)
+
+
+def _oracle_replay(table, stream, beta2):
+    """The independent recomputation the ledger must reproduce."""
+    pad_row = table.shape[0] - 1
+    out = []
+    for b in range(stream.player_idx.shape[0]):
+        if int(stream.mode_id[b]) < 0 or bool(stream.afk[b]):
+            continue
+        rows_a = [int(r) for r in stream.player_idx[b, 0]
+                  if 0 <= int(r) != pad_row]
+        rows_b = [int(r) for r in stream.player_idx[b, 1]
+                  if 0 <= int(r) != pad_row]
+        if not rows_a or not rows_b:
+            continue
+        p = float(win_probability(table, rows_a, rows_b, beta2))
+        y = 1.0 if int(stream.winner[b]) == 0 else 0.0
+        out.append((p, y))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The ledger
+# ---------------------------------------------------------------------------
+
+
+class TestCalibrationLedger:
+    def test_scores_are_the_oracle_link_exactly(self):
+        cfg = RatingConfig()
+        table = _rated_table(60)
+        stream = _stream(80, 60, seed=3)
+        ledger = CalibrationLedger(cfg, mirror=False)
+        n = ledger.score_batch(
+            table, stream.player_idx, stream.winner, stream.mode_id,
+            stream.afk, pad_row=table.shape[0] - 1,
+        )
+        replay = _oracle_replay(table, stream, cfg.beta2)
+        assert n == len(replay) > 0
+        s = ledger.summary()
+        assert s["matches_scored"] == n
+        brier = sum((p - y) ** 2 for p, y in replay) / n
+        assert s["brier"] == round(brier, 6)
+        total_count = sum(b["count"] for b in s["bins"])
+        assert total_count == n
+
+    def test_summary_deterministic_per_stream(self):
+        cfg = RatingConfig()
+        table = _rated_table(40, seed=1)
+        stream = _stream(50, 40, seed=7)
+        out = []
+        for _ in range(2):
+            led = CalibrationLedger(cfg, mirror=False)
+            led.score_batch(
+                table, stream.player_idx, stream.winner, stream.mode_id,
+                stream.afk, pad_row=table.shape[0] - 1,
+            )
+            out.append(json.dumps(led.summary(), sort_keys=True))
+        assert out[0] == out[1]
+
+    def test_unratable_matches_are_skipped(self):
+        cfg = RatingConfig()
+        table = _rated_table(10)
+        idx = np.zeros((3, 2, 3), np.int32)
+        idx[:, 0, :] = [[0, 1, 2]] * 3
+        idx[:, 1, :] = [[3, 4, 5]] * 3
+        winner = np.zeros(3, np.int32)
+        mode = np.asarray([0, -1, 0], np.int32)  # match 1: unsupported
+        afk = np.asarray([False, False, True])   # match 2: AFK
+        led = CalibrationLedger(cfg, mirror=False)
+        n = led.score_batch(table, idx, winner, mode, afk, pad_row=10)
+        assert n == 1
+        assert led.summary()["matches_scored"] == 1
+
+    def test_negative_and_pad_slots_drop_from_teams(self):
+        cfg = RatingConfig()
+        table = _rated_table(10)
+        pad = table.shape[0] - 1
+        # 2v2 padded two ways: -1 (raw stream) and pad_row (packed).
+        idx = np.asarray([[[0, 1, -1], [2, 3, pad]]], np.int32)
+        led = CalibrationLedger(cfg, mirror=False)
+        led.score_batch(
+            table, idx, np.zeros(1, np.int32), np.zeros(1, np.int32),
+            np.zeros(1, bool), pad_row=pad,
+        )
+        z, _ = led.retained()
+        p_direct = float(win_probability(table, [0, 1], [2, 3], cfg.beta2))
+        eps = QUALITY_TABLE["prob_eps"]
+        pc = min(max(p_direct, eps), 1.0 - eps)
+        assert z[0] == pytest.approx(math.log(pc / (1.0 - pc)))
+
+    def test_ece_from_bins_identity(self):
+        # Two bins: perfect calibration in one, 0.5 gap in the other.
+        p_sum = [4 * 0.1, 6 * 0.9]
+        y_sum = [4 * 0.1, 6 * 0.4]
+        ece = ece_from_bins(p_sum, y_sum, 10)
+        assert ece == pytest.approx(6 * 0.5 / 10)
+        assert ece_from_bins([], [], 0) is None
+
+    def test_worst_bin_names_the_largest_gap(self):
+        cfg = RatingConfig()
+        table = _rated_table(30, seed=2)
+        stream = _stream(60, 30, seed=9)
+        led = CalibrationLedger(cfg, mirror=False)
+        led.score_batch(
+            table, stream.player_idx, stream.winner, stream.mode_id,
+            stream.afk, pad_row=table.shape[0] - 1,
+        )
+        wb = led.worst_bin()
+        gaps = [
+            abs(b["mean_p"] - b["mean_y"])
+            for b in led.summary()["bins"] if b["count"]
+        ]
+        assert wb is not None and wb["gap"] == pytest.approx(max(gaps), abs=1e-4)
+
+    def test_fleet_merge_of_summed_bins(self):
+        """Counters sum: two shards' bin counters, added, reproduce the
+        union ledger's ECE exactly — what lets the fleet Collector and
+        the windowed live objective work from sums alone."""
+        cfg = RatingConfig()
+        table = _rated_table(50, seed=4)
+        s1 = _stream(40, 50, seed=11)
+        s2 = _stream(40, 50, seed=12)
+        led1 = CalibrationLedger(cfg, mirror=False)
+        led2 = CalibrationLedger(cfg, mirror=False)
+        union = CalibrationLedger(cfg, mirror=False)
+        pad = table.shape[0] - 1
+        for led, s in ((led1, s1), (led2, s2), (union, s1), (union, s2)):
+            led.score_batch(
+                table, s.player_idx, s.winner, s.mode_id, s.afk, pad_row=pad
+            )
+        merged_p = led1._bin_p_sum + led2._bin_p_sum
+        merged_y = led1._bin_y_sum + led2._bin_y_sum
+        n = led1._n + led2._n
+        assert n == union._n
+        assert ece_from_bins(merged_p, merged_y, n) == pytest.approx(
+            ece_from_bins(union._bin_p_sum, union._bin_y_sum, union._n)
+        )
+
+    def test_score_table_clips_out_of_range_rows(self):
+        cfg = RatingConfig()
+        table = _rated_table(8)
+        idx = np.asarray([[[0, 1, 99], [2, 3, -1]]], np.int32)  # 99 >> rows
+
+        class S:
+            player_idx = idx
+            winner = np.zeros(1, np.int32)
+            mode_id = np.zeros(1, np.int32)
+            afk = np.zeros(1, bool)
+
+        s = score_table(table, S(), cfg)
+        assert s["matches_scored"] == 1
+        assert "drift" not in s  # the replay judge has no population clock
+
+    def test_observe_population_pins_reference_and_tracks_psi(self):
+        cfg = RatingConfig()
+        led = CalibrationLedger(cfg, mirror=False)
+        table = _rated_table(100, seed=5)
+        led.observe_population(table, now=10.0)
+        d0 = led.summary()["drift"]
+        assert d0["psi_mu"] == 0.0 and not d0["psi_alert"]
+        assert d0["t"] == 10.0
+        # A hard mu shift against the pinned reference must alarm.
+        shifted = table.copy()
+        shifted[:100, MU_LO] += 2000.0
+        led.observe_population(shifted, now=20.0)
+        d1 = led.summary()["drift"]
+        assert d1["psi_mu"] > QUALITY_TABLE["psi_alert"]
+        assert d1["psi_alert"]
+
+    def test_render_quality_shapes(self):
+        cfg = RatingConfig()
+        table = _rated_table(30)
+        stream = _stream(40, 30)
+        led = CalibrationLedger(cfg, mirror=False)
+        led.score_batch(
+            table, stream.player_idx, stream.winner, stream.mode_id,
+            stream.afk, pad_row=table.shape[0] - 1,
+        )
+        led.observe_population(table, now=1.0)
+        text = render_quality(led.summary())
+        assert "matches scored" in text and "drift:" in text
+        assert "worst bin" in text
+
+    def test_registry_mirror_series(self):
+        cfg = RatingConfig()
+        table = _rated_table(30)
+        stream = _stream(40, 30)
+        led = CalibrationLedger(cfg)  # mirror=True
+        n = led.score_batch(
+            table, stream.player_idx, stream.winner, stream.mode_id,
+            stream.afk, pad_row=table.shape[0] - 1,
+        )
+        reg = get_registry()
+        assert reg.counter("quality.matches_scored_total").value == n
+        snap = reg.snapshot()
+        assert any(
+            k.startswith("quality.bin_count{") for k in snap["counters"]
+        )
+        assert snap["gauges"]["quality.ece"] is not None
+
+
+# ---------------------------------------------------------------------------
+# Temperature fitting (satellite: the orphaned fit_temperature wired in)
+# ---------------------------------------------------------------------------
+
+
+class TestTemperatureFitting:
+    def _overconfident(self, n=400, scale=3.0, seed=0):
+        rng = np.random.default_rng(seed)
+        z_true = rng.normal(0.0, 1.2, n)
+        p_true = 1.0 / (1.0 + np.exp(-z_true))
+        y = (rng.random(n) < p_true).astype(np.float64)
+        return z_true * scale, y  # logits inflated by `scale`
+
+    def test_deterministic(self):
+        from analyzer_tpu.models.calibration import fit_temperature
+
+        z, y = self._overconfident()
+        assert fit_temperature(z, y) == fit_temperature(z, y)
+
+    def test_empty_and_degenerate(self):
+        from analyzer_tpu.models.calibration import fit_temperature
+
+        assert fit_temperature(np.asarray([]), np.asarray([])) == 1.0
+        # All-one labels: must return a finite T inside the bracket.
+        z = np.asarray([0.5, 1.0, 2.0])
+        t = fit_temperature(z, np.ones(3))
+        assert 0.05 <= t <= 20.0 and np.isfinite(t)
+
+    def test_nll_improves_on_overconfident_logits(self):
+        from analyzer_tpu.models.calibration import fit_temperature
+
+        z, y = self._overconfident(scale=3.0)
+
+        def nll(t):
+            zz = np.clip(z / t, -30, 30)
+            p = 1.0 / (1.0 + np.exp(-zz))
+            eps = 1e-12
+            return float(-np.mean(
+                y * np.log(p + eps) + (1 - y) * np.log(1 - p + eps)
+            ))
+
+        t = fit_temperature(z, y)
+        assert 1.5 < t < 6.0  # recovers the inflation, loosely
+        assert nll(t) < nll(1.0)
+
+    def test_cli_fit_temperature_over_live_ledger(self, capsys):
+        from analyzer_tpu import cli
+
+        cfg = RatingConfig()
+        table = _rated_table(50, seed=6)
+        stream = _stream(60, 50, seed=13)
+        led = CalibrationLedger(cfg, mirror=False)
+        led.score_batch(
+            table, stream.player_idx, stream.winner, stream.mode_id,
+            stream.afk, pad_row=table.shape[0] - 1,
+        )
+        set_quality_ledger(led)
+        rc = cli.main(["quality", "--fit-temperature", "--json"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        payload = json.loads(out)
+        t = payload["temperature"]
+        assert t["n"] == led.summary()["retained"]
+        assert t["nll_after"] <= t["nll_before"]
+        # Rendered mode mentions the fit too.
+        rc = cli.main(["quality", "--fit-temperature"])
+        assert rc == 0
+        assert "temperature:" in capsys.readouterr().out
+
+    def test_cli_fit_temperature_refuses_artifact_source(self, tmp_path):
+        from analyzer_tpu import cli
+
+        art = tmp_path / "SOAK_x.json"
+        art.write_text(json.dumps({"quality": {"matches_scored": 0}}))
+        rc = cli.main([
+            "quality", "--artifact", str(art), "--fit-temperature",
+        ])
+        assert rc == 2
+
+
+# ---------------------------------------------------------------------------
+# The worker's commit site
+# ---------------------------------------------------------------------------
+
+
+def mk_match(api_id, created_at=0, mode="ranked", afk=False):
+    def part(p):
+        return fake_participant(player=p, went_afk=1 if afk else 0)
+
+    players = [
+        fake_player(skill_tier=15, api_id=f"{api_id}-p{i}") for i in range(6)
+    ]
+    m = fake_match(
+        mode,
+        [fake_roster(True, [part(p) for p in players[:3]]),
+         fake_roster(False, [part(p) for p in players[3:]])],
+        api_id=api_id,
+    )
+    m.created_at = created_at
+    return m
+
+
+class TestWorkerCommitSite:
+    def _rig(self, quality=True):
+        broker = InMemoryBroker()
+        store = InMemoryStore()
+        worker = Worker(
+            broker, store, ServiceConfig(batch_size=4, idle_timeout=0.0),
+            RatingConfig(), quality=quality,
+        )
+        return broker, store, worker
+
+    def test_commit_site_scores_against_pre_update_priors(self):
+        broker, store, worker = self._rig()
+        captured = {}
+        real = worker.quality.score_batch
+
+        def spy(table, idx, winner, mode_id, afk, pad_row):
+            captured.update(
+                table=np.array(table, copy=True), idx=np.asarray(idx),
+                winner=np.asarray(winner), pad=pad_row,
+            )
+            return real(table, idx, winner, mode_id, afk, pad_row)
+
+        worker.quality.score_batch = spy
+        try:
+            for i in range(4):
+                store.add_match(mk_match(f"q{i}", created_at=i))
+                broker.publish("analyze", f"q{i}".encode())
+            assert worker.poll()
+        finally:
+            worker.quality.score_batch = real
+            worker.close()
+        assert worker.quality.stats()["matches_scored"] == 4
+        # The captured snapshot is the PRE-update table: recomputing the
+        # oracle link over it reproduces the retained logits bit-for-bit.
+        z, _ = worker.quality.retained()
+        table, idx, pad = captured["table"], captured["idx"], captured["pad"]
+        beta2 = worker.rating_config.beta2
+        eps = QUALITY_TABLE["prob_eps"]
+        for b in range(min(4, idx.shape[0])):
+            rows_a = [int(r) for r in idx[b, 0] if 0 <= int(r) != pad]
+            rows_b = [int(r) for r in idx[b, 1] if 0 <= int(r) != pad]
+            p = float(win_probability(table, rows_a, rows_b, beta2))
+            pc = min(max(p, eps), 1.0 - eps)
+            assert z[b] == pytest.approx(math.log(pc / (1.0 - pc)))
+
+    def test_stats_quality_block_and_none_when_off(self):
+        broker, store, worker = self._rig()
+        try:
+            assert worker.stats()["quality"] == {
+                "matches_scored": 0, "brier": None, "ece": None,
+                "psi_mu": None,
+            }
+        finally:
+            worker.close()
+        broker, store, worker = self._rig(quality=False)
+        try:
+            assert worker.quality is None
+            assert worker.stats()["quality"] is None
+        finally:
+            worker.close()
+
+    def test_close_releases_the_singleton(self):
+        broker, store, worker = self._rig()
+        assert get_quality_ledger() is worker.quality
+        worker.close()
+        assert get_quality_ledger() is None
+
+    def test_qualityz_endpoint(self):
+        broker = InMemoryBroker()
+        store = InMemoryStore()
+        worker = Worker(
+            broker, store, ServiceConfig(batch_size=2, idle_timeout=0.0),
+            RatingConfig(), obs_port=0,
+        )
+        try:
+            store.add_match(mk_match("e0"))
+            store.add_match(mk_match("e1"))
+            broker.publish("analyze", b"e0")
+            broker.publish("analyze", b"e1")
+            worker.poll()
+            code, body = http_get(worker.obs_server.url + "/qualityz")
+            assert code == 200
+            payload = json.loads(body)
+            assert payload["enabled"] is True
+            assert payload["matches_scored"] == 2
+            assert len(payload["bins"]) == QUALITY_TABLE["bins"]
+        finally:
+            worker.close()
+
+    def test_qualityz_reports_disabled_without_ledger(self):
+        worker = Worker(
+            InMemoryBroker(), InMemoryStore(),
+            ServiceConfig(batch_size=2, idle_timeout=0.0), RatingConfig(),
+            obs_port=0, quality=False,
+        )
+        try:
+            code, body = http_get(worker.obs_server.url + "/qualityz")
+            assert code == 200
+            assert json.loads(body) == {"enabled": False}
+        finally:
+            worker.close()
+
+
+# ---------------------------------------------------------------------------
+# Soak integration: bit-identity + determinism + sharded parity
+# ---------------------------------------------------------------------------
+
+
+def _soak_cfg(**kw):
+    from analyzer_tpu.loadgen import SoakConfig
+
+    base = dict(
+        seed=5, duration_s=3.0, tick_s=1.0, qps=10.0, query_qps=4.0,
+        n_players=100, batch_size=32, use_http=False,
+    )
+    base.update(kw)
+    return SoakConfig(**base)
+
+
+def _run_soak(cfg):
+    from analyzer_tpu.loadgen import SoakDriver
+
+    reset_registry()
+    reset_history()
+    reset_watchdog()
+    reset_quality_ledger()
+    driver = SoakDriver(cfg)
+    try:
+        return driver.run()
+    finally:
+        driver.close()
+
+
+@pytest.fixture(scope="module")
+def soak_quality_pair():
+    on = _run_soak(_soak_cfg(quality=True))
+    off = _run_soak(_soak_cfg(quality=False))
+    return on, off
+
+
+class TestSoakQualityBlock:
+    def test_deterministic_block_identical_quality_on_vs_off(
+        self, soak_quality_pair
+    ):
+        on, off = soak_quality_pair
+        assert json.dumps(on["deterministic"], sort_keys=True) == json.dumps(
+            off["deterministic"], sort_keys=True
+        )
+
+    def test_quality_block_present_only_when_on(self, soak_quality_pair):
+        on, off = soak_quality_pair
+        assert "quality" not in off
+        q = on["quality"]
+        assert q["matches_scored"] > 0
+        assert q["brier"] is not None and q["ece"] is not None
+        assert q["drift"] is not None  # the slo-tick snapshots ran
+
+    def test_quality_block_byte_identical_across_reruns(
+        self, soak_quality_pair
+    ):
+        on, _ = soak_quality_pair
+        again = _run_soak(_soak_cfg(quality=True))
+        assert json.dumps(on["quality"], sort_keys=True) == json.dumps(
+            again["quality"], sort_keys=True
+        )
+
+    def test_sharded_plane_scores_identically(self, soak_quality_pair):
+        """The ledger rides the rating path, which serve-plane sharding
+        must not perturb: the quality block is identical with a
+        2-sharded serve plane."""
+        on, _ = soak_quality_pair
+        sharded = _run_soak(_soak_cfg(quality=True, serve_shards=2))
+        assert json.dumps(on["quality"], sort_keys=True) == json.dumps(
+            sharded["quality"], sort_keys=True
+        )
+
+    def test_cli_quality_renders_the_artifact(
+        self, soak_quality_pair, tmp_path, capsys
+    ):
+        from analyzer_tpu import cli
+
+        on, _ = soak_quality_pair
+        path = tmp_path / "SOAK_q.json"
+        path.write_text(json.dumps(on))
+        rc = cli.main(["quality", "--artifact", str(path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "matches scored" in out and "bin" in out
+
+
+# ---------------------------------------------------------------------------
+# The calibration-floor objective: one engine, three consumers
+# ---------------------------------------------------------------------------
+
+
+def _artifact_with_quality(ece, n=200):
+    return {
+        "metric": "soak.matches_per_sec", "value": 50.0,
+        "latency_ms": {"p99": 5.0},
+        "deterministic": {
+            "matches_published": n, "matches_rated": n,
+            "batches_ok": 4, "dead_letters": 0,
+            "view_lag_ticks_max": 0, "queue_depth_final": 0,
+            "retraces_steady": 0, "drained": True,
+        },
+        "slo": {"thresholds": {"max_view_lag_ticks": 2}},
+        "capture": {"degraded": False},
+        "quality": {"matches_scored": n, "ece": ece, "brier": 0.25},
+    }
+
+
+class TestCalibrationObjective:
+    def test_artifact_check_gates_on_ece(self):
+        thr = QUALITY_TABLE["ece_alert"]
+        assert soak_violations(_artifact_with_quality(thr - 0.05)) == []
+        v = soak_violations(_artifact_with_quality(thr + 0.1))
+        assert len(v) == 1 and "calibration" in v[0]
+        assert "Triaging a calibration burn" in v[0]
+
+    def test_artifact_check_volume_guard_and_absent_block(self):
+        low = _artifact_with_quality(0.9, n=QUALITY_TABLE["min_matches"] - 1)
+        assert soak_violations(low) == []
+        art = _artifact_with_quality(0.9)
+        del art["quality"]
+        assert soak_violations(art) == []
+
+    def test_benchdiff_delegate_trips_identically(self):
+        from analyzer_tpu.obs.benchdiff import soak_slo_violations
+
+        bad = _artifact_with_quality(0.9)
+        assert soak_slo_violations(bad) == soak_violations(bad) != []
+
+    def test_live_watchdog_burns_on_ring_fed_miscalibration(self):
+        """Consumer 3: quality.* counters ring-fed on an injected clock.
+        The windowed ECE is exact from bin-counter deltas — miscalibrated
+        sums burn, calibrated sums do not, and sub-volume traffic is
+        guarded."""
+        reg = get_registry()
+        h = HistorySampler(registry=reg)
+        wd = Watchdog(history=h)
+
+        def feed(p_sum, y_sum, n, t0, t1):
+            reg.counter("quality.matches_scored_total").add(n)
+            reg.counter("quality.bin_p_sum", bin=9).add(p_sum)
+            reg.counter("quality.bin_y_sum", bin=9).add(y_sum)
+            reg.counter("quality.bin_count", bin=9).add(n)
+            t = float(t0)
+            while t < t1:
+                h.sample(t)
+                t += 1.0
+
+        # Calibrated volume: mean_p 0.9, mean_y 0.9 -> no burn.
+        feed(180.0, 180.0, 200, 0, 400)
+        wd.check(399.0)
+        assert "calibration-floor" not in wd.burning
+        # Doctored outcomes: mean_p 0.9 but y all-loss -> windowed ECE
+        # ~0.9 over 200 matches in the last 300s window.
+        feed(180.0, 0.0, 200, 400, 500)
+        wd.check(499.0)
+        assert "calibration-floor" in wd.burning
+        burn = next(
+            o for o in wd.status()["objectives"]
+            if o["name"] == "calibration-floor"
+        )
+        assert burn["state"] == "burning"
+        assert "windowed ece" in burn["detail"]
+
+    def test_live_watchdog_volume_guard(self):
+        reg = get_registry()
+        h = HistorySampler(registry=reg)
+        wd = Watchdog(history=h)
+        # Horribly miscalibrated but BELOW min_matches: no verdict.
+        reg.counter("quality.matches_scored_total").add(10)
+        reg.counter("quality.bin_p_sum", bin=9).add(9.0)
+        reg.counter("quality.bin_y_sum", bin=9).add(0.0)
+        reg.counter("quality.bin_count", bin=9).add(10)
+        t = 0.0
+        while t < 400:
+            h.sample(t)
+            t += 1.0
+        wd.check(399.0)
+        assert "calibration-floor" not in wd.burning
+
+
+class TestDoctoredOutcomeStream:
+    """The end-to-end acceptance pin: doctor the outcome stream (every
+    match reported as a team-A win regardless of the model's p) and the
+    calibration floor trips the SoakDriver verdict AND the benchdiff
+    soak gate on the resulting artifact."""
+
+    @pytest.fixture(scope="class")
+    def doctored_artifact(self):
+        from analyzer_tpu.loadgen.outcomes import OutcomeModel
+
+        real = OutcomeModel.resolve
+
+        def doctored(self, team_a_rows, team_b_rows):
+            winner, p_a = real(self, team_a_rows, team_b_rows)
+            return 0, p_a  # team A always "wins"; the model's p stands
+
+        OutcomeModel.resolve = doctored
+        try:
+            # ~8s x 24qps ~= 192 ratable matches: above the volume floor.
+            art = _run_soak(_soak_cfg(
+                seed=7, duration_s=8.0, qps=24.0, query_qps=2.0,
+            ))
+        finally:
+            OutcomeModel.resolve = real
+        return art
+
+    def test_driver_verdict_trips(self, doctored_artifact):
+        art = doctored_artifact
+        q = art["quality"]
+        assert q["matches_scored"] >= QUALITY_TABLE["min_matches"]
+        assert q["ece"] > QUALITY_TABLE["ece_alert"]
+        assert not art["slo"]["pass"]
+        assert any("calibration" in v for v in art["slo"]["violations"])
+
+    def test_benchdiff_soak_gate_trips(self, doctored_artifact, tmp_path,
+                                       capsys):
+        from analyzer_tpu import cli
+
+        healthy = _artifact_with_quality(0.05)
+        a = tmp_path / "SOAK_r01.json"
+        b = tmp_path / "SOAK_r02.json"
+        a.write_text(json.dumps(healthy))
+        b.write_text(json.dumps(doctored_artifact))
+        rc = cli.main(["benchdiff", str(a), str(b), "--family", "soak"])
+        err = capsys.readouterr()
+        assert rc == 1
+        assert "calibration" in err.out + err.err
+
+    def test_quality_deltas_ride_the_soak_family(self, doctored_artifact):
+        from analyzer_tpu.obs.benchdiff import bench_configs, family_configs
+
+        names = [
+            c.name for c in family_configs(
+                bench_configs(doctored_artifact), "soak"
+            )
+        ]
+        assert "quality.brier" in names and "quality.ece" in names
+
+    def test_benchdiff_fails_vanished_quality_block(self, tmp_path, capsys):
+        from analyzer_tpu import cli
+
+        healthy = _artifact_with_quality(0.05)
+        lost = _artifact_with_quality(0.05)
+        del lost["quality"]
+        a = tmp_path / "SOAK_r01.json"
+        b = tmp_path / "SOAK_r02.json"
+        a.write_text(json.dumps(healthy))
+        b.write_text(json.dumps(lost))
+        rc = cli.main(["benchdiff", str(a), str(b), "--family", "soak"])
+        err = capsys.readouterr().err
+        assert rc == 1
+        assert "no rating-quality block" in err

@@ -647,7 +647,12 @@ class TestStats:
         "serve",
         "migration",
         "slo",
+        "quality",
     }
+
+    #: The calibration ledger's nested keys when quality is on (ISSUE
+    #: 18 — docs/observability.md "Rating quality").
+    QUALITY_SCHEMA = {"matches_scored", "brier", "ece", "psi_mu"}
 
     #: The serving plane's nested keys when serve_port is on (ISSUE 4).
     SERVE_SCHEMA = {"view_version", "view_age_s", "queries_total"}
@@ -681,6 +686,10 @@ class TestStats:
         assert s["serve"] is None
         # No migration ran in this rig either: present, None.
         assert s["migration"] is None
+        # The calibration ledger scored the rated batch (quality=True
+        # by default): the nested schema is pinned like serve's.
+        assert set(s["quality"]) == self.QUALITY_SCHEMA
+        assert s["quality"]["matches_scored"] >= 0
 
     def test_stats_migration_block(self, rig):
         """A live migration surfaces phase/watermark/progress/lineage
