@@ -23,7 +23,12 @@ Subcommands:
           ratings, rate through the worker, query /v1/* concurrently,
           gate SLOs; emits SOAK_*.json for benchdiff --family soak
           (deterministic per seed — docs/OPERATIONS.md); --migrate
-          runs a full re-rate under the live load as the judge
+          runs a full re-rate under the live load as the judge;
+          --hosts N runs the soak over a real multi-process fabric
+          (FABRIC_BENCH_*.json for benchdiff --family fabric)
+  fabric  launch a standing multi-host rate fabric: shard-owning host
+          processes, partitioned ingest, per-host serve planes and
+          /fabric/* control surfaces (docs/fabric.md)
   migrate zero-downtime global re-rate: streamed decode->assign->scan
           backfill into a staging view lineage while the live lineage
           serves, atomic cutover, checkpoint/resume (docs/migration.md)
@@ -976,6 +981,39 @@ def cmd_benchdiff(args) -> int:
                 file=sys.stderr,
             )
             return 1
+    if args.family == "fabric":
+        # The fabric family's ABSOLUTE half, gated on the candidate
+        # alone: lost work, dead letters, view staleness past the
+        # configured tick bound, per-host steady-state retraces,
+        # burning fleet objectives.
+        from analyzer_tpu.obs.benchdiff import fabric_slo_violations
+
+        violations = fabric_slo_violations(b_raw)
+        for v in violations:
+            print(f"SLO VIOLATION: {v}")
+        if violations:
+            print(
+                f"error: {os.path.basename(b_path)} violates "
+                f"{len(violations)} fabric SLO(s)", file=sys.stderr,
+            )
+            rc = 1
+        # The vanished-block contract for the fabric: a baseline
+        # captured over a real multi-host topology and a candidate
+        # whose fleet block reports a single process means the soak
+        # silently fell back to one host — the exact regression this
+        # family exists to catch (a single-process capture flatters
+        # every remote-path number), and one a delta gate would merely
+        # call "faster".
+        a_hosts = int((a_raw.get("fleet") or {}).get("n_hosts") or 1)
+        b_hosts = int((b_raw.get("fleet") or {}).get("n_hosts") or 1)
+        if a_hosts > 1 and b_hosts <= 1:
+            print(
+                f"error: {os.path.basename(b_path)} captured a "
+                f"single-process topology but {os.path.basename(a_path)} "
+                f"ran {a_hosts} hosts (silent fall-back to "
+                "single-process?)", file=sys.stderr,
+            )
+            return 1
     if args.family == "tiered" and a and not b:
         # The baseline captured a tiered block but the candidate has
         # none: the run silently fell back to untiered — exactly the
@@ -1718,6 +1756,8 @@ def cmd_soak(args) -> int:
     from analyzer_tpu.loadgen import SoakConfig, SoakDriver
     from analyzer_tpu.loadgen.driver import write_artifact
 
+    if args.hosts is not None:
+        return _cmd_soak_fabric(args)
     for flag in ("duration", "qps", "tick", "players", "batch_size",
                  "polls_per_tick", "serve_shards", "broker_partitions",
                  "audit_sample_denom", "migrate_matches"):
@@ -1804,6 +1844,151 @@ def cmd_soak(args) -> int:
             print(f"SLO VIOLATION: {v}", file=sys.stderr)
         return 1
     return 0
+
+
+def _cmd_soak_fabric(args) -> int:
+    """``cli soak --hosts N``: the closed-loop soak over a REAL
+    multi-process fabric (analyzer_tpu/fabric) — N shard-owning host
+    subprocesses, broker-partitioned ingest, routed /v1/* queries, and
+    a fleet Collector judging STANDARD_OBJECTIVES across the hosts'
+    obsd planes. The artifact's deterministic block is bit-identical
+    per (seed, config) at any --hosts count (FABRIC_BENCH_*.json, the
+    ``benchdiff --family fabric`` input)."""
+    from analyzer_tpu.fabric.driver import FabricSoakConfig, FabricSoakDriver
+    from analyzer_tpu.loadgen.driver import write_artifact
+
+    for flag in ("hosts", "duration", "qps", "tick", "players",
+                 "batch_size", "fabric_shards"):
+        if getattr(args, flag) <= 0:
+            print(f"error: --{flag.replace('_', '-')} must be positive",
+                  file=sys.stderr)
+            return 2
+    if args.query_qps < 0:
+        print("error: --query-qps must be >= 0 (0 = no read traffic)",
+              file=sys.stderr)
+        return 2
+    if args.fabric_shards < args.hosts:
+        print(
+            "error: --fabric-shards must be >= --hosts (every host "
+            "must own at least one shard)", file=sys.stderr,
+        )
+        return 2
+    cfg = FabricSoakConfig(
+        seed=args.seed,
+        duration_s=args.duration,
+        tick_s=args.tick,
+        qps=args.qps,
+        query_qps=args.query_qps,
+        n_players=args.players,
+        batch_size=args.batch_size,
+        n_shards=args.fabric_shards,
+        n_hosts=args.hosts,
+        team5_frac=args.team5_frac,
+        afk_rate=args.afk_rate,
+        warmup=not args.no_warmup,
+        trace=bool(args.trace or args.trace_events),
+        quality=not args.no_quality,
+        slo_plane=not args.no_slo_plane,
+        max_view_lag_ticks=args.max_view_lag_ticks,
+    )
+    driver = FabricSoakDriver(cfg)
+    try:
+        artifact = driver.run()
+    finally:
+        driver.close()
+    line = {
+        k: artifact[k]
+        for k in ("metric", "value", "latency_ms", "measured", "slo")
+    }
+    line["deterministic"] = artifact["deterministic"]
+    print(json.dumps(line))
+    if args.out:
+        write_artifact(artifact, args.out)
+        print(f"wrote fabric artifact to {args.out}", file=sys.stderr)
+    if not artifact["slo"]["pass"]:
+        for v in artifact["slo"]["violations"]:
+            print(f"SLO VIOLATION: {v}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_fabric(args) -> int:
+    """Bring up a standing fabric: N shard-owning host processes
+    (analyzer_tpu/fabric/process), each with its own partitioned
+    ingest, serve plane, obsd, and /fabric/* control surface. Prints
+    one JSON line per host once its listeners are bound (the
+    serve_url/control_url/obs_port a router or fleet Collector needs),
+    then runs until --duration wall seconds elapse or Ctrl-C, and
+    signals every host down on the way out."""
+    import tempfile
+    import time as _time
+
+    if args.hosts <= 0 or args.shards <= 0:
+        print("error: --hosts and --shards must be positive",
+              file=sys.stderr)
+        return 2
+    if args.shards < args.hosts:
+        print(
+            "error: --shards must be >= --hosts (every host must own "
+            "at least one shard)", file=sys.stderr,
+        )
+        return 2
+    from analyzer_tpu.fabric.driver import spawn_fabric_hosts
+
+    rc = 0
+    with tempfile.TemporaryDirectory(prefix="fabric-cli-") as tmp:
+        exit_file = os.path.join(tmp, "exit")
+        base_spec = {
+            "n_shards": args.shards,
+            "n_hosts": args.hosts,
+            "seed": args.seed,
+            "n_players": args.players,
+            "batch_size": args.batch_size,
+            "max_wall_s": args.duration + 60.0,
+        }
+        hosts: list = []
+        try:
+            hosts = spawn_fabric_hosts(base_spec, tmp, exit_file)
+            for h in hosts:
+                print(json.dumps({
+                    "host": h["host"],
+                    "shards": list(range(h["host"], args.shards,
+                                         args.hosts)),
+                    "serve_url": h["serve_url"],
+                    "control_url": h["control_url"],
+                    "obs_port": h["obs_port"],
+                    "pid": h["pid"],
+                }))
+            sys.stdout.flush()
+            deadline = _time.monotonic() + args.duration
+            try:
+                while _time.monotonic() < deadline:
+                    for h in hosts:
+                        if h["proc"].poll() is not None:
+                            print(
+                                f"error: fabric host {h['host']} exited "
+                                f"rc={h['proc'].returncode}; see "
+                                f"{h['log_path']}", file=sys.stderr,
+                            )
+                            rc = 1
+                    if rc:
+                        break
+                    _time.sleep(0.2)
+            except KeyboardInterrupt:
+                print("interrupt: signalling fabric down", file=sys.stderr)
+        except RuntimeError as err:
+            print(f"error: {err}", file=sys.stderr)
+            rc = 1
+        finally:
+            with open(exit_file, "w", encoding="utf-8") as f:
+                f.write("exit\n")
+            for h in hosts:
+                try:
+                    h["proc"].wait(timeout=30)
+                except Exception:
+                    h["proc"].kill()
+                h["log"].close()
+    return rc
 
 
 def _migrate_quality(data: bytes, report, pre_live_view, cfg):
@@ -2250,7 +2435,10 @@ def main(argv=None) -> int:
     )
     s.add_argument(
         "--family",
-        choices=("bench", "serve", "tiered", "soak", "ingest", "migrate"),
+        choices=(
+            "bench", "serve", "tiered", "soak", "ingest", "migrate",
+            "fabric",
+        ),
         default="bench",
         help="artifact family for --against-latest scans: bench "
         "(BENCH_*.json, the write path), serve (SERVE_BENCH_*.json — "
@@ -2267,7 +2455,11 @@ def main(argv=None) -> int:
         "migrate (MIGRATE_BENCH_*.json from `cli bench --migrate` — "
         "backfill matches/s, live serve p99 under concurrent migration, "
         "cutover pause ms; a candidate whose backfill silently fell "
-        "back to the offline re-rate fails); "
+        "back to the offline re-rate fails), or fabric "
+        "(FABRIC_BENCH_*.json from `cli soak --hosts N` — per-host "
+        "ingest matches/s, routed-query p99, worst per-host view "
+        "staleness, plus the fleet-scope absolute SLOs; a candidate "
+        "that silently fell back to a single-process topology fails); "
         "explicit two-path diffs auto-detect from the metric name",
     )
     s.set_defaults(fn=cmd_benchdiff)
@@ -2506,6 +2698,34 @@ def main(argv=None) -> int:
     s.set_defaults(fn=cmd_fleet)
 
     s = sub.add_parser(
+        "fabric",
+        help="launch a standing multi-host rate fabric: N shard-owning "
+        "host processes with partitioned ingest, per-host serve "
+        "planes, and /fabric/* control surfaces (docs/fabric.md)",
+    )
+    s.add_argument(
+        "--hosts", type=int, default=2, metavar="N",
+        help="host process count (default: 2)",
+    )
+    s.add_argument(
+        "--shards", type=int, default=4, metavar="S",
+        help="shard count; ownership is shard s -> host s %% N, so S "
+        "must be >= --hosts (default: 4)",
+    )
+    s.add_argument("--seed", type=int, default=0)
+    s.add_argument("--players", type=int, default=400)
+    s.add_argument(
+        "--batch-size", type=int, default=64,
+        help="per-host worker micro-batch size (default: 64)",
+    )
+    s.add_argument(
+        "--duration", type=float, default=600.0, metavar="S",
+        help="wall seconds to keep the fabric up (default: 600; ^C "
+        "exits early and signals the hosts down)",
+    )
+    s.set_defaults(fn=cmd_fabric)
+
+    s = sub.add_parser(
         "soak",
         help="closed-loop matchmaking soak with SLO gates "
         "(analyzer_tpu/loadgen; artifact for benchdiff --family soak)",
@@ -2670,6 +2890,22 @@ def main(argv=None) -> int:
     s.add_argument(
         "--migrate-matches", type=int, default=400, metavar="N",
         help="matches in the migrated synthetic history (default: 400)",
+    )
+    s.add_argument(
+        "--hosts", type=int, metavar="N",
+        help="run the soak over a REAL multi-process fabric of N "
+        "shard-owning host subprocesses (analyzer_tpu/fabric): "
+        "broker-partitioned ingest, routed /v1/* queries, fleet-scope "
+        "SLOs; the deterministic block is bit-identical per (seed, "
+        "config) at any N, and the artifact is FABRIC_BENCH-shaped "
+        "(`benchdiff --family fabric`). Flags that configure the "
+        "single-process pipeline shape (--serve-shards, "
+        "--broker-partitions, --migrate, --audit, ...) do not apply",
+    )
+    s.add_argument(
+        "--fabric-shards", type=int, default=4, metavar="S",
+        help="fabric shard count for --hosts (ownership: shard s -> "
+        "host s %% N; must be >= --hosts; default: 4)",
     )
     s.set_defaults(fn=cmd_soak)
 

@@ -158,6 +158,16 @@ RULES: dict[str, str] = {
         "QUALITY_TABLE (bin edges and alert floors have ONE home; "
         "0.0/0.5/1.0/2.0 arithmetic identities are exempt)"
     ),
+    "GL048": (
+        "fabric discipline: a wall-clock read inside analyzer_tpu/"
+        "fabric/ (clock-injected like GL032/GL034/GL046/GL047 — the "
+        "soak's deterministic block is bit-identical per (seed, config) "
+        "at every host count, so decisions ride the injected clock), "
+        "or a direct host_table() access outside fabric/route.py and "
+        "fabric/host.py (cross-host table reads go through the "
+        "directory/route helpers; a raw read of a non-owned shard is "
+        "the torn-view bug the version protocol prevents)"
+    ),
 }
 
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")

@@ -304,6 +304,28 @@ _GL047_TABLE = "QUALITY_TABLE"
 #: 1.0 complements, 2.0 (the erfc normalizer).
 _GL047_FLOAT_OK = (0.0, 0.5, 1.0, 2.0)
 
+#: The multi-host rate fabric (GL048): every module under
+#: ``analyzer_tpu/fabric/`` is CLOCK-INJECTED (clock half) — the soak's
+#: deterministic block must be bit-identical per (seed, config) at every
+#: host count, so fabric decisions ride the driver's VirtualClock; the
+#: subprocess liveness loop and measured remote latencies carry
+#: line-scoped disables with reasons. The access half forces cross-host
+#: table reads through the directory/route helpers: a direct
+#: ``host_table()`` on a non-owned shard is exactly the torn-view bug
+#: the version protocol exists to prevent.
+_GL048_DIRS = ("analyzer_tpu/fabric/",)
+
+#: The sanctioned homes for raw ``host_table()`` access inside the
+#: fabric: route.py (the kernel-replay read path, behind the directory's
+#: staleness bound) and host.py (a host reading its OWN view).
+_GL048_TABLE_HOMES = (
+    "analyzer_tpu/fabric/route.py",
+    "analyzer_tpu/fabric/host.py",
+)
+
+#: The attribute whose bare use outside the table homes flags.
+_GL048_TABLE_ATTR = "host_table"
+
 #: Wall-clock reads GL028 bans in loadgen decision paths. Pacing and
 #: measured-latency reads carry line-scoped disables with reasons.
 #: (GL032 reuses the same needle set for the SLO plane's modules.)
@@ -374,6 +396,8 @@ class ShellRules:
         quality_table_span = (
             self._quality_table_span() if quality_home else None
         )
+        fabric_layer = self._in_fabric_layer()
+        fabric_table_home = self._in_fabric_table_home()
         tests = self._in_tests()
         pallas_home = self._in_pallas_home()
         table_home = self._in_table_home()
@@ -411,6 +435,8 @@ class ShellRules:
                     self._check_profile_plane_clock(node)
                 if quality_home:
                     self._check_quality_plane_clock(node)
+                if fabric_layer:
+                    self._check_fabric_clock(node)
                 if federate_home:
                     self._check_federate_clock(node)
                 elif not tests:
@@ -434,6 +460,20 @@ class ShellRules:
                         "in backfill code — a torn migration is a silent "
                         "correctness bug; consume the immutable current() "
                         "snapshot or the public version property instead",
+                    )
+                elif (
+                    fabric_layer
+                    and not (tests or fabric_table_home)
+                    and node.attr == _GL048_TABLE_ATTR
+                ):
+                    self._flag(
+                        "GL048", node,
+                        f"direct `.{_GL048_TABLE_ATTR}()` access in fabric "
+                        "code outside route.py/host.py — a raw table read "
+                        "of a non-owned shard is the torn cross-host view "
+                        "the version protocol exists to prevent; go "
+                        "through FabricRouter / the directory's staleness-"
+                        "bounded client helpers instead",
                     )
             elif isinstance(node, (ast.Import, ast.ImportFrom)):
                 if not obs_layer:
@@ -537,6 +577,14 @@ class ShellRules:
     def _in_quality_home(self) -> bool:
         path = self.path.replace("\\", "/")
         return any(path.endswith(frag) for frag in _GL047_FILES)
+
+    def _in_fabric_layer(self) -> bool:
+        path = self.path.replace("\\", "/")
+        return any(frag in path for frag in _GL048_DIRS)
+
+    def _in_fabric_table_home(self) -> bool:
+        path = self.path.replace("\\", "/")
+        return any(path.endswith(frag) for frag in _GL048_TABLE_HOMES)
 
     def _quality_table_span(self) -> tuple[int, int] | None:
         """The module-level ``QUALITY_TABLE = {...}`` assignment's line
@@ -911,6 +959,27 @@ class ShellRules:
                 "rating-quality plane (obs/quality.py) — take `now` "
                 "from the caller (the worker's clock / the soak's "
                 "VirtualClock); this module must never own a clock",
+            )
+
+    def _check_fabric_clock(self, node: ast.Call) -> None:
+        """GL048 (clock half): a wall-clock read inside the multi-host
+        rate fabric (``analyzer_tpu/fabric/``). The fabric's headline
+        contract is a deterministic soak block that is bit-identical per
+        (seed, config) at every host count — so every DECISION
+        (matchmaking, drain barriers, staleness checks, burn windows)
+        rides the driver's injected VirtualClock. A stray
+        ``time.time()`` would fork behavior per topology silently. The
+        genuinely wall-shaped reads (subprocess liveness deadlines,
+        measured remote-call latency) carry line-scoped disables with
+        reasons."""
+        resolved = self.imports.resolve(node.func)
+        if resolved in _GL028_CLOCKS:
+            self._flag(
+                "GL048", node,
+                f"wall-clock read `{resolved}` in the clock-injected "
+                "fabric (analyzer_tpu/fabric/) — take `now` from the "
+                "caller (the soak driver's VirtualClock); a decision on "
+                "wall time forks the deterministic block per host count",
             )
 
     def _check_federate_clock(self, node: ast.Call) -> None:

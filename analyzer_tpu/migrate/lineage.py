@@ -99,6 +99,26 @@ class LineageManager:
         )
         return self.staging
 
+    def begin_fabric(self, directory, host: int, clock=None):
+        """The sharded-backfill seam for one fabric host: mints the
+        staging lineage exactly like :meth:`begin`, then returns it
+        WRAPPED in a :class:`~analyzer_tpu.fabric.publish.
+        FabricShardPublisher` — the host's re-rate publishes a staging
+        lineage scoped to its OWNED shards (non-owned patches emptied),
+        every staging version recorded in ``directory`` so the fleet
+        can watch per-owner backfill progress before any cutover.
+
+        ``self.staging`` stays the RAW publisher: :meth:`cutover` and
+        :meth:`abort` operate on the lineage itself, not the ownership
+        filter (``cutover_from`` consumes publisher internals the
+        wrapper deliberately does not proxy).
+        """
+        from analyzer_tpu.fabric.publish import FabricShardPublisher
+
+        return FabricShardPublisher(
+            directory, host, self.begin(), clock=clock
+        )
+
     def versions(self) -> dict:
         """Operator snapshot: the two lineages' current versions."""
         return {

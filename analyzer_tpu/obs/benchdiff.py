@@ -155,6 +155,39 @@ def bench_configs(data: dict) -> list[BenchConfig]:
                 )
             )
         return out
+    if str(data["metric"]).startswith("fabric."):
+        # Fabric family (``FABRIC_BENCH_*``, metric
+        # ``fabric.matches_per_sec_per_host``): per-host ingest
+        # matches/s (higher — the scaling headline), the routed query
+        # workload's client-observed p99 (lower — the cross-host read
+        # tax), and the worst per-host view staleness in ticks (lower —
+        # a host whose version stopped advancing under load is the
+        # protocol regressing even when throughput holds). The absolute
+        # SLOs gate on the candidate alone (:func:`fabric_slo_
+        # violations`); the silent fall-back to a single-process
+        # topology is the --family fabric vanished-block gate in ``cli
+        # benchdiff``, not a delta here.
+        measured = data.get("measured") or {}
+        if measured.get("remote_lookup_p99_ms") is not None:
+            out.append(
+                BenchConfig(
+                    name="fabric.remote_lookup_p99_ms",
+                    value=float(measured["remote_lookup_p99_ms"]),
+                    higher_is_better=False,
+                    degraded=degraded,
+                )
+            )
+        det = data.get("deterministic") or {}
+        if det.get("view_staleness_ticks_max") is not None:
+            out.append(
+                BenchConfig(
+                    name="fabric.view_staleness_ticks_max",
+                    value=float(det["view_staleness_ticks_max"]),
+                    higher_is_better=False,
+                    degraded=degraded,
+                )
+            )
+        return out
     if str(data["metric"]).startswith("ingest."):
         # Ingest family (``INGEST_BENCH_*``, metric
         # ``ingest.bytes_per_sec``): decoded bytes/s (higher), the
@@ -403,6 +436,7 @@ FAMILIES = {
     "soak": "SOAK",
     "ingest": "INGEST_BENCH",
     "migrate": "MIGRATE_BENCH",
+    "fabric": "FABRIC_BENCH",
 }
 
 
@@ -421,6 +455,8 @@ def family_configs(
         return [c for c in configs if c.name.startswith("tiered.")]
     if family == "soak":
         return [c for c in configs if c.name.startswith(("soak.", "quality."))]
+    if family == "fabric":
+        return [c for c in configs if c.name.startswith("fabric.")]
     if family == "ingest":
         return [c for c in configs if c.name.startswith("ingest.")]
     if family == "migrate":
@@ -449,6 +485,43 @@ def soak_slo_violations(data: dict) -> list[str]:
     from analyzer_tpu.obs.slo import soak_violations
 
     return soak_violations(data)
+
+
+def fabric_slo_violations(data: dict) -> list[str]:
+    """The fabric family's ABSOLUTE gate, re-derived from the
+    candidate's artifact alone (the CI mirror of
+    ``FabricSoakDriver._violations``): every published match rated,
+    zero dead letters fleet-wide, per-host view staleness within the
+    configured tick bound, zero steady-state retraces on every host
+    (when the capture warmed up), and no fleet objective burning.
+    Returns human-readable violation strings; empty means pass."""
+    det = data.get("deterministic") or {}
+    fleet = data.get("fleet") or {}
+    thresholds = (data.get("slo") or {}).get("thresholds") or {}
+    cfg = data.get("config") or {}
+    out = []
+    published = det.get("matches_published")
+    rated = det.get("matches_rated")
+    if published is not None and rated is not None and rated < published:
+        out.append(f"lost work: {published} published, {rated} rated")
+    if det.get("dead_letters"):
+        out.append(f"dead letters: {det['dead_letters']}")
+    lag_max = thresholds.get("max_view_lag_ticks")
+    staleness = det.get("view_staleness_ticks_max")
+    if lag_max is not None and staleness is not None and staleness > lag_max:
+        out.append(
+            f"view staleness {staleness} ticks exceeds {lag_max}"
+        )
+    if cfg.get("warmup"):
+        for h in fleet.get("hosts") or []:
+            if h.get("retraces_steady", 0) > 0:
+                out.append(
+                    f"host {h.get('host')}: "
+                    f"{h['retraces_steady']:.0f} steady-state retraces"
+                )
+    for name in fleet.get("burning") or []:
+        out.append(f"fleet objective burning: {name}")
+    return out
 
 
 #: Causal tracing must stay (nearly) free when enabled: the bench's

@@ -63,9 +63,13 @@ class RoutedHTTPServer:
 
     ``routes`` maps an exact path (``"/healthz"``) to
     ``fn(params: dict[str, str]) -> (status, body, content_type)``.
-    ``port=0`` binds an ephemeral port (tests); the bound port is
-    readable at :attr:`port`. Stop with :meth:`close` (idempotent) —
-    whoever started the plane owns that call.
+    ``post_routes`` maps a path to ``fn(body) -> (status, body,
+    content_type)`` where ``body`` is the request's parsed JSON (None
+    for an empty body) — the fabric's control surface
+    (``fabric/host.py``) is the first POST plane. ``port=0`` binds an
+    ephemeral port (tests); the bound port is readable at :attr:`port`.
+    Stop with :meth:`close` (idempotent) — whoever started the plane
+    owns that call.
     """
 
     def __init__(
@@ -76,8 +80,10 @@ class RoutedHTTPServer:
         name: str = "analyzer-httpd",
         json_errors: bool = False,
         local_only: set | None = None,
+        post_routes: dict | None = None,
     ) -> None:
         self._routes = dict(routes)
+        self._post_routes = dict(post_routes or {})
         self._json_errors = json_errors
         # Paths that ACT (trigger a dump) rather than read: they answer
         # only to loopback peers even when an operator widened the bind
@@ -126,6 +132,35 @@ class RoutedHTTPServer:
                     # surface as a 500 response, not kill the serving
                     # thread the other routes still need.
                     logger.exception("%s route failed for %s", name, path)
+                    self._send(*server._error(500, "internal error"))
+
+            def do_POST(self):  # noqa: N802 — http.server contract
+                parsed = urllib.parse.urlsplit(self.path)
+                path = parsed.path
+                fn = server._post_routes.get(path)
+                if fn is None:
+                    self._send(*server._error(404, "not found"))
+                    return
+                if path in server._local_only and (
+                    self.client_address[0] not in ("127.0.0.1", "::1")
+                ):
+                    self._send(*server._error(
+                        403, "localhost-only endpoint"
+                    ))
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    raw = self.rfile.read(length) if length else b""
+                    body = json.loads(raw) if raw else None
+                except (ValueError, UnicodeDecodeError):
+                    self._send(*server._error(400, "body must be JSON"))
+                    return
+                try:
+                    self._send(*fn(body))
+                except HttpError as err:
+                    self._send(*server._error(err.status, err.message))
+                except Exception:  # noqa: BLE001 — same crash guard as GET
+                    logger.exception("%s POST route failed for %s", name, path)
                     self._send(*server._error(500, "internal error"))
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)

@@ -323,6 +323,17 @@ STANDARD_COUNTERS = (
     "quality.bin_count",
     "quality.bin_p_sum",
     "quality.bin_y_sum",
+    # The multi-host rate fabric (analyzer_tpu/fabric, docs/fabric.md):
+    # version-vector observations recorded by the host-local directory,
+    # queries the router sent to peer hosts, and routed calls that
+    # failed transport (the peer is marked down and leaves the merge).
+    # Follower view adoptions (serve/view.py adopt_view — the fabric's
+    # by-reference read-replica path) ride the serve.* family.
+    # Pre-declared so a single-host deployment reads 0, not missing.
+    "fabric.version_observations_total",
+    "fabric.remote_lookups_total",
+    "fabric.remote_errors_total",
+    "serve.view_adoptions_total",
 )
 STANDARD_GAUGES = (
     "worker.pipeline_lag",
@@ -406,6 +417,13 @@ STANDARD_GAUGES = (
     "quality.brier",
     "quality.ece",
     "quality.psi_mu",
+    # The fabric's topology gauges (analyzer_tpu/fabric): fleet host
+    # count from the directory's topology, this process's host index,
+    # and how many shards it owns (0/absent on a non-fabric worker —
+    # fabric.host_index/owned_shards are set by the fabric host wiring).
+    "fabric.hosts",
+    "fabric.host_index",
+    "fabric.owned_shards",
 )
 
 #: Histogram families the runtime emits (graftlint GL030 resolves
@@ -418,6 +436,10 @@ STANDARD_HISTOGRAMS = (
     "serve.microbatch_occupancy",
     "jax.backend_compile_seconds",
     "jax.trace_seconds",
+    # Routed cross-host query latency (fabric/route.py, per-peer series
+    # fabric.remote_lookup_ms{peer=} — observed on the CALLER's injected
+    # clock, so a soak's virtual milliseconds are what land here).
+    "fabric.remote_lookup_ms",
 )
 
 #: The span/instant name catalog: every runtime-emitted trace-event name
@@ -611,11 +633,23 @@ SCHEMA_HELP = {
     "quality.ece": "running expected calibration error (lower = better)",
     "quality.psi_mu":
         "population-stability index of mu vs the pinned reference window",
+    "fabric.version_observations_total":
+        "per-host view versions recorded into the fabric directory",
+    "fabric.remote_lookups_total": "queries routed to peer fabric hosts",
+    "fabric.remote_errors_total":
+        "routed fabric calls that failed transport (peer marked down)",
+    "serve.view_adoptions_total":
+        "leader views adopted by reference into a follower lineage",
+    "fabric.hosts": "host count of the fabric topology",
+    "fabric.host_index": "this process's fabric host index",
+    "fabric.owned_shards": "shards this fabric host owns",
     "phase_seconds": "wall seconds per instrumented phase",
     "sched.pack_occupancy": "per-schedule slot occupancy distribution",
     "serve.microbatch_occupancy": "per-tick serve microbatch fill",
     "jax.backend_compile_seconds": "XLA backend compile durations",
     "jax.trace_seconds": "XLA trace durations",
+    "fabric.remote_lookup_ms":
+        "routed cross-host query latency (caller-clock milliseconds)",
 }
 
 

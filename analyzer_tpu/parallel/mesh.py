@@ -627,6 +627,7 @@ def rate_history_sharded(
     routing_capacity: int | None = None,
     prefetch_depth: int | None = None,
     view_publisher=None,
+    fabric_directory=None,
 ) -> PlayerState:
     """Full-history re-rate, data-parallel over the mesh. Returns final state.
 
@@ -660,8 +661,17 @@ def rate_history_sharded(
     own patch path, one monotone version across shards — plus an
     unthrottled final publish. A plain ``ViewPublisher`` gets only the
     final assembled table (a mid-run cross-shard gather would serialize
-    the feed overlap). Single-process only: a multi-host serve tier is
-    ``parallel/multihost.py`` future work.
+    the feed overlap).
+
+    On a multi-process mesh each process only sees its own shards'
+    blocks, so a raw sharded publisher would tear the view. Pass
+    ``fabric_directory`` (a :class:`~analyzer_tpu.fabric.directory.
+    FabricDirectory` whose topology matches the publisher's shard
+    count) and this runner wraps the publisher in a
+    :class:`~analyzer_tpu.fabric.publish.FabricShardPublisher`: each
+    process publishes ONLY the shards it owns under its own monotone
+    version, recorded in the directory so fabric readers route around
+    staleness (docs/fabric.md).
     """
     mesh = mesh or make_mesh()
     n_dev = mesh.devices.size
@@ -706,11 +716,23 @@ def rate_history_sharded(
         view_publisher, "publish_shard_patches"
     )
     if sharded_publisher:
-        if jax.process_count() != 1:
+        if fabric_directory is not None:
+            from analyzer_tpu.fabric.publish import FabricShardPublisher
+
+            # Each process publishes only its owned shards' patches
+            # under its own monotone version; the directory carries the
+            # fleet's (host, shards, version) vector for routed reads.
+            view_publisher = FabricShardPublisher(
+                fabric_directory, jax.process_index(), view_publisher
+            )
+        elif jax.process_count() != 1:
             raise ValueError(
-                "per-shard view publishing is single-process (the "
-                "publisher would only see this process's shards); run "
-                "the serve tier separately on multi-host"
+                "per-shard view publishing on a multi-process mesh "
+                "needs a fabric directory (each process only sees its "
+                "own shards' blocks — a raw publisher would tear the "
+                "view); pass fabric_directory= to route owned shards "
+                "through the fabric protocol, or bring the serve tier "
+                "up as its own fleet with `cli fabric`"
             )
         if view_publisher.n_shards != n_dev:
             raise ValueError(

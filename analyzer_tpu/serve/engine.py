@@ -196,6 +196,22 @@ track_jit("serve._tier_counts", _tier_counts)
 track_jit("serve._count_below", _count_below)
 
 
+def merge_topk_candidates(entries, k: int | None = None) -> list:
+    """THE serving plane's boundary-safe top-k merge, exported so every
+    tier that stitches partial top-k lists — the sharded engine's
+    per-shard merge here, the fabric's per-HOST merge
+    (:mod:`analyzer_tpu.fabric.route`) — uses one pinned key.
+
+    ``entries`` are ``(score, global_row, payload)`` triples; the result
+    is sorted by ``(-score, global_row)`` — ``lax.top_k``'s descending
+    order with low-index tie-break on the UNSHARDED table, which makes
+    ties spanning shard (and host) boundaries land exactly where the
+    single-device plane puts them — truncated to ``k`` when given.
+    Float negation is exact, so the key loses no bits."""
+    cand = sorted(entries, key=lambda c: (-c[0], c[1]))
+    return cand if k is None else cand[:k]
+
+
 def _finish_winprob(n, s2, mu_diff, beta2: float):
     """Host float64 finish of P(team A wins) = Phi(mu_diff / c) from the
     kernel's float32 statistics, rounded once to float32. Pure
@@ -1007,16 +1023,16 @@ class ShardedQueryEngine(QueryEngine):
         reg = get_registry()
         reg.counter("serve.shard.merges_total").add(1)
         reg.counter("serve.shard.merge_candidates_total").add(n_shards * kb)
-        cand = []
+        entries = []
         for d in range(n_shards):
             for j in range(kb):
                 v = float(vals_s[d, j])
                 if not math.isfinite(v):
                     break  # the shard's rated rows ran out (-inf tail)
-                cand.append((-v, int(idx_s[d, j]) * n_shards + d, vals_s[d, j]))
-        cand.sort(key=lambda c: (c[0], c[1]))
-        vals = np.array([c[2] for c in cand], np.float32)
-        idx = np.array([c[1] for c in cand], np.int64)
+                entries.append((v, int(idx_s[d, j]) * n_shards + d, vals_s[d, j]))
+        merged = merge_topk_candidates(entries)
+        vals = np.array([c[2] for c in merged], np.float32)
+        idx = np.array([c[1] for c in merged], np.int64)
         return vals, idx
 
     def _leader_rows(self, view, rows_idx: list) -> np.ndarray:

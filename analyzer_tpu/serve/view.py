@@ -440,6 +440,49 @@ class ViewPublisher:
             get_registry().counter("serve.view_cutovers_total").add(1)
             return self._swap(view.table, view.n_players)
 
+    @thread_role("any")
+    def adopt_view(self, view: RatingsView) -> bool:
+        """FOLLOWER adoption (the fabric read path, docs/fabric.md):
+        makes ``view`` — another lineage's published snapshot — this
+        publisher's current view BY REFERENCE, ``cutover_from``'s
+        mechanism without consuming the source. The leader keeps
+        publishing into its own lineage; a follower re-adopts each new
+        version as it observes one, and its readers get the same
+        atomic-reference guarantee as the leader's: one assignment, no
+        torn state, version numbers tracking the LEADER's monotone
+        sequence (not a local counter).
+
+        Returns True when the view was adopted, False when the follower
+        already serves this version (the idempotent re-poll). A version
+        moving backwards raises — same protocol violation
+        ``FabricDirectory.observe`` rejects. A follower is read-only by
+        contract: its own staging buffer never merges the adopted
+        tables, so publishing into it afterwards would fork the lineage
+        — don't."""
+        with self._lock:
+            if self._retired:
+                raise RuntimeError(
+                    "publisher was retired by a lineage cutover; a retired "
+                    "lineage cannot adopt views"
+                )
+            cur = self._view
+            if cur is not None and view.version == cur.version:
+                return False
+            if cur is not None and view.version < cur.version:
+                raise ValueError(
+                    f"adopt_view would rewind {cur.version} -> "
+                    f"{view.version}; followers adopt monotone leader "
+                    "versions only (a restarted leader means a fresh "
+                    "follower)"
+                )
+            self._view = view
+            self._version = view.version
+            self._last_publish = time.monotonic()
+            reg = get_registry()
+            reg.gauge("serve.view_version").set(self._version)
+            reg.counter("serve.view_adoptions_total").add(1)
+            return True
+
     def _grow(self, alloc: int) -> None:
         if alloc + 1 <= self._staging.shape[0]:
             return

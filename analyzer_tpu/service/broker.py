@@ -255,15 +255,25 @@ class PartitionedBroker:
     def publish_topic(self, exchange: str, routing_key: str, body: bytes) -> None:
         self.topics.append((exchange, routing_key, body))
 
-    def _pop_merged(self, queue: str, lane: str, limit: int, out: list) -> None:
+    def _pop_merged(
+        self,
+        queue: str,
+        lane: str,
+        limit: int,
+        out: list,
+        partitions=None,
+    ) -> None:
         """Moves up to ``limit - len(out)`` messages of ``lane`` into
         ``out`` in global sequence order (smallest head across the
         partitions first — requeued messages keep their original seq,
-        so a redelivery outranks everything published after it)."""
+        so a redelivery outranks everything published after it).
+        ``partitions`` restricts the merge to a subset of partition
+        indices (a fabric worker's owned frontier); None means all."""
         parts = self.queues[queue]
+        span = range(self.partitions) if partitions is None else partitions
         while len(out) < limit:
             best = None
-            for p in range(self.partitions):
+            for p in span:
                 q = parts[p][lane]
                 if q and (best is None or q[0][0] < parts[best][lane][0][0]):
                     best = p
@@ -274,24 +284,26 @@ class PartitionedBroker:
             self._unacked[msg.delivery_tag] = (queue, best, lane, seq, msg)
             out.append(msg)
 
-    def get(self, queue: str, limit: int) -> list[Message]:
+    def get(self, queue: str, limit: int, partitions=None) -> list[Message]:
         self.declare_queue(queue)
         out: list[Message] = []
-        self._pop_merged(queue, LANE_LIVE, limit, out)
+        self._pop_merged(queue, LANE_LIVE, limit, out, partitions)
         room = limit - len(out)
         if self.lanes and room > 0:
-            live_left = self.lane_size(queue, LANE_LIVE)
+            live_left = self.lane_size(queue, LANE_LIVE, partitions)
             quota = (
                 self.admission.quota(live_left, room)
                 if self.admission is not None else room
             )
             quota = min(quota, room)
             before = len(out)
-            self._pop_merged(queue, LANE_BACKFILL, before + quota, out)
+            self._pop_merged(
+                queue, LANE_BACKFILL, before + quota, out, partitions
+            )
             admitted = len(out) - before
             if admitted:
                 self._admitted.add(admitted)
-            waiting = self.lane_size(queue, LANE_BACKFILL)
+            waiting = self.lane_size(queue, LANE_BACKFILL, partitions)
             if waiting and quota < room:
                 self._throttled.add(min(waiting, room - quota))
         return out
@@ -319,19 +331,21 @@ class PartitionedBroker:
         """No delivery bound to adjust in memory; recorded for tests."""
         self.prefetch = int(prefetch)
 
-    def lane_size(self, queue: str, lane: str) -> int:
-        """Ready depth of one lane across every partition."""
+    def lane_size(self, queue: str, lane: str, partitions=None) -> int:
+        """Ready depth of one lane across every partition (or the given
+        subset of partition indices)."""
         parts = self.queues.get(queue)
         if parts is None:
             return 0
-        return sum(len(parts[p][lane]) for p in range(self.partitions))
+        span = range(self.partitions) if partitions is None else partitions
+        return sum(len(parts[p][lane]) for p in span)
 
-    def qsize(self, queue: str) -> int:
+    def qsize(self, queue: str, partitions=None) -> int:
         """AGGREGATE ready depth across all partitions and lanes — the
         number a single-queue broker would report, so existing
         ``broker.queue_depth`` consumers (worker gauge, soak sampler)
         keep meaning the same thing."""
-        return sum(self.lane_size(queue, lane) for lane in _LANES)
+        return sum(self.lane_size(queue, lane, partitions) for lane in _LANES)
 
     def partition_depths(self, queue: str) -> dict[int, dict[str, int]]:
         """Per-partition, per-lane ready depths — the skew surface the
@@ -440,7 +454,7 @@ class AmqpPartitionedBroker:
     def _head(self, queue: str, p: int, lane: str) -> deque:
         return self._heads.setdefault((queue, p, lane), deque())
 
-    def _pull(self, queue: str, lane: str, limit: int) -> None:
+    def _pull(self, queue: str, lane: str, limit: int, partitions=None) -> None:
         """Tops up each partition's local head buffer from the base
         broker so the merge can see every partition's frontier. Each
         buffer is kept seq-sorted: a nacked-with-requeue message
@@ -448,8 +462,10 @@ class AmqpPartitionedBroker:
         back while larger-seq messages already sit buffered — the sort
         restores the per-partition ascending order the k-way merge
         assumes (a redelivery outranks everything published after it,
-        the in-memory broker's contract)."""
-        for p in range(self.partitions):
+        the in-memory broker's contract). ``partitions`` restricts the
+        pull to a subset of partition indices; None means all."""
+        span = range(self.partitions) if partitions is None else partitions
+        for p in span:
             buf = self._head(queue, p, lane)
             want = limit - len(buf)
             if want > 0:
@@ -472,16 +488,24 @@ class AmqpPartitionedBroker:
             msg.headers["x-seq"] = seq
         return int(seq)
 
-    def _pop_merged(self, queue: str, lane: str, limit: int, out: list) -> None:
+    def _pop_merged(
+        self,
+        queue: str,
+        lane: str,
+        limit: int,
+        out: list,
+        partitions=None,
+    ) -> None:
         """Moves up to ``limit - len(out)`` buffered messages of ``lane``
         into ``out`` in global x-seq order (smallest head across the
         partitions first) — the in-memory broker's merge, over the
         heads the server has delivered."""
-        self._pull(queue, lane, limit)
+        self._pull(queue, lane, limit, partitions)
+        span = range(self.partitions) if partitions is None else partitions
         while len(out) < limit:
             best = None
             best_seq = None
-            for p in range(self.partitions):
+            for p in span:
                 buf = self._heads.get((queue, p, lane))
                 if not buf:
                     continue
@@ -492,24 +516,26 @@ class AmqpPartitionedBroker:
                 return
             out.append(self._heads[(queue, best, lane)].popleft())
 
-    def get(self, queue: str, limit: int) -> list[Message]:
+    def get(self, queue: str, limit: int, partitions=None) -> list[Message]:
         self.declare_queue(queue)
         out: list[Message] = []
-        self._pop_merged(queue, LANE_LIVE, limit, out)
+        self._pop_merged(queue, LANE_LIVE, limit, out, partitions)
         room = limit - len(out)
         if self.lanes and room > 0:
-            live_left = self.lane_size(queue, LANE_LIVE)
+            live_left = self.lane_size(queue, LANE_LIVE, partitions)
             quota = (
                 self.admission.quota(live_left, room)
                 if self.admission is not None else room
             )
             quota = min(quota, room)
             before = len(out)
-            self._pop_merged(queue, LANE_BACKFILL, before + quota, out)
+            self._pop_merged(
+                queue, LANE_BACKFILL, before + quota, out, partitions
+            )
             admitted = len(out) - before
             if admitted:
                 self._admitted.add(admitted)
-            waiting = self.lane_size(queue, LANE_BACKFILL)
+            waiting = self.lane_size(queue, LANE_BACKFILL, partitions)
             if waiting and quota < room:
                 self._throttled.add(min(waiting, room - quota))
         return out
@@ -532,20 +558,22 @@ class AmqpPartitionedBroker:
         if set_prefetch is not None:
             set_prefetch(int(prefetch))
 
-    def lane_size(self, queue: str, lane: str) -> int:
-        """Ready depth of one lane across every partition: the base
-        broker's per-physical-queue depth plus locally buffered heads."""
+    def lane_size(self, queue: str, lane: str, partitions=None) -> int:
+        """Ready depth of one lane across every partition (or the given
+        subset): the base broker's per-physical-queue depth plus locally
+        buffered heads."""
         total = 0
-        for p in range(self.partitions):
+        span = range(self.partitions) if partitions is None else partitions
+        for p in span:
             total += self.base.qsize(physical_queue(queue, p, lane))
             total += len(self._heads.get((queue, p, lane), ()))
         return total
 
-    def qsize(self, queue: str) -> int:
+    def qsize(self, queue: str, partitions=None) -> int:
         """Aggregate ready depth across partitions and lanes — the same
         single number a one-queue broker reports (worker gauge, soak
         sampler)."""
-        return sum(self.lane_size(queue, lane) for lane in _LANES)
+        return sum(self.lane_size(queue, lane, partitions) for lane in _LANES)
 
     def partition_depths(self, queue: str) -> dict[int, dict[str, int]]:
         """Per-partition, per-lane ready depths — the /statusz skew
@@ -562,6 +590,82 @@ class AmqpPartitionedBroker:
             }
             for p in range(self.partitions)
         }
+
+
+class PartitionSubscription:
+    """A shard-owning worker's consumption window onto a partitioned
+    broker (docs/fabric.md "Broker-partitioned ingest").
+
+    In a fabric every host owns the shards ``s % n_hosts == host`` and,
+    because ``partition_of == shard ownership`` (the publisher stamps
+    ``x-partition`` with the match's home shard), exactly the same
+    partitions. This wrapper implements the :class:`Broker` protocol
+    over one broker with ``get``/depth restricted to those owned
+    partition indices, so the :class:`~analyzer_tpu.service.worker.
+    Worker` stays partition-blind: it consumes "a broker" and the
+    subscription decides which physical frontier that means.
+
+    Publish passes through UNRESTRICTED — a dead-letter republish to
+    ``<queue>_failed`` keeps the message's original ``x-partition``
+    header, so poison traffic stays attributed to the owning shard even
+    when the republishing host does not own it. Ack/nack/prefetch pass
+    straight through (delivery tags are the wrapped broker's own).
+    """
+
+    def __init__(self, broker, partitions) -> None:
+        owned = tuple(sorted({int(p) for p in partitions}))
+        if not owned:
+            raise ValueError("subscription needs at least one partition")
+        total = int(broker.partitions)
+        for p in owned:
+            if not 0 <= p < total:
+                raise ValueError(
+                    f"partition {p} outside the broker's 0..{total - 1}"
+                )
+        self.broker = broker
+        self.owned = owned
+        self.partitions = total  # the LOGICAL layout, not the window
+
+    def declare_queue(self, name: str) -> None:
+        self.broker.declare_queue(name)
+
+    def publish(self, queue: str, body: bytes, headers: dict | None = None) -> None:
+        self.broker.publish(queue, body, headers=headers)
+
+    def publish_topic(self, exchange: str, routing_key: str, body: bytes) -> None:
+        self.broker.publish_topic(exchange, routing_key, body)
+
+    def get(self, queue: str, limit: int) -> list[Message]:
+        return self.broker.get(queue, limit, partitions=self.owned)
+
+    def ack(self, delivery_tag: int) -> None:
+        self.broker.ack(delivery_tag)
+
+    def nack(self, delivery_tag: int, requeue: bool = False) -> None:
+        self.broker.nack(delivery_tag, requeue=requeue)
+
+    def requeue_unacked(self) -> None:
+        requeue = getattr(self.broker, "requeue_unacked", None)
+        if requeue is not None:
+            requeue()
+
+    def set_prefetch(self, prefetch: int) -> None:
+        set_prefetch = getattr(self.broker, "set_prefetch", None)
+        if set_prefetch is not None:
+            set_prefetch(int(prefetch))
+
+    def lane_size(self, queue: str, lane: str) -> int:
+        return self.broker.lane_size(queue, lane, self.owned)
+
+    def qsize(self, queue: str) -> int:
+        """Ready depth of the OWNED partitions only — the worker's
+        ``broker.queue_depth`` gauge then reports this host's actual
+        backlog, which is what per-host burn attribution wants."""
+        return self.broker.qsize(queue, self.owned)
+
+    def partition_depths(self, queue: str) -> dict[int, dict[str, int]]:
+        full = self.broker.partition_depths(queue)
+        return {p: d for p, d in full.items() if p in self.owned}
 
 
 def make_partitioned_pika_broker(

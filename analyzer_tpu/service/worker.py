@@ -346,6 +346,13 @@ class Worker:
 
             self.quality = CalibrationLedger(self.rating_config)
             set_quality_ledger(self.quality)
+        # Fabric membership (analyzer_tpu/fabric): set by the fabric
+        # host wiring to a zero-arg callable returning the directory's
+        # ``stats()['fabric']`` block — host index, owned shards, the
+        # fleet version vector — so /statusz shows the topology without
+        # the worker importing the fabric package. None on every
+        # non-fabric worker; scrapers key on presence.
+        self.fabric_info = None
 
     # -- micro-batcher ----------------------------------------------------
     def poll(self) -> bool:
@@ -1369,6 +1376,12 @@ class Worker:
             # carries the full reliability table (obs/quality.py).
             "quality": (
                 self.quality.stats() if self.quality is not None else None
+            ),
+            # Fabric membership (None off-fabric): the directory's
+            # /statusz block — host index, owned shards, the fleet's
+            # (host, shards, version) vector with down-ness.
+            "fabric": (
+                self.fabric_info() if self.fabric_info is not None else None
             ),
         }
 

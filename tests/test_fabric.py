@@ -1,0 +1,711 @@
+"""Multi-host rate fabric unit suite (ISSUE 19, docs/fabric.md).
+
+Acceptance contract for the in-process half of the fabric:
+
+  * ownership math is THE serve-plane layout invariant extended one
+    level (row -> shard -> host, all pure functions);
+  * the directory's version vector is per-host monotone, rewinds raise,
+    staleness and explicit down marks remove a host from the merge and
+    the next observe brings it back;
+  * the shard publisher filters non-owned patches and records versions;
+  * routed reads — point lookups, winprob (single- and cross-owner),
+    leaderboards, tiers, percentile — are BIT-IDENTICAL to a single
+    plane holding the union table;
+  * the follower plane adopts leader views by reference with monotone
+    versions;
+  * shard-pure matchmaking is deterministic per (seed, shard) and never
+    crosses a shard boundary;
+  * a PartitionSubscription delivers exactly the owned partitions in
+    the broker's global seq order;
+  * the mesh runner's single-process guard is retired: a multi-process
+    mesh with a fabric directory publishes owned shards through the
+    fabric protocol, and without one the error points at `cli fabric`;
+  * begin_fabric wraps a staging lineage in the ownership filter;
+  * the benchdiff fabric family gates the FABRIC_BENCH artifacts.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.core.state import MU_LO, SIGMA_LO, PlayerState
+from analyzer_tpu.fabric import (
+    FabricDirectory,
+    FabricRouter,
+    FabricShardPublisher,
+    FabricTopology,
+    FollowerPlane,
+    ShardMatchmaker,
+    host_of_row,
+    host_of_shard,
+    owned_partitions,
+    owned_rows,
+    owned_shards,
+    row_of_id,
+)
+from analyzer_tpu.fabric.route import EngineHostClient, HostDownError
+from analyzer_tpu.obs import get_registry, reset_registry
+from analyzer_tpu.serve import QueryEngine, ViewPublisher
+from analyzer_tpu.serve.view import shard_of_row
+
+CFG = RatingConfig()
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    reset_registry()
+    yield
+    reset_registry()
+
+
+def rated_table(n_players: int, n_rated: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    state = PlayerState.create(
+        n_players, skill_tier=rng.integers(1, 29, n_players), cfg=CFG
+    )
+    table = np.asarray(state.table).copy()
+    table[:n_rated, MU_LO] = rng.normal(1500, 400, n_rated).astype(np.float32)
+    table[:n_rated, SIGMA_LO] = rng.uniform(50, 600, n_rated).astype(
+        np.float32
+    )
+    return table[:n_players]
+
+
+def pid(r: int) -> str:
+    return f"p{r:06d}"
+
+
+class Fleet:
+    """An in-process fabric: per-host planes over owned rows, one
+    oracle plane over the union table, a directory + router wired with
+    EngineHostClients. ``now`` drives the injected clock."""
+
+    def __init__(self, n_players=60, n_shards=4, n_hosts=2, seed=0):
+        self.topology = FabricTopology(n_shards, n_hosts)
+        self.table = rated_table(n_players, int(n_players * 0.8), seed)
+        self.ids = [pid(r) for r in range(n_players)]
+        self.now = 0.0
+        self.directory = FabricDirectory(self.topology, down_after_s=10.0)
+        self.engines = []
+        clients = {}
+        for h in range(n_hosts):
+            rows = self.topology.owned_rows(h, n_players)
+            pub = ViewPublisher(min_publish_interval_s=0.0)
+            pub.publish_rows([pid(r) for r in rows], self.table[rows])
+            eng = QueryEngine(pub, cfg=CFG).start()
+            self.engines.append(eng)
+            clients[h] = EngineHostClient(eng)
+            self.directory.register(h, now=self.now)
+            self.directory.observe(h, pub.version, self.now)
+        self.oracle_pub = ViewPublisher(min_publish_interval_s=0.0)
+        self.oracle_pub.publish_rows(self.ids, self.table)
+        self.oracle = QueryEngine(self.oracle_pub, cfg=CFG).start()
+        self.router = FabricRouter(
+            self.directory, clients=clients, cfg=CFG,
+            clock=lambda: self.now,
+        )
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    return Fleet()
+
+
+# ---------------------------------------------------------------------------
+class TestOwnershipMath:
+    def test_host_maps_are_the_layout_invariant_extended(self):
+        for n_shards, n_hosts in ((4, 2), (5, 3), (8, 8), (3, 1)):
+            for r in range(40):
+                s = shard_of_row(r, n_shards)
+                assert host_of_shard(s, n_hosts) == s % n_hosts
+                assert (
+                    host_of_row(r, n_shards, n_hosts)
+                    == host_of_shard(shard_of_row(r, n_shards), n_hosts)
+                )
+
+    def test_owned_sets_partition_the_universe(self):
+        n_shards, n_hosts, n_players = 5, 3, 47
+        all_shards = sorted(
+            s for h in range(n_hosts)
+            for s in owned_shards(h, n_shards, n_hosts)
+        )
+        assert all_shards == list(range(n_shards))
+        all_rows = sorted(
+            r for h in range(n_hosts)
+            for r in owned_rows(h, n_players, n_shards, n_hosts)
+        )
+        assert all_rows == list(range(n_players))
+        # partition == shard ownership, the ingest invariant.
+        for h in range(n_hosts):
+            assert owned_partitions(h, n_shards, n_hosts) == owned_shards(
+                h, n_shards, n_hosts
+            )
+
+    def test_row_of_id_roundtrip_and_rejects(self):
+        assert row_of_id(pid(123)) == 123
+        assert row_of_id("p7") == 7
+        for bad in ("x7", "p", "", "p-3", "q000001"):
+            with pytest.raises(ValueError, match="p<row>"):
+                row_of_id(bad)
+
+    def test_topology_validation(self):
+        with pytest.raises(ValueError, match="own nothing"):
+            FabricTopology(2, 3)
+        with pytest.raises(ValueError):
+            FabricTopology(0, 1)
+        t = FabricTopology(4, 2)
+        assert t.owned_shards(0) == (0, 2)
+        assert t.owned_shards(1) == (1, 3)
+        assert t.host_of_id(pid(5)) == (5 % 4) % 2
+
+
+# ---------------------------------------------------------------------------
+class TestFabricDirectory:
+    def _dir(self):
+        return FabricDirectory(FabricTopology(4, 2), down_after_s=5.0)
+
+    def test_register_observe_vector(self):
+        d = self._dir()
+        d.register(0, serve_url="http://h0", now=0.0)
+        d.register(1, now=0.0)
+        d.observe(0, 3, now=1.0)
+        d.observe(1, 1, now=1.0)
+        assert d.vector() == {0: 3, 1: 1}
+        assert d.entry(0).shards == (0, 2)
+        assert d.route_shard(3).host == 1
+        assert d.route_id(pid(6)).host == (6 % 4) % 2
+
+    def test_monotone_version_rewind_raises(self):
+        d = self._dir()
+        d.register(0, now=0.0)
+        d.observe(0, 5, now=1.0)
+        d.observe(0, 5, now=2.0)  # equal is fine (idempotent publish)
+        with pytest.raises(ValueError, match="rewound"):
+            d.observe(0, 4, now=3.0)
+        # The restart path: re-register resets the floor.
+        d.register(0, now=4.0)
+        d.observe(0, 1, now=5.0)
+        assert d.vector()[0] == 1
+
+    def test_observe_before_register_raises(self):
+        d = self._dir()
+        with pytest.raises(KeyError, match="register"):
+            d.observe(1, 1, now=0.0)
+
+    def test_staleness_and_mark_down_and_reentry(self):
+        d = self._dir()
+        d.register(0, now=0.0)
+        d.register(1, now=0.0)
+        d.observe(0, 1, now=0.0)
+        d.observe(1, 1, now=0.0)
+        assert d.down_hosts(now=1.0) == []
+        # Host 1 stops publishing; past down_after_s it leaves.
+        d.observe(0, 2, now=8.0)
+        assert d.down_hosts(now=8.0) == [1]
+        assert [e.host for e in d.alive_hosts(8.0)] == [0]
+        lag = d.lag_s(8.0)
+        assert lag[1] == 8.0 and lag[0] == 0.0
+        # The next observed publish brings it back.
+        d.observe(1, 2, now=9.0)
+        assert d.down_hosts(now=9.0) == []
+        d.mark_down(0)
+        assert 0 in d.down_hosts(now=9.0)
+        d.observe(0, 3, now=9.5)
+        assert 0 not in d.down_hosts(now=9.5)
+
+    def test_snapshot_shape(self):
+        d = self._dir()
+        d.register(0, serve_url="http://h0", now=0.0)
+        snap = d.snapshot(now=20.0)
+        assert snap["n_shards"] == 4 and snap["n_hosts"] == 2
+        assert snap["hosts"][0]["down"] is True  # never observed
+
+
+# ---------------------------------------------------------------------------
+class _FakeShardedPublisher:
+    def __init__(self, n_shards):
+        self.n_shards = n_shards
+        self.version = 0
+        self.published = []
+
+    def publish_shard_patches(self, patches, n_players, blocks_thunk):
+        self.published.append(patches)
+        self.version += 1
+        return f"view-v{self.version}"
+
+
+class TestFabricShardPublisher:
+    def test_filters_non_owned_and_records_version(self):
+        d = FabricDirectory(FabricTopology(4, 2))
+        inner = _FakeShardedPublisher(4)
+        now = [3.5]
+        wrapped = FabricShardPublisher(d, 1, inner, clock=lambda: now[0])
+        patches = [
+            (np.array([s]), np.full((1, 16), s, np.float32))
+            for s in range(4)
+        ]
+        out = wrapped.publish_shard_patches(patches, 8, lambda: None)
+        assert out == "view-v1"
+        sent = inner.published[0]
+        # Host 1 owns shards 1 and 3: those pass through; 0 and 2 empty.
+        assert sent[1][0].tolist() == [1] and sent[3][0].tolist() == [3]
+        assert len(sent[0][0]) == 0 and len(sent[2][0]) == 0
+        assert d.vector()[1] == 1
+        assert d.entry(1).last_seen == 3.5
+
+    def test_topology_mismatch_rejected(self):
+        d = FabricDirectory(FabricTopology(4, 2))
+        with pytest.raises(ValueError, match="must agree"):
+            FabricShardPublisher(d, 0, _FakeShardedPublisher(3))
+
+
+# ---------------------------------------------------------------------------
+class TestFollowerPlane:
+    def test_adopts_by_reference_with_monotone_versions(self):
+        leader = ViewPublisher(min_publish_interval_s=0.0)
+        table = rated_table(20, 16, seed=3)
+        leader.publish_rows([pid(r) for r in range(20)], table)
+        follower = FollowerPlane(leader, cfg=CFG).start()
+        try:
+            assert follower.version == leader.version
+            # Same bits as the leader's own engine.
+            leader_eng = QueryEngine(leader, cfg=CFG).start()
+            ids = [pid(3), pid(7)]
+            a = leader_eng.get_ratings(ids)
+            b = follower.engine.get_ratings(ids)
+            assert a == b
+            # No new leader view -> refresh is a no-op.
+            assert follower.refresh() is False
+            # Leader advances; follower adopts the NEW version.
+            t2 = table.copy()
+            t2[:, MU_LO] += 10.0
+            leader.publish_rows([pid(r) for r in range(20)], t2)
+            assert follower.refresh() is True
+            assert follower.version == leader.version
+            got = follower.engine.get_ratings([pid(0)])["ratings"][0]["mu"]
+            assert np.float32(got) == np.float32(t2[0, MU_LO])
+            # By reference: the adopted table IS the leader's buffer.
+            assert (
+                follower.publisher.current().host_table()
+                is leader.current().host_table()
+            )
+        finally:
+            follower.close()
+
+
+# ---------------------------------------------------------------------------
+class TestFabricRouterOracle:
+    """Routed reads vs the single plane holding the union table —
+    bit-for-bit after version stripping."""
+
+    def test_point_lookups_split_by_owner_preserve_order(self, fleet):
+        ids = [pid(7), pid(0), pid(13), "ghost", pid(2), pid(59)]
+        routed = fleet.router.get_ratings(ids)
+        oracle = fleet.oracle.get_ratings([i for i in ids if i != "ghost"])
+        assert routed["unknown"] == ["ghost"]
+        assert routed["ratings"] == oracle["ratings"]
+        assert set(routed["versions"]) == {"0", "1"}
+
+    def test_winprob_single_owner_routes_whole(self, fleet):
+        # Shard-pure teams (all rows = 1 mod 4 -> shard 1, host 1).
+        a, b = [pid(1), pid(5), pid(9)], [pid(13), pid(17), pid(21)]
+        routed = fleet.router.win_probability(a, b)
+        oracle = fleet.oracle.win_probability(a, b)
+        assert np.float32(routed["p_a"]) == np.float32(oracle["p_a"])
+        assert np.float32(routed["quality"]) == np.float32(
+            oracle["quality"]
+        )
+        assert list(routed["versions"]) == ["1"]
+
+    def test_winprob_cross_owner_replays_kernel_bits(self, fleet):
+        # Rows from shards 0..3 — both hosts involved.
+        a, b = [pid(0), pid(1), pid(2)], [pid(3), pid(4), pid(5)]
+        routed = fleet.router.win_probability(a, b)
+        oracle = fleet.oracle.win_probability(a, b)
+        assert np.float32(routed["p_a"]) == np.float32(oracle["p_a"])
+        assert np.float32(routed["quality"]) == np.float32(
+            oracle["quality"]
+        )
+        from analyzer_tpu.serve.engine import UnknownPlayerError
+
+        with pytest.raises(UnknownPlayerError):
+            fleet.router.win_probability([pid(0), "zzz"], [pid(1), pid(2)])
+
+    def test_leaderboard_merge_bit_identical(self, fleet):
+        for k in (1, 5, 10, 25, 60):
+            routed = fleet.router.leaderboard(k)
+            oracle = fleet.oracle.leaderboard(k)
+            assert routed["leaders"] == oracle["leaders"], k
+
+    def test_tiers_and_percentile_sum_exactly(self, fleet):
+        routed = fleet.router.tier_histogram()
+        oracle = fleet.oracle.tier_histogram()
+        assert routed["edges"] == oracle["edges"]
+        assert routed["counts"] == oracle["counts"]
+        assert routed["rated"] == oracle["rated"]
+        for score in (800.0, 1500.0, 2400.0):
+            rp = fleet.router.percentile(score)
+            op = fleet.oracle.percentile(score)
+            assert (rp["below"], rp["rated"], rp["percentile"]) == (
+                op["below"], op["rated"], op["percentile"]
+            )
+
+    def test_strip_versions_is_topology_invariant_digest_body(self, fleet):
+        resp = fleet.router.leaderboard(5)
+        stripped = FabricRouter.strip_versions(resp)
+        assert "versions" not in stripped and stripped["leaders"]
+        assert FabricRouter.strip_versions(
+            fleet.oracle.leaderboard(5)
+        )["leaders"] == stripped["leaders"]
+
+
+class TestRouterDownHost:
+    def test_down_host_leaves_merge_without_wedging_readers(self):
+        f = Fleet(n_players=40, n_shards=4, n_hosts=2)
+        f.now = 100.0  # both hosts now stale -> down by staleness
+        with pytest.raises(HostDownError, match="every fabric host"):
+            f.router.leaderboard(5)
+        # Host 0 publishes again; merge serves from it alone.
+        f.directory.observe(0, 2, now=f.now)
+        resp = f.router.leaderboard(40)
+        assert list(resp["versions"]) == ["0"]
+        owned0 = {
+            pid(r)
+            for r in f.topology.owned_rows(0, 40)
+        }
+        assert {e["id"] for e in resp["leaders"]} <= owned0
+        # Point lookups to the down owner still fail loudly: only the
+        # owner has the rows.
+        tiers = f.router.tier_histogram()
+        assert sum(tiers["counts"]) <= len(owned0)
+
+    def test_transport_failure_marks_down(self):
+        f = Fleet(n_players=40, n_shards=4, n_hosts=2)
+
+        class Boom:
+            def leaderboard(self, k):
+                raise OSError("connection refused")
+
+            def tier_histogram(self):
+                raise OSError("connection refused")
+
+        f.router._clients[1] = Boom()
+        resp = f.router.leaderboard(10)  # host 1 drops mid-merge
+        assert list(resp["versions"]) == ["0"]
+        assert f.directory.entry(1).down is True
+        assert get_registry().counter("fabric.remote_errors_total").value >= 1
+
+
+# ---------------------------------------------------------------------------
+class TestShardMatchmaker:
+    def _mm(self, shard, n_shards=4, seed=5, n_players=80):
+        from analyzer_tpu.io.synthetic import synthetic_players
+
+        players = synthetic_players(n_players, seed=seed)
+        pub = ViewPublisher(min_publish_interval_s=0.0)
+        pub.publish_rows(
+            [pid(r) for r in range(n_players)],
+            rated_table(n_players, n_players, seed=seed),
+        )
+        eng = QueryEngine(pub, cfg=CFG).start()
+        from analyzer_tpu.loadgen.matchmaker import EngineServeClient
+
+        return ShardMatchmaker(
+            players, EngineServeClient(eng), shard, n_shards, seed=seed,
+            cfg=CFG,
+        )
+
+    def test_matches_are_shard_pure(self):
+        for shard in (0, 3):
+            mm = self._mm(shard)
+            for m in mm.form(12):
+                rows = list(m.team_a_rows) + list(m.team_b_rows)
+                assert all(r % 4 == shard for r in rows), (shard, rows)
+
+    def test_deterministic_per_seed_shard(self):
+        a = [
+            (m.mode, m.team_a_rows, m.team_b_rows, m.split)
+            for m in self._mm(2).form(10)
+        ]
+        b = [
+            (m.mode, m.team_a_rows, m.team_b_rows, m.split)
+            for m in self._mm(2).form(10)
+        ]
+        assert a == b
+        c = [m.team_a_rows for m in self._mm(1).form(10)]
+        assert c != [t[1] for t in a]
+
+    def test_sample_rows_distinct_global_shard_rows(self):
+        mm = self._mm(1)
+        rows = mm.sample_rows(8)
+        assert len(set(rows)) == 8
+        assert all(r % 4 == 1 for r in rows)
+
+    def test_too_small_shard_rejected(self):
+        with pytest.raises(ValueError, match="at least 10"):
+            self._mm(0, n_shards=16, n_players=100)
+
+
+# ---------------------------------------------------------------------------
+class TestPartitionSubscription:
+    def _broker(self):
+        from analyzer_tpu.service.broker import PartitionedBroker
+
+        b = PartitionedBroker(partitions=4)
+        b.declare_queue("analyze")
+        return b
+
+    def _publish(self, b, n=12):
+        for i in range(n):
+            b.publish(
+                "analyze", json.dumps({"i": i}).encode(),
+                headers={"x-partition": i % 4},
+            )
+
+    def test_owned_only_in_seq_order(self):
+        from analyzer_tpu.service.broker import PartitionSubscription
+
+        b = self._broker()
+        self._publish(b)
+        sub0 = PartitionSubscription(b, (0, 2))
+        sub1 = PartitionSubscription(b, (1, 3))
+        got0 = [json.loads(m.body)["i"] for m in sub0.get("analyze", 100)]
+        got1 = [json.loads(m.body)["i"] for m in sub1.get("analyze", 100)]
+        assert got0 == [0, 2, 4, 6, 8, 10]
+        assert got1 == [1, 3, 5, 7, 9, 11]
+
+    def test_depths_restricted_to_owned(self):
+        from analyzer_tpu.service.broker import PartitionSubscription
+
+        b = self._broker()
+        self._publish(b, 8)
+        sub = PartitionSubscription(b, (1,))
+        assert sub.qsize("analyze") == 2
+        assert b.qsize("analyze") == 8
+        assert set(sub.partition_depths("analyze")) == {1}
+
+    def test_validation(self):
+        from analyzer_tpu.service.broker import PartitionSubscription
+
+        b = self._broker()
+        with pytest.raises(ValueError):
+            PartitionSubscription(b, ())
+        with pytest.raises(ValueError):
+            PartitionSubscription(b, (4,))
+        with pytest.raises(ValueError):
+            PartitionSubscription(b, (-1,))
+
+    def test_dead_letter_keeps_original_partition(self):
+        from analyzer_tpu.service.broker import PartitionSubscription
+
+        b = self._broker()
+        b.declare_queue("analyze.dead")
+        self._publish(b, 4)
+        sub = PartitionSubscription(b, (2,))
+        (msg,) = sub.get("analyze", 10)
+        # The worker's dead-letter path republishes through the
+        # subscription with the ORIGINAL headers — poison stays
+        # attributed to the owning shard.
+        sub.publish("analyze.dead", msg.body, headers=msg.headers)
+        sub.ack(msg.delivery_tag)
+        depths = b.partition_depths("analyze.dead")
+        assert depths[2]["live"] == 1
+
+
+# ---------------------------------------------------------------------------
+class TestMeshFabricGuard:
+    """Satellite: the retired single-process guard in
+    parallel/mesh.py rate_history_sharded."""
+
+    def _setup(self, n_matches=40, n_players=24, batch_size=8, seed=11):
+        from analyzer_tpu.io.synthetic import (
+            synthetic_players,
+            synthetic_stream,
+        )
+        from analyzer_tpu.sched import pack_schedule
+
+        players = synthetic_players(n_players, seed=seed)
+        stream = synthetic_stream(n_matches, players, seed=seed)
+        state = PlayerState.create(
+            n_players,
+            rank_points_ranked=players.rank_points_ranked,
+            rank_points_blitz=players.rank_points_blitz,
+            skill_tier=players.skill_tier,
+        )
+        sched = pack_schedule(
+            stream, pad_row=state.pad_row, batch_size=batch_size
+        )
+        return state, sched
+
+    def test_multiprocess_without_directory_points_at_cli_fabric(
+        self, monkeypatch
+    ):
+        import jax
+
+        from analyzer_tpu.parallel import make_mesh, rate_history_sharded
+        from analyzer_tpu.serve.view import ShardedViewPublisher
+
+        state, sched = self._setup()
+        mesh = make_mesh(min(2, len(jax.devices())))
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        with pytest.raises(ValueError, match="cli fabric"):
+            rate_history_sharded(
+                state, sched, CFG, mesh=mesh,
+                view_publisher=ShardedViewPublisher(
+                    mesh.devices.size, min_publish_interval_s=0.0
+                ),
+            )
+
+    def test_multiprocess_with_directory_publishes_owned_shards(
+        self, monkeypatch
+    ):
+        import jax
+
+        from analyzer_tpu.parallel import make_mesh, rate_history_sharded
+        from analyzer_tpu.serve.view import ShardedViewPublisher
+
+        if not hasattr(jax, "shard_map"):
+            pytest.skip("jax.shard_map unavailable in this build")
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 virtual devices")
+        state, sched = self._setup()
+        mesh = make_mesh(2)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        directory = FabricDirectory(FabricTopology(2, 2))
+        pub = ShardedViewPublisher(2, min_publish_interval_s=0.0)
+        final = rate_history_sharded(
+            state, sched, CFG, mesh=mesh, view_publisher=pub,
+            fabric_directory=directory,
+        )
+        # This process (index 0) published only shard 0's rows, under
+        # versions the directory recorded.
+        assert directory.vector()[0] >= 1
+        view = pub.current()
+        assert view is not None
+        ft = np.asarray(final.table)
+        for r in range(24):
+            got = view.resolve(str(r))
+            if r % 2 == 0:
+                assert got is not None
+                np.testing.assert_array_equal(
+                    view.host_table()[got], ft[r]
+                )
+            else:
+                assert got is None, f"non-owned row {r} published"
+
+
+# ---------------------------------------------------------------------------
+class TestBeginFabric:
+    def test_wraps_staging_in_ownership_filter(self):
+        from analyzer_tpu.migrate.lineage import LineageManager
+
+        live = _FakeShardedPublisher(4)
+        live.version = 7
+        mgr = LineageManager(live, factory=lambda: _FakeShardedPublisher(4))
+        d = FabricDirectory(FabricTopology(4, 2))
+        wrapped = mgr.begin_fabric(d, host=1, clock=lambda: 2.0)
+        assert isinstance(wrapped, FabricShardPublisher)
+        assert wrapped.inner is mgr.staging  # raw lineage stays managed
+        patches = [
+            (np.array([s]), np.full((1, 16), s, np.float32))
+            for s in range(4)
+        ]
+        wrapped.publish_shard_patches(patches, 8, lambda: None)
+        sent = mgr.staging.published[0]
+        assert len(sent[0][0]) == 0 and sent[1][0].tolist() == [1]
+        assert d.vector()[1] == 1
+        mgr.abort()
+        assert mgr.staging is None
+
+    def test_one_migration_at_a_time_still_enforced(self):
+        from analyzer_tpu.migrate.lineage import LineageManager
+
+        mgr = LineageManager(
+            _FakeShardedPublisher(2),
+            factory=lambda: _FakeShardedPublisher(2),
+        )
+        d = FabricDirectory(FabricTopology(2, 2))
+        mgr.begin_fabric(d, host=0)
+        with pytest.raises(RuntimeError, match="already in flight"):
+            mgr.begin_fabric(d, host=0)
+
+
+# ---------------------------------------------------------------------------
+def fabric_artifact(**over):
+    art = {
+        "metric": "fabric.matches_per_sec_per_host",
+        "value": 50.0,
+        "config": {"warmup": True},
+        "capture": {"degraded": False},
+        "deterministic": {
+            "matches_published": 100, "matches_rated": 100,
+            "dead_letters": 0, "view_staleness_ticks_max": 1,
+        },
+        "fleet": {
+            "n_hosts": 2,
+            "hosts": [
+                {"host": 0, "retraces_steady": 0.0},
+                {"host": 1, "retraces_steady": 0.0},
+            ],
+            "burning": [],
+        },
+        "measured": {"remote_lookup_p99_ms": 4.5},
+        "latency_ms": {"p99": 4.5},
+        "slo": {"thresholds": {"max_view_lag_ticks": 2}},
+    }
+    for k, v in over.items():
+        node = art
+        *path, leaf = k.split(".")
+        for p in path:
+            node = node[p]
+        node[leaf] = v
+    return art
+
+
+class TestBenchdiffFabricFamily:
+    def test_configs_and_family_filter(self):
+        from analyzer_tpu.obs.benchdiff import (
+            FAMILIES,
+            bench_configs,
+            family_configs,
+        )
+
+        assert FAMILIES["fabric"] == "FABRIC_BENCH"
+        configs = family_configs(
+            bench_configs(fabric_artifact()), "fabric"
+        )
+        by_name = {c.name: c for c in configs}
+        assert by_name["fabric.matches_per_sec_per_host"].higher_is_better
+        assert not by_name["fabric.remote_lookup_p99_ms"].higher_is_better
+        assert not by_name[
+            "fabric.view_staleness_ticks_max"
+        ].higher_is_better
+
+    def test_slo_violations(self):
+        from analyzer_tpu.obs.benchdiff import fabric_slo_violations
+
+        assert fabric_slo_violations(fabric_artifact()) == []
+        v = fabric_slo_violations(
+            fabric_artifact(**{"deterministic.matches_rated": 90})
+        )
+        assert any("lost work" in s for s in v)
+        v = fabric_slo_violations(
+            fabric_artifact(**{"deterministic.dead_letters": 2})
+        )
+        assert any("dead letters" in s for s in v)
+        v = fabric_slo_violations(
+            fabric_artifact(**{"deterministic.view_staleness_ticks_max": 5})
+        )
+        assert any("staleness" in s for s in v)
+        v = fabric_slo_violations(
+            fabric_artifact(**{"fleet.burning": ["zero-dead-letters"]})
+        )
+        assert any("burning" in s for s in v)
+        art = fabric_artifact()
+        art["fleet"]["hosts"][1]["retraces_steady"] = 2.0
+        assert any("retraces" in s for s in fabric_slo_violations(art))
+        # warmup=False runs measure warmup compiles too — ungated.
+        art["config"]["warmup"] = False
+        assert fabric_slo_violations(art) == []

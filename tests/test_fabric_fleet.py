@@ -1,0 +1,517 @@
+"""3-host fabric acceptance: REAL shard-owning subprocesses.
+
+Extends the two-worker fleet template (tests/test_federate.py
+TestTwoWorkerTopology) to the fabric's shape: three
+``analyzer_tpu.fabric.process`` children, each owning ``shard % 3``
+of a 6-shard topology, fed per-(tick, shard) match groups by this
+(driver) process over the ``/fabric/*`` control plane. Asserts the
+ISSUE's satellite contract end to end:
+
+  * partitioned publish — every group lands on the shard's owner and
+    drains inside the call (the bit-identity barrier);
+  * cross-host reads — point lookups split by owner and the merged
+    leaderboard/tiers/percentile are BIT-IDENTICAL to a single
+    in-process plane holding the union of the hosts' published tables;
+  * version monotonicity — every host's published version advances
+    through the run and never rewinds in the directory;
+  * fleet SLOs — the Collector scrapes all three hosts, stays green
+    through the rated load, and attributes an injected dead-letter burn
+    to exactly the burned host;
+  * host death — exiting one host leaves the merge (readers keep
+    serving from the survivors, point lookups to the dead owner fail
+    loudly) without wedging;
+  * trace stitching — a traced match's chain is complete across the
+    process boundary, ``broker_transit`` measured on every match.
+
+Plus the headline determinism check: the full
+:class:`~analyzer_tpu.fabric.driver.FabricSoakDriver` deterministic
+block is bit-identical across host counts (1 vs 2 in tier-1; 4 in the
+slow lane).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.fabric import (
+    FabricDirectory,
+    FabricRouter,
+    FabricTopology,
+    row_of_id,
+)
+from analyzer_tpu.fabric.route import HostDownError
+from analyzer_tpu.loadgen.matchmaker import player_id
+from analyzer_tpu.obs import reset_registry
+from analyzer_tpu.obs.federate import Collector
+from analyzer_tpu.obs.tracer import reset_tracer
+from analyzer_tpu.serve import QueryEngine, ViewPublisher
+from tests.hostmesh import REPO, scrubbed_env
+
+CFG = RatingConfig()
+
+N_SHARDS = 6
+N_HOSTS = 3
+N_PLAYERS = 120
+BATCH = 8
+SEED = 13
+TICKS = 2
+
+
+@pytest.fixture(autouse=True)
+def fresh_planes():
+    reset_registry()
+    reset_tracer()
+    yield
+    reset_registry()
+    reset_tracer()
+
+
+def http_get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+def post_json(url, obj, timeout=300):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def seed_table() -> np.ndarray:
+    """The same population every host builds from ``seed`` — the parent
+    keeps the union for oracle planes."""
+    from analyzer_tpu.core.state import PlayerState
+    from analyzer_tpu.io.synthetic import synthetic_players
+
+    players = synthetic_players(N_PLAYERS, seed=SEED)
+    state = PlayerState.create(
+        N_PLAYERS,
+        rank_points_ranked=players.rank_points_ranked,
+        rank_points_blitz=players.rank_points_blitz,
+        skill_tier=players.skill_tier,
+        cfg=CFG,
+    )
+    return np.asarray(state.table)[:N_PLAYERS].copy()
+
+
+def oracle_engine(table: np.ndarray) -> QueryEngine:
+    pub = ViewPublisher(min_publish_interval_s=0.0)
+    pub.publish_rows([player_id(r) for r in range(N_PLAYERS)], table)
+    return QueryEngine(pub, cfg=CFG).start()
+
+
+def strip(resp: dict) -> dict:
+    return FabricRouter.strip_versions(resp)
+
+
+class TestThreeHostFabric:
+    def _spawn(self, tmp_path, host):
+        spec = {
+            "host": host,
+            "n_shards": N_SHARDS,
+            "n_hosts": N_HOSTS,
+            "seed": SEED,
+            "n_players": N_PLAYERS,
+            "batch_size": BATCH,
+            "trace": True,
+            "trace_out": str(tmp_path / f"host{host}.jsonl"),
+            "ready_file": str(tmp_path / f"ready{host}"),
+            "exit_file": str(tmp_path / f"exit{host}"),
+            "max_wall_s": 600.0,
+        }
+        spec_path = tmp_path / f"spec{host}.json"
+        spec_path.write_text(json.dumps(spec))
+        env = scrubbed_env(extra={"JAX_PLATFORMS": "cpu"})
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "analyzer_tpu.fabric.process",
+             str(spec_path)],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        return proc, spec
+
+    @staticmethod
+    def _await_file(path, procs, timeout=280.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if os.path.exists(path):
+                return
+            for proc in procs:
+                if proc.poll() is not None and proc.returncode != 0:
+                    out, err = proc.communicate()
+                    raise AssertionError(
+                        f"fabric host died rc={proc.returncode}\n"
+                        f"stdout:\n{out}\nstderr:\n{err}"
+                    )
+            time.sleep(0.1)
+        raise AssertionError(f"timed out waiting for {path}")
+
+    def _shard_pure_specs(self, tick: int):
+        """One handcrafted 3v3 per shard per tick — every row ≡ shard
+        (mod N_SHARDS), trace-minted, partition-stamped."""
+        from analyzer_tpu.obs import tracectx
+
+        specs = {}
+        for s in range(N_SHARDS):
+            mid = f"fleet-t{tick}-s{s}"
+            ctx = tracectx.mint(mid)
+            headers = dict(tracectx.headers(ctx) or {})
+            headers["x-partition"] = s
+            specs[s] = {
+                "id": mid,
+                "mode": "ranked",
+                "a_rows": [s, s + N_SHARDS, s + 2 * N_SHARDS],
+                "b_rows": [
+                    s + 3 * N_SHARDS, s + 4 * N_SHARDS, s + 5 * N_SHARDS
+                ],
+                "winner": (tick + s) % 2,
+                "afk": False,
+                "created_at": tick * N_SHARDS + s,
+                "headers": headers,
+            }
+        return specs
+
+    def test_three_host_fabric_end_to_end(self, tmp_path):
+        from analyzer_tpu.obs import tracectx
+        from analyzer_tpu.obs.snapshot import write_chrome_trace
+        from analyzer_tpu.obs.traceview import (
+            build_model,
+            critical_path,
+            load_forest,
+            match_report,
+            verify_chain,
+        )
+
+        topology = FabricTopology(N_SHARDS, N_HOSTS)
+        table = seed_table()
+        procs, specs = [], []
+        collector = None
+        try:
+            for h in range(N_HOSTS):
+                proc, spec = self._spawn(tmp_path, h)
+                procs.append(proc)
+                specs.append(spec)
+            ready = []
+            for spec in specs:
+                self._await_file(spec["ready_file"], procs)
+                with open(spec["ready_file"]) as f:
+                    ready.append(json.load(f))
+
+            directory = FabricDirectory(topology, down_after_s=1e9)
+            for info in ready:
+                directory.register(
+                    info["host"], serve_url=info["serve_url"], now=0.0
+                )
+            router = FabricRouter(directory, cfg=CFG)
+
+            # -- seed: each host gets exactly its owned slice ----------
+            for info in ready:
+                h = info["host"]
+                owned = [
+                    r for r in range(N_PLAYERS)
+                    if topology.host_of_row(r) == h
+                ]
+                resp = post_json(
+                    info["control_url"] + "/fabric/seed",
+                    {
+                        "ids": [player_id(r) for r in owned],
+                        "rows": [
+                            [float(x) for x in table[r]] for r in owned
+                        ],
+                    },
+                )
+                assert resp["version"] == 1 and resp["n"] == len(owned)
+                directory.observe(h, resp["version"], 0.0)
+
+            # A foreign id is rejected loudly, not silently adopted.
+            foreign = player_id(
+                next(
+                    r for r in range(N_PLAYERS)
+                    if topology.host_of_row(r) != ready[0]["host"]
+                )
+            )
+            with pytest.raises(urllib.error.HTTPError) as err:
+                post_json(
+                    ready[0]["control_url"] + "/fabric/seed",
+                    {"ids": [foreign], "rows": [[0.0] * 16]},
+                )
+            assert err.value.code == 400
+
+            # -- pre-rating: routed reads == single union plane --------
+            oracle = oracle_engine(table)
+            ids = [player_id(r) for r in (0, 7, 14, 33, 119)]
+            assert strip(router.get_ratings(ids)) == strip(
+                oracle.get_ratings(ids)
+            )
+            assert strip(router.leaderboard(10)) == strip(
+                oracle.leaderboard(10)
+            )
+            assert strip(router.tier_histogram()) == strip(
+                oracle.tier_histogram()
+            )
+
+            # -- collector over all three hosts ------------------------
+            targets = [f"127.0.0.1:{i['obs_port']}" for i in ready]
+            collector = Collector(targets, request_flight_dumps=False)
+            collector.scrape(0.0)
+            assert collector.fleetz()["up"] == N_HOSTS
+            assert not collector.burning
+
+            # -- partitioned publish: per-(tick, shard) groups ---------
+            tracectx.enable_tracing(True)
+            versions = {h: [1] for h in range(N_HOSTS)}
+            all_mids = []
+            try:
+                for tick in range(TICKS):
+                    now = float(tick + 1)
+                    shard_specs = self._shard_pure_specs(tick)
+                    all_mids.extend(m["id"] for m in shard_specs.values())
+                    for s in range(N_SHARDS):  # fixed shard order
+                        h = topology.host_of_shard(s)
+                        resp = post_json(
+                            ready[h]["control_url"] + "/fabric/rate",
+                            {
+                                "now": now,
+                                "matches": [shard_specs[s]],
+                                "peer_versions": {
+                                    str(k): v
+                                    for k, v in directory.vector().items()
+                                },
+                            },
+                        )
+                        assert resp["dead_letters"] == 0
+                        directory.observe(h, resp["version"], now)
+                        versions[h].append(resp["version"])
+            finally:
+                tracectx.enable_tracing(False)
+            pub_trace = tmp_path / "publisher.jsonl"
+            write_chrome_trace(str(pub_trace))
+
+            # Monotone and advancing: each host saw one group per owned
+            # shard per tick, every group published at least one batch.
+            for h, seq in versions.items():
+                assert seq == sorted(seq), (h, seq)
+                assert seq[-1] > 1, (h, seq)
+
+            # A shard-impure group is refused by the owner.
+            with pytest.raises(urllib.error.HTTPError) as err:
+                post_json(
+                    ready[0]["control_url"] + "/fabric/rate",
+                    {
+                        "now": float(TICKS + 1),
+                        "matches": [{
+                            "id": "impure", "mode": "ranked",
+                            "a_rows": [0, 1, 2], "b_rows": [3, 4, 5],
+                            "winner": 0, "afk": False, "created_at": 0,
+                        }],
+                    },
+                )
+            assert err.value.code == 400
+
+            # -- post-rating: reassemble, then merge == union plane ----
+            rated = np.zeros((N_PLAYERS, table.shape[1]), np.float32)
+            seen = set()
+            for info in ready:
+                t = json.loads(
+                    http_get(info["control_url"] + "/fabric/table")[1]
+                )
+                assert t["version"] >= versions[info["host"]][-1]
+                for pid, row in zip(t["ids"], t["rows"]):
+                    r = row_of_id(pid)
+                    assert topology.host_of_row(r) == info["host"]
+                    rated[r] = np.asarray(row, np.float32)
+                    seen.add(r)
+            assert len(seen) == N_PLAYERS, "hosts dropped rows"
+            assert not np.array_equal(rated, table), "nothing was rated"
+
+            oracle2 = oracle_engine(rated)
+            assert strip(router.leaderboard(10)) == strip(
+                oracle2.leaderboard(10)
+            )
+            assert strip(router.leaderboard(N_PLAYERS)) == strip(
+                oracle2.leaderboard(N_PLAYERS)
+            )
+            assert strip(router.tier_histogram()) == strip(
+                oracle2.tier_histogram()
+            )
+            assert strip(router.get_ratings(ids)) == strip(
+                oracle2.get_ratings(ids)
+            )
+            p = router.percentile(1500.0)
+            op = oracle2.percentile(1500.0)
+            assert (p["below"], p["rated"]) == (op["below"], op["rated"])
+            # Cross-owner winprob replays the kernel over remote rows.
+            a = [player_id(r) for r in (0, 1, 2)]
+            b = [player_id(r) for r in (3, 4, 5)]
+            assert strip(router.win_probability(a, b)) == strip(
+                oracle2.win_probability(a, b)
+            )
+
+            # -- fleet SLOs green, then a burn attributed to host 1 ----
+            collector.scrape(10.0)
+            assert not collector.burning, collector.burning
+            merged = collector.fleet_snapshot()
+            assert (
+                merged["counters"]["worker.matches_rated_total"]
+                == TICKS * N_SHARDS
+            )
+            for info, target in zip(ready, targets):
+                owned_shards = len(topology.owned_shards(info["host"]))
+                key = f"worker.matches_rated_total{{host={target}}}"
+                assert merged["counters"][key] == TICKS * owned_shards
+
+            post_json(
+                ready[1]["control_url"] + "/fabric/burn", {"count": 3}
+            )
+            collector.scrape(40.0)
+            collector.scrape(71.0)
+            assert "zero-dead-letters" in collector.burning
+            assert collector.attribution()["zero-dead-letters"] == [
+                targets[1]
+            ]
+
+            # -- finish accounting: no lost work, no dead letters ------
+            total_rated = 0
+            for info in ready:
+                fin = post_json(
+                    info["control_url"] + "/fabric/finish", {}
+                )
+                total_rated += fin["matches_rated"]
+                # The burn was injected telemetry (the registry counter
+                # the SLO watches), not a real poison message: the
+                # worker's own accounting stays clean.
+                assert fin["dead_letters"] == 0
+            assert total_rated == TICKS * N_SHARDS
+
+            # -- host death: the merge survives, the owner's rows fail
+            #    loudly, nothing wedges --------------------------------
+            with open(specs[2]["exit_file"], "w") as f:
+                f.write("done\n")
+            procs[2].wait(timeout=60)
+            resp = router.leaderboard(N_PLAYERS)  # first call marks down
+            assert directory.entry(2).down is True
+            assert "2" not in resp["versions"]
+            survivors = {
+                player_id(r)
+                for r in range(N_PLAYERS)
+                if topology.host_of_row(r) != 2
+            }
+            leaders = {e["id"] for e in resp["leaders"]}
+            assert leaders and leaders <= survivors
+            dead_owned = player_id(
+                next(
+                    r for r in range(N_PLAYERS)
+                    if topology.host_of_row(r) == 2
+                )
+            )
+            with pytest.raises(HostDownError):
+                router.get_ratings([dead_owned])
+            # Readers are not wedged: the next merge still answers,
+            # counting only the survivors' populations.
+            rated_now = router.tier_histogram()["rated"]
+            assert 0 < rated_now <= len(survivors)
+
+            # -- graceful exit, then cross-process trace stitching -----
+            for spec in specs[:2]:
+                with open(spec["exit_file"], "w") as f:
+                    f.write("done\n")
+            for proc in procs[:2]:
+                proc.wait(timeout=60)
+
+            events = load_forest([
+                str(pub_trace),
+                specs[0]["trace_out"],
+                specs[1]["trace_out"],
+                specs[2]["trace_out"],
+            ])
+            model = build_model(events)
+            assert model.hosts == {
+                "publisher", "host0", "host1", "host2"
+            }
+            assert sorted(model.match_batch) == sorted(all_mids)
+            for mid in all_mids:
+                problems = verify_chain(model, mid)
+                assert problems == [], (mid, problems)
+                rep = match_report(model, mid)
+                shard = int(mid.rsplit("s", 1)[1])
+                assert rep["enqueue_host"] == "publisher"
+                assert rep["batch_host"] == (
+                    f"host{topology.host_of_shard(shard)}"
+                )
+                transit = rep["stages_ms"]["broker_transit"]
+                assert transit is not None and transit >= 0
+                assert rep["publish_version"] is not None
+            cp = critical_path(model)
+            assert set(cp["hosts"]) <= {
+                "publisher", "host0", "host1", "host2"
+            }
+            assert cp["dominant_stage"] in cp["stages_ms"]
+        finally:
+            for spec in specs:
+                try:
+                    with open(spec["exit_file"], "w") as f:
+                        f.write("done\n")
+                except OSError:
+                    pass
+            for proc in procs:
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+def _soak(hosts: int, ticks: int = 3):
+    from analyzer_tpu.fabric.driver import FabricSoakConfig, FabricSoakDriver
+
+    reset_registry()
+    reset_tracer()
+    driver = FabricSoakDriver(FabricSoakConfig(
+        seed=7, duration_s=float(ticks), tick_s=1.0, qps=8.0,
+        query_qps=4.0, n_players=120, batch_size=16, n_shards=4,
+        n_hosts=hosts, warmup=False, trace=False, scrape=False,
+    ))
+    try:
+        return driver.run()
+    finally:
+        driver.close()
+
+
+class TestFabricSoakBitIdentity:
+    """The headline: the deterministic block of a fabric soak is a pure
+    function of (seed, config) — the host count is not an input."""
+
+    def test_hosts_1_vs_2_bit_identical(self):
+        one = _soak(1)
+        two = _soak(2)
+        assert one["slo"]["pass"], one["slo"]["violations"]
+        assert two["slo"]["pass"], two["slo"]["violations"]
+        assert json.dumps(one["deterministic"], sort_keys=True) == (
+            json.dumps(two["deterministic"], sort_keys=True)
+        )
+        assert two["fleet"]["n_hosts"] == 2
+        assert len(two["fleet"]["hosts"]) == 2
+        # Work actually distributed: every host rated something.
+        assert all(
+            h["matches_rated"] > 0 for h in two["fleet"]["hosts"]
+        )
+
+    @pytest.mark.slow
+    def test_hosts_4_bit_identical(self):
+        one = _soak(1)
+        four = _soak(4)
+        assert json.dumps(one["deterministic"], sort_keys=True) == (
+            json.dumps(four["deterministic"], sort_keys=True)
+        )

@@ -2503,3 +2503,95 @@ class TestGL047QualityPlane:
         from analyzer_tpu.lint.findings import RULES
 
         assert "GL047" in RULES
+
+
+class TestGL048Fabric:
+    """GL048 guards the multi-host rate fabric (analyzer_tpu/fabric/):
+    the soak's deterministic block is bit-identical per (seed, config)
+    at every host count, so fabric decisions ride the injected clock
+    (clock half), and cross-host table access goes through the
+    directory/route helpers — a direct host_table() outside
+    route.py/host.py is the torn-view bug the version protocol exists
+    to prevent (access half)."""
+
+    WALL_CLOCK_SRC = """
+    import time
+
+    def next_tick(state):
+        return state.advance(time.monotonic())
+    """
+
+    TABLE_SRC = """
+    def peek(view):
+        return view.host_table()[:8]
+    """
+
+    def test_wall_clock_fires_only_inside_fabric(self):
+        for path in (
+            "analyzer_tpu/fabric/directory.py",
+            "analyzer_tpu/fabric/matchmaker.py",
+            "analyzer_tpu/fabric/driver.py",
+        ):
+            assert "GL048" in rules_of(self.WALL_CLOCK_SRC, path), path
+        for path in (
+            "analyzer_tpu/service/worker.py",
+            "analyzer_tpu/obs/prof.py",  # the capture side owns clocks
+        ):
+            assert "GL048" not in rules_of(self.WALL_CLOCK_SRC, path), path
+
+    def test_every_wall_clock_needle_fires(self):
+        src = """
+        import time
+        import datetime
+
+        def bad():
+            time.time()
+            time.perf_counter()
+            time.sleep(1)
+            datetime.datetime.now()
+        """
+        assert rules_of(src, "analyzer_tpu/fabric/topology.py") == (
+            ["GL048"] * 4
+        )
+
+    def test_host_table_access_fires_outside_homes(self):
+        for path in (
+            "analyzer_tpu/fabric/driver.py",
+            "analyzer_tpu/fabric/publish.py",
+            "analyzer_tpu/fabric/directory.py",
+        ):
+            assert "GL048" in rules_of(self.TABLE_SRC, path), path
+
+    def test_host_table_access_sanctioned_in_homes_and_tests(self):
+        for path in (
+            "analyzer_tpu/fabric/route.py",   # kernel-replay read path
+            "analyzer_tpu/fabric/host.py",    # a host's OWN view
+            "tests/test_fabric.py",
+            "analyzer_tpu/serve/view.py",     # outside the fabric layer
+        ):
+            assert rules_of(self.TABLE_SRC, path) == [], path
+
+    def test_line_scoped_disable_works(self):
+        src = """
+        import time
+
+        def liveness(spec):
+            return time.time() + spec["max_wall_s"]  # graftlint: disable=GL048 — subprocess liveness deadline, wall-shaped by nature
+        """
+        assert rules_of(src, "analyzer_tpu/fabric/process.py") == []
+
+    def test_shipping_fabric_modules_are_clean(self):
+        fabric_dir = os.path.join(_REPO, "analyzer_tpu", "fabric")
+        mods = sorted(
+            m for m in os.listdir(fabric_dir) if m.endswith(".py")
+        )
+        assert mods, fabric_dir
+        for mod in mods:
+            rel = f"analyzer_tpu/fabric/{mod}"
+            with open(os.path.join(_REPO, rel), encoding="utf-8") as f:
+                assert rules_of(f.read(), rel) == [], rel
+
+    def test_catalog_has_gl048(self):
+        from analyzer_tpu.lint.findings import RULES
+
+        assert "GL048" in RULES

@@ -648,6 +648,7 @@ class TestStats:
         "migration",
         "slo",
         "quality",
+        "fabric",
     }
 
     #: The calibration ledger's nested keys when quality is on (ISSUE
