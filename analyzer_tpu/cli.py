@@ -1088,6 +1088,22 @@ def cmd_benchdiff(args) -> int:
                 file=sys.stderr,
             )
             return 1
+        # And the front door's native-codec contract (same pattern as
+        # the migrate family's assign-native gate): a baseline whose
+        # socket plane rendered every response through the zero-copy
+        # native codec and a candidate reporting native: false means the
+        # codec silently fell back to python json.dumps — a route flip a
+        # delta gate would merely call "slower".
+        a_native = bool((a_raw.get("frontdoor") or {}).get("native"))
+        b_native = bool((b_raw.get("frontdoor") or {}).get("native"))
+        if a_native and not b_native:
+            print(
+                f"error: {os.path.basename(b_path)} has no native-codec "
+                f"front door capture but {os.path.basename(a_path)} does "
+                "(silent fall-back to the python json encoder?)",
+                file=sys.stderr,
+            )
+            return 1
     # The vanished-block contract for profile intelligence (any family —
     # bench --profile stamps the block wherever a capture was armed): a
     # baseline whose device profile parsed and a candidate whose profile
@@ -1772,6 +1788,10 @@ def cmd_soak(args) -> int:
     if args.backfill_qps < 0:
         print("error: --backfill-qps must be >= 0", file=sys.stderr)
         return 2
+    if args.serve_http and args.in_process:
+        print("error: --serve-http drives the HTTP socket path; it cannot "
+              "combine with --in-process", file=sys.stderr)
+        return 2
     if args.backfill_qps > 0 and not args.priority_lanes:
         print("error: --backfill-qps needs --priority-lanes (backfill "
               "traffic rides the backfill lane)", file=sys.stderr)
@@ -1800,6 +1820,7 @@ def cmd_soak(args) -> int:
         afk_rate=args.afk_rate,
         warmup=not args.no_warmup,
         use_http=not args.in_process,
+        serve_http=args.serve_http,
         serve_shards=args.serve_shards,
         broker_partitions=args.broker_partitions,
         priority_lanes=args.priority_lanes,
@@ -2785,6 +2806,14 @@ def main(argv=None) -> int:
     s.add_argument(
         "--in-process", action="store_true",
         help="query the engine in-process instead of over HTTP /v1/*",
+    )
+    s.add_argument(
+        "--serve-http", action="store_true",
+        help="drive the HTTP query workload through the concurrent serve "
+        "front door (serve/frontdoor.py: keep-alive socket plane + native "
+        "codec) instead of the stdlib RoutedHTTPServer plane; the "
+        "deterministic block is bit-identical either way "
+        "(docs/serving.md \"Front door\")",
     )
     s.add_argument(
         "--serve-shards", type=int, default=1, metavar="S",

@@ -46,12 +46,12 @@ from __future__ import annotations
 import json
 import urllib.error
 import urllib.parse
-import urllib.request
 
 import numpy as np
 
 from analyzer_tpu.config import RatingConfig
 from analyzer_tpu.obs import get_registry
+from analyzer_tpu.obs.httpd import PooledHTTPClient
 from analyzer_tpu.serve.engine import (
     QueryEngine,
     UnknownPlayerError,
@@ -67,18 +67,21 @@ from analyzer_tpu.fabric.topology import row_of_id
 
 class HttpHostClient:
     """One host's ``/v1/*`` surface as a client (an HTTP *client* — the
-    listening sockets stay in serve/ + obs/, graftlint GL024)."""
+    listening sockets stay in serve/ + obs/, graftlint GL024). Rides
+    one pooled keep-alive connection
+    (:class:`~analyzer_tpu.obs.httpd.PooledHTTPClient`) instead of a
+    TCP handshake per lookup; the pool's urlopen-compatible errors keep
+    the router's mark-down semantics unchanged."""
 
     def __init__(self, base_url: str, timeout_s: float = 30.0) -> None:
         self.base_url = base_url.rstrip("/")
         self.timeout_s = float(timeout_s)
+        self.pool = PooledHTTPClient(self.base_url, timeout_s=timeout_s)
 
     def _get(self, path: str, params: dict | None = None) -> dict:
-        url = self.base_url + path
         if params:
-            url += "?" + urllib.parse.urlencode(params)
-        with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
-            return json.loads(resp.read().decode("utf-8"))
+            path += "?" + urllib.parse.urlencode(params)
+        return json.loads(self.pool.get(path).decode("utf-8"))
 
     def get_ratings(self, ids) -> dict:
         return self._get("/v1/ratings", {"ids": ",".join(ids)})

@@ -168,6 +168,18 @@ RULES: dict[str, str] = {
         "directory/route helpers; a raw read of a non-owned shard is "
         "the torn-view bug the version protocol prevents)"
     ),
+    "GL049": (
+        "front-door discipline: a json.dumps call in analyzer_tpu/"
+        "serve/ outside the codec module (serve/fastjson.py) and the "
+        "designated _error_body helpers (responses render through "
+        "ResponseCodec — byte-identical to the dumps oracle, python "
+        "fallback counted; a stray dumps walk dodges the vanished-"
+        "native benchdiff gate), or a wall-clock read in serve/"
+        "frontdoor.py (the event loop paces on selector readiness and "
+        "engine ticks; latency timestamps ride the engine's pendings, "
+        "so the HTTP-mode soak block stays bit-identical per (seed, "
+        "config))"
+    ),
 }
 
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")

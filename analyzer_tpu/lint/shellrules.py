@@ -340,6 +340,35 @@ _GL028_CLOCKS = {
     "datetime.date.today",
 }
 
+#: The serve front door (GL049, json half): response rendering in
+#: ``analyzer_tpu/serve/`` goes through ``serve/fastjson.ResponseCodec``
+#: — the native zero-copy encoder whose output is byte-identical to the
+#: ``json.dumps(obj, sort_keys=True)`` oracle and whose python fallback
+#: is COUNTED (``frontdoor.codec_fallbacks_total``, the bench's
+#: ``native`` flag, the benchdiff vanished-native gate). A stray
+#: ``json.dumps`` on a hot path silently forfeits the codec's
+#: throughput AND dodges every one of those tripwires.
+_GL049_DIRS = ("analyzer_tpu/serve/",)
+
+#: The codec module itself — the dumps oracle and the counted fallback
+#: live here by design; the whole file is exempt.
+_GL049_CODEC_HOME = ("analyzer_tpu/serve/fastjson.py",)
+
+#: Designated cold-path helpers allowed to call ``json.dumps`` outside
+#: the codec home: error bodies are rare, tiny, and must match the
+#: stdlib plane's bytes exactly.
+_GL049_HELPERS = frozenset({"_error_body"})
+
+#: The resolved call the json half needles on.
+_GL049_JSON = "json.dumps"
+
+#: The front door's event loop (GL049, clock half): the accept/parse/
+#: pump loop paces itself on selector readiness and the engine's
+#: microbatch ticks — latency telemetry rides the engine's injected
+#: timestamps, so a wall-clock read here is a pacing decision the
+#: soak's VirtualClock cannot see.
+_GL049_FRONTDOOR_FILES = ("analyzer_tpu/serve/frontdoor.py",)
+
 _BROAD = {"Exception", "BaseException"}
 _MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
                      ast.SetComp)
@@ -401,8 +430,15 @@ class ShellRules:
         tests = self._in_tests()
         pallas_home = self._in_pallas_home()
         table_home = self._in_table_home()
+        codec_home = self._in_codec_home()
+        frontdoor_home = self._in_frontdoor_home()
         merge_ranges = (
             self._merge_helper_ranges() if serve_layer and not tests else ()
+        )
+        error_helper_ranges = (
+            self._gl049_helper_ranges()
+            if serve_layer and not (tests or codec_home)
+            else ()
         )
         cutover_ranges = (
             self._cutover_entry_ranges() if migrate_layer and not tests
@@ -425,6 +461,10 @@ class ShellRules:
                     self._check_soak_determinism(node)
                 if serve_layer and not tests:
                     self._check_cross_shard_gather(node, merge_ranges)
+                    if not codec_home:
+                        self._check_serve_json(node, error_helper_ranges)
+                    if frontdoor_home:
+                        self._check_frontdoor_clock(node)
                 if schema_layer and not tests:
                     self._check_schema_name(node)
                 if ingest_layer and not tests:
@@ -634,6 +674,27 @@ class ShellRules:
             if (
                 isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
                 and node.name in _GL029_MERGE_HELPERS
+            ):
+                out.append((node.lineno, node.end_lineno or node.lineno))
+        return tuple(out)
+
+    def _in_codec_home(self) -> bool:
+        path = self.path.replace("\\", "/")
+        return any(path.endswith(frag) for frag in _GL049_CODEC_HOME)
+
+    def _in_frontdoor_home(self) -> bool:
+        path = self.path.replace("\\", "/")
+        return any(path.endswith(frag) for frag in _GL049_FRONTDOOR_FILES)
+
+    def _gl049_helper_ranges(self) -> tuple:
+        """(start, end) line spans of the designated error-body helpers
+        — the only functions in serve/ (outside the codec module)
+        sanctioned to call ``json.dumps`` (GL049)."""
+        out = []
+        for node in ast.walk(self.tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in _GL049_HELPERS
             ):
                 out.append((node.lineno, node.end_lineno or node.lineno))
         return tuple(out)
@@ -980,6 +1041,46 @@ class ShellRules:
                 "fabric (analyzer_tpu/fabric/) — take `now` from the "
                 "caller (the soak driver's VirtualClock); a decision on "
                 "wall time forks the deterministic block per host count",
+            )
+
+    def _check_serve_json(self, node: ast.Call, helper_ranges) -> None:
+        """GL049 (json half): a ``json.dumps`` call in serve/ outside
+        the codec module and the designated ``_error_body`` helpers —
+        responses render through ``serve/fastjson.ResponseCodec``, whose
+        python fallback is counted (``frontdoor.codec_fallbacks_total``,
+        the bench's ``native`` flag, benchdiff's vanished-native gate);
+        a stray dumps walk forfeits the native throughput and dodges
+        every tripwire that would have reported the route flip."""
+        resolved = self.imports.resolve(node.func)
+        if resolved != _GL049_JSON:
+            return
+        if any(lo <= node.lineno <= hi for lo, hi in helper_ranges):
+            return
+        self._flag(
+            "GL049", node,
+            "`json.dumps` in a serve/ hot path — render through "
+            "serve/fastjson.ResponseCodec (byte-identical to the dumps "
+            "oracle, fallback counted) or move the cold-path bytes into "
+            "a designated _error_body helper; a stray dumps walk "
+            "silently dodges the vanished-native benchdiff gate",
+        )
+
+    def _check_frontdoor_clock(self, node: ast.Call) -> None:
+        """GL049 (clock half): a wall-clock read inside the front
+        door's event loop (serve/frontdoor.py). The loop paces itself on
+        selector readiness and the engine's microbatch ticks; request
+        latency telemetry rides the engine's injected timestamps. A
+        stray ``time.monotonic()`` is a pacing decision the soak's
+        VirtualClock cannot see — the HTTP-mode deterministic block
+        must stay bit-identical to the in-process one."""
+        resolved = self.imports.resolve(node.func)
+        if resolved in _GL028_CLOCKS:
+            self._flag(
+                "GL049", node,
+                f"wall-clock read `{resolved}` in the front door "
+                "(serve/frontdoor.py) — pace on selector readiness and "
+                "engine ticks; latency timestamps come from the "
+                "engine's pendings, never from a clock here",
             )
 
     def _check_federate_clock(self, node: ast.Call) -> None:

@@ -32,11 +32,11 @@ from __future__ import annotations
 import dataclasses
 import json
 import urllib.parse
-import urllib.request
 
 import numpy as np
 
 from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.obs.httpd import PooledHTTPClient
 from analyzer_tpu.io.synthetic import AliasSampler, SyntheticPlayers
 
 #: Fixed ratings-lookup page: every conservative-rating fetch pads to
@@ -94,20 +94,24 @@ class EngineServeClient:
 
 class HttpServeClient:
     """ServeClient over a live ``/v1/*`` endpoint (an HTTP *client* —
-    the listening sockets stay in obs/ + serve/, graftlint GL024)."""
+    the listening sockets stay in obs/ + serve/, graftlint GL024).
+    Rides one pooled keep-alive connection
+    (:class:`~analyzer_tpu.obs.httpd.PooledHTTPClient`): the soak's
+    closed-loop query thread stops paying a TCP handshake per query,
+    which is what lets ``--serve-http`` drive the frontdoor at socket
+    rates instead of measuring connect latency."""
 
     def __init__(self, base_url: str, timeout: float = 30.0) -> None:
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.calls: dict[str, int] = {}
+        self.pool = PooledHTTPClient(self.base_url, timeout_s=timeout)
 
     def _get(self, kind: str, path: str, params: dict | None = None) -> dict:
         self.calls[kind] = self.calls.get(kind, 0) + 1
-        url = self.base_url + path
         if params:
-            url += "?" + urllib.parse.urlencode(params)
-        with urllib.request.urlopen(url, timeout=self.timeout) as resp:
-            return json.loads(resp.read().decode("utf-8"))
+            path += "?" + urllib.parse.urlencode(params)
+        return json.loads(self.pool.get(path).decode("utf-8"))
 
     def get_ratings(self, ids) -> dict:
         return self._get("ratings", "/v1/ratings", {"ids": ",".join(ids)})

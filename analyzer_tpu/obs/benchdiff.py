@@ -317,6 +317,34 @@ def bench_configs(data: dict) -> list[BenchConfig]:
                         degraded=s_degraded,
                     )
                 )
+        frontdoor = data.get("frontdoor") or {}
+        if frontdoor.get("queries_per_sec") is not None:
+            # The socket plane (serve/frontdoor.py): end-to-end qps over
+            # pipelined keep-alive connections (higher) and the client-
+            # observed p99 while a publisher thread republishes the view
+            # (lower — the number an operator pages on). A candidate that
+            # silently lost the native codec (``native`` false) is failed
+            # outright by the serve family's vanished-native gate in
+            # ``cli benchdiff`` instead of being diffed as an honest
+            # regression.
+            f_degraded = degraded or not frontdoor.get("stable", True)
+            out.append(
+                BenchConfig(
+                    name="frontdoor.queries_per_sec",
+                    value=float(frontdoor["queries_per_sec"]),
+                    higher_is_better=True,
+                    degraded=f_degraded,
+                )
+            )
+            if frontdoor.get("p99_ms_under_publish") is not None:
+                out.append(
+                    BenchConfig(
+                        name="frontdoor.p99_ms_under_publish",
+                        value=float(frontdoor["p99_ms_under_publish"]),
+                        higher_is_better=False,
+                        degraded=f_degraded,
+                    )
+                )
         return out
     if capture.get("min_over_predicted") is not None:
         out.append(

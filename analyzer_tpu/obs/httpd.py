@@ -24,8 +24,11 @@ stay inside ``analyzer_tpu/obs/`` + ``analyzer_tpu/serve/``, and a bare
 
 from __future__ import annotations
 
+import http.client
+import io
 import json
 import threading
+import urllib.error
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -95,6 +98,14 @@ class RoutedHTTPServer:
         class Handler(BaseHTTPRequestHandler):
             # The handler closes over the server object, not globals —
             # two planes in one process must not share route tables.
+
+            # Keep-alive: the stdlib default (HTTP/1.0) closes the TCP
+            # connection after every response, so each obsd scrape and
+            # HttpHostClient lookup paid a fresh handshake. Every _send
+            # stamps Content-Length, which is all HTTP/1.1 persistence
+            # requires.
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, fmt, *args):  # quiet: curl spam is DEBUG
                 logger.debug("%s: " + fmt, name, *args)
 
@@ -192,3 +203,107 @@ class RoutedHTTPServer:
         httpd.shutdown()
         httpd.server_close()
         self._thread.join(timeout=5)
+
+
+class PooledHTTPClient:
+    """One persistent keep-alive connection to a single ``host:port``.
+
+    The client side of :attr:`Handler.protocol_version` = HTTP/1.1: the
+    fabric's ``HttpHostClient`` and the loadgen's ``HttpServeClient``
+    used to ``urlopen`` per call — a fresh TCP handshake per lookup, by
+    far the dominant cost of a small GET. This pool holds ONE
+    ``http.client.HTTPConnection`` and reuses it across requests
+    (``frontdoor.pool_reuse_total`` counts the saved handshakes;
+    :attr:`reuse_count` is the per-pool view the tests assert on).
+
+    urlopen-compatible failure surface, so the routing/mark-down logic
+    above stays untouched: a non-2xx status raises
+    :class:`urllib.error.HTTPError` (body readable), a transport
+    failure raises :class:`urllib.error.URLError` (an ``OSError``). A
+    request that dies on a PREVIOUSLY-USED connection is retried once
+    on a fresh one — the server idle-closing between requests is the
+    one legal keep-alive race; a fresh-connection failure is real and
+    propagates. Thread-safe: one in-flight request at a time (lock);
+    callers that want parallelism hold one pool per thread or accept
+    the serialization.
+    """
+
+    def __init__(self, base_url: str, timeout_s: float = 30.0) -> None:
+        parsed = urllib.parse.urlsplit(base_url)
+        if parsed.scheme not in ("http", ""):
+            raise ValueError(f"PooledHTTPClient is http-only: {base_url!r}")
+        self.base_url = base_url.rstrip("/")
+        self.host = parsed.hostname or DEFAULT_HOST
+        self.port = parsed.port or 80
+        self.timeout_s = float(timeout_s)
+        self.reuse_count = 0
+        self.requests = 0
+        self._conn: http.client.HTTPConnection | None = None
+        self._lock = threading.Lock()
+
+    def _drop(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+    def _exchange(self, path_qs: str, fresh: bool) -> bytes:
+        conn = self._conn
+        conn.request("GET", path_qs)
+        resp = conn.getresponse()
+        body = resp.read()  # drain fully or the conn can't be reused
+        if resp.will_close:
+            self._drop()
+        if not fresh:
+            self.reuse_count += 1
+            _registry().counter("frontdoor.pool_reuse_total").add(1)
+        if not 200 <= resp.status < 300:
+            raise urllib.error.HTTPError(
+                self.base_url + path_qs, resp.status, resp.reason,
+                resp.headers, io.BytesIO(body),
+            )
+        return body
+
+    def get(self, path_qs: str) -> bytes:
+        """GET ``path_qs`` (path + encoded query) over the pooled
+        connection; returns the response body bytes."""
+        with self._lock:
+            self.requests += 1
+            fresh = self._conn is None
+            if fresh:
+                self._conn = http.client.HTTPConnection(
+                    self.host, self.port, timeout=self.timeout_s
+                )
+            try:
+                return self._exchange(path_qs, fresh)
+            except urllib.error.HTTPError:
+                raise
+            except (http.client.HTTPException, OSError) as err:
+                self._drop()
+                if fresh:
+                    raise urllib.error.URLError(err) from err
+                # Stale pooled connection: retry exactly once, fresh.
+                self._conn = http.client.HTTPConnection(
+                    self.host, self.port, timeout=self.timeout_s
+                )
+                try:
+                    return self._exchange(path_qs, True)
+                except urllib.error.HTTPError:
+                    raise
+                except (http.client.HTTPException, OSError) as err2:
+                    self._drop()
+                    raise urllib.error.URLError(err2) from err2
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop()
+
+
+def _registry():
+    # Lazy: httpd must stay importable in the jax-free CLI paths even
+    # if registry wiring changes; the counter is best-effort telemetry.
+    from analyzer_tpu.obs.registry import get_registry
+
+    return get_registry()

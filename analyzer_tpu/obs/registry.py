@@ -334,6 +334,17 @@ STANDARD_COUNTERS = (
     "fabric.remote_lookups_total",
     "fabric.remote_errors_total",
     "serve.view_adoptions_total",
+    # The serve front door (serve/frontdoor.py, docs/serving.md "Front
+    # door"): requests answered across all reader loops, response bytes
+    # rendered (native codec + counted python fallbacks — a nonzero
+    # fallback count flips the bench block's native flag), and
+    # keep-alive connection reuses saved by the pooled HTTP client
+    # (obs/httpd.py PooledHTTPClient — the client half of the same
+    # story). Pre-declared so a RoutedHTTPServer-only process reads 0.
+    "frontdoor.requests_total",
+    "frontdoor.encode_bytes_total",
+    "frontdoor.codec_fallbacks_total",
+    "frontdoor.pool_reuse_total",
 )
 STANDARD_GAUGES = (
     "worker.pipeline_lag",
@@ -424,6 +435,10 @@ STANDARD_GAUGES = (
     "fabric.hosts",
     "fabric.host_index",
     "fabric.owned_shards",
+    # Open sockets across the front door's reader loops: the /statusz
+    # saturation signal (docs/OPERATIONS.md "Diagnosing a saturated
+    # front door").
+    "frontdoor.connections",
 )
 
 #: Histogram families the runtime emits (graftlint GL030 resolves
@@ -561,6 +576,13 @@ SCHEMA_HELP = {
     "serve.view_version": "current served view version",
     "serve.view_age_seconds": "seconds since the current view published",
     "serve.shards": "shard count of the serving plane (0 = single)",
+    "frontdoor.connections": "open sockets across the front door readers",
+    "frontdoor.requests_total": "requests answered by the front door",
+    "frontdoor.encode_bytes_total": "response bytes rendered by the codec",
+    "frontdoor.codec_fallbacks_total":
+        "responses the native codec routed to the python encoder",
+    "frontdoor.pool_reuse_total":
+        "keep-alive connection reuses by the pooled HTTP client",
     "soak.ticks_total": "soak virtual ticks executed",
     "soak.matches_published_total": "matchmade matches pushed to the queue",
     "soak.queries_sent_total": "serve queries issued by the soak workload",

@@ -189,6 +189,172 @@ def sharded_phase(
     }
 
 
+def _read_response(sock, buf: bytearray) -> tuple[int, bytes]:
+    """One HTTP/1.1 response off a keep-alive socket (Content-Length
+    framing — the only framing the serve planes emit)."""
+    while True:
+        end = buf.find(b"\r\n\r\n")
+        if end >= 0:
+            break
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("peer closed mid-response")
+        buf += chunk
+    head = bytes(buf[:end])
+    status = int(head.split(None, 2)[1])
+    clen = 0
+    for line in head.split(b"\r\n")[1:]:
+        name, _, value = line.partition(b":")
+        if name.strip().lower() == b"content-length":
+            clen = int(value)
+    del buf[:end + 4]
+    while len(buf) < clen:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("peer closed mid-body")
+        buf += chunk
+    body = bytes(buf[:clen])
+    del buf[:clen]
+    return status, body
+
+
+def _frontdoor_client(port, paths, depth, lats, errs):
+    """One keep-alive connection driving ``paths`` in pipelined windows
+    of ``depth``; appends per-request client-observed latencies (s)."""
+    import socket as socketlib
+
+    sock = socketlib.create_connection(("127.0.0.1", port), timeout=60)
+    sock.setsockopt(socketlib.IPPROTO_TCP, socketlib.TCP_NODELAY, 1)
+    buf = bytearray()
+    try:
+        for i in range(0, len(paths), depth):
+            window = paths[i:i + depth]
+            payload = b"".join(
+                f"GET {p} HTTP/1.1\r\nHost: bench\r\n\r\n".encode()
+                for p in window
+            )
+            t_send = time.perf_counter()
+            sock.sendall(payload)
+            for _ in window:
+                status, _body = _read_response(sock, buf)
+                if status != 200:
+                    errs.append(status)
+                lats.append(time.perf_counter() - t_send)
+    finally:
+        sock.close()
+
+
+def frontdoor_phase(args, publisher, ids, table, cfg, reg, engine) -> dict:
+    """The socket plane measured under concurrent publishes.
+
+    Two sub-phases on the SAME engine: (a) the old stdlib
+    RoutedHTTPServer path driven urlopen-per-request — the effective
+    HTTP throughput every pre-frontdoor client saw (r01 has no HTTP
+    number, so the baseline is self-measured); (b) the FrontDoor driven
+    by ``--frontdoor-connections`` keep-alive sockets pipelining
+    ``--pipeline-depth`` deep, while a publisher thread republishes the
+    table — p99 under publish is the number an operator cares about.
+    """
+    import threading
+    import urllib.request
+
+    from analyzer_tpu.serve.frontdoor import FrontDoor
+    from analyzer_tpu.serve.server import ServeServer
+
+    matchups = gen_matchups(args.players, args.frontdoor_queries,
+                            args.seed + 4)
+    paths = [
+        f"/v1/winprob?a={','.join(a)}&b={','.join(b)}"
+        for a, b in matchups
+    ]
+    engine.start()
+
+    # -- (a) stdlib-plane baseline: urlopen per request ------------------
+    srv = ServeServer(engine)
+    base_n = min(args.http_queries, len(paths))
+    done = [0] * 8
+    def _urlopen_worker(w):
+        for p in paths[w:base_n:8]:
+            with urllib.request.urlopen(srv.url + p, timeout=60) as resp:
+                resp.read()
+            done[w] += 1
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=_urlopen_worker, args=(w,), daemon=True)
+        for w in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    t_base = time.perf_counter() - t0
+    srv.close()
+    http_qps = sum(done) / t_base if t_base > 0 else 0.0
+
+    # -- (b) the front door under concurrent publish ---------------------
+    door = FrontDoor(engine, readers=args.frontdoor_readers)
+    # Warm the publisher's 1024-row ingest shape so the measured window
+    # prices steady republishes, not the one-time compile.
+    publisher.publish_rows(ids[:1024], table[:1024])
+    retraces_before = reg.counter("jax.retraces_total").value
+    stop = threading.Event()
+    publishes = [0]
+    def _publisher():
+        while not stop.wait(0.005):
+            publisher.publish_rows(ids[:1024], table[:1024])
+            publishes[0] += 1
+    pub_thread = threading.Thread(target=_publisher, daemon=True)
+    pub_thread.start()
+    lats: list[list] = [[] for _ in range(args.frontdoor_connections)]
+    errs: list[list] = [[] for _ in range(args.frontdoor_connections)]
+    shards = [
+        paths[c::args.frontdoor_connections]
+        for c in range(args.frontdoor_connections)
+    ]
+    clients = [
+        threading.Thread(
+            target=_frontdoor_client,
+            args=(door.port, shards[c], args.pipeline_depth,
+                  lats[c], errs[c]),
+            daemon=True,
+        )
+        for c in range(args.frontdoor_connections)
+    ]
+    t0 = time.perf_counter()
+    for t in clients:
+        t.start()
+    for t in clients:
+        t.join()
+    t_front = time.perf_counter() - t0
+    stop.set()
+    pub_thread.join(timeout=5)
+    steady = reg.counter("jax.retraces_total").value - retraces_before
+    stats = door.codec_stats()
+    door.close()
+    engine.close()
+    flat = [x * 1e3 for part in lats for x in part]
+    n_err = sum(len(e) for e in errs)
+    qps = len(flat) / t_front if t_front > 0 else 0.0
+    return {
+        "native": stats["native"],
+        "encodes": stats["encodes"],
+        "fallbacks": stats["fallbacks"],
+        "queries_per_sec": round(qps, 1),
+        "p50_ms_under_publish": round(quantile(flat, 0.50), 3),
+        "p99_ms_under_publish": round(quantile(flat, 0.99), 3),
+        "http_baseline_queries_per_sec": round(http_qps, 1),
+        "speedup_vs_http": round(qps / http_qps, 2) if http_qps else None,
+        "connections": args.frontdoor_connections,
+        "pipeline_depth": args.pipeline_depth,
+        "readers": args.frontdoor_readers,
+        "queries": len(flat),
+        "errors": n_err,
+        "publishes": publishes[0],
+        "steady_retraces": steady,
+        "stable": bool(steady == 0 and n_err == 0),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--players", type=int, default=100_000)
@@ -203,6 +369,18 @@ def main() -> int:
         help="sharded-plane phase width (0 skips the phase — the "
         "benchdiff gate will flag the vanished block)",
     )
+    ap.add_argument(
+        "--frontdoor", action="store_true",
+        help="measure the concurrent socket plane (serve/frontdoor.py) "
+        "vs the stdlib HTTP path, under concurrent publishes — emits "
+        "the `frontdoor` block benchdiff gates on",
+    )
+    ap.add_argument("--frontdoor-queries", type=int, default=20_000)
+    ap.add_argument("--frontdoor-connections", type=int, default=32)
+    ap.add_argument("--pipeline-depth", type=int, default=8)
+    ap.add_argument("--frontdoor-readers", type=int, default=4)
+    ap.add_argument("--http-queries", type=int, default=1_000,
+                    help="stdlib-plane baseline queries (urlopen each)")
     ap.add_argument("--out", help="also write the artifact to this path")
     args = ap.parse_args()
 
@@ -260,6 +438,13 @@ def main() -> int:
             args, table, ids, cfg, reg, t_batched, single_lb_ms, engine
         )
 
+    # -- front door: socket plane under concurrent publishes -------------
+    frontdoor = None
+    if args.frontdoor:
+        frontdoor = frontdoor_phase(
+            args, publisher, ids, table, cfg, reg, engine
+        )
+
     steady_retraces = retraces_after - retraces_before
     speedup = qps / naive_qps if naive_qps > 0 else None
     line = {
@@ -281,6 +466,7 @@ def main() -> int:
             "mean": occ["mean"], "p50": occ["p50"], "p99": occ["p99"],
         },
         "sharded": sharded,
+        "frontdoor": frontdoor,
         "phases": {
             "build_s": round(t_build, 3),
             "warmup_s": round(t_warm, 3),

@@ -358,6 +358,22 @@ class TestThreeHostFabric:
                 oracle2.win_probability(a, b)
             )
 
+            # Every routed read above rode ONE pooled keep-alive
+            # connection per host — the pool was exercised, not
+            # silently bypassed by a per-request handshake.
+            pools = [
+                router.client_of(h).pool for h in range(N_HOSTS)
+            ]
+            assert all(p.requests > 1 for p in pools), [
+                (p.requests, p.reuse_count) for p in pools
+            ]
+            assert sum(p.reuse_count for p in pools) > 0
+            from analyzer_tpu.obs import get_registry
+
+            assert get_registry().counter(
+                "frontdoor.pool_reuse_total"
+            ).value == sum(p.reuse_count for p in pools)
+
             # -- fleet SLOs green, then a burn attributed to host 1 ----
             collector.scrape(10.0)
             assert not collector.burning, collector.burning

@@ -1,0 +1,571 @@
+"""Front door: the concurrent socket plane (ISSUE 20).
+
+Acceptance contract: every byte the front door serves equals the stdlib
+``ServeServer``'s — same routes, same error mapping, same
+``json.dumps(obj, sort_keys=True)`` bytes — while the native codec
+(``serve/fastjson``) renders the hot paths and every surprise routes to
+the COUNTED python fallback. Pipelined clients never see a torn or
+reordered response, per-connection view versions are monotone under a
+concurrent publisher, malformed requests answer 400-family statuses
+without killing the reader loop, the shared httpd plumbing keeps a
+socket alive across requests (HTTP/1.1), follower replicas serve the
+leader's bytes within a bounded staleness, and the HTTP-mode soak's
+deterministic block is bit-identical to the in-process run.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from analyzer_tpu.config import RatingConfig
+from analyzer_tpu.obs import get_registry, reset_registry
+from analyzer_tpu.serve import QueryEngine, ViewPublisher
+from analyzer_tpu.serve import fastjson
+from analyzer_tpu.serve.fastjson import ResponseCodec
+from analyzer_tpu.serve.frontdoor import (
+    MAX_REQUEST_BYTES,
+    FollowerGroup,
+    FrontDoor,
+)
+from analyzer_tpu.serve.server import ServeServer
+from tests.test_serve import http_get, rated_table
+
+CFG = RatingConfig()
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    reset_registry()
+    yield
+    reset_registry()
+
+
+def make_plane(n_players=60, n_rated=45, seed=0, **door_kw):
+    pub = ViewPublisher()
+    ids = [f"p{i}" for i in range(n_players)]
+    pub.publish_rows(ids, rated_table(n_players, n_rated, seed))
+    engine = QueryEngine(pub, cfg=CFG).start()
+    door = FrontDoor(engine, **door_kw)
+    return pub, ids, engine, door
+
+
+def read_response(sock, buf: bytearray):
+    """(status, headers, body) for one Content-Length-framed response."""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("closed mid-head")
+        buf += chunk
+    end = buf.index(b"\r\n\r\n")
+    head = bytes(buf[:end])
+    del buf[: end + 4]
+    lines = head.split(b"\r\n")
+    status = int(lines[0].split(None, 2)[1])
+    headers = {}
+    for line in lines[1:]:
+        name, _, value = line.partition(b":")
+        headers[name.strip().lower()] = value.strip()
+    clen = int(headers.get(b"content-length", b"0"))
+    while len(buf) < clen:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("closed mid-body")
+        buf += chunk
+    body = bytes(buf[:clen])
+    del buf[:clen]
+    return status, headers, body
+
+
+def get_raw(port, target, sock=None, buf=None):
+    """One GET over a (possibly reused) raw socket."""
+    own = sock is None
+    if own:
+        sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        buf = bytearray()
+    try:
+        sock.sendall(f"GET {target} HTTP/1.1\r\nHost: t\r\n\r\n".encode())
+        return read_response(sock, buf)
+    finally:
+        if own:
+            sock.close()
+
+
+# ---------------------------------------------------------------------------
+# The shared httpd plumbing: HTTP/1.1 keep-alive (satellite of ISSUE 20).
+
+
+class TestHttpdKeepAlive:
+    def test_two_requests_one_socket(self):
+        pub, ids, engine, door = make_plane()
+        door.close()
+        srv = ServeServer(engine)
+        try:
+            sock = socket.create_connection(
+                ("127.0.0.1", srv.port), timeout=10
+            )
+            buf = bytearray()
+            try:
+                s1, h1, b1 = get_raw(srv.port, "/healthz", sock, buf)
+                # Same socket, second request: HTTP/1.0 would have closed.
+                s2, h2, b2 = get_raw(
+                    srv.port, "/v1/leaderboard?k=3", sock, buf
+                )
+            finally:
+                sock.close()
+            assert (s1, b1) == (200, b"ok\n")
+            assert s2 == 200
+            assert len(json.loads(b2)["leaders"]) == 3
+        finally:
+            srv.close()
+            engine.close()
+
+
+# ---------------------------------------------------------------------------
+# Byte-for-byte parity with the stdlib plane.
+
+PARITY_TARGETS = [
+    "/healthz",
+    "/v1/ratings?ids=p0,p1,p2,p44",
+    "/v1/ratings?ids=p50,ghost,p0",        # unrated + unknown mix
+    "/v1/leaderboard",                     # default k
+    "/v1/leaderboard?k=7",
+    "/v1/leaderboard?k=0",                 # 400: out of range
+    "/v1/leaderboard?k=zebra",             # 400: not an integer
+    "/v1/winprob?a=p0,p1&b=p2,p3",
+    "/v1/winprob?a=p0&b=ghost",            # 404: unknown player
+    "/v1/tiers",
+    "/v1/tiers?score=1500.5",
+    "/v1/tiers?score=tall",                # 400: not a number
+    "/v1/ratings?ids=",                    # 400: empty ids
+    "/nope",                               # 404: unrouted
+]
+
+
+class TestServeParity:
+    def test_byte_for_byte_with_stdlib_plane(self):
+        import http.client
+
+        pub, ids, engine, door = make_plane()
+        srv = ServeServer(engine)
+        try:
+            ref = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                             timeout=10)
+            for target in PARITY_TARGETS:
+                ref.request("GET", target)
+                resp = ref.getresponse()
+                want_status, want_body = resp.status, resp.read()
+                got_status, _, got_body = get_raw(door.port, target)
+                assert got_status == want_status, target
+                assert got_body == want_body, target
+            ref.close()
+            stats = door.codec_stats()
+            if fastjson.NATIVE:
+                assert stats["native"] and stats["fallbacks"] == 0
+        finally:
+            srv.close()
+            door.close()
+            engine.close()
+
+    def test_pipelined_responses_in_request_order(self):
+        pub, ids, engine, door = make_plane()
+        try:
+            targets = [
+                "/v1/leaderboard?k=1",
+                "/v1/ratings?ids=p5",
+                "/healthz",
+                "/v1/tiers",
+            ] * 5
+            sock = socket.create_connection(
+                ("127.0.0.1", door.port), timeout=10
+            )
+            buf = bytearray()
+            try:
+                sock.sendall(b"".join(
+                    f"GET {t} HTTP/1.1\r\nHost: t\r\n\r\n".encode()
+                    for t in targets
+                ))
+                for target in targets:
+                    status, _, body = read_response(sock, buf)
+                    assert status == 200
+                    if target == "/healthz":
+                        assert body == b"ok\n"
+                    elif "leaderboard" in target:
+                        assert len(json.loads(body)["leaders"]) == 1
+                    elif "ratings" in target:
+                        assert json.loads(body)["ratings"][0]["id"] == "p5"
+                    else:
+                        assert "edges" in json.loads(body)
+            finally:
+                sock.close()
+        finally:
+            door.close()
+            engine.close()
+
+
+# ---------------------------------------------------------------------------
+# Malformed requests: 400-family, never a crash, reader loop survives.
+
+MALFORMED = [
+    (b"GARBAGE\r\n\r\n", 400),                           # no method/target
+    (b"GET /healthz HTTP/2.0\r\nHost: t\r\n\r\n", 400),  # bad version
+    (b"GET /healthz\r\n\r\n", 400),                      # no version
+    (b"POST /v1/ratings?ids=p0 HTTP/1.1\r\nHost: t\r\n\r\n", 405),
+    (b"DELETE /healthz HTTP/1.1\r\n\r\n", 405),
+    (b"GET /v1/ratings?ids=p0 HTTP/1.1\r\nContent-Length: 5\r\n\r\nhello",
+     400),                                               # body rejected
+    (b"GET /v1/ratings?ids=p0 HTTP/1.1\r\nTransfer-Encoding: chunked"
+     b"\r\n\r\n", 400),
+    (b"GET /" + b"x" * MAX_REQUEST_BYTES + b" HTTP/1.1\r\n\r\n", 431),
+]
+
+
+class TestMalformed:
+    def test_malformed_table_then_still_serving(self):
+        pub, ids, engine, door = make_plane()
+        try:
+            for payload, want in MALFORMED:
+                sock = socket.create_connection(
+                    ("127.0.0.1", door.port), timeout=10
+                )
+                try:
+                    sock.sendall(payload)
+                    status, _, body = read_response(sock, bytearray())
+                    assert status == want, payload[:40]
+                    if status != 431:
+                        assert b"error" in body, payload[:40]
+                finally:
+                    sock.close()
+                # The loop survived: a fresh connection still serves.
+                status, _, body = get_raw(door.port, "/healthz")
+                assert (status, body) == (200, b"ok\n"), payload[:40]
+        finally:
+            door.close()
+            engine.close()
+
+    def test_half_open_and_midstream_close_survive(self):
+        pub, ids, engine, door = make_plane()
+        try:
+            # Partial request then hard close, mid-head and mid-target.
+            for fragment in (b"GET /v1/rat", b"GET /healthz HTTP/1.1\r\nHo"):
+                sock = socket.create_connection(
+                    ("127.0.0.1", door.port), timeout=10
+                )
+                sock.sendall(fragment)
+                sock.close()
+            status, _, body = get_raw(door.port, "/healthz")
+            assert (status, body) == (200, b"ok\n")
+        finally:
+            door.close()
+            engine.close()
+
+
+# ---------------------------------------------------------------------------
+# The torture: 64 pipelined sockets vs a publisher thread.
+
+
+class TestPipelinedTorture:
+    N_SOCKETS = 64
+    REQUESTS_PER_SOCKET = 24
+
+    def _client(self, port, worker, failures, versions):
+        targets = [
+            ("/v1/leaderboard?k=3", "leaderboard"),
+            (f"/v1/ratings?ids=p{worker % 60},p{(worker + 7) % 60}",
+             "ratings"),
+            (f"/v1/winprob?a=p{worker % 45}&b=p{(worker + 1) % 45}",
+             "winprob"),
+            ("/v1/tiers", "tiers"),
+        ]
+        seen = []
+        try:
+            sock = socket.create_connection(("127.0.0.1", port), timeout=30)
+            buf = bytearray()
+            try:
+                reqs = [
+                    targets[i % len(targets)]
+                    for i in range(self.REQUESTS_PER_SOCKET)
+                ]
+                # Two pipelined bursts per socket.
+                half = len(reqs) // 2
+                for burst in (reqs[:half], reqs[half:]):
+                    sock.sendall(b"".join(
+                        f"GET {t} HTTP/1.1\r\nHost: t\r\n\r\n".encode()
+                        for t, _ in burst
+                    ))
+                    for target, kind in burst:
+                        status, _, body = read_response(sock, buf)
+                        if status != 200:
+                            failures.append((worker, target, status))
+                            continue
+                        obj = json.loads(body)  # torn bytes would raise
+                        if kind == "leaderboard" and len(obj["leaders"]) != 3:
+                            failures.append((worker, target, "short board"))
+                        if kind == "winprob" and not (
+                            0.0 <= obj["p_a"] <= 1.0
+                        ):
+                            failures.append((worker, target, obj["p_a"]))
+                        seen.append(obj["version"])
+            finally:
+                sock.close()
+        except Exception as err:  # noqa: BLE001 — report, don't hang join
+            failures.append((worker, "transport", repr(err)))
+        versions[worker] = seen
+
+    def test_no_torn_responses_and_monotone_versions(self):
+        pub, ids, engine, door = make_plane(readers=4)
+        stop = threading.Event()
+        published = []
+
+        def publisher():
+            seed = 1
+            while not stop.is_set():
+                pub.publish_rows(ids, rated_table(60, 45, seed))
+                published.append(pub.version)
+                seed += 1
+                time.sleep(0.002)
+
+        failures: list = []
+        versions: dict = {}
+        pub_thread = threading.Thread(target=publisher, daemon=True)
+        pub_thread.start()
+        try:
+            clients = [
+                threading.Thread(
+                    target=self._client,
+                    args=(door.port, w, failures, versions),
+                    daemon=True,
+                )
+                for w in range(self.N_SOCKETS)
+            ]
+            for t in clients:
+                t.start()
+            for t in clients:
+                t.join(timeout=60)
+                assert not t.is_alive(), "client hung"
+        finally:
+            stop.set()
+            pub_thread.join(timeout=10)
+            stats = door.codec_stats()
+            door.close()
+            engine.close()
+        assert failures == []
+        assert len(published) >= 2, "publisher barely ran"
+        total = sum(len(v) for v in versions.values())
+        assert total == self.N_SOCKETS * self.REQUESTS_PER_SOCKET
+        for worker, seen in versions.items():
+            assert seen == sorted(seen), f"non-monotone on {worker}: {seen}"
+        # Connections spanned publishes: someone saw a version advance.
+        assert any(len(set(v)) > 1 for v in versions.values())
+        if fastjson.NATIVE:
+            assert stats["native"] and stats["fallbacks"] == 0
+        reg = get_registry()
+        assert reg.counter("frontdoor.requests_total").value >= total
+        assert reg.counter("frontdoor.encode_bytes_total").value > 0
+
+
+# ---------------------------------------------------------------------------
+# Codec: differential parity against the json.dumps oracle.
+
+
+def oracle_bytes(obj) -> bytes:
+    return (json.dumps(obj, sort_keys=True) + "\n").encode("utf-8")
+
+
+RATINGS_SHAPES = [
+    {"version": 1, "ratings": [], "unknown": []},
+    {"version": 7, "unknown": ["ghost", "zéro"], "ratings": [
+        {"id": "p0", "rated": True, "mu": 1500.25, "sigma": 71.5,
+         "conservative": 1285.75, "seed_mu": 1500.0, "seed_sigma": 400.0},
+        {"id": "p☃", "rated": False, "mu": None, "sigma": None,
+         "conservative": None, "seed_mu": 1437.5, "seed_sigma": 350.0},
+    ]},
+]
+
+ADVERSARIAL_SHAPES = [
+    ("ratings", {"version": 1, "ratings": {"a": 1}, "unknown": []}),
+    ("ratings", {"version": 1, "ratings": [
+        {"id": "p0", "rated": True, "mu": 1, "sigma": 2.0,   # int mu
+         "conservative": 3.0, "seed_mu": 4.0, "seed_sigma": 5.0}],
+        "unknown": []}),
+    ("ratings", {"version": 1, "ratings": [
+        {"id": "p0", "rated": 1, "mu": 1.0, "sigma": 2.0,    # int rated
+         "conservative": 3.0, "seed_mu": 4.0, "seed_sigma": 5.0}],
+        "unknown": []}),
+    ("ratings", {"version": True, "ratings": [], "unknown": []}),
+    ("ratings", {"version": 1, "ratings": [], "unknown": [3]}),
+    ("ratings", {"version": 1, "ratings": [{"id": "p0"}], "unknown": []}),
+    ("ratings", {"version": 1, "ratings": [
+        {"id": "p0", "rated": True, "mu": 1.0, "sigma": 2.0,
+         "conservative": 3.0, "seed_mu": 4.0, "seed_sigma": 5.0,
+         "extra": 1}], "unknown": []}),
+    ("leaderboard", {"version": 1, "leaders": [
+        {"rank": 1.0, "id": "p0", "mu": 1.0, "sigma": 2.0,   # float rank
+         "conservative": 3.0}]}),
+    ("leaderboard", {"version": 1, "leaders": None}),
+    ("winprob", {"version": 1, "p_a": "0.5", "quality": 1.0}),
+    ("winprob", {"version": 1, "p_a": 0.5}),
+    ("tiers", {"version": 1, "edges": [1.0], "counts": (0,), "rated": 0}),
+    ("tiers", {"version": 1, "edges": [1.0], "counts": [0], "rated": 0,
+               "score": 1.0}),                     # partial percentile keys
+]
+
+
+class TestCodecDifferential:
+    def test_response_shapes_byte_identical(self):
+        codec = ResponseCodec()
+        cases = [("ratings", s) for s in RATINGS_SHAPES]
+        cases += [
+            ("leaderboard", {"version": 3, "leaders": [
+                {"rank": 1, "id": "p9", "mu": 1712.0, "sigma": 50.5,
+                 "conservative": 1560.5},
+                {"rank": 2, "id": "pü", "mu": -0.125, "sigma": 1e-3,
+                 "conservative": 12345678.90625},
+            ]}),
+            ("leaderboard", {"version": 3, "leaders": []}),
+            ("winprob", {"version": 2, "p_a": 0.7310585786300049,
+                         "quality": 0.9999999999999999}),
+            ("tiers", {"version": 4, "edges": [1000.0, 1500.0],
+                       "counts": [10, 5, 1], "rated": 16}),
+            ("tiers", {"version": 4, "edges": [1000.0, 1500.0],
+                       "counts": [10, 5, 1], "rated": 16,
+                       "score": 1234.5, "below": 9, "percentile": 56.25}),
+            ("tiers", {"version": 4, "edges": [], "counts": [0], "rated": 0,
+                       "score": 1.5, "below": 0, "percentile": None}),
+        ]
+        for kind, obj in cases:
+            assert codec.encode(kind, obj) == oracle_bytes(obj), (kind, obj)
+        if fastjson.NATIVE:
+            assert codec.fallbacks == 0
+
+    def test_float_repr_sweep(self):
+        import numpy as np
+
+        rng = np.random.default_rng(20)
+        vals = [float(x) for x in rng.normal(0, 1e4, 400)]
+        vals += [float(x) for x in rng.uniform(-1, 1, 400)]
+        vals += [0.0, -0.0, 1e-308, 1.7976931348623157e308, 0.1, 2.0 / 3.0]
+        codec = ResponseCodec()
+        for i in range(0, len(vals), 8):
+            chunk = vals[i:i + 8]
+            obj = {"version": 1, "p_a": chunk[0],
+                   "quality": sum(chunk) or 0.5}
+            assert codec.encode("winprob", obj) == oracle_bytes(obj)
+            obj = {"version": 2, "edges": chunk, "counts": [1] * 9,
+                   "rated": 9}
+            assert codec.encode("tiers", obj) == oracle_bytes(obj)
+        if fastjson.NATIVE:
+            assert codec.fallbacks == 0
+
+    def test_adversarial_shapes_fall_back_byte_identical(self):
+        codec = ResponseCodec()
+        for kind, obj in ADVERSARIAL_SHAPES:
+            assert codec.encode(kind, obj) == oracle_bytes(obj), (kind, obj)
+        if fastjson.NATIVE:
+            # Every one routed to the counted fallback.
+            assert codec.fallbacks == len(ADVERSARIAL_SHAPES)
+            assert get_registry().counter(
+                "frontdoor.codec_fallbacks_total"
+            ).value == len(ADVERSARIAL_SHAPES)
+
+    def test_non_finite_raises_not_emits(self):
+        codec = ResponseCodec()
+        for bad in (float("nan"), float("inf"), float("-inf")):
+            with pytest.raises(ValueError):
+                codec.encode(
+                    "winprob", {"version": 1, "p_a": bad, "quality": 1.0}
+                )
+
+    @pytest.mark.skipif(not fastjson.NATIVE, reason="native codec absent")
+    def test_native_repr_double_matches_cpython(self):
+        import numpy as np
+
+        from analyzer_tpu.serve._native_json import repr_double
+
+        rng = np.random.default_rng(21)
+        vals = [float(x) for x in rng.normal(0, 1, 500)]
+        vals += [float(x) for x in 10.0 ** rng.uniform(-300, 300, 500)]
+        for v in vals:
+            assert repr_double(v).decode() == repr(v), v
+
+
+# ---------------------------------------------------------------------------
+# Follower read replicas.
+
+
+class TestFollowerGroup:
+    def test_replicas_serve_leader_bytes_within_staleness(self):
+        pub, ids, engine, door = make_plane()
+        door.close()
+        group = FollowerGroup(
+            pub, cfg=CFG, n_followers=3, refresh_interval_s=0.003,
+        )
+        group.start()
+        try:
+            assert len(group.urls) == 3
+            group.refresh()
+            assert group.versions == [pub.version] * 3
+            # Same bytes from every replica, equal to the leader plane.
+            targets = ["/v1/leaderboard?k=5", "/v1/ratings?ids=p0,p50",
+                       "/v1/winprob?a=p0&b=p1", "/v1/tiers?score=1500.0"]
+            for target in targets:
+                bodies = {
+                    get_raw(d.port, target)[2] for d in group.doors
+                }
+                assert len(bodies) == 1, target
+            # Publish: the refresher thread adopts within the bound.
+            pub.publish_rows(ids, rated_table(60, 45, 9))
+            deadline = time.monotonic() + 5.0
+            while group.versions != [pub.version] * 3:
+                assert time.monotonic() < deadline, group.versions
+                time.sleep(0.005)
+        finally:
+            group.close()
+            engine.close()
+
+    def test_follower_bytes_equal_leader_bytes(self):
+        pub, ids, engine, door = make_plane()
+        group = FollowerGroup(pub, cfg=CFG, n_followers=2)
+        group.start()
+        try:
+            group.refresh()
+            for target in ["/v1/leaderboard?k=8", "/v1/tiers",
+                           "/v1/ratings?ids=p3,p44,p59"]:
+                _, _, leader = get_raw(door.port, target)
+                for d in group.doors:
+                    assert get_raw(d.port, target)[2] == leader, target
+        finally:
+            group.close()
+            door.close()
+            engine.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP-mode soak: deterministic block bit-identical to in-process.
+
+
+class TestSoakBitIdentity:
+    @pytest.mark.slow
+    def test_serve_http_block_matches_in_process(self):
+        from analyzer_tpu.loadgen.driver import SoakConfig, SoakDriver
+
+        base = dict(
+            seed=5, duration_s=2.0, tick_s=1.0, qps=8.0, query_qps=5.0,
+            n_players=80, batch_size=32, polls_per_tick=4,
+        )
+        blocks = []
+        for serve_http in (False, True):
+            reset_registry()
+            driver = SoakDriver(SoakConfig(**base, serve_http=serve_http))
+            try:
+                art = driver.run()
+            finally:
+                driver.close()
+            if serve_http:
+                assert art["frontdoor"]["encodes"] > 0
+                if fastjson.NATIVE:
+                    assert art["frontdoor"]["native"]
+            blocks.append(json.dumps(art["deterministic"], sort_keys=True))
+        assert blocks[0] == blocks[1]

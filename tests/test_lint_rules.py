@@ -2595,3 +2595,92 @@ class TestGL048Fabric:
         from analyzer_tpu.lint.findings import RULES
 
         assert "GL048" in RULES
+
+
+class TestGL049FrontDoor:
+    """GL049 guards the serve front door: responses render through the
+    native codec (serve/fastjson.py) whose python fallback is COUNTED —
+    a stray json.dumps in serve/ dodges the vanished-native benchdiff
+    gate (json half) — and the front door's event loop paces on
+    selector readiness, never a wall clock, so the HTTP-mode soak block
+    stays bit-identical to the in-process one (clock half)."""
+
+    DUMPS_SRC = """
+    import json
+
+    def render(obj):
+        return (json.dumps(obj, sort_keys=True) + "\\n").encode()
+    """
+
+    CLOCK_SRC = """
+    import time
+
+    def pump(conns):
+        return time.monotonic()
+    """
+
+    def test_dumps_fires_in_serve_hot_paths(self):
+        for path in (
+            "analyzer_tpu/serve/server.py",
+            "analyzer_tpu/serve/frontdoor.py",
+            "analyzer_tpu/serve/engine.py",
+        ):
+            assert "GL049" in rules_of(self.DUMPS_SRC, path), path
+
+    def test_dumps_sanctioned_in_codec_home_tests_and_elsewhere(self):
+        for path in (
+            "analyzer_tpu/serve/fastjson.py",  # the oracle + fallback
+            "tests/test_frontdoor.py",
+            "analyzer_tpu/obs/httpd.py",       # outside the serve layer
+        ):
+            assert "GL049" not in rules_of(self.DUMPS_SRC, path), path
+
+    def test_dumps_sanctioned_in_designated_error_helper(self):
+        src = """
+        import json
+
+        def _error_body(message):
+            return (json.dumps({"error": message}) + "\\n").encode()
+
+        def render(obj):
+            return json.dumps(obj)
+        """
+        # Only the call OUTSIDE the helper's span flags.
+        findings = [
+            f for f in lint_source(
+                textwrap.dedent(src), "analyzer_tpu/serve/frontdoor.py"
+            )
+            if f.rule == "GL049"
+        ]
+        assert [f.line for f in findings] == [8]
+
+    def test_wall_clock_fires_only_in_frontdoor(self):
+        assert "GL049" in rules_of(
+            self.CLOCK_SRC, "analyzer_tpu/serve/frontdoor.py"
+        )
+        for path in (
+            "analyzer_tpu/serve/server.py",   # stdlib plane may block
+            "analyzer_tpu/serve/engine.py",   # owns tick timing
+            "analyzer_tpu/obs/httpd.py",
+        ):
+            assert "GL049" not in rules_of(self.CLOCK_SRC, path), path
+
+    def test_shipping_serve_modules_are_gl049_clean(self):
+        serve_dir = os.path.join(_REPO, "analyzer_tpu", "serve")
+        mods = sorted(
+            m for m in os.listdir(serve_dir) if m.endswith(".py")
+        )
+        assert "frontdoor.py" in mods, serve_dir
+        for mod in mods:
+            rel = f"analyzer_tpu/serve/{mod}"
+            with open(os.path.join(_REPO, rel), encoding="utf-8") as f:
+                found = [r for r in rules_of(f.read(), rel) if r == "GL049"]
+            assert found == [], rel
+
+    def test_catalog_and_docs_have_gl049(self):
+        from analyzer_tpu.lint.findings import RULES
+
+        assert "GL049" in RULES
+        with open(os.path.join(_REPO, "docs", "lint.md"),
+                  encoding="utf-8") as f:
+            assert "| GL049 |" in f.read()
